@@ -1,0 +1,26 @@
+"""geomesa_tpu: a TPU-native spatio-temporal indexing and analytics framework.
+
+A ground-up re-design of the capabilities of GeoMesa (reference:
+/root/reference, v2.4.0-SNAPSHOT) for TPU hardware: space-filling-curve
+indexing of point/line/polygon + time data, cost-based query planning with
+z-range decomposition, pushed-down candidate filtering, and distributed
+aggregation — expressed as JAX/XLA array programs over HBM-resident
+structure-of-arrays columns, sharded across device meshes.
+
+Where the reference keeps rows in distributed sorted KV stores and runs
+filters in server-side iterators (Accumulo iterators / HBase coprocessors),
+this framework keeps sorted SoA columns in HBM, vmaps curve encoding and
+predicate masks over millions of features per chip, and reduces aggregates
+over ICI with `jax.lax.psum`.
+
+The library requires 64-bit integer support (z-values are 62/63-bit morton
+codes, matching the reference's key layout, e.g.
+geomesa-z3/.../curve/Z3SFC.scala:21 — 21 bits/dim × 3 dims); x64 mode is
+enabled at import.
+"""
+
+from jax import config as _jax_config
+
+_jax_config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,135 @@
+"""Morton (z-order) bit interleaving — the bit algebra under every z index.
+
+The reference outsources this to the external ``sfcurve-zorder`` library
+(``org.locationtech.sfcurve.zorder.{Z2, Z3}``; dependency declared at
+geomesa-z3/pom.xml:16-17, call sites geomesa-z3/.../curve/Z2SFC.scala:52 and
+Z3SFC.scala:61).  Here it is implemented directly with magic-bit shuffles so
+the same code runs vectorized on device (jax.numpy, under jit/vmap) and on
+host (numpy) for planning and oracles.
+
+Bit convention (matches sfcurve, verified against the reference's
+geomesa-z3/src/test/.../Z2Test.scala "split" expectations):
+
+* 2-D: ``z = split2(x) | split2(y) << 1`` — x occupies even bits, 31 bits
+  per dimension → 62-bit z.
+* 3-D: ``z = split3(x) | split3(y) << 1 | split3(t) << 2`` — x occupies bits
+  0, 3, 6, …; 21 bits per dimension → 63-bit z.
+
+All functions take/return unsigned-64 arrays (or int64, converted), and are
+pure elementwise ops: they vectorize trivially under ``vmap`` and fuse into
+surrounding XLA programs.  ``xp`` selects the array namespace (jax.numpy on
+device, numpy on host) — the arithmetic is identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "split2", "combine2", "interleave2", "deinterleave2",
+    "split3", "combine3", "interleave3", "deinterleave3",
+    "MAX_2D_BITS", "MAX_3D_BITS",
+]
+
+# 31 bits/dim for 2-D (Z2SFC default, curve/Z2SFC.scala:15);
+# 21 bits/dim for 3-D (Z3SFC default, curve/Z3SFC.scala:21).
+MAX_2D_BITS = 31
+MAX_3D_BITS = 21
+
+
+def _u64(xp, value):
+    return xp.uint64(value)
+
+
+def split2(x, xp=jnp):
+    """Spread the low 32 bits of ``x`` onto even bit positions of a u64."""
+    x = xp.asarray(x).astype(xp.uint64) & _u64(xp, 0x00000000FFFFFFFF)
+    x = (x ^ (x << _u64(xp, 16))) & _u64(xp, 0x0000FFFF0000FFFF)
+    x = (x ^ (x << _u64(xp, 8))) & _u64(xp, 0x00FF00FF00FF00FF)
+    x = (x ^ (x << _u64(xp, 4))) & _u64(xp, 0x0F0F0F0F0F0F0F0F)
+    x = (x ^ (x << _u64(xp, 2))) & _u64(xp, 0x3333333333333333)
+    x = (x ^ (x << _u64(xp, 1))) & _u64(xp, 0x5555555555555555)
+    return x
+
+
+def combine2(z, xp=jnp):
+    """Gather even bits of ``z`` back into a contiguous low-32-bit value."""
+    x = xp.asarray(z).astype(xp.uint64) & _u64(xp, 0x5555555555555555)
+    x = (x ^ (x >> _u64(xp, 1))) & _u64(xp, 0x3333333333333333)
+    x = (x ^ (x >> _u64(xp, 2))) & _u64(xp, 0x0F0F0F0F0F0F0F0F)
+    x = (x ^ (x >> _u64(xp, 4))) & _u64(xp, 0x00FF00FF00FF00FF)
+    x = (x ^ (x >> _u64(xp, 8))) & _u64(xp, 0x0000FFFF0000FFFF)
+    x = (x ^ (x >> _u64(xp, 16))) & _u64(xp, 0x00000000FFFFFFFF)
+    return x
+
+
+def interleave2(x, y, xp=jnp):
+    """Morton-interleave two dimension indices: x → even bits, y → odd."""
+    return split2(x, xp) | (split2(y, xp) << _u64(xp, 1))
+
+
+def deinterleave2(z, xp=jnp):
+    """Inverse of :func:`interleave2`; returns ``(x, y)`` as uint64."""
+    z = xp.asarray(z).astype(xp.uint64)
+    return combine2(z, xp), combine2(z >> _u64(xp, 1), xp)
+
+
+def split3(x, xp=jnp):
+    """Spread the low 21 bits of ``x`` to every third bit position."""
+    x = xp.asarray(x).astype(xp.uint64) & _u64(xp, 0x1FFFFF)
+    x = (x | (x << _u64(xp, 32))) & _u64(xp, 0x1F00000000FFFF)
+    x = (x | (x << _u64(xp, 16))) & _u64(xp, 0x1F0000FF0000FF)
+    x = (x | (x << _u64(xp, 8))) & _u64(xp, 0x100F00F00F00F00F)
+    x = (x | (x << _u64(xp, 4))) & _u64(xp, 0x10C30C30C30C30C3)
+    x = (x | (x << _u64(xp, 2))) & _u64(xp, 0x1249249249249249)
+    return x
+
+
+def combine3(z, xp=jnp):
+    """Gather every third bit of ``z`` into a contiguous low-21-bit value."""
+    x = xp.asarray(z).astype(xp.uint64) & _u64(xp, 0x1249249249249249)
+    x = (x ^ (x >> _u64(xp, 2))) & _u64(xp, 0x10C30C30C30C30C3)
+    x = (x ^ (x >> _u64(xp, 4))) & _u64(xp, 0x100F00F00F00F00F)
+    x = (x ^ (x >> _u64(xp, 8))) & _u64(xp, 0x1F0000FF0000FF)
+    x = (x ^ (x >> _u64(xp, 16))) & _u64(xp, 0x1F00000000FFFF)
+    x = (x ^ (x >> _u64(xp, 32))) & _u64(xp, 0x1FFFFF)
+    return x
+
+
+def interleave3(x, y, t, xp=jnp):
+    """Morton-interleave three dims: x → bits 0,3,…; y → 1,4,…; t → 2,5,…"""
+    return (
+        split3(x, xp)
+        | (split3(y, xp) << _u64(xp, 1))
+        | (split3(t, xp) << _u64(xp, 2))
+    )
+
+
+def deinterleave3(z, xp=jnp):
+    """Inverse of :func:`interleave3`; returns ``(x, y, t)`` as uint64."""
+    z = xp.asarray(z).astype(xp.uint64)
+    return (
+        combine3(z, xp),
+        combine3(z >> _u64(xp, 1), xp),
+        combine3(z >> _u64(xp, 2), xp),
+    )
+
+
+# Convenience host-side (numpy) wrappers, used by the planner's range
+# decomposition where device dispatch would be pure overhead.
+
+def interleave2_np(x, y):
+    return interleave2(np.asarray(x), np.asarray(y), xp=np)
+
+
+def deinterleave2_np(z):
+    return deinterleave2(np.asarray(z), xp=np)
+
+
+def interleave3_np(x, y, t):
+    return interleave3(np.asarray(x), np.asarray(y), np.asarray(t), xp=np)
+
+
+def deinterleave3_np(z):
+    return deinterleave3(np.asarray(z), xp=np)

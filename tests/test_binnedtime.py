@@ -1,0 +1,114 @@
+"""Time binning vs a python-datetime oracle (reference: BinnedTime.scala)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import (
+    TimePeriod,
+    bin_to_ms,
+    from_binned_time,
+    max_date_ms,
+    max_offset,
+    to_binned_time,
+)
+
+UTC = dt.timezone.utc
+EPOCH = dt.datetime(1970, 1, 1, tzinfo=UTC)
+
+
+def ms_of(*args):
+    return int(dt.datetime(*args, tzinfo=UTC).timestamp() * 1000)
+
+
+def oracle_bin(d: dt.datetime, period: TimePeriod):
+    if period is TimePeriod.DAY:
+        return (d - EPOCH).days
+    if period is TimePeriod.WEEK:
+        return (d - EPOCH).days // 7
+    if period is TimePeriod.MONTH:
+        return (d.year - 1970) * 12 + d.month - 1
+    return d.year - 1970
+
+
+def oracle_offset(ms: int, d: dt.datetime, period: TimePeriod):
+    sec = ms // 1000
+    if period is TimePeriod.DAY:
+        return ms - ms // 86_400_000 * 86_400_000
+    if period is TimePeriod.WEEK:
+        week_start = (d - EPOCH).days // 7 * 7 * 86_400
+        return sec - week_start
+    if period is TimePeriod.MONTH:
+        month_start = int(dt.datetime(d.year, d.month, 1, tzinfo=UTC).timestamp())
+        return sec - month_start
+    year_start = int(dt.datetime(d.year, 1, 1, tzinfo=UTC).timestamp())
+    return (sec - year_start) // 60
+
+
+def test_max_offsets():
+    # BinnedTime.scala maxOffset: day=ms/day, week=s/week, month=s in 31d,
+    # year=minutes in 52 weeks
+    assert max_offset(TimePeriod.DAY) == 86_400_000
+    assert max_offset(TimePeriod.WEEK) == 604_800
+    assert max_offset(TimePeriod.MONTH) == 2_678_400
+    assert max_offset(TimePeriod.YEAR) == 524_160
+
+
+def test_known_date():
+    # 2018-01-01T00:00:00Z
+    ms = ms_of(2018, 1, 1)
+    assert ms == 1514764800000
+    for period, expected_bin in [
+        (TimePeriod.DAY, 17532),
+        (TimePeriod.WEEK, 2504),
+        (TimePeriod.MONTH, 576),
+        (TimePeriod.YEAR, 48),
+    ]:
+        bins, offs = to_binned_time(ms, period)
+        assert int(bins) == expected_bin, period
+        d = dt.datetime.fromtimestamp(ms / 1000, UTC)
+        assert int(offs) == oracle_offset(ms, d, period), period
+
+
+@pytest.mark.parametrize("period", list(TimePeriod))
+def test_random_dates_vs_oracle(period, rng):
+    ms = rng.integers(0, ms_of(2059, 9, 1), size=300)
+    bins, offs = to_binned_time(ms, period)
+    for i in range(len(ms)):
+        d = dt.datetime.fromtimestamp(int(ms[i]) / 1000, UTC)
+        assert int(bins[i]) == oracle_bin(d, period), (period, d)
+        assert int(offs[i]) == oracle_offset(int(ms[i]), d, period), (period, d)
+
+
+@pytest.mark.parametrize("period", list(TimePeriod))
+def test_roundtrip(period, rng):
+    ms = rng.integers(0, ms_of(2059, 9, 1), size=200)
+    bins, offs = to_binned_time(ms, period)
+    back = from_binned_time(bins, offs, period)
+    # precision: day→ms exact; week/month→seconds; year→minutes
+    tol = {TimePeriod.DAY: 0, TimePeriod.WEEK: 999,
+           TimePeriod.MONTH: 999, TimePeriod.YEAR: 59_999}[period]
+    assert np.all(ms - back >= 0)
+    assert np.all(ms - back <= tol)
+
+
+def test_bounds_validation():
+    with pytest.raises(ValueError):
+        to_binned_time(-1, TimePeriod.WEEK)
+    with pytest.raises(ValueError):
+        to_binned_time(max_date_ms(TimePeriod.DAY), TimePeriod.DAY)
+    # max date is exclusive: one ms before it must work
+    to_binned_time(max_date_ms(TimePeriod.DAY) - 1, TimePeriod.DAY)
+
+
+def test_max_dates_match_reference_docs():
+    # exclusive bound = start of bin 32768; BinnedTime.scala docs quote
+    # 2059/09/18 (day, last indexable day) and 2598/01/04 (week, exclusive)
+    assert np.datetime64(max_date_ms(TimePeriod.DAY) - 1, "ms").astype("M8[D]") == np.datetime64("2059-09-18")
+    assert np.datetime64(max_date_ms(TimePeriod.WEEK), "ms").astype("M8[D]") == np.datetime64("2598-01-04")
+
+
+def test_bin_to_ms_month_year():
+    assert int(bin_to_ms(576, TimePeriod.MONTH)) == ms_of(2018, 1, 1)
+    assert int(bin_to_ms(48, TimePeriod.YEAR)) == ms_of(2018, 1, 1)

@@ -1,0 +1,59 @@
+"""Normalization semantics vs the reference's BitNormalizedDimension
+(geomesa-z3/.../curve/NormalizedDimension.scala:60-71): floor-based binning,
+>=max clamps to max_index, denormalize returns bin centers."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import normalized_lat, normalized_lon, normalized_time
+
+
+def oracle_normalize(x, lo, hi, precision):
+    if x >= hi:
+        return (1 << precision) - 1
+    return math.floor((x - lo) * ((1 << precision) / (hi - lo)))
+
+
+@pytest.mark.parametrize("precision", [8, 21, 31])
+def test_scalar_matches_oracle(precision, rng):
+    dim = normalized_lon(precision)
+    for x in list(rng.uniform(-180, 180, 200)) + [-180.0, 180.0, 179.999999999, 0.0]:
+        assert dim.normalize_scalar(x) == oracle_normalize(x, -180, 180, precision)
+
+
+def test_max_clamps():
+    lat = normalized_lat(21)
+    assert lat.normalize_scalar(90.0) == lat.max_index
+    assert lat.normalize_scalar(91.0) == lat.max_index
+    assert lat.normalize_scalar(-90.0) == 0
+
+
+def test_vectorized_matches_scalar(rng):
+    lon = normalized_lon(21)
+    xs = np.concatenate([rng.uniform(-180, 180, 500), [-180.0, 180.0, 179.9999999]])
+    vec_np = lon.normalize(xs, xp=np)
+    vec_jnp = np.asarray(lon.normalize(jnp.asarray(xs)))
+    scal = np.array([lon.normalize_scalar(float(x)) for x in xs])
+    np.testing.assert_array_equal(vec_np, scal)
+    np.testing.assert_array_equal(vec_jnp, scal)
+
+
+def test_denormalize_centers():
+    lon = normalized_lon(21)
+    for i in [0, 1, 12345, lon.max_index - 1]:
+        lo_edge = -180.0 + i * 360.0 / (1 << 21)
+        assert abs(lon.denormalize_scalar(i) - (lo_edge + 0.5 * 360.0 / (1 << 21))) < 1e-9
+    # max bin denormalizes to the center of the *last* bin even when asked
+    # beyond it (reference: denormalize of x >= maxIndex)
+    assert lon.denormalize_scalar(lon.max_index) == lon.denormalize_scalar(lon.max_index + 5)
+
+
+def test_roundtrip_within_bin(rng):
+    t = normalized_time(21, 604800.0)
+    xs = rng.uniform(0, 604800.0, 1000)
+    idx = t.normalize(xs, xp=np)
+    back = t.denormalize(idx, xp=np)
+    assert np.max(np.abs(back - xs)) <= 604800.0 / (1 << 21)

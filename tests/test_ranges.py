@@ -1,0 +1,102 @@
+"""Z-range decomposition vs brute-force oracles.
+
+Includes the reference's golden case (Z2Test.scala "calculate ranges"):
+box (2,2)-(3,6) in normalized space decomposes to exactly 3 merged ranges.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import zranges
+from geomesa_tpu.curve.zorder import interleave2, interleave3
+
+
+def z2(x, y):
+    return int(interleave2(np.int64(x), np.int64(y), xp=np))
+
+
+def covered_set(ranges):
+    out = set()
+    for lo, hi in ranges:
+        out.update(range(int(lo), int(hi) + 1))
+    return out
+
+
+def brute_set_2d(xmin, ymin, xmax, ymax):
+    out = set()
+    for x in range(xmin, xmax + 1):
+        for y in range(ymin, ymax + 1):
+            out.add(z2(x, y))
+    return out
+
+
+def test_golden_z2_case():
+    # reference Z2Test: ZRange(Z2(2,2), Z2(3,6)) -> 3 ranges
+    ranges = zranges([[2, 2]], [[3, 6]], dims=2, bits=31)
+    assert ranges.shape == (3, 2)
+    expected = [
+        (z2(2, 2), z2(3, 3)),
+        (z2(2, 4), z2(3, 5)),
+        (z2(2, 6), z2(3, 6)),
+    ]
+    got = [tuple(r) for r in ranges]
+    assert sorted(got) == sorted(expected)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_exact_cover_2d(seed):
+    rng = np.random.default_rng(seed)
+    bits = 8
+    for _ in range(5):
+        x = np.sort(rng.integers(0, 1 << bits, 2))
+        y = np.sort(rng.integers(0, 1 << bits, 2))
+        ranges = zranges([[x[0], y[0]]], [[x[1], y[1]]], dims=2, bits=bits,
+                         max_ranges=10**9)
+        assert covered_set(ranges) == brute_set_2d(x[0], y[0], x[1], y[1])
+
+
+def test_exact_cover_3d():
+    rng = np.random.default_rng(42)
+    bits = 5
+    for _ in range(4):
+        lo = rng.integers(0, 1 << bits, 3)
+        hi = np.minimum(lo + rng.integers(0, 8, 3), (1 << bits) - 1)
+        ranges = zranges([lo], [hi], dims=3, bits=bits, max_ranges=10**9)
+        brute = set()
+        for x in range(lo[0], hi[0] + 1):
+            for y in range(lo[1], hi[1] + 1):
+                for t in range(lo[2], hi[2] + 1):
+                    brute.add(int(interleave3(np.int64(x), np.int64(y), np.int64(t), xp=np)))
+        assert covered_set(ranges) == brute
+
+
+def test_multiple_boxes_merged():
+    bits = 8
+    r = zranges([[0, 0], [1, 0]], [[1, 1], [3, 3]], dims=2, bits=bits,
+                max_ranges=10**9)
+    want = brute_set_2d(0, 0, 1, 1) | brute_set_2d(1, 0, 3, 3)
+    assert covered_set(r) == want
+    # ranges must be disjoint and sorted
+    assert np.all(r[1:, 0] > r[:-1, 1] + 1 - 1)
+
+
+def test_budget_produces_superset():
+    bits = 10
+    box = ([[3, 5]], [[900, 700]])
+    exact = zranges(*box, dims=2, bits=bits, max_ranges=10**9)
+    budget = zranges(*box, dims=2, bits=bits, max_ranges=20)
+    assert len(budget) <= 20
+    assert len(budget) < len(exact)
+    assert covered_set(exact) <= covered_set(budget)
+
+
+def test_full_domain():
+    r = zranges([[0, 0]], [[(1 << 8) - 1, (1 << 8) - 1]], dims=2, bits=8)
+    assert r.shape == (1, 2)
+    assert r[0, 0] == 0 and r[0, 1] == (1 << 16) - 1
+
+
+def test_single_cell():
+    r = zranges([[37, 91]], [[37, 91]], dims=2, bits=8)
+    assert r.shape == (1, 2)
+    assert r[0, 0] == r[0, 1] == z2(37, 91)

@@ -1,0 +1,93 @@
+"""Z2/Z3 curve semantics: bounds, clamping, roundtrips, range correctness
+(reference: curve/Z2SFC.scala, Z3SFC.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.curve import TimePeriod, max_offset, z2_sfc, z3_sfc
+
+
+def test_z2_extremes():
+    sfc = z2_sfc()
+    assert int(sfc.index(-180.0, -90.0, xp=np)) == 0
+    # all 62 bits set at the max corner
+    assert int(sfc.index(180.0, 90.0, xp=np)) == (1 << 62) - 1
+
+
+def test_z3_extremes():
+    sfc = z3_sfc(TimePeriod.WEEK)
+    assert int(sfc.index(-180.0, -90.0, 0.0, xp=np)) == 0
+    assert int(sfc.index(180.0, 90.0, float(max_offset(TimePeriod.WEEK)), xp=np)) == (1 << 63) - 1
+
+
+def test_z2_lenient_clamp():
+    sfc = z2_sfc()
+    assert int(sfc.index(-181.0, -91.0, xp=np)) == int(sfc.index(-180.0, -90.0, xp=np))
+    assert int(sfc.index(181.0, 91.0, xp=np)) == int(sfc.index(180.0, 90.0, xp=np))
+
+
+def test_z2_invert_roundtrip(rng):
+    sfc = z2_sfc()
+    x = rng.uniform(-180, 180, 500)
+    y = rng.uniform(-90, 90, 500)
+    z = sfc.index(x, y, xp=np)
+    rx, ry = sfc.invert(z)
+    assert np.max(np.abs(rx - x)) <= 360.0 / (1 << 31)
+    assert np.max(np.abs(ry - y)) <= 180.0 / (1 << 31)
+
+
+def test_z3_invert_roundtrip(rng):
+    sfc = z3_sfc(TimePeriod.WEEK)
+    x = rng.uniform(-180, 180, 500)
+    y = rng.uniform(-90, 90, 500)
+    t = rng.uniform(0, max_offset(TimePeriod.WEEK), 500)
+    z = sfc.index(x, y, t, xp=np)
+    rx, ry, rt = sfc.invert(z)
+    assert np.max(np.abs(rx - x)) <= 360.0 / (1 << 21)
+    assert np.max(np.abs(ry - y)) <= 180.0 / (1 << 21)
+    assert np.max(np.abs(rt - t)) <= max_offset(TimePeriod.WEEK) / (1 << 21)
+
+
+def test_device_matches_host(rng):
+    sfc = z3_sfc(TimePeriod.WEEK)
+    x = rng.uniform(-180, 180, 1000)
+    y = rng.uniform(-90, 90, 1000)
+    t = rng.uniform(0, max_offset(TimePeriod.WEEK), 1000)
+    host = sfc.index(x, y, t, xp=np)
+    dev = np.asarray(jax.jit(lambda a, b, c: sfc.index(a, b, c))(x, y, t))
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_z2_ranges_contain_all_points(rng):
+    sfc = z2_sfc()
+    box = (-10.0, 35.0, 15.0, 52.0)
+    x = rng.uniform(box[0], box[2], 300)
+    y = rng.uniform(box[1], box[3], 300)
+    z = sfc.index(x, y, xp=np).astype(np.int64)
+    ranges = sfc.ranges([box])
+    in_any = np.zeros(len(z), dtype=bool)
+    for lo, hi in ranges:
+        in_any |= (z >= lo) & (z <= hi)
+    assert in_any.all()
+
+
+def test_z3_ranges_contain_all_points(rng):
+    sfc = z3_sfc(TimePeriod.WEEK)
+    box = (-74.2, 40.5, -73.7, 40.9)
+    tlo, thi = 86_400, 2 * 86_400
+    x = rng.uniform(box[0], box[2], 300)
+    y = rng.uniform(box[1], box[3], 300)
+    t = rng.uniform(tlo, thi, 300)
+    z = sfc.index(x, y, t, xp=np).astype(np.int64)
+    ranges = sfc.ranges([box], [(tlo, thi)])
+    assert len(ranges) <= 2000
+    in_any = np.zeros(len(z), dtype=bool)
+    for lo, hi in ranges:
+        in_any |= (z >= lo) & (z <= hi)
+    assert in_any.all()
+
+
+def test_z3_whole_period():
+    sfc = z3_sfc(TimePeriod.WEEK)
+    assert sfc.whole_period == (0, max_offset(TimePeriod.WEEK))

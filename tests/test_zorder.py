@@ -1,0 +1,92 @@
+"""Bit-interleave kernels vs an independent pure-python oracle.
+
+Golden expectations mirror the reference's Z2Test/Z3Test "split" cases
+(geomesa-z3/src/test/.../Z2Test.scala, Z3Test.scala): splitting value v
+intersperses (step-1) zero bits between the bits of v.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.curve import zorder
+
+
+def py_split(v: int, step: int) -> int:
+    out = 0
+    for i in range(32):
+        if (v >> i) & 1:
+            out |= 1 << (i * step)
+    return out
+
+
+def py_interleave(coords, step):
+    out = 0
+    for d, c in enumerate(coords):
+        out |= py_split(c, step) << d
+    return out
+
+
+GOLDEN = [0x00000000FFFFFF, 0x0, 0x1, 0x000000000C0F02, 0x00000000000802]
+
+
+def test_split2_golden():
+    for v in GOLDEN:
+        got = int(zorder.split2(np.uint64(v), xp=np))
+        assert got == py_split(v, 2), hex(v)
+
+
+def test_split3_golden():
+    for v in GOLDEN:
+        v &= 0x1FFFFF
+        got = int(zorder.split3(np.uint64(v), xp=np))
+        assert got == py_split(v, 3), hex(v)
+
+
+def test_roundtrip_2d(rng):
+    x = rng.integers(0, 1 << 31, size=1000, dtype=np.int64)
+    y = rng.integers(0, 1 << 31, size=1000, dtype=np.int64)
+    # include extremes
+    x[:2], y[:2] = [0, (1 << 31) - 1], [0, (1 << 31) - 1]
+    z = zorder.interleave2(x, y, xp=np)
+    rx, ry = zorder.deinterleave2(z, xp=np)
+    np.testing.assert_array_equal(rx.astype(np.int64), x)
+    np.testing.assert_array_equal(ry.astype(np.int64), y)
+    # spot-check against the oracle
+    for i in range(10):
+        assert int(z[i]) == py_interleave((int(x[i]), int(y[i])), 2)
+
+
+def test_roundtrip_3d(rng):
+    x = rng.integers(0, 1 << 21, size=1000, dtype=np.int64)
+    y = rng.integers(0, 1 << 21, size=1000, dtype=np.int64)
+    t = rng.integers(0, 1 << 21, size=1000, dtype=np.int64)
+    x[:2], y[:2], t[:2] = [0, (1 << 21) - 1], [0, (1 << 21) - 1], [0, (1 << 21) - 1]
+    z = zorder.interleave3(x, y, t, xp=np)
+    rx, ry, rt = zorder.deinterleave3(z, xp=np)
+    np.testing.assert_array_equal(rx.astype(np.int64), x)
+    np.testing.assert_array_equal(ry.astype(np.int64), y)
+    np.testing.assert_array_equal(rt.astype(np.int64), t)
+    for i in range(10):
+        assert int(z[i]) == py_interleave((int(x[i]), int(y[i]), int(t[i])), 3)
+
+
+def test_jnp_matches_numpy(rng):
+    x = rng.integers(0, 1 << 31, size=256, dtype=np.int64)
+    y = rng.integers(0, 1 << 31, size=256, dtype=np.int64)
+    z_np = zorder.interleave2(x, y, xp=np)
+    z_jnp = np.asarray(zorder.interleave2(jnp.asarray(x), jnp.asarray(y), xp=jnp))
+    np.testing.assert_array_equal(z_np, z_jnp)
+
+    x3 = x & 0x1FFFFF
+    y3 = y & 0x1FFFFF
+    t3 = rng.integers(0, 1 << 21, size=256, dtype=np.int64)
+    z_np3 = zorder.interleave3(x3, y3, t3, xp=np)
+    z_jnp3 = np.asarray(zorder.interleave3(jnp.asarray(x3), jnp.asarray(y3), jnp.asarray(t3)))
+    np.testing.assert_array_equal(z_np3, z_jnp3)
+
+
+def test_z_order_is_monotonic_per_dim(rng):
+    # increasing one dimension with others fixed must increase z
+    x = np.arange(100, dtype=np.int64)
+    z = zorder.interleave2(x, np.full(100, 7, dtype=np.int64), xp=np)
+    assert np.all(np.diff(z.astype(np.int64)) > 0)
