@@ -1,0 +1,88 @@
+"""Typed system properties — the framework's config/flag system.
+
+Mirrors the reference's three-tier config model (SURVEY.md §5): this module
+is tier 1, the equivalent of ``GeoMesaSystemProperties.SystemProperty``
+(geomesa-utils/.../conf/GeoMesaSystemProperties.scala:17-27) and the query
+knobs in ``QueryProperties`` (geomesa-index-api/.../conf/
+QueryProperties.scala:17-44).  Values resolve, in order: programmatic
+override → environment variable (dots become underscores, upper-cased) →
+default.  Tier 2 is per-schema user data (features/feature_type.py), tier
+3 per-query hints (index/query options).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+__all__ = ["SystemProperty", "QueryProperties", "set_property", "clear_property"]
+
+_overrides: dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def set_property(name: str, value) -> None:
+    with _lock:
+        _overrides[name] = value
+
+
+def clear_property(name: str) -> None:
+    with _lock:
+        _overrides.pop(name, None)
+
+
+@dataclass(frozen=True)
+class SystemProperty:
+    """A named, typed knob with env-var and programmatic override."""
+
+    name: str
+    default: Any
+
+    @property
+    def env_var(self) -> str:
+        return self.name.replace(".", "_").upper()
+
+    def get(self):
+        with _lock:
+            if self.name in _overrides:
+                return _overrides[self.name]
+        raw = os.environ.get(self.env_var)
+        if raw is None:
+            return self.default
+        if isinstance(self.default, bool):
+            return raw.strip().lower() in ("1", "true", "yes")
+        if isinstance(self.default, int):
+            return int(raw)
+        if isinstance(self.default, float):
+            return float(raw)
+        return raw
+
+    def to_int(self) -> int:
+        return int(self.get())
+
+    def to_bool(self) -> bool:
+        return bool(self.get())
+
+
+class QueryProperties:
+    """Planner guardrails (QueryProperties.scala:17-44 equivalents)."""
+
+    #: target number of scan ranges per query (split across time bins)
+    SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", 2000)
+    #: query timeout in seconds; 0 disables (ThreadManagement reaper analog)
+    QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", 0)
+    #: skip the exact geometry re-check and trust index-key resolution
+    LOOSE_BBOX = SystemProperty("geomesa.query.loose.bounding.box", False)
+    #: refuse queries that would scan the full table (opt-in, like the
+    #: reference's BlockFullTableScans)
+    BLOCK_FULL_TABLE_SCANS = SystemProperty(
+        "geomesa.scan.block.full.table", False)
+    #: cost strategy: 'stats' (cost-based) or 'index' (heuristic priority)
+    COST_TYPE = SystemProperty("geomesa.query.cost.type", "stats")
+
+
+#: default scan-ranges budget (import-time snapshot users can override per
+#: call; the live knob is QueryProperties.SCAN_RANGES_TARGET)
+DEFAULT_MAX_RANGES = QueryProperties.SCAN_RANGES_TARGET.default

@@ -25,11 +25,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from .zorder import deinterleave2, deinterleave3, interleave2, interleave3
+from ..config import DEFAULT_MAX_RANGES
+from .zorder import deinterleave2, deinterleave3
 
 __all__ = ["zranges", "merge_ranges"]
-
-DEFAULT_MAX_RANGES = 2000  # reference: geomesa.scan.ranges.target default
 
 
 def _deinterleave(z: np.ndarray, dims: int):
@@ -38,12 +37,6 @@ def _deinterleave(z: np.ndarray, dims: int):
         return np.stack([x, y])
     x, y, t = deinterleave3(z, xp=np)
     return np.stack([x, y, t])
-
-
-def _interleave(coords: np.ndarray, dims: int) -> np.ndarray:
-    if dims == 2:
-        return interleave2(coords[0], coords[1], xp=np)
-    return interleave3(coords[0], coords[1], coords[2], xp=np)
 
 
 def merge_ranges(los: np.ndarray, his: np.ndarray) -> np.ndarray:

@@ -114,22 +114,3 @@ def deinterleave3(z, xp=jnp):
         combine3(z >> _u64(xp, 1), xp),
         combine3(z >> _u64(xp, 2), xp),
     )
-
-
-# Convenience host-side (numpy) wrappers, used by the planner's range
-# decomposition where device dispatch would be pure overhead.
-
-def interleave2_np(x, y):
-    return interleave2(np.asarray(x), np.asarray(y), xp=np)
-
-
-def deinterleave2_np(z):
-    return deinterleave2(np.asarray(z), xp=np)
-
-
-def interleave3_np(x, y, t):
-    return interleave3(np.asarray(x), np.asarray(y), np.asarray(t), xp=np)
-
-
-def deinterleave3_np(z):
-    return deinterleave3(np.asarray(z), xp=np)

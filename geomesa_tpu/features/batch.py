@@ -1,0 +1,143 @@
+"""Columnar feature batches (SoA), the unit of ingest and query results.
+
+The TPU-first replacement for per-row SimpleFeatures + Kryo payloads
+(geomesa-features/.../kryo/KryoFeatureSerializer.scala): features live as
+parallel columns —
+
+* point geometry → two float64 columns ``<geom>_x`` / ``<geom>_y``
+* non-point geometry → a :class:`PackedGeometry` + a (N, 4) bbox column
+* date → int64 epoch-millis
+* string → numpy object array host-side (dictionary-encode on demand)
+* numerics/bool → natural numpy dtypes
+
+The reference's "lazy deserialization" trick (KryoBufferSimpleFeature
+reading only touched attributes) becomes simply *column projection* —
+touch only the columns a query needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..geometry.packed import PackedGeometry, pack_geometries
+from .feature_type import FeatureType
+
+__all__ = ["FeatureBatch"]
+
+_DTYPES = {
+    "int": np.int32,
+    "long": np.int64,
+    "float": np.float32,
+    "double": np.float64,
+    "bool": np.bool_,
+    "date": np.int64,  # epoch millis
+}
+
+
+@dataclass
+class FeatureBatch:
+    """N features of one FeatureType as columns."""
+
+    sft: FeatureType
+    columns: dict                    # name -> np.ndarray (see module doc)
+    ids: np.ndarray | None = None    # feature ids (object array of str) or None
+    geoms: PackedGeometry | None = None  # packed non-point default geometry
+
+    def __post_init__(self):
+        n = len(self)
+        for name, col in self.columns.items():
+            if len(col) != n:
+                raise ValueError(
+                    f"column {name!r} has length {len(col)}, expected {n}")
+        if self.ids is None:
+            self.ids = np.array([str(i) for i in range(n)], dtype=object)
+
+    def __len__(self) -> int:
+        if self.columns:
+            return len(next(iter(self.columns.values())))
+        return 0 if self.geoms is None else len(self.geoms)
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def from_dict(cls, sft: FeatureType, data: dict, ids=None) -> "FeatureBatch":
+        """Build from a dict of attribute name → values.
+
+        Geometry attributes accept Geometry objects (packed automatically);
+        the point default-geometry fast path accepts ``(x, y)`` tuples of
+        arrays under the geometry attribute name.
+        """
+        columns: dict = {}
+        geoms = None
+        for attr in sft.attributes:
+            if attr.name not in data:
+                continue
+            vals = data[attr.name]
+            if attr.is_geometry:
+                if attr.type == "point" and isinstance(vals, tuple):
+                    x, y = vals
+                    columns[f"{attr.name}_x"] = np.asarray(x, dtype=np.float64)
+                    columns[f"{attr.name}_y"] = np.asarray(y, dtype=np.float64)
+                else:
+                    packed = vals if isinstance(vals, PackedGeometry) else pack_geometries(vals)
+                    if attr.name == sft.default_geom:
+                        geoms = packed
+                    columns[f"{attr.name}_bbox"] = packed.bbox
+                    if packed.kinds.size and (packed.kinds == 0).all():
+                        # pure point column: also expose x/y fast path
+                        pts = packed.coords[packed.ring_offsets[:-1]]
+                        columns[f"{attr.name}_x"] = pts[:, 0]
+                        columns[f"{attr.name}_y"] = pts[:, 1]
+            elif attr.type == "date":
+                vals = np.asarray(vals)
+                if vals.dtype.kind == "M":
+                    vals = vals.astype("M8[ms]").astype(np.int64)
+                columns[attr.name] = vals.astype(np.int64)
+            elif attr.type in ("string", "bytes"):
+                columns[attr.name] = np.asarray(vals, dtype=object)
+            else:
+                columns[attr.name] = np.asarray(vals, dtype=_DTYPES[attr.type])
+        ids_arr = None if ids is None else np.asarray(ids, dtype=object)
+        return cls(sft, columns, ids_arr, geoms)
+
+    # -- access -----------------------------------------------------------
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def geom_xy(self, name: str | None = None):
+        name = name or self.sft.default_geom
+        return self.columns[f"{name}_x"], self.columns[f"{name}_y"]
+
+    def geom_bbox(self, name: str | None = None) -> np.ndarray:
+        name = name or self.sft.default_geom
+        key = f"{name}_bbox"
+        if key in self.columns:
+            return self.columns[key]
+        x, y = self.geom_xy(name)
+        return np.stack([x, y, x, y], axis=1)
+
+    def take(self, positions: np.ndarray) -> "FeatureBatch":
+        """Row subset (gather) — used to materialize query results."""
+        cols = {k: v[positions] for k, v in self.columns.items()}
+        geoms = None
+        if self.geoms is not None:
+            geoms = pack_geometries([self.geoms.geometry(int(i)) for i in positions])
+        return FeatureBatch(self.sft, cols, self.ids[positions], geoms)
+
+    def concat(self, other: "FeatureBatch") -> "FeatureBatch":
+        if other.sft.name != self.sft.name:
+            raise ValueError("cannot concat batches of different schemas")
+        cols = {
+            k: np.concatenate([v, other.columns[k]]) for k, v in self.columns.items()
+        }
+        if (self.geoms is None) != (other.geoms is None):
+            raise ValueError(
+                "cannot concat: one batch has packed geometries, the other none")
+        geoms = None
+        if self.geoms is not None and other.geoms is not None:
+            all_geoms = [self.geoms.geometry(i) for i in range(len(self.geoms))]
+            all_geoms += [other.geoms.geometry(i) for i in range(len(other.geoms))]
+            geoms = pack_geometries(all_geoms)
+        return FeatureBatch(
+            self.sft, cols, np.concatenate([self.ids, other.ids]), geoms)

@@ -1,0 +1,40 @@
+"""Geometry model: the framework's replacement for the reference's JTS
+dependency (used throughout geomesa-utils/geomesa-filter for geometry
+parsing, envelopes and predicates).
+
+Two representations:
+
+* **Object form** (:mod:`geomesa_tpu.geometry.types`): small dataclasses
+  (Point/LineString/Polygon/Multi*) for host-side planning, WKT I/O and
+  tests.
+* **Packed SoA form** (:mod:`geomesa_tpu.geometry.packed`): flat coordinate
+  buffers + offset arrays, the columnar layout device kernels and the XZ
+  indexes consume (bbox columns, vertex buffers).
+
+Predicates (:mod:`geomesa_tpu.geometry.predicates`) are vectorized numpy
+(crossing-number point-in-polygon, segment intersection, bbox algebra) —
+used as the exact re-check stage after index-range candidate filtering,
+the role the reference's CQL geometry evaluation plays in
+FilterTransformIterator.
+"""
+
+from .packed import PackedGeometry, pack_geometries
+from .predicates import (
+    bbox_intersects,
+    geometry_intersects,
+    point_in_polygon,
+    points_in_packed_polygon,
+    points_on_rings,
+    segments_intersect,
+)
+from .types import (
+    Envelope,
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+from .wkt import geometry_from_wkt, geometry_to_wkt

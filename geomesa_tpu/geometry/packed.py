@@ -1,0 +1,145 @@
+"""Packed SoA geometry columns: flat buffers for batches of geometries.
+
+The reference serializes geometries per-row with WKB/TWKB codecs
+(geomesa-features/.../serialization/TwkbSerialization.scala) because its
+storage is row-oriented KV.  Device-resident columnar storage wants the
+opposite: one flat coordinate buffer plus offset arrays (arrow-style
+nesting), so vertex data can live in HBM and predicates can run as dense
+array ops.
+
+Nesting model (three levels, covering all seven WKT families):
+
+``geometry → part → ring → coords``
+
+* Point/LineString: 1 part, 1 ring.
+* MultiPoint: 1 part, 1 ring (the point list).
+* Polygon: 1 part, ring 0 = shell, rings 1.. = holes.
+* MultiLineString: one part per line.
+* MultiPolygon: one part per polygon.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .types import (
+    Geometry,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+)
+
+__all__ = ["PackedGeometry", "pack_geometries", "GEOM_KIND"]
+
+GEOM_KIND = {
+    "Point": 0, "MultiPoint": 1, "LineString": 2,
+    "MultiLineString": 3, "Polygon": 4, "MultiPolygon": 5,
+}
+_KIND_NAMES = {v: k for k, v in GEOM_KIND.items()}
+
+
+@dataclass
+class PackedGeometry:
+    """A column of N geometries in flat SoA buffers."""
+
+    kinds: np.ndarray             # (N,) int8
+    coords: np.ndarray            # (C, 2) float64
+    ring_offsets: np.ndarray      # (R+1,) int64 → coords
+    part_ring_offsets: np.ndarray # (P+1,) int64 → rings
+    geom_part_offsets: np.ndarray # (N+1,) int64 → parts
+    bbox: np.ndarray              # (N, 4) float64: xmin, ymin, xmax, ymax
+
+    def __len__(self) -> int:
+        return len(self.kinds)
+
+    def geometry(self, i: int) -> Geometry:
+        """Reconstruct the i-th geometry object (host-side)."""
+        kind = _KIND_NAMES[int(self.kinds[i])]
+        p0, p1 = self.geom_part_offsets[i], self.geom_part_offsets[i + 1]
+        parts = []
+        for p in range(p0, p1):
+            r0, r1 = self.part_ring_offsets[p], self.part_ring_offsets[p + 1]
+            rings = [
+                self.coords[self.ring_offsets[r]:self.ring_offsets[r + 1]]
+                for r in range(r0, r1)
+            ]
+            parts.append(rings)
+        if kind == "Point":
+            c = parts[0][0][0]
+            return Point(float(c[0]), float(c[1]))
+        if kind == "MultiPoint":
+            return MultiPoint(parts[0][0])
+        if kind == "LineString":
+            return LineString(parts[0][0])
+        if kind == "MultiLineString":
+            return MultiLineString(tuple(LineString(p[0]) for p in parts))
+        if kind == "Polygon":
+            return Polygon(parts[0][0], tuple(parts[0][1:]))
+        return MultiPolygon(tuple(Polygon(p[0], tuple(p[1:])) for p in parts))
+
+    def rings_of(self, i: int) -> list[np.ndarray]:
+        """All rings of geometry i as coordinate arrays."""
+        p0, p1 = self.geom_part_offsets[i], self.geom_part_offsets[i + 1]
+        r0, r1 = self.part_ring_offsets[p0], self.part_ring_offsets[p1]
+        return [
+            self.coords[self.ring_offsets[r]:self.ring_offsets[r + 1]]
+            for r in range(r0, r1)
+        ]
+
+
+def _rings_for(geom: Geometry) -> tuple[int, list[list[np.ndarray]]]:
+    if isinstance(geom, Point):
+        return GEOM_KIND["Point"], [[np.array([[geom.x, geom.y]])]]
+    if isinstance(geom, MultiPoint):
+        return GEOM_KIND["MultiPoint"], [[geom.coords]]
+    if isinstance(geom, LineString):
+        return GEOM_KIND["LineString"], [[geom.coords]]
+    if isinstance(geom, MultiLineString):
+        return GEOM_KIND["MultiLineString"], [[l.coords] for l in geom.lines]
+    if isinstance(geom, Polygon):
+        return GEOM_KIND["Polygon"], [[geom.shell, *geom.holes]]
+    if isinstance(geom, MultiPolygon):
+        return GEOM_KIND["MultiPolygon"], [
+            [p.shell, *p.holes] for p in geom.polygons
+        ]
+    raise ValueError(f"cannot pack {geom!r}")
+
+
+def pack_geometries(geoms) -> PackedGeometry:
+    kinds = np.empty(len(geoms), dtype=np.int8)
+    coords_parts: list[np.ndarray] = []
+    ring_lens: list[int] = []
+    part_ring_counts: list[int] = []
+    geom_part_counts: list[int] = []
+    bbox = np.empty((len(geoms), 4), dtype=np.float64)
+
+    for i, g in enumerate(geoms):
+        kind, parts = _rings_for(g)
+        kinds[i] = kind
+        geom_part_counts.append(len(parts))
+        for rings in parts:
+            part_ring_counts.append(len(rings))
+            for ring in rings:
+                coords_parts.append(np.asarray(ring, dtype=np.float64))
+                ring_lens.append(len(ring))
+        env = g.envelope
+        bbox[i] = env.as_tuple()
+
+    coords = (
+        np.vstack(coords_parts) if coords_parts else np.empty((0, 2), np.float64)
+    )
+    ring_offsets = np.concatenate([[0], np.cumsum(ring_lens)]).astype(np.int64)
+    part_ring_offsets = np.concatenate(
+        [[0], np.cumsum(part_ring_counts)]).astype(np.int64)
+    geom_part_offsets = np.concatenate(
+        [[0], np.cumsum(geom_part_counts)]).astype(np.int64)
+    return PackedGeometry(
+        kinds=kinds, coords=coords, ring_offsets=ring_offsets,
+        part_ring_offsets=part_ring_offsets,
+        geom_part_offsets=geom_part_offsets, bbox=bbox,
+    )

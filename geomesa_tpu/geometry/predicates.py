@@ -1,0 +1,217 @@
+"""Vectorized geometry predicates.
+
+The exact re-check stage of query evaluation: after index ranges produce
+candidates (a superset), these predicates compute the final hit set — the
+role the reference delegates to CQL geometry evaluation inside
+FilterTransformIterator / FastFilterFactory (geomesa-filter).
+
+All core tests are numpy-vectorized over points × segments.  Boundary
+semantics follow JTS ``intersects``: points on a polygon boundary are
+inside; touching segments intersect.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .types import Envelope, Geometry, LineString, MultiLineString, MultiPoint, MultiPolygon, Point, Polygon
+
+__all__ = [
+    "bbox_intersects",
+    "point_in_polygon",
+    "points_in_packed_polygon",
+    "points_on_rings",
+    "segments_intersect",
+    "geometry_intersects",
+]
+
+_EDGE_CHUNK = 4096  # bound the (points × edges) broadcast memory
+
+
+def bbox_intersects(bbox: np.ndarray, window) -> np.ndarray:
+    """(N, 4) bbox column vs one (xmin, ymin, xmax, ymax) window → mask."""
+    bbox = np.asarray(bbox)
+    return (
+        (bbox[:, 0] <= window[2]) & (bbox[:, 2] >= window[0])
+        & (bbox[:, 1] <= window[3]) & (bbox[:, 3] >= window[1])
+    )
+
+
+def _rings_of(geom: Geometry) -> list[np.ndarray]:
+    if isinstance(geom, Polygon):
+        return [geom.shell, *geom.holes]
+    if isinstance(geom, MultiPolygon):
+        out = []
+        for p in geom.polygons:
+            out.extend([p.shell, *p.holes])
+        return out
+    raise ValueError(f"expected polygonal geometry, got {geom.geom_type}")
+
+
+def _crossing_parity(px: np.ndarray, py: np.ndarray, rings) -> np.ndarray:
+    """Even-odd ray casting: odd number of upward/downward edge crossings to
+    the right of the point ⇒ inside.  Holes flip parity naturally."""
+    inside = np.zeros(px.shape, dtype=bool)
+    for ring in rings:
+        x1, y1 = ring[:-1, 0], ring[:-1, 1]
+        x2, y2 = ring[1:, 0], ring[1:, 1]
+        for s in range(0, len(x1), _EDGE_CHUNK):
+            ex1, ey1 = x1[s:s + _EDGE_CHUNK], y1[s:s + _EDGE_CHUNK]
+            ex2, ey2 = x2[s:s + _EDGE_CHUNK], y2[s:s + _EDGE_CHUNK]
+            straddle = (ey1[None, :] > py[:, None]) != (ey2[None, :] > py[:, None])
+            with np.errstate(divide="ignore", invalid="ignore"):
+                xint = ex1[None, :] + (py[:, None] - ey1[None, :]) / (
+                    ey2[None, :] - ey1[None, :]
+                ) * (ex2[None, :] - ex1[None, :])
+            hits = straddle & (px[:, None] < xint)
+            inside ^= (np.sum(hits, axis=1) % 2).astype(bool)
+    return inside
+
+
+def points_on_rings(px: np.ndarray, py: np.ndarray, rings, eps: float = 0.0) -> np.ndarray:
+    """True where a point lies exactly on any ring segment (boundary)."""
+    on = np.zeros(px.shape, dtype=bool)
+    for ring in rings:
+        x1, y1 = ring[:-1, 0], ring[:-1, 1]
+        x2, y2 = ring[1:, 0], ring[1:, 1]
+        for s in range(0, len(x1), _EDGE_CHUNK):
+            ex1, ey1 = x1[s:s + _EDGE_CHUNK], y1[s:s + _EDGE_CHUNK]
+            ex2, ey2 = x2[s:s + _EDGE_CHUNK], y2[s:s + _EDGE_CHUNK]
+            dx, dy = ex2 - ex1, ey2 - ey1
+            vx = px[:, None] - ex1[None, :]
+            vy = py[:, None] - ey1[None, :]
+            cross = np.abs(vx * dy[None, :] - vy * dx[None, :])
+            dot = vx * dx[None, :] + vy * dy[None, :]
+            sq = (dx * dx + dy * dy)[None, :]
+            on |= ((cross <= eps * np.sqrt(np.maximum(sq, 1e-300)))
+                   & (dot >= 0) & (dot <= sq)).any(axis=1) if eps else (
+                (cross == 0) & (dot >= 0) & (dot <= sq)).any(axis=1)
+    return on
+
+
+def point_in_polygon(px, py, geom: Geometry, include_boundary: bool = True) -> np.ndarray:
+    """Vectorized point-in-(Multi)Polygon with even-odd hole handling."""
+    px = np.asarray(px, dtype=np.float64)
+    py = np.asarray(py, dtype=np.float64)
+    rings = _rings_of(geom)
+    inside = _crossing_parity(px, py, rings)
+    if include_boundary:
+        inside |= points_on_rings(px, py, rings)
+    return inside
+
+
+def points_in_packed_polygon(px, py, packed, i: int) -> np.ndarray:
+    """Point-in-polygon against geometry ``i`` of a PackedGeometry column."""
+    rings = packed.rings_of(i)
+    px = np.asarray(px, dtype=np.float64)
+    py = np.asarray(py, dtype=np.float64)
+    return _crossing_parity(px, py, rings) | points_on_rings(px, py, rings)
+
+
+def segments_intersect(p1, p2, q1, q2) -> np.ndarray:
+    """Vectorized proper-or-touching segment intersection.
+
+    ``p1, p2``: (A, 2) segment endpoints; ``q1, q2``: (B, 2).  Returns
+    (A, B) boolean matrix.  Uses orientation sign tests with collinear
+    overlap handled by bbox checks.
+    """
+    p1 = np.asarray(p1, np.float64)[:, None, :]
+    p2 = np.asarray(p2, np.float64)[:, None, :]
+    q1 = np.asarray(q1, np.float64)[None, :, :]
+    q2 = np.asarray(q2, np.float64)[None, :, :]
+
+    def cross(o, a, b):
+        return (a[..., 0] - o[..., 0]) * (b[..., 1] - o[..., 1]) - (
+            a[..., 1] - o[..., 1]) * (b[..., 0] - o[..., 0])
+
+    d1 = cross(q1, q2, p1)
+    d2 = cross(q1, q2, p2)
+    d3 = cross(p1, p2, q1)
+    d4 = cross(p1, p2, q2)
+    proper = (
+        (((d1 > 0) & (d2 < 0)) | ((d1 < 0) & (d2 > 0)))
+        & (((d3 > 0) & (d4 < 0)) | ((d3 < 0) & (d4 > 0)))
+    )
+
+    def on_bbox(a1, a2, b):
+        return (
+            (b[..., 0] >= np.minimum(a1[..., 0], a2[..., 0]))
+            & (b[..., 0] <= np.maximum(a1[..., 0], a2[..., 0]))
+            & (b[..., 1] >= np.minimum(a1[..., 1], a2[..., 1]))
+            & (b[..., 1] <= np.maximum(a1[..., 1], a2[..., 1]))
+        )
+
+    touch = (
+        ((d1 == 0) & on_bbox(q1, q2, p1))
+        | ((d2 == 0) & on_bbox(q1, q2, p2))
+        | ((d3 == 0) & on_bbox(p1, p2, q1))
+        | ((d4 == 0) & on_bbox(p1, p2, q2))
+    )
+    return proper | touch
+
+
+def _segments(geom: Geometry) -> tuple[np.ndarray, np.ndarray]:
+    rings: list[np.ndarray] = []
+    if isinstance(geom, LineString):
+        rings = [geom.coords]
+    elif isinstance(geom, MultiLineString):
+        rings = [l.coords for l in geom.lines]
+    elif isinstance(geom, (Polygon, MultiPolygon)):
+        rings = _rings_of(geom)
+    else:
+        return np.empty((0, 2)), np.empty((0, 2))
+    a = np.vstack([r[:-1] for r in rings]) if rings else np.empty((0, 2))
+    b = np.vstack([r[1:] for r in rings]) if rings else np.empty((0, 2))
+    return a, b
+
+
+def _points_of(geom: Geometry) -> np.ndarray:
+    if isinstance(geom, Point):
+        return np.array([[geom.x, geom.y]])
+    if isinstance(geom, MultiPoint):
+        return geom.coords
+    if isinstance(geom, LineString):
+        return geom.coords
+    if isinstance(geom, MultiLineString):
+        return np.vstack([l.coords for l in geom.lines])
+    if isinstance(geom, Polygon):
+        return geom.shell
+    if isinstance(geom, MultiPolygon):
+        return np.vstack([p.shell for p in geom.polygons])
+    raise ValueError(geom)
+
+
+def geometry_intersects(a: Geometry, b: Geometry) -> bool:
+    """JTS-style ``intersects`` dispatch over the supported type lattice."""
+    if not a.envelope.intersects(b.envelope):
+        return False
+    a_poly = isinstance(a, (Polygon, MultiPolygon))
+    b_poly = isinstance(b, (Polygon, MultiPolygon))
+    a_pts = _points_of(a)
+    b_pts = _points_of(b)
+    # vertex containment either direction
+    if b_poly and point_in_polygon(a_pts[:, 0], a_pts[:, 1], b).any():
+        return True
+    if a_poly and point_in_polygon(b_pts[:, 0], b_pts[:, 1], a).any():
+        return True
+    # point-only operands are settled by containment / coincidence
+    if isinstance(a, (Point, MultiPoint)) or isinstance(b, (Point, MultiPoint)):
+        if isinstance(a, (Point, MultiPoint)) and isinstance(b, (Point, MultiPoint)):
+            return bool(
+                (np.abs(a_pts[:, None, :] - b_pts[None, :, :]).sum(axis=2) == 0).any()
+            )
+        pts, other = (a_pts, b) if isinstance(a, (Point, MultiPoint)) else (b_pts, a)
+        if isinstance(other, (LineString, MultiLineString)):
+            s1, s2 = _segments(other)
+            rings = [np.vstack([p1, p2]) for p1, p2 in zip(s1, s2)]
+            return bool(points_on_rings(pts[:, 0], pts[:, 1], rings).any())
+        return False  # polygon cases already handled above
+    # segment crossings
+    a1, a2 = _segments(a)
+    b1, b2 = _segments(b)
+    if a1.size and b1.size:
+        # chunk to bound memory
+        for s in range(0, len(a1), _EDGE_CHUNK):
+            if segments_intersect(a1[s:s + _EDGE_CHUNK], a2[s:s + _EDGE_CHUNK], b1, b2).any():
+                return True
+    return False

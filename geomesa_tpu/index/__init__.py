@@ -1,0 +1,12 @@
+"""Feature indexes: sorted SoA device-resident index structures.
+
+The TPU-native replacement for the reference's index layer
+(geomesa-index-api): instead of writing ``[shard][bin][z][id]`` rows into a
+distributed sorted KV store, each index keeps lexicographically sorted key
+columns (plus a permutation into the feature columns) resident in device
+HBM; queries decompose filters into key ranges on host and evaluate
+seek + candidate-filter as fused array kernels on device.
+"""
+
+from .z2 import Z2PointIndex
+from .z3 import Z3PointIndex

@@ -1,0 +1,132 @@
+"""Z2 point index: spatial-only bbox queries over (lon, lat) points.
+
+TPU-native analog of the reference's Z2 index
+(geomesa-index-api/.../index/z2/Z2IndexKeySpace.scala; key layout
+``[shard][8B z][id]``, :42): one sorted int64 z column + permutation.
+Supports multi-box (OR of bboxes) queries — the reference's
+FilterSplitter-produced disjunctions (BASELINE config 2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..curve.sfc import Z2SFC, z2_sfc
+from ..curve.zorder import deinterleave2
+from ..config import DEFAULT_MAX_RANGES
+from ..ops.search import expand_ranges, gather_capacity
+
+__all__ = ["Z2PointIndex", "Z2QueryPlan", "plan_z2_query"]
+
+
+@dataclass
+class Z2QueryPlan:
+    rzlo: np.ndarray   # (R,) int64
+    rzhi: np.ndarray
+    ixy: np.ndarray    # (B, 4) int32 normalized bounds
+    boxes: np.ndarray  # (B, 4) float64 exact bounds
+
+    @property
+    def num_ranges(self) -> int:
+        return len(self.rzlo)
+
+
+def plan_z2_query(boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> Z2QueryPlan:
+    sfc = z2_sfc()
+    boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+    zr = sfc.ranges(boxes, max_ranges=max_ranges)
+    ixy = np.stack(
+        [
+            [
+                sfc.lon.normalize_scalar(b[0]),
+                sfc.lat.normalize_scalar(b[1]),
+                sfc.lon.normalize_scalar(b[2]),
+                sfc.lat.normalize_scalar(b[3]),
+            ]
+            for b in boxes
+        ]
+    ).astype(np.int32)
+    return Z2QueryPlan(rzlo=zr[:, 0], rzhi=zr[:, 1], ixy=ixy, boxes=boxes)
+
+
+@jax.jit
+def _range_bounds(z, rzlo, rzhi):
+    starts = jnp.searchsorted(z, rzlo, side="left")
+    ends = jnp.searchsorted(z, rzhi, side="right")
+    return starts, jnp.maximum(ends - starts, 0)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _scan_candidates(z, pos, x, y, starts, counts, ixy, boxes, capacity: int):
+    idx, valid, _ = expand_ranges(starts, counts, capacity)
+    zc = z[idx]
+    posc = pos[idx]
+    ix, iy = deinterleave2(zc.astype(jnp.uint64))
+    ix = ix.astype(jnp.int64)
+    iy = iy.astype(jnp.int64)
+    in_box_int = (
+        (ix[:, None] >= ixy[None, :, 0])
+        & (iy[:, None] >= ixy[None, :, 1])
+        & (ix[:, None] <= ixy[None, :, 2])
+        & (iy[:, None] <= ixy[None, :, 3])
+    ).any(axis=1)
+    xc = x[posc]
+    yc = y[posc]
+    in_box_exact = (
+        (xc[:, None] >= boxes[None, :, 0])
+        & (yc[:, None] >= boxes[None, :, 1])
+        & (xc[:, None] <= boxes[None, :, 2])
+        & (yc[:, None] <= boxes[None, :, 3])
+    ).any(axis=1)
+    return posc, valid & in_box_int & in_box_exact
+
+
+class Z2PointIndex:
+    """Device-resident Z2 index over point features."""
+
+    def __init__(self, z, pos, x, y):
+        self.sfc: Z2SFC = z2_sfc()
+        self.z = z
+        self.pos = pos
+        self.x = x
+        self.y = y
+
+    @classmethod
+    def build(cls, x, y) -> "Z2PointIndex":
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        sfc = z2_sfc()
+        xd = jnp.asarray(x)
+        yd = jnp.asarray(y)
+        z = jax.jit(lambda a, b: sfc.index(a, b))(xd, yd)
+        order = jnp.argsort(z)
+        return cls(z=z[order], pos=order.astype(jnp.int32), x=xd, y=yd)
+
+    def __len__(self) -> int:
+        return int(self.z.shape[0])
+
+    def query(self, boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> np.ndarray:
+        """Original-order positions matching any of the bboxes, exactly."""
+        plan = plan_z2_query(boxes, max_ranges)
+        if plan.num_ranges == 0 or len(self) == 0:
+            return np.empty(0, dtype=np.int64)
+        starts, counts = _range_bounds(
+            self.z, jnp.asarray(plan.rzlo), jnp.asarray(plan.rzhi)
+        )
+        total = int(jnp.sum(counts))
+        if total == 0:
+            return np.empty(0, dtype=np.int64)
+        posc, mask = _scan_candidates(
+            self.z, self.pos, self.x, self.y,
+            starts, counts,
+            jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
+            capacity=gather_capacity(total),
+        )
+        posc = np.asarray(posc)
+        mask = np.asarray(mask)
+        return np.sort(posc[mask]).astype(np.int64)

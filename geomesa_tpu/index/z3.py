@@ -1,0 +1,275 @@
+"""Z3 point index: bbox + time queries over (lon, lat, dtg) point features.
+
+TPU-native analog of the reference's Z3 index
+(geomesa-index-api/.../index/z3/Z3IndexKeySpace.scala):
+
+* **Key layout.** The reference writes ``[1B shard][2B bin][8B z][id]``
+  rows (Z3IndexKeySpace.scala:60).  Here the same order lives as two
+  sorted device columns — ``bins`` (int32) and ``z`` (int64) sorted
+  lexicographically — plus ``pos``, the permutation into the original
+  feature columns.  No shard byte: write/scan parallelism comes from mesh
+  sharding, not key-prefix salting (SURVEY.md §2.7).
+* **Write path.** ``build`` = host time-binning (calendar-aware,
+  BinnedTime semantics) → jitted vectorized SFC encode (the reference's
+  per-feature hot loop, Z3IndexKeySpace.toIndexKey:64-96, as one fused
+  device kernel) → device lexsort (the KV store's implicit sort made
+  explicit).
+* **Query path.** Host planning mirrors Z3IndexKeySpace.getIndexValues/
+  getRanges (:98-189): bin the time interval, decompose bbox × per-bin
+  time windows into covering z-ranges with the scan-ranges budget split
+  across bins (:166-168).  Device scan = vectorized binary-search seeks +
+  one fixed-capacity gather + a fused candidate mask combining the
+  normalized-int bounds check (filters/Z3Filter.scala:19-55 semantics)
+  with the exact double-precision predicate (the reference's
+  FilterTransformIterator CQL re-check).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..curve.binnedtime import TimePeriod, max_date_ms, max_offset, to_binned_time
+from ..curve.sfc import Z3SFC, z3_sfc
+from ..curve.zorder import deinterleave3
+from ..config import DEFAULT_MAX_RANGES
+from ..ops.search import expand_ranges, gather_capacity, searchsorted2
+
+__all__ = ["Z3PointIndex", "Z3QueryPlan", "plan_z3_query"]
+
+
+@dataclass
+class Z3QueryPlan:
+    """Host-side scan plan: covering ranges + filter bounds (all numpy)."""
+
+    # per-range arrays (R,)
+    rbin: np.ndarray      # int32 time bin
+    rzlo: np.ndarray      # int64 inclusive z lo
+    rzhi: np.ndarray      # int64 inclusive z hi
+    rtlo: np.ndarray      # int32 normalized time lo for the range's bin
+    rthi: np.ndarray      # int32 normalized time hi
+    # normalized-int spatial bounds (Z3Filter semantics), per box (B, 4)
+    ixy: np.ndarray
+    # exact double-precision bounds
+    boxes: np.ndarray     # (B, 4) xmin, ymin, xmax, ymax
+    t_lo_ms: int
+    t_hi_ms: int
+
+    @property
+    def num_ranges(self) -> int:
+        return len(self.rbin)
+
+
+def _time_windows_by_bin(t_lo_ms: int, t_hi_ms: int, period: TimePeriod):
+    """Split [lo, hi] ms into per-bin offset windows; mirror of the
+    reference's ``timesByBin`` construction (Z3IndexKeySpace.scala:120-158):
+    interior bins get the whole period, boundary bins get partial windows."""
+    lo_ms = max(0, int(t_lo_ms))
+    hi_ms = min(int(t_hi_ms), max_date_ms(period) - 1)
+    if lo_ms > hi_ms:
+        return {}
+    blo_a, olo_a = to_binned_time(lo_ms, period)
+    bhi_a, ohi_a = to_binned_time(hi_ms, period)
+    blo, olo, bhi, ohi = int(blo_a), int(olo_a), int(bhi_a), int(ohi_a)
+    whole = (0, max_offset(period))
+    if blo == bhi:
+        return {blo: (olo, ohi)}
+    windows = {blo: (olo, whole[1]), bhi: (0, ohi)}
+    for b in range(blo + 1, bhi):
+        windows[b] = whole
+    return windows
+
+
+def plan_z3_query(
+    boxes,
+    t_lo_ms: int,
+    t_hi_ms: int,
+    period: TimePeriod | str = TimePeriod.WEEK,
+    max_ranges: int = DEFAULT_MAX_RANGES,
+) -> Z3QueryPlan:
+    """Decompose bbox(es) + time interval into a covering-range scan plan.
+
+    The scan-ranges budget is split across time bins as in
+    Z3IndexKeySpace.getRanges (:166-168); whole-period bins share one
+    decomposition, partial (boundary) bins get their own.
+    """
+    period = TimePeriod.parse(period)
+    sfc = z3_sfc(period)
+    boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+    windows = _time_windows_by_bin(t_lo_ms, t_hi_ms, period)
+    empty = np.empty(0, dtype=np.int64)
+    if not windows:
+        return Z3QueryPlan(
+            rbin=empty.astype(np.int32), rzlo=empty, rzhi=empty,
+            rtlo=empty.astype(np.int32), rthi=empty.astype(np.int32),
+            ixy=np.empty((0, 4), np.int32), boxes=boxes,
+            t_lo_ms=int(t_lo_ms), t_hi_ms=int(t_hi_ms),
+        )
+    target = max(1, max_ranges // max(1, len(windows)))
+
+    # group bins by identical time window so whole-period bins share one
+    # range decomposition
+    by_window: dict[tuple[int, int], list[int]] = {}
+    for b, w in windows.items():
+        by_window.setdefault(w, []).append(b)
+
+    rbin, rzlo, rzhi, rtlo, rthi = [], [], [], [], []
+    for (wlo, whi), bs in by_window.items():
+        zr = sfc.ranges(boxes, [(wlo, whi)], max_ranges=target)
+        itlo = sfc.time.normalize_scalar(float(wlo))
+        ithi = sfc.time.normalize_scalar(float(whi))
+        for b in sorted(bs):
+            rbin.append(np.full(len(zr), b, dtype=np.int32))
+            rzlo.append(zr[:, 0])
+            rzhi.append(zr[:, 1])
+            rtlo.append(np.full(len(zr), itlo, dtype=np.int32))
+            rthi.append(np.full(len(zr), ithi, dtype=np.int32))
+
+    ixy = np.stack(
+        [
+            [
+                sfc.lon.normalize_scalar(b[0]),
+                sfc.lat.normalize_scalar(b[1]),
+                sfc.lon.normalize_scalar(b[2]),
+                sfc.lat.normalize_scalar(b[3]),
+            ]
+            for b in boxes
+        ]
+    ).astype(np.int32)
+
+    return Z3QueryPlan(
+        rbin=np.concatenate(rbin),
+        rzlo=np.concatenate(rzlo),
+        rzhi=np.concatenate(rzhi),
+        rtlo=np.concatenate(rtlo),
+        rthi=np.concatenate(rthi),
+        ixy=ixy,
+        boxes=boxes,
+        t_lo_ms=int(t_lo_ms),
+        t_hi_ms=int(t_hi_ms),
+    )
+
+
+@jax.jit
+def _range_bounds(bins, z, rbin, rzlo, rzhi):
+    starts = searchsorted2(bins, z, rbin, rzlo, side="left")
+    ends = searchsorted2(bins, z, rbin, rzhi, side="right")
+    return starts, jnp.maximum(ends - starts, 0)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _scan_candidates(
+    bins, z, pos, x, y, dtg,
+    starts, counts, rtlo, rthi,
+    ixy, boxes, t_lo_ms, t_hi_ms,
+    capacity: int,
+):
+    """Fixed-capacity candidate gather + fused filter.
+
+    The mask fuses the reference's two server-side stages: the z-decode
+    int-space bounds test (Z3Iterator/Z3Filter) and the exact geometry/time
+    re-check (FilterTransformIterator) — one pass over gathered candidates.
+    """
+    idx, valid, rid = expand_ranges(starts, counts, capacity)
+    zc = z[idx]
+    posc = pos[idx]
+    ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
+    ix = ix.astype(jnp.int32)
+    iy = iy.astype(jnp.int32)
+    it = it.astype(jnp.int32)
+    # int-space spatial check against any box (B, 4)
+    in_box_int = (
+        (ix[:, None] >= ixy[None, :, 0])
+        & (iy[:, None] >= ixy[None, :, 1])
+        & (ix[:, None] <= ixy[None, :, 2])
+        & (iy[:, None] <= ixy[None, :, 3])
+    ).any(axis=1)
+    in_time_int = (it >= rtlo[rid]) & (it <= rthi[rid])
+    # exact double-precision predicate on the original columns
+    xc = x[posc]
+    yc = y[posc]
+    tc = dtg[posc]
+    in_box_exact = (
+        (xc[:, None] >= boxes[None, :, 0])
+        & (yc[:, None] >= boxes[None, :, 1])
+        & (xc[:, None] <= boxes[None, :, 2])
+        & (yc[:, None] <= boxes[None, :, 3])
+    ).any(axis=1)
+    in_time_exact = (tc >= t_lo_ms) & (tc <= t_hi_ms)
+    mask = valid & in_box_int & in_time_int & in_box_exact & in_time_exact
+    return posc, mask
+
+
+class Z3PointIndex:
+    """Device-resident Z3 index over point features with timestamps."""
+
+    def __init__(self, period, bins, z, pos, x, y, dtg):
+        self.period = TimePeriod.parse(period)
+        self.sfc: Z3SFC = z3_sfc(self.period)
+        self.bins = bins
+        self.z = z
+        self.pos = pos
+        self.x = x
+        self.y = y
+        self.dtg = dtg
+
+    @classmethod
+    def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK) -> "Z3PointIndex":
+        """Encode keys (device) and sort (device lexsort, bin-major)."""
+        period = TimePeriod.parse(period)
+        sfc = z3_sfc(period)
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
+        host_bins, host_offs = to_binned_time(dtg_ms, period)
+
+        xd = jnp.asarray(x)
+        yd = jnp.asarray(y)
+        td = jnp.asarray(dtg_ms)
+        bind = jnp.asarray(host_bins.astype(np.int32))
+        offd = jnp.asarray(host_offs.astype(np.float64))
+
+        z = jax.jit(lambda a, b, c: sfc.index(a, b, c))(xd, yd, offd)
+        order = jnp.lexsort((z, bind))
+        return cls(
+            period,
+            bins=bind[order],
+            z=z[order],
+            pos=order.astype(jnp.int32),
+            x=xd,
+            y=yd,
+            dtg=td,
+        )
+
+    def __len__(self) -> int:
+        return int(self.z.shape[0])
+
+    def query(self, boxes, t_lo_ms: int, t_hi_ms: int,
+              max_ranges: int = DEFAULT_MAX_RANGES) -> np.ndarray:
+        """Return original-order positions of features matching
+        bbox(es) ∧ time interval, exactly (oracle-equal hit sets)."""
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        if plan.num_ranges == 0 or len(self) == 0:
+            return np.empty(0, dtype=np.int64)
+        starts, counts = _range_bounds(
+            self.bins, self.z,
+            jnp.asarray(plan.rbin), jnp.asarray(plan.rzlo), jnp.asarray(plan.rzhi),
+        )
+        total = int(jnp.sum(counts))
+        if total == 0:
+            return np.empty(0, dtype=np.int64)
+        posc, mask = _scan_candidates(
+            self.bins, self.z, self.pos, self.x, self.y, self.dtg,
+            starts, counts,
+            jnp.asarray(plan.rtlo), jnp.asarray(plan.rthi),
+            jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
+            plan.t_lo_ms, plan.t_hi_ms,
+            capacity=gather_capacity(total),
+        )
+        posc = np.asarray(posc)
+        mask = np.asarray(mask)
+        return np.sort(posc[mask]).astype(np.int64)

@@ -1,0 +1,4 @@
+"""Device-side array ops: the kernels that replace the reference's
+server-side scan machinery (Accumulo iterators / HBase filters)."""
+
+from .search import expand_ranges, searchsorted2

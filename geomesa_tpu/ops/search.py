@@ -1,0 +1,90 @@
+"""Sorted-key search kernels: the TPU replacement for KV-store seeks.
+
+The reference's scan path turns z-ranges into tablet-server seeks over a
+distributed sorted map (e.g. AccumuloQueryPlan BatchScanPlan,
+geomesa-accumulo/.../data/AccumuloQueryPlan.scala:123-157).  Here the
+"table" is a lexicographically sorted pair of device-resident columns
+``(hi, lo)`` — for Z3, ``hi`` = time bin and ``lo`` = 63-bit z — and a
+seek is a branchless vectorized binary search evaluated for all R query
+ranges at once.  Fixed iteration count (log2 n), no data-dependent control
+flow: jit/vmap/shard_map friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["searchsorted2", "expand_ranges", "gather_capacity"]
+
+
+def gather_capacity(total: int, minimum: int = 1024) -> int:
+    """Static gather capacity: next power of two ≥ total.  Bounds the number
+    of distinct compiled shapes for the candidate-scan kernels to log2(N)."""
+    cap = minimum
+    while cap < total:
+        cap *= 2
+    return cap
+
+
+def searchsorted2(keys_hi, keys_lo, q_hi, q_lo, side: str = "left"):
+    """Vectorized binary search over lexicographically sorted key pairs.
+
+    Equivalent to ``np.searchsorted`` on the composite key ``(hi, lo)``
+    (which for Z3 matches the reference's big-endian ``[2B bin][8B z]``
+    row-key ordering, index/index/z3/Z3IndexKeySpace.scala:60): returns,
+    per query, the first index at which the query could be inserted while
+    keeping order ('left'), or the index past any equal run ('right').
+
+    All comparisons are signed int64 — z values occupy ≤63 bits so signed
+    order equals unsigned byte order.
+    """
+    if side not in ("left", "right"):
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    n = keys_hi.shape[0]
+    q_hi = jnp.asarray(q_hi)
+    q_lo = jnp.asarray(q_lo)
+    lo = jnp.zeros(q_hi.shape, jnp.int64)
+    hi = jnp.full(q_hi.shape, n, jnp.int64)
+    nsteps = max(1, n.bit_length())
+
+    def body(_, carry):
+        lo, hi = carry
+        active = lo < hi
+        mid = jnp.minimum((lo + hi) >> 1, n - 1)
+        mh = keys_hi[mid]
+        ml = keys_lo[mid]
+        if side == "left":
+            go_right = (mh < q_hi) | ((mh == q_hi) & (ml < q_lo))
+        else:
+            go_right = (mh < q_hi) | ((mh == q_hi) & (ml <= q_lo))
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, nsteps, body, (lo, hi))
+    return lo
+
+
+def expand_ranges(starts, counts, capacity: int):
+    """Flatten R variable-length index ranges into one fixed-size gather.
+
+    Given per-range start offsets and lengths (the result of searchsorted
+    over the sorted key columns), produce ``capacity`` gather indices that
+    enumerate ``starts[r] + 0..counts[r]-1`` for every range in order, plus
+    a validity mask and the owning range id per slot.  ``capacity`` must be
+    static (>= total count); surplus slots are masked out.  This is the
+    fixed-shape replacement for the KV scan's variable-length result
+    iteration — XLA sees one dense gather.
+    """
+    starts = jnp.asarray(starts, dtype=jnp.int64)
+    counts = jnp.asarray(counts, dtype=jnp.int64)
+    offsets = jnp.cumsum(counts)
+    total = offsets[-1] if counts.shape[0] > 0 else jnp.int64(0)
+    j = jnp.arange(capacity, dtype=jnp.int64)
+    rid = jnp.searchsorted(offsets, j, side="right")
+    rid_c = jnp.minimum(rid, counts.shape[0] - 1)
+    prev = jnp.where(rid_c > 0, offsets[rid_c - 1], 0)
+    idx = starts[rid_c] + (j - prev)
+    valid = j < total
+    return jnp.where(valid, idx, 0), valid, rid_c

@@ -1,0 +1,82 @@
+"""Schema spec parsing (reference: SimpleFeatureTypes spec strings)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.geometry import Point, Polygon
+
+
+def test_parse_basic_spec():
+    sft = parse_spec(
+        "gdelt",
+        "name:String,age:Int,weight:Double,dtg:Date,*geom:Point:srid=4326;"
+        "geomesa.z3.interval=day,geomesa.xz.precision=10",
+    )
+    assert sft.name == "gdelt"
+    assert sft.attribute_names == ["name", "age", "weight", "dtg", "geom"]
+    assert sft.default_geom == "geom"
+    assert sft.dtg_field == "dtg"
+    assert sft.z3_interval == "day"
+    assert sft.xz_precision == 10
+    assert sft.is_points
+    assert sft.attribute("geom").options["srid"] == "4326"
+
+
+def test_default_geom_inferred():
+    sft = parse_spec("t", "a:String,geom:Polygon,dtg:Date")
+    assert sft.default_geom == "geom"
+    assert not sft.is_points
+
+
+def test_spec_roundtrip():
+    spec = "name:String,dtg:Date,*geom:Point;geomesa.z3.interval=week"
+    sft = parse_spec("t", spec)
+    sft2 = parse_spec("t", sft.spec_string())
+    assert sft == sft2
+
+
+def test_indexed_attribute():
+    sft = parse_spec("t", "name:String:index=true,dtg:Date,*geom:Point")
+    assert sft.attribute("name").indexed
+    assert not sft.attribute("dtg").indexed
+
+
+def test_enabled_indices():
+    sft = parse_spec("t", "dtg:Date,*geom:Point;geomesa.indices.enabled='z3,id'")
+    assert sft.enabled_indices == ["z3", "id"]
+
+
+def test_duplicate_names_rejected():
+    with pytest.raises(ValueError):
+        parse_spec("t", "a:Int,a:String")
+
+
+def test_batch_from_dict_points():
+    sft = parse_spec("t", "name:String,dtg:Date,*geom:Point")
+    batch = FeatureBatch.from_dict(
+        sft,
+        {
+            "name": ["a", "b"],
+            "dtg": np.array([1000, 2000], dtype=np.int64),
+            "geom": (np.array([1.0, 2.0]), np.array([3.0, 4.0])),
+        },
+    )
+    assert len(batch) == 2
+    x, y = batch.geom_xy()
+    np.testing.assert_array_equal(x, [1.0, 2.0])
+    np.testing.assert_array_equal(batch.geom_bbox()[:, 1], [3.0, 4.0])
+    sub = batch.take(np.array([1]))
+    assert sub.column("name")[0] == "b"
+    assert len(batch.concat(sub)) == 3
+
+
+def test_batch_from_dict_polygons():
+    sft = parse_spec("t", "name:String,*geom:Polygon")
+    polys = [
+        Polygon([[0, 0], [1, 0], [1, 1]]),
+        Polygon([[5, 5], [6, 5], [6, 6]]),
+    ]
+    batch = FeatureBatch.from_dict(sft, {"name": ["a", "b"], "geom": polys})
+    assert batch.geoms is not None
+    np.testing.assert_allclose(batch.geom_bbox()[1], [5, 5, 6, 6])

@@ -1,0 +1,168 @@
+"""Filter AST / ECQL / extraction / evaluation (reference: geomesa-filter)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.filters import (
+    And, BBox, During, Exclude, In, Include, Intersects, Like, Not, Or,
+    PropertyCompare, evaluate_filter, extract_geometries, extract_intervals,
+    parse_ecql, to_cnf,
+)
+from geomesa_tpu.filters.ecql import parse_iso_ms
+from geomesa_tpu.geometry import Polygon
+
+MS_2018 = 1514764800000
+
+
+def test_parse_bbox_and_during():
+    f = parse_ecql(
+        "BBOX(geom, -10, 35, 15, 52) AND "
+        "dtg DURING 2018-01-01T00:00:00Z/2018-01-08T00:00:00Z"
+    )
+    assert isinstance(f, And)
+    bbox, during = f.filters
+    assert isinstance(bbox, BBox) and bbox.xmin == -10 and bbox.ymax == 52
+    assert isinstance(during, During)
+    assert during.lo_ms == MS_2018
+    assert during.hi_ms == MS_2018 + 7 * 86_400_000
+
+
+def test_parse_iso():
+    assert parse_iso_ms("2018-01-01T00:00:00Z") == MS_2018
+    assert parse_iso_ms("2018-01-01T00:00:00.500Z") == MS_2018 + 500
+
+
+def test_parse_intersects_wkt():
+    f = parse_ecql("INTERSECTS(geom, POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0)))")
+    assert isinstance(f, Intersects)
+    assert f.geometry.envelope.as_tuple() == (0.0, 0.0, 10.0, 10.0)
+
+
+def test_parse_logic_and_comparisons():
+    f = parse_ecql("name = 'alice' OR (age >= 21 AND NOT flag = 'x')")
+    assert isinstance(f, Or)
+    assert isinstance(f.filters[1], And)
+    assert isinstance(f.filters[1].filters[1], Not)
+    f2 = parse_ecql("vessel IN ('a', 'b', 'c')")
+    assert isinstance(f2, In) and f2.values == ("a", "b", "c")
+    f3 = parse_ecql("name LIKE 'foo%'")
+    assert isinstance(f3, Like)
+    assert parse_ecql("INCLUDE") is Include
+    assert parse_ecql("EXCLUDE") is Exclude
+
+
+def test_parse_quoted_escapes():
+    f = parse_ecql("name = 'o''brien'")
+    assert f.value == "o'brien"
+
+
+def test_cnf():
+    a = PropertyCompare("a", "=", 1)
+    b = PropertyCompare("b", "=", 2)
+    c = PropertyCompare("c", "=", 3)
+    f = Or((And((a, b)), c))
+    cnf = to_cnf(f)
+    assert isinstance(cnf, And)
+    for clause in cnf.filters:
+        assert isinstance(clause, Or)
+    # not-pushdown: ¬(a ∧ b) → ¬a ∨ ¬b
+    cnf2 = to_cnf(Not(And((a, b))))
+    assert isinstance(cnf2, Or)
+    assert all(isinstance(p, Not) for p in cnf2.filters)
+
+
+def test_extract_geometries_and():
+    f = parse_ecql("BBOX(geom, 0, 0, 10, 10) AND BBOX(geom, 5, 5, 20, 20)")
+    vals = extract_geometries(f, "geom")
+    assert len(vals.values) == 1
+    assert vals.values[0].envelope.as_tuple() == (5.0, 5.0, 10.0, 10.0)
+    # disjoint AND
+    f2 = parse_ecql("BBOX(geom, 0, 0, 1, 1) AND BBOX(geom, 5, 5, 6, 6)")
+    assert extract_geometries(f2, "geom").disjoint
+
+
+def test_extract_geometries_or():
+    f = parse_ecql("BBOX(geom, 0, 0, 1, 1) OR BBOX(geom, 5, 5, 6, 6)")
+    vals = extract_geometries(f, "geom")
+    assert len(vals.values) == 2
+    # OR with an unconstrained branch → unconstrained
+    f2 = parse_ecql("BBOX(geom, 0, 0, 1, 1) OR name = 'x'")
+    assert not extract_geometries(f2, "geom").values
+
+
+def test_extract_intervals():
+    f = parse_ecql(
+        "dtg DURING 2018-01-01T00:00:00Z/2018-01-08T00:00:00Z AND dtg AFTER 2018-01-03T00:00:00Z"
+    )
+    vals = extract_intervals(f, "dtg")
+    assert len(vals.values) == 1
+    lo, hi = vals.values[0]
+    assert lo == parse_iso_ms("2018-01-03T00:00:00Z") + 1
+    assert hi == parse_iso_ms("2018-01-08T00:00:00Z")
+    # disjoint
+    f2 = parse_ecql(
+        "dtg BEFORE 2018-01-01T00:00:00Z AND dtg AFTER 2018-02-01T00:00:00Z")
+    assert extract_intervals(f2, "dtg").disjoint
+
+
+@pytest.fixture
+def batch():
+    sft = parse_spec("t", "name:String,age:Int,dtg:Date,*geom:Point")
+    return FeatureBatch.from_dict(
+        sft,
+        {
+            "name": ["alice", "bob", "carol", "dave"],
+            "age": [30, 17, 25, 40],
+            "dtg": np.array([MS_2018, MS_2018 + 1000, MS_2018 + 2000, MS_2018 + 3000]),
+            "geom": (np.array([0.0, 5.0, 20.0, 5.0]), np.array([0.0, 5.0, 20.0, 6.0])),
+        },
+    )
+
+
+def test_evaluate_bbox(batch):
+    mask = evaluate_filter(parse_ecql("BBOX(geom, -1, -1, 10, 10)"), batch)
+    np.testing.assert_array_equal(mask, [True, True, False, True])
+
+
+def test_evaluate_intersects_polygon(batch):
+    f = parse_ecql("INTERSECTS(geom, POLYGON ((4 4, 6 4, 6 7, 4 7, 4 4)))")
+    np.testing.assert_array_equal(evaluate_filter(f, batch),
+                                  [False, True, False, True])
+
+
+def test_evaluate_compound(batch):
+    f = parse_ecql("age >= 21 AND BBOX(geom, -1, -1, 10, 10) AND name <> 'dave'")
+    np.testing.assert_array_equal(evaluate_filter(f, batch),
+                                  [True, False, False, False])
+
+
+def test_evaluate_during(batch):
+    f = parse_ecql(
+        "dtg DURING 2018-01-01T00:00:01Z/2018-01-01T00:00:02Z")
+    np.testing.assert_array_equal(evaluate_filter(f, batch),
+                                  [False, True, True, False])
+
+
+def test_evaluate_in_like_not(batch):
+    np.testing.assert_array_equal(
+        evaluate_filter(parse_ecql("name IN ('alice', 'dave')"), batch),
+        [True, False, False, True])
+    np.testing.assert_array_equal(
+        evaluate_filter(parse_ecql("name LIKE 'a%'"), batch),
+        [True, False, False, False])
+    np.testing.assert_array_equal(
+        evaluate_filter(parse_ecql("NOT name = 'bob'"), batch),
+        [True, False, True, True])
+
+
+def test_evaluate_polygon_batch():
+    sft = parse_spec("t", "*geom:Polygon")
+    polys = [
+        Polygon([[0, 0], [2, 0], [2, 2], [0, 2]]),
+        Polygon([[10, 10], [12, 10], [12, 12], [10, 12]]),
+        Polygon([[1, 1], [3, 1], [3, 3], [1, 3]]),
+    ]
+    batch = FeatureBatch.from_dict(sft, {"geom": polys})
+    f = parse_ecql("INTERSECTS(geom, POLYGON ((1.5 1.5, 5 1.5, 5 5, 1.5 5, 1.5 1.5)))")
+    np.testing.assert_array_equal(evaluate_filter(f, batch), [True, False, True])

@@ -1,0 +1,163 @@
+"""Geometry model: WKT roundtrips, packing, and predicate correctness."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.geometry import (
+    Envelope,
+    LineString,
+    MultiLineString,
+    MultiPoint,
+    MultiPolygon,
+    Point,
+    Polygon,
+    geometry_from_wkt,
+    geometry_intersects,
+    geometry_to_wkt,
+    pack_geometries,
+    point_in_polygon,
+    points_in_packed_polygon,
+    segments_intersect,
+)
+
+SQUARE = Polygon([[0, 0], [10, 0], [10, 10], [0, 10]])
+DONUT = Polygon([[0, 0], [10, 0], [10, 10], [0, 10]],
+                holes=[[[3, 3], [7, 3], [7, 7], [3, 7]]])
+
+
+def test_wkt_roundtrip():
+    cases = [
+        "POINT (30 10)",
+        "LINESTRING (30 10, 10 30, 40 40)",
+        "POLYGON ((30 10, 40 40, 20 40, 10 20, 30 10))",
+        "POLYGON ((35 10, 45 45, 15 40, 10 20, 35 10), (20 30, 35 35, 30 20, 20 30))",
+        "MULTIPOINT ((10 40), (40 30), (20 20), (30 10))",
+        "MULTILINESTRING ((10 10, 20 20, 10 40), (40 40, 30 30, 40 20, 30 10))",
+        "MULTIPOLYGON (((30 20, 45 40, 10 40, 30 20)), ((15 5, 40 10, 10 20, 5 10, 15 5)))",
+    ]
+    for wkt in cases:
+        g = geometry_from_wkt(wkt)
+        g2 = geometry_from_wkt(geometry_to_wkt(g))
+        assert g.envelope == g2.envelope
+        assert g.geom_type == g2.geom_type
+
+
+def test_envelope_ops():
+    a = Envelope(0, 0, 10, 10)
+    b = Envelope(5, 5, 15, 15)
+    assert a.intersects(b)
+    assert a.intersection(b) == Envelope(5, 5, 10, 10)
+    assert not a.intersects(Envelope(11, 11, 12, 12))
+    assert a.expand(b) == Envelope(0, 0, 15, 15)
+    assert Envelope.WHOLE_WORLD.contains(a)
+
+
+def test_point_in_square():
+    px = np.array([5.0, -1.0, 10.0, 0.0, 15.0])
+    py = np.array([5.0, 5.0, 5.0, 0.0, 5.0])
+    got = point_in_polygon(px, py, SQUARE)
+    # boundary points count as inside (JTS intersects semantics)
+    np.testing.assert_array_equal(got, [True, False, True, True, False])
+
+
+def test_point_in_donut():
+    px = np.array([1.0, 5.0, 3.0, 8.0])
+    py = np.array([1.0, 5.0, 3.0, 8.0])
+    got = point_in_polygon(px, py, DONUT)
+    # (5,5) is inside the hole → outside; (3,3) is on the hole boundary →
+    # boundary of the polygon → inside
+    np.testing.assert_array_equal(got, [True, False, True, True])
+
+
+def test_point_in_polygon_random_vs_matplotlib_style(rng):
+    # independent oracle: winding number via angle sum (slow but different)
+    poly = Polygon([[0, 0], [4, 0], [4, 1], [1, 1], [1, 3], [4, 3], [4, 4], [0, 4]])
+    px = rng.uniform(-1, 5, 500)
+    py = rng.uniform(-1, 5, 500)
+    got = point_in_polygon(px, py, poly, include_boundary=False)
+    shell = poly.shell
+    for i in range(0, 500, 17):
+        x, y = px[i], py[i]
+        # ray casting scalar oracle
+        inside = False
+        for j in range(len(shell) - 1):
+            x1, y1 = shell[j]
+            x2, y2 = shell[j + 1]
+            if (y1 > y) != (y2 > y) and x < x1 + (y - y1) / (y2 - y1) * (x2 - x1):
+                inside = not inside
+        assert bool(got[i]) == inside, (x, y)
+
+
+def test_multipolygon_containment():
+    mp = MultiPolygon((
+        Polygon([[0, 0], [2, 0], [2, 2], [0, 2]]),
+        Polygon([[5, 5], [7, 5], [7, 7], [5, 7]]),
+    ))
+    px = np.array([1.0, 6.0, 3.5])
+    py = np.array([1.0, 6.0, 3.5])
+    np.testing.assert_array_equal(point_in_polygon(px, py, mp), [True, True, False])
+
+
+def test_segments_intersect():
+    a1 = np.array([[0.0, 0.0], [0.0, 0.0], [0.0, 0.0]])
+    a2 = np.array([[10.0, 10.0], [1.0, 0.0], [0.0, 1.0]])
+    b1 = np.array([[0.0, 10.0], [5.0, 5.0]])
+    b2 = np.array([[10.0, 0.0], [6.0, 6.0]])
+    got = segments_intersect(a1, a2, b1, b2)
+    assert got[0, 0]          # X crossing
+    assert not got[1, 0]      # far apart
+    assert not got[2, 1]
+    # touching endpoint counts
+    t = segments_intersect(np.array([[0.0, 0.0]]), np.array([[1.0, 1.0]]),
+                           np.array([[1.0, 1.0]]), np.array([[2.0, 0.0]]))
+    assert t[0, 0]
+
+
+def test_geometry_intersects_dispatch():
+    sq = SQUARE
+    assert geometry_intersects(Point(5, 5), sq)
+    assert not geometry_intersects(Point(50, 50), sq)
+    assert geometry_intersects(LineString([[-5, 5], [15, 5]]), sq)   # crosses
+    assert geometry_intersects(LineString([[-5, -5], [5, 15]]), sq)  # crosses
+    assert not geometry_intersects(LineString([[-5, -5], [-1, 15]]), sq)
+    other = Polygon([[8, 8], [12, 8], [12, 12], [8, 12]])
+    assert geometry_intersects(sq, other)
+    assert geometry_intersects(other, sq)
+    disjoint = Polygon([[20, 20], [30, 20], [30, 30], [20, 30]])
+    assert not geometry_intersects(sq, disjoint)
+    # polygon fully inside the other
+    inner = Polygon([[4, 4], [6, 4], [6, 6], [4, 6]])
+    assert geometry_intersects(sq, inner)
+    assert geometry_intersects(inner, sq)
+    # polygon inside a donut hole does NOT intersect
+    hole_dweller = Polygon([[4, 4], [6, 4], [6, 6], [4, 6]])
+    assert not geometry_intersects(DONUT, hole_dweller)
+
+
+def test_pack_roundtrip():
+    geoms = [
+        Point(1, 2),
+        LineString([[0, 0], [1, 1], [2, 0]]),
+        DONUT,
+        MultiPolygon((Polygon([[0, 0], [1, 0], [1, 1]]),
+                      Polygon([[5, 5], [6, 5], [6, 6]]))),
+        MultiPoint([[1, 1], [2, 2]]),
+        MultiLineString((LineString([[0, 0], [1, 0]]), LineString([[2, 2], [3, 3]]))),
+    ]
+    packed = pack_geometries(geoms)
+    assert len(packed) == len(geoms)
+    for i, g in enumerate(geoms):
+        back = packed.geometry(i)
+        assert back.geom_type == g.geom_type
+        assert back.envelope == g.envelope
+    np.testing.assert_allclose(packed.bbox[0], [1, 2, 1, 2])
+
+
+def test_packed_point_in_polygon():
+    packed = pack_geometries([SQUARE, DONUT])
+    px = np.array([5.0, 5.0])
+    py = np.array([5.0, 1.0])
+    np.testing.assert_array_equal(points_in_packed_polygon(px, py, packed, 0),
+                                  [True, True])
+    np.testing.assert_array_equal(points_in_packed_polygon(px, py, packed, 1),
+                                  [False, True])

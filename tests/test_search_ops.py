@@ -1,0 +1,62 @@
+"""searchsorted2 / expand_ranges kernels vs numpy equivalents."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.ops import expand_ranges, searchsorted2
+
+
+def ref_searchsorted2(hi, lo, qh, ql, side):
+    # composite via python tuples
+    keys = list(zip(hi.tolist(), lo.tolist()))
+    out = []
+    import bisect
+    for q in zip(qh.tolist(), ql.tolist()):
+        fn = bisect.bisect_left if side == "left" else bisect.bisect_right
+        out.append(fn(keys, q))
+    return np.array(out)
+
+
+def test_searchsorted2_matches_bisect(rng):
+    n = 5000
+    hi = np.sort(rng.integers(0, 50, n))
+    lo = rng.integers(0, 1 << 40, n)
+    # sort lexicographically
+    order = np.lexsort((lo, hi))
+    hi, lo = hi[order], lo[order]
+    qh = rng.integers(-1, 52, 200)
+    ql = rng.integers(0, 1 << 40, 200)
+    for side in ("left", "right"):
+        got = np.asarray(searchsorted2(jnp.asarray(hi), jnp.asarray(lo),
+                                       jnp.asarray(qh), jnp.asarray(ql), side=side))
+        np.testing.assert_array_equal(got, ref_searchsorted2(hi, lo, qh, ql, side))
+
+
+def test_searchsorted2_empty_and_single():
+    hi = jnp.asarray(np.array([5], dtype=np.int64))
+    lo = jnp.asarray(np.array([7], dtype=np.int64))
+    q = jnp.asarray(np.array([4, 5, 6], dtype=np.int64))
+    ql = jnp.asarray(np.array([9, 7, 0], dtype=np.int64))
+    got = np.asarray(searchsorted2(hi, lo, q, ql, side="left"))
+    np.testing.assert_array_equal(got, [0, 0, 1])
+    got_r = np.asarray(searchsorted2(hi, lo, q, ql, side="right"))
+    np.testing.assert_array_equal(got_r, [0, 1, 1])
+
+
+def test_expand_ranges_basic():
+    starts = jnp.asarray(np.array([10, 100, 1000]))
+    counts = jnp.asarray(np.array([3, 0, 2]))
+    idx, valid, rid = expand_ranges(starts, counts, capacity=8)
+    np.testing.assert_array_equal(np.asarray(idx)[np.asarray(valid)],
+                                  [10, 11, 12, 1000, 1001])
+    np.testing.assert_array_equal(np.asarray(rid)[np.asarray(valid)],
+                                  [0, 0, 0, 2, 2])
+    assert int(np.asarray(valid).sum()) == 5
+
+
+def test_expand_ranges_exact_capacity():
+    starts = jnp.asarray(np.array([0, 5]))
+    counts = jnp.asarray(np.array([2, 2]))
+    idx, valid, _ = expand_ranges(starts, counts, capacity=4)
+    assert np.asarray(valid).all()
+    np.testing.assert_array_equal(np.asarray(idx), [0, 1, 5, 6])

@@ -1,0 +1,181 @@
+"""XZ2 curve vs a pure-python descent oracle + the reference's
+containment/disjoint query cases (XZ2SFCTest.scala)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve.xz2 import XZ2SFC, xz2_sfc
+
+G = 12
+
+
+def py_index(sfc: XZ2SFC, xmin, ymin, xmax, ymax):
+    """Direct double-precision descent, following the paper definitions."""
+    g = sfc.g
+    xs, ys = sfc.x_hi - sfc.x_lo, sfc.y_hi - sfc.y_lo
+    nxmin = (xmin - sfc.x_lo) / xs
+    nymin = (ymin - sfc.y_lo) / ys
+    nxmax = (xmax - sfc.x_lo) / xs
+    nymax = (ymax - sfc.y_lo) / ys
+    max_dim = max(nxmax - nxmin, nymax - nymin)
+    if max_dim <= 0.0:
+        l1 = g
+    else:
+        l1 = int(math.floor(math.log(max_dim) / math.log(0.5)))
+    if l1 >= g:
+        length = g
+    else:
+        w2 = 0.5 ** (l1 + 1)
+        fits = lambda mn, mx: mx <= math.floor(mn / w2) * w2 + 2 * w2
+        length = l1 + 1 if fits(nxmin, nxmax) and fits(nymin, nymax) else l1
+    x, y = nxmin, nymin
+    lo_x, lo_y, hi_x, hi_y = 0.0, 0.0, 1.0, 1.0
+    cs = 0
+    for i in range(length):
+        xc, yc = (lo_x + hi_x) / 2, (lo_y + hi_y) / 2
+        q = (0 if x < xc else 1) + (0 if y < yc else 2)
+        cs += 1 + q * (4 ** (g - i) - 1) // 3
+        if x < xc:
+            hi_x = xc
+        else:
+            lo_x = xc
+        if y < yc:
+            hi_y = yc
+        else:
+            lo_y = yc
+    return cs
+
+
+@pytest.fixture(scope="module")
+def sfc():
+    return xz2_sfc(G)
+
+
+def test_index_matches_oracle(sfc, rng):
+    for _ in range(300):
+        x0, x1 = np.sort(rng.uniform(-180, 180, 2))
+        y0, y1 = np.sort(rng.uniform(-90, 90, 2))
+        got = int(sfc.index(x0, y0, x1, y1, xp=np))
+        assert got == py_index(sfc, x0, y0, x1, y1), (x0, y0, x1, y1)
+
+
+def test_point_index_matches_oracle(sfc, rng):
+    for _ in range(100):
+        x = rng.uniform(-180, 180)
+        y = rng.uniform(-90, 90)
+        assert int(sfc.index(x, y, x, y, xp=np)) == py_index(sfc, x, y, x, y)
+
+
+def test_extremes(sfc):
+    # whole world: l1=0 but the l1+1 refinement fits (a 1x1 object spans
+    # two 0.5-cells on each axis), so length=1 and the code is 1 — matches
+    # the reference's own formula
+    assert int(sfc.index(-180.0, -90.0, 180.0, 90.0, xp=np)) == py_index(
+        sfc, -180.0, -90.0, 180.0, 90.0) == 1
+    # corners
+    assert int(sfc.index(-180.0, -90.0, -180.0, -90.0, xp=np)) == py_index(
+        sfc, -180.0, -90.0, -180.0, -90.0)
+    assert int(sfc.index(180.0, 90.0, 180.0, 90.0, xp=np)) == py_index(
+        sfc, 180.0, 90.0, 180.0, 90.0)
+
+
+def _code_in_ranges(code, ranges):
+    return any(lo <= code <= hi for lo, hi in ranges)
+
+
+def test_reference_polygon_query_cases(sfc):
+    # mirror of XZ2SFCTest "index polygons and query them"
+    poly = int(sfc.index(10.0, 10.0, 12.0, 12.0, xp=np))
+    matching = [
+        (9.0, 9.0, 13.0, 13.0),
+        (-180.0, -90.0, 180.0, 90.0),
+        (0.0, 0.0, 180.0, 90.0),
+        (0.0, 0.0, 20.0, 20.0),
+        (11.0, 11.0, 13.0, 13.0),
+        (9.0, 9.0, 11.0, 11.0),
+        (10.5, 10.5, 11.5, 11.5),
+        (11.0, 11.0, 11.0, 11.0),
+    ]
+    disjoint = [
+        (-180.0, -90.0, 8.0, 8.0),
+        (0.0, 0.0, 8.0, 8.0),
+        (9.0, 9.0, 9.5, 9.5),
+        (20.0, 20.0, 180.0, 90.0),
+    ]
+    for w in matching:
+        assert _code_in_ranges(poly, sfc.ranges([w])), w
+    for w in disjoint:
+        assert not _code_in_ranges(poly, sfc.ranges([w])), w
+
+
+def test_reference_point_query_cases(sfc):
+    poly = int(sfc.index(11.0, 11.0, 11.0, 11.0, xp=np))
+    matching = [
+        (9.0, 9.0, 13.0, 13.0),
+        (-180.0, -90.0, 180.0, 90.0),
+        (0.0, 0.0, 180.0, 90.0),
+        (0.0, 0.0, 20.0, 20.0),
+        (11.0, 11.0, 13.0, 13.0),
+        (9.0, 9.0, 11.0, 11.0),
+        (10.5, 10.5, 11.5, 11.5),
+        (11.0, 11.0, 11.0, 11.0),
+    ]
+    disjoint = [
+        (-180.0, -90.0, 8.0, 8.0),
+        (0.0, 0.0, 8.0, 8.0),
+        (9.0, 9.0, 9.5, 9.5),
+        (12.5, 12.5, 13.5, 13.5),
+        (20.0, 20.0, 180.0, 90.0),
+    ]
+    for w in matching:
+        assert _code_in_ranges(poly, sfc.ranges([w])), w
+    for w in disjoint:
+        assert not _code_in_ranges(poly, sfc.ranges([w])), w
+
+
+def test_ranges_cover_all_intersecting_objects(sfc, rng):
+    """The core correctness invariant: any object bbox intersecting the
+    query window must have its sequence code inside the covering ranges."""
+    n = 2000
+    cx = rng.uniform(-170, 170, n)
+    cy = rng.uniform(-80, 80, n)
+    w = rng.exponential(1.0, n).clip(0, 30)
+    h = rng.exponential(1.0, n).clip(0, 30)
+    xmin, xmax = cx - w / 2, cx + w / 2
+    ymin, ymax = cy - h / 2, cy + h / 2
+    xmin, xmax = xmin.clip(-180, 180), xmax.clip(-180, 180)
+    ymin, ymax = ymin.clip(-90, 90), ymax.clip(-90, 90)
+    codes = sfc.index(xmin, ymin, xmax, ymax, xp=np)
+    for window in [(-10.0, -10.0, 10.0, 10.0), (50.0, 20.0, 51.0, 21.0),
+                   (-180.0, -90.0, -100.0, 0.0)]:
+        ranges = sfc.ranges([window])
+        intersects = (
+            (xmax >= window[0]) & (xmin <= window[2])
+            & (ymax >= window[1]) & (ymin <= window[3])
+        )
+        in_ranges = np.zeros(n, dtype=bool)
+        for lo, hi in ranges:
+            in_ranges |= (codes >= lo) & (codes <= hi)
+        missed = np.flatnonzero(intersects & ~in_ranges)
+        assert missed.size == 0, (window, missed[:5])
+
+
+def test_budget_produces_superset(sfc, rng):
+    window = (-10.0, -10.0, 40.0, 30.0)
+    exact = sfc.ranges([window], max_ranges=10**9)
+    tight = sfc.ranges([window], max_ranges=30)
+    assert len(tight) < len(exact)
+    # every code covered by exact must be covered by tight
+    n = 1000
+    x = rng.uniform(-15, 45, n)
+    y = rng.uniform(-15, 35, n)
+    codes = sfc.index(x, y, x + 0.1, y + 0.1, xp=np)
+    cov_exact = np.zeros(n, bool)
+    for lo, hi in exact:
+        cov_exact |= (codes >= lo) & (codes <= hi)
+    cov_tight = np.zeros(n, bool)
+    for lo, hi in tight:
+        cov_tight |= (codes >= lo) & (codes <= hi)
+    assert (cov_exact <= cov_tight).all()

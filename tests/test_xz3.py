@@ -1,0 +1,102 @@
+"""XZ3 curve vs a pure-python octree-descent oracle (reference: XZ3SFC.scala)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import TimePeriod, max_offset
+from geomesa_tpu.curve.xz3 import XZ3SFC, xz3_sfc
+
+G = 12
+WEEK = float(max_offset(TimePeriod.WEEK))
+
+
+def py_index(sfc: XZ3SFC, xmin, ymin, zmin, xmax, ymax, zmax):
+    g = sfc.g
+    xs, ys, zs = sfc.x_hi - sfc.x_lo, sfc.y_hi - sfc.y_lo, sfc.z_hi - sfc.z_lo
+    nxmin, nymin, nzmin = (xmin - sfc.x_lo) / xs, (ymin - sfc.y_lo) / ys, (zmin - sfc.z_lo) / zs
+    nxmax, nymax, nzmax = (xmax - sfc.x_lo) / xs, (ymax - sfc.y_lo) / ys, (zmax - sfc.z_lo) / zs
+    max_dim = max(nxmax - nxmin, nymax - nymin, nzmax - nzmin)
+    l1 = g if max_dim <= 0 else int(math.floor(math.log(max_dim) / math.log(0.5)))
+    if l1 >= g:
+        length = g
+    else:
+        w2 = 0.5 ** (l1 + 1)
+        fits = lambda mn, mx: mx <= math.floor(mn / w2) * w2 + 2 * w2
+        length = (
+            l1 + 1
+            if fits(nxmin, nxmax) and fits(nymin, nymax) and fits(nzmin, nzmax)
+            else l1
+        )
+    x, y, z = nxmin, nymin, nzmin
+    b = [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
+    cs = 0
+    for i in range(length):
+        xc, yc, zc = (b[0] + b[3]) / 2, (b[1] + b[4]) / 2, (b[2] + b[5]) / 2
+        q = (0 if x < xc else 1) + (0 if y < yc else 2) + (0 if z < zc else 4)
+        cs += 1 + q * (8 ** (g - i) - 1) // 7
+        if x < xc: b[3] = xc
+        else: b[0] = xc
+        if y < yc: b[4] = yc
+        else: b[1] = yc
+        if z < zc: b[5] = zc
+        else: b[2] = zc
+    return cs
+
+
+@pytest.fixture(scope="module")
+def sfc():
+    return xz3_sfc(TimePeriod.WEEK, G)
+
+
+def test_index_matches_oracle(sfc, rng):
+    for _ in range(200):
+        x0, x1 = np.sort(rng.uniform(-180, 180, 2))
+        y0, y1 = np.sort(rng.uniform(-90, 90, 2))
+        z0, z1 = np.sort(rng.uniform(0, WEEK, 2))
+        got = int(sfc.index(x0, y0, z0, x1, y1, z1, xp=np))
+        assert got == py_index(sfc, x0, y0, z0, x1, y1, z1)
+
+
+def test_point_geometries(sfc, rng):
+    for _ in range(100):
+        x = rng.uniform(-180, 180)
+        y = rng.uniform(-90, 90)
+        z = rng.uniform(0, WEEK)
+        assert int(sfc.index(x, y, z, x, y, z, xp=np)) == py_index(sfc, x, y, z, x, y, z)
+
+
+def test_ranges_cover_all_intersecting_objects(sfc, rng):
+    n = 1500
+    cx = rng.uniform(-170, 170, n)
+    cy = rng.uniform(-80, 80, n)
+    ct = rng.uniform(0, WEEK, n)
+    w = rng.exponential(1.0, n).clip(0, 20)
+    h = rng.exponential(1.0, n).clip(0, 20)
+    d = rng.exponential(3600.0, n).clip(0, WEEK / 10)
+    xmin, xmax = (cx - w / 2).clip(-180, 180), (cx + w / 2).clip(-180, 180)
+    ymin, ymax = (cy - h / 2).clip(-90, 90), (cy + h / 2).clip(-90, 90)
+    zmin, zmax = (ct - d / 2).clip(0, WEEK), (ct + d / 2).clip(0, WEEK)
+    codes = sfc.index(xmin, ymin, zmin, xmax, ymax, zmax, xp=np)
+    for window in [
+        (-10.0, -10.0, 0.0, 10.0, 10.0, WEEK / 4),
+        (30.0, 20.0, WEEK / 2, 60.0, 50.0, WEEK),
+    ]:
+        ranges = sfc.ranges([window])
+        intersects = (
+            (xmax >= window[0]) & (xmin <= window[3])
+            & (ymax >= window[1]) & (ymin <= window[4])
+            & (zmax >= window[2]) & (zmin <= window[5])
+        )
+        in_ranges = np.zeros(n, dtype=bool)
+        for lo, hi in ranges:
+            in_ranges |= (codes >= lo) & (codes <= hi)
+        assert not np.any(intersects & ~in_ranges)
+
+
+def test_budget(sfc):
+    window = (-40.0, -20.0, 0.0, 40.0, 20.0, WEEK)
+    exact = sfc.ranges([window], max_ranges=10**8)
+    tight = sfc.ranges([window], max_ranges=25)
+    assert len(tight) < len(exact)

@@ -1,0 +1,78 @@
+"""Z2 index hit-set equality vs brute-force oracle (incl. multi-bbox OR —
+BASELINE config 2 shape)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.index import Z2PointIndex
+
+
+def oracle(x, y, boxes):
+    boxes = np.atleast_2d(boxes)
+    m = np.zeros(len(x), dtype=bool)
+    for b in boxes:
+        m |= (x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+    return np.flatnonzero(m)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(17)
+    n = 300_000
+    # clustered + uniform mix, world-wide
+    xu = rng.uniform(-180, 180, n // 2)
+    yu = rng.uniform(-90, 90, n // 2)
+    xc = rng.normal(2.35, 0.5, n // 2).clip(-180, 180)   # Paris cluster
+    yc = rng.normal(48.85, 0.5, n // 2).clip(-90, 90)
+    return np.concatenate([xu, xc]), np.concatenate([yu, yc])
+
+
+@pytest.fixture(scope="module")
+def index(dataset):
+    return Z2PointIndex.build(*dataset)
+
+
+def test_single_bbox(index, dataset):
+    x, y = dataset
+    box = (2.0, 48.5, 2.7, 49.1)
+    np.testing.assert_array_equal(index.query([box]), oracle(x, y, box))
+
+
+def test_multi_bbox_or(index, dataset):
+    x, y = dataset
+    boxes = [(2.0, 48.5, 2.7, 49.1), (-123.3, 37.2, -121.7, 38.1),
+             (139.0, 35.0, 140.5, 36.2)]
+    np.testing.assert_array_equal(index.query(boxes), oracle(x, y, boxes))
+
+
+def test_overlapping_boxes_no_duplicates(index, dataset):
+    x, y = dataset
+    boxes = [(2.0, 48.5, 2.7, 49.1), (2.3, 48.7, 3.0, 49.3)]
+    got = index.query(boxes)
+    assert len(got) == len(np.unique(got))
+    np.testing.assert_array_equal(got, oracle(x, y, boxes))
+
+
+def test_world_query(index, dataset):
+    x, y = dataset
+    got = index.query([(-180.0, -90.0, 180.0, 90.0)])
+    np.testing.assert_array_equal(got, np.arange(len(x)))
+
+
+def test_empty(index):
+    # box with no data (mid-pacific sliver)
+    got = index.query([(-179.99, -0.001, -179.98, 0.001)])
+    assert isinstance(got, np.ndarray)
+
+
+def test_antimeridian_edges(index, dataset):
+    x, y = dataset
+    for box in [(-180.0, -90.0, -179.0, 90.0), (179.0, -90.0, 180.0, 90.0)]:
+        np.testing.assert_array_equal(index.query([box]), oracle(x, y, box))
+
+
+def test_budget_exactness(index, dataset):
+    x, y = dataset
+    box = (0.0, 40.0, 25.0, 55.0)
+    np.testing.assert_array_equal(index.query([box], max_ranges=8),
+                                  oracle(x, y, box))
