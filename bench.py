@@ -1,0 +1,92 @@
+"""Benchmark: Z3 ingest key generation + bbox+time scan (BASELINE config 1).
+
+Measures the framework's hot paths on one chip, GDELT-shaped synthetic
+data:
+
+* **ingest**: vectorized Z3 SFC encode + device key sort, keys/sec/chip
+  (the reference's write-path hot loop, Z3IndexKeySpace.toIndexKey —
+  per-feature JVM code it claims >10k records/sec/node for;
+  docs/user/introduction.rst:26).
+* **scan**: bbox+week query over the built index — plan (host range
+  decomposition) + device seeks + fused candidate filter — reported as
+  features-matched/sec.
+
+Prints ONE JSON line with the primary metric (ingest keys/sec/chip);
+vs_baseline is the ratio to the reference's 10k records/sec/node claim.
+"""
+
+import json
+import time
+
+import numpy as np
+
+N = 4_000_000
+MS_2018 = 1514764800000
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import geomesa_tpu  # noqa: F401  (enables x64)
+    from geomesa_tpu.curve import TimePeriod, to_binned_time, z3_sfc
+    from geomesa_tpu.index import Z3PointIndex
+
+    rng = np.random.default_rng(42)
+    # GDELT-shaped: world-wide events over two weeks
+    x = rng.uniform(-180.0, 180.0, N)
+    y = rng.uniform(-56.0, 72.0, N)
+    t = rng.integers(MS_2018, MS_2018 + 14 * 86_400_000, N)
+
+    sfc = z3_sfc(TimePeriod.WEEK)
+    bins, offs = to_binned_time(t, TimePeriod.WEEK)
+
+    xd = jax.device_put(jnp.asarray(x))
+    yd = jax.device_put(jnp.asarray(y))
+    od = jax.device_put(jnp.asarray(offs.astype(np.float64)))
+    bd = jax.device_put(jnp.asarray(bins.astype(np.int32)))
+
+    @jax.jit
+    def ingest(xs, ys, os_, bs):
+        z = sfc.index(xs, ys, os_)
+        order = jnp.lexsort((z, bs))
+        return bs[order], z[order], order.astype(jnp.int32)
+
+    # warmup/compile
+    out = ingest(xd, yd, od, bd)
+    jax.block_until_ready(out)
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = ingest(xd, yd, od, bd)
+    jax.block_until_ready(out)
+    ingest_rate = iters * N / (time.perf_counter() - t0)
+
+    # scan: selective bbox + 5-day window
+    index = Z3PointIndex.build(x, y, t, period=TimePeriod.WEEK)
+    box = (-80.0, 30.0, -60.0, 50.0)
+    tlo, thi = MS_2018 + 2 * 86_400_000, MS_2018 + 7 * 86_400_000
+    hits = index.query([box], tlo, thi)  # warm (compiles both phases)
+    t0 = time.perf_counter()
+    q_iters = 10
+    for _ in range(q_iters):
+        hits = index.query([box], tlo, thi)
+    scan_rate = q_iters * len(hits) / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "z3_ingest_keys_per_sec_per_chip",
+        "value": round(ingest_rate),
+        "unit": "keys/sec",
+        "vs_baseline": round(ingest_rate / 10_000.0, 2),
+        "extra": {
+            "n_points": N,
+            "bbox_time_scan_features_per_sec": round(scan_rate),
+            "scan_hits": int(len(hits)),
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

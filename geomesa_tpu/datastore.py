@@ -1,0 +1,282 @@
+"""TpuDataStore: the user-facing store facade.
+
+The analog of the reference's GeoMesaDataStore / MetadataBackedDataStore
+(geomesa-index-api/.../index/geotools/GeoMesaDataStore.scala:48-431;
+createSchema at MetadataBackedDataStore.scala:121): schema lifecycle,
+ingest, query, stats and explain — but over device/host-resident columnar
+storage instead of a distributed KV store.
+
+Index maintenance model: writes append to the schema's column store and
+mark indexes dirty; indexes (device sort for Z2/Z3, host sorts for
+XZ/attr/id) rebuild lazily on the next query.  This is the bulk-ingest
+pattern the reference optimizes for (BatchWriter + periodic compaction),
+without the KV store's per-row write amplification.  Stats are observed on
+write (the reference's StatsCombiner role) and serialized to the metadata
+catalog (metadata/GeoMesaMetadata.scala analog, JSON on disk).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .features.batch import FeatureBatch
+from .features.feature_type import FeatureType, parse_spec
+from .filters.ast import Filter
+from .index.attribute import AttributeIndex
+from .index.id import IdIndex
+from .index.xz2 import XZ2Index
+from .index.xz3 import XZ3Index
+from .index.z2 import Z2PointIndex
+from .index.z3 import Z3PointIndex
+from .planning.explain import Explainer, ExplainNull
+from .planning.planner import Query, QueryPlanner, QueryResult
+from .stats.stat import (
+    CountStat, EnumerationStat, Histogram, MinMax, Stat, TopK, stat_from_json,
+)
+
+__all__ = ["TpuDataStore"]
+
+
+class _SchemaStore:
+    """Per-schema storage: the column batch + lazily-built indexes + stats."""
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+        self.batch: FeatureBatch | None = None
+        self._dirty = True
+        self._indexes: dict = {}
+        self._stats: dict[str, Stat] = {}
+        self._init_stats()
+
+    def _init_stats(self):
+        sft = self.sft
+        self._stats["count"] = CountStat()
+        if sft.dtg_field:
+            self._stats["dtg_minmax"] = MinMax(sft.dtg_field)
+        for a in sft.attributes:
+            if a.is_geometry or a.name == sft.dtg_field:
+                continue
+            if a.type in ("int", "long", "float", "double"):
+                self._stats[f"{a.name}_minmax"] = MinMax(a.name)
+            elif a.type == "string" and a.indexed:
+                self._stats[f"{a.name}_topk"] = TopK(a.name)
+                self._stats[f"{a.name}_enumeration"] = EnumerationStat(a.name)
+
+    def write(self, batch: FeatureBatch):
+        if self.batch is None:
+            self.batch = batch
+        else:
+            self.batch = self.batch.concat(batch)
+        for s in self._stats.values():
+            s.observe(batch)
+        self._dirty = True
+
+    def stats_map(self) -> dict:
+        return self._stats
+
+    def _rebuild_if_dirty(self):
+        if self._dirty:
+            self._indexes.clear()
+            self._dirty = False
+
+    # -- lazily-built indexes --------------------------------------------
+    def z3_index(self) -> Z3PointIndex:
+        self._rebuild_if_dirty()
+        if "z3" not in self._indexes:
+            x, y = self.batch.geom_xy()
+            dtg = self.batch.column(self.sft.dtg_field)
+            self._indexes["z3"] = Z3PointIndex.build(
+                x, y, dtg, period=self.sft.z3_interval)
+        return self._indexes["z3"]
+
+    def z2_index(self) -> Z2PointIndex:
+        self._rebuild_if_dirty()
+        if "z2" not in self._indexes:
+            x, y = self.batch.geom_xy()
+            self._indexes["z2"] = Z2PointIndex.build(x, y)
+        return self._indexes["z2"]
+
+    def xz3_index(self) -> XZ3Index:
+        self._rebuild_if_dirty()
+        if "xz3" not in self._indexes:
+            dtg = self.batch.column(self.sft.dtg_field)
+            self._indexes["xz3"] = XZ3Index.build(
+                self.batch.geoms, dtg, period=self.sft.z3_interval,
+                g=self.sft.xz_precision)
+        return self._indexes["xz3"]
+
+    def xz2_index(self) -> XZ2Index:
+        self._rebuild_if_dirty()
+        if "xz2" not in self._indexes:
+            self._indexes["xz2"] = XZ2Index.build(
+                self.batch.geoms, g=self.sft.xz_precision)
+        return self._indexes["xz2"]
+
+    def id_index(self) -> IdIndex:
+        self._rebuild_if_dirty()
+        if "id" not in self._indexes:
+            self._indexes["id"] = IdIndex.build(self.batch.ids)
+        return self._indexes["id"]
+
+    def attribute_index(self, attr: str) -> AttributeIndex:
+        self._rebuild_if_dirty()
+        key = f"attr:{attr}"
+        if key not in self._indexes:
+            self._indexes[key] = AttributeIndex.build(
+                attr, self.batch.column(attr))
+        return self._indexes[key]
+
+
+class TpuDataStore:
+    """In-process spatio-temporal datastore over columnar TPU indexes."""
+
+    def __init__(self, catalog_dir: str | None = None):
+        self._schemas: dict[str, _SchemaStore] = {}
+        self._catalog_dir = catalog_dir
+        if catalog_dir:
+            os.makedirs(catalog_dir, exist_ok=True)
+            self._load_catalog()
+
+    # -- schema lifecycle (MetadataBackedDataStore.createSchema etc.) ----
+    def create_schema(self, sft_or_name, spec: str | None = None) -> FeatureType:
+        if isinstance(sft_or_name, FeatureType):
+            sft = sft_or_name
+        else:
+            sft = parse_spec(sft_or_name, spec)
+        if sft.name in self._schemas:
+            raise ValueError(f"schema {sft.name!r} already exists")
+        self._schemas[sft.name] = _SchemaStore(sft)
+        self._persist_schema(sft)
+        return sft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._store(name).sft
+
+    def update_schema(self, name: str, sft: FeatureType) -> None:
+        """Replace schema metadata (the reference's updateSchema,
+        MetadataBackedDataStore.scala:205 — rename/user-data updates)."""
+        store = self._store(name)
+        if [a.name for a in sft.attributes] != [a.name for a in store.sft.attributes]:
+            raise ValueError("updateSchema cannot add/remove attributes")
+        store.sft = sft
+        if sft.name != name:
+            self._schemas[sft.name] = self._schemas.pop(name)
+        self._persist_schema(sft)
+
+    def remove_schema(self, name: str) -> None:
+        self._schemas.pop(name, None)
+        if self._catalog_dir:
+            path = os.path.join(self._catalog_dir, f"{name}.schema.json")
+            if os.path.exists(path):
+                os.remove(path)
+
+    @property
+    def type_names(self) -> list[str]:
+        return sorted(self._schemas)
+
+    def _store(self, name: str) -> _SchemaStore:
+        if name not in self._schemas:
+            raise KeyError(f"no such schema: {name!r}")
+        return self._schemas[name]
+
+    # -- ingest -----------------------------------------------------------
+    def write(self, name: str, data, ids=None) -> int:
+        """Append features: a FeatureBatch or a dict of columns."""
+        store = self._store(name)
+        batch = (data if isinstance(data, FeatureBatch)
+                 else FeatureBatch.from_dict(store.sft, data, ids=ids))
+        if ids is None and not isinstance(data, FeatureBatch):
+            # feature ids must be unique across writes
+            base = 0 if store.batch is None else len(store.batch)
+            batch.ids = np.array([str(base + i) for i in range(len(batch))],
+                                 dtype=object)
+        store.write(batch)
+        return len(batch)
+
+    # -- query ------------------------------------------------------------
+    def query(self, name: str, query="INCLUDE",
+              explain: Explainer | None = None) -> FeatureBatch:
+        return self.query_result(name, query, explain).batch
+
+    def query_result(self, name: str, query="INCLUDE",
+                     explain: Explainer | None = None) -> QueryResult:
+        store = self._store(name)
+        q = query if isinstance(query, Query) else Query.of(query)
+        if store.batch is None or len(store.batch) == 0:
+            empty = FeatureBatch(store.sft, {
+                k: np.empty(0, dtype=v.dtype)
+                for k, v in (store.batch.columns.items() if store.batch else [])
+            })
+            from .planning.strategy import FilterStrategy
+            return QueryResult(empty, np.empty(0, dtype=np.int64),
+                               FilterStrategy("none", 0), 0.0, 0.0)
+        return QueryPlanner(store.sft, store).run(q, explain)
+
+    def explain(self, name: str, query="INCLUDE") -> str:
+        from .planning.explain import ExplainString
+        ex = ExplainString()
+        self.query_result(name, query, ex)
+        return str(ex)
+
+    # -- stats (GeoMesaStats analog) --------------------------------------
+    def get_count(self, name: str, query=None) -> int:
+        store = self._store(name)
+        if query is None:
+            return store._stats["count"].count
+        return len(self.query(name, query))
+
+    def get_bounds(self, name: str):
+        store = self._store(name)
+        if store.batch is None or len(store.batch) == 0:
+            return None
+        bb = store.batch.geom_bbox()
+        from .geometry.types import Envelope
+        return Envelope(float(bb[:, 0].min()), float(bb[:, 1].min()),
+                        float(bb[:, 2].max()), float(bb[:, 3].max()))
+
+    def get_attribute_bounds(self, name: str, attr: str):
+        mm = self._store(name)._stats.get(f"{attr}_minmax")
+        return None if mm is None or mm.is_empty else mm.bounds
+
+    def stat(self, name: str, key: str) -> Stat | None:
+        return self._store(name)._stats.get(key)
+
+    # -- metadata catalog persistence -------------------------------------
+    def _persist_schema(self, sft: FeatureType) -> None:
+        if not self._catalog_dir:
+            return
+        path = os.path.join(self._catalog_dir, f"{sft.name}.schema.json")
+        with open(path, "w") as f:
+            json.dump({"name": sft.name, "spec": sft.spec_string(),
+                       "updated": time.time()}, f)
+
+    def persist_stats(self, name: str) -> None:
+        if not self._catalog_dir:
+            return
+        store = self._store(name)
+        path = os.path.join(self._catalog_dir, f"{name}.stats.json")
+        with open(path, "w") as f:
+            json.dump({k: s.to_json() for k, s in store._stats.items()}, f)
+
+    def load_stats(self, name: str) -> None:
+        if not self._catalog_dir:
+            return
+        path = os.path.join(self._catalog_dir, f"{name}.stats.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                raw = json.load(f)
+            self._store(name)._stats = {
+                k: stat_from_json(v) for k, v in raw.items()}
+
+    def _load_catalog(self) -> None:
+        for fn in os.listdir(self._catalog_dir):
+            if fn.endswith(".schema.json"):
+                with open(os.path.join(self._catalog_dir, fn)) as f:
+                    meta = json.load(f)
+                sft = parse_spec(meta["name"], meta["spec"])
+                self._schemas[sft.name] = _SchemaStore(sft)

@@ -18,6 +18,7 @@ from .ast import (
     DWithin,
     Exclude,
     Filter,
+    IdFilter,
     In,
     Include,
     Intersects,
@@ -33,7 +34,7 @@ from .extract import FilterValues, extract_geometries, extract_intervals, to_cnf
 
 __all__ = [
     "And", "Attribute", "BBox", "Between", "Contains", "During", "DWithin",
-    "Exclude", "Filter", "In", "Include", "Intersects", "Like", "Not", "Or",
+    "Exclude", "Filter", "IdFilter", "In", "Include", "Intersects", "Like", "Not", "Or",
     "PropertyCompare", "Within", "parse_ecql", "evaluate_filter",
     "FilterValues", "extract_geometries", "extract_intervals", "to_cnf",
 ]

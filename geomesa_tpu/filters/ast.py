@@ -18,7 +18,7 @@ from ..geometry.types import Envelope, Geometry
 __all__ = [
     "Filter", "Include", "Exclude", "And", "Or", "Not", "BBox", "Intersects",
     "Contains", "Within", "DWithin", "During", "PropertyCompare", "Between",
-    "In", "Like", "Attribute",
+    "In", "IdFilter", "Like", "Attribute",
 ]
 
 
@@ -159,6 +159,16 @@ class In(Filter):
 
     def __post_init__(self):
         object.__setattr__(self, "values", tuple(self.values))
+
+
+@dataclass(frozen=True)
+class IdFilter(Filter):
+    """Feature-id filter (GeoTools ``Filter.id`` / bare ``IN ('id1', …)``) —
+    served by the record/id index."""
+    ids: tuple
+
+    def __post_init__(self):
+        object.__setattr__(self, "ids", tuple(str(i) for i in self.ids))
 
 
 @dataclass(frozen=True)

@@ -18,8 +18,8 @@ import re
 
 from ..geometry.wkt import geometry_from_wkt
 from .ast import (
-    And, BBox, Between, Contains, During, DWithin, Exclude, Filter, In,
-    Include, Intersects, Like, Not, Or, PropertyCompare, Within,
+    And, BBox, Between, Contains, During, DWithin, Exclude, Filter, IdFilter,
+    In, Include, Intersects, Like, Not, Or, PropertyCompare, Within,
 )
 
 __all__ = ["parse_ecql", "parse_iso_ms"]
@@ -190,6 +190,21 @@ def _literal(kind: str, val: str):
     raise ValueError(f"expected literal, got {val!r}")
 
 
+def _parse_literal_list(toks: _Tokens, what: str) -> list:
+    """Parse '( literal, literal, … )' after IN."""
+    toks.expect("(")
+    values = []
+    while True:
+        k, v = toks.next()
+        values.append(_literal(k, v))
+        k, v = toks.next()
+        if v == ")":
+            break
+        if v != ",":
+            raise ValueError(f"bad {what} list near {v!r}")
+    return values
+
+
 def _parse_predicate(toks: _Tokens) -> Filter:
     kind, val = toks.next()
     if kind != "word":
@@ -200,6 +215,10 @@ def _parse_predicate(toks: _Tokens) -> Filter:
         return Include
     if upper == "EXCLUDE":
         return Exclude
+
+    if upper == "IN":
+        # bare IN list = feature-id filter (GeoTools convention)
+        return IdFilter(tuple(str(v) for v in _parse_literal_list(toks, "id")))
 
     if upper == "BBOX":
         toks.expect("(")
@@ -256,17 +275,7 @@ def _parse_predicate(toks: _Tokens) -> Filter:
                 return During(prop, ms + 1, None)
             return During(prop, ms, ms)
         if upper == "IN":
-            toks.expect("(")
-            values = []
-            while True:
-                k, v = toks.next()
-                values.append(_literal(k, v))
-                k, v = toks.next()
-                if v == ")":
-                    break
-                if v != ",":
-                    raise ValueError(f"bad IN list near {v!r}")
-            return In(prop, tuple(values))
+            return In(prop, tuple(_parse_literal_list(toks, "IN")))
         if upper in ("LIKE", "ILIKE"):
             k, v = toks.next()
             return Like(prop, _literal(k, v), case_insensitive=(upper == "ILIKE"))

@@ -32,8 +32,8 @@ from ..geometry.types import (
     Polygon,
 )
 from .ast import (
-    And, BBox, Between, Contains, During, DWithin, Filter, In, Intersects,
-    Like, Not, Or, PropertyCompare, Within, _Exclude, _Include,
+    And, BBox, Between, Contains, During, DWithin, Filter, IdFilter, In,
+    Intersects, Like, Not, Or, PropertyCompare, Within, _Exclude, _Include,
 )
 
 __all__ = ["evaluate_filter"]
@@ -173,6 +173,9 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
         for v in f.values:
             mask |= col == v
         return mask
+    if isinstance(f, IdFilter):
+        wanted = set(f.ids)
+        return np.array([str(v) in wanted for v in batch.ids], dtype=bool)
     if isinstance(f, Like):
         col = batch.column(f.prop)
         rx = _like_regex(f.pattern, f.case_insensitive)

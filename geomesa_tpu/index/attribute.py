@@ -1,0 +1,75 @@
+"""Attribute index: equality/range/prefix queries on indexed attributes.
+
+Analog of the reference's attribute index (geomesa-index-api/.../index/
+attribute/ — lexicoded values via ``AttributeIndexKey.typeRegistry``
+(AttributeIndexKey.scala:38), ``encodeForQuery`` :52).  Lexicographic byte
+encoding is unnecessary here: the "table" is a host-side sorted column in
+its natural dtype (numpy sort order == lexicoder order for numerics and
+strings), plus the permutation.  A secondary Z3/date tier (the reference's
+tiered keys) is planned as a follow-up; date refinement currently happens
+in the residual filter.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["AttributeIndex"]
+
+
+class AttributeIndex:
+    """Sorted-column index over one attribute."""
+
+    def __init__(self, attr: str, values: np.ndarray, pos: np.ndarray):
+        self.attr = attr
+        self.values = values      # sorted
+        self.pos = pos
+
+    @classmethod
+    def build(cls, attr: str, column: np.ndarray) -> "AttributeIndex":
+        col = np.asarray(column)
+        if col.dtype == object:
+            col = col.astype(str)
+        order = np.argsort(col, kind="stable")
+        return cls(attr, col[order], order.astype(np.int64))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def _cast(self, v):
+        if self.values.dtype.kind in ("U", "S"):
+            return str(v)
+        return v
+
+    def query_equals(self, value) -> np.ndarray:
+        value = self._cast(value)
+        lo = np.searchsorted(self.values, value, side="left")
+        hi = np.searchsorted(self.values, value, side="right")
+        return np.sort(self.pos[lo:hi])
+
+    def query_in(self, values) -> np.ndarray:
+        if not len(values):
+            return np.empty(0, dtype=np.int64)
+        return np.sort(np.unique(np.concatenate(
+            [self.query_equals(v) for v in values])))
+
+    def query_range(self, lo=None, hi=None, lo_inclusive=True,
+                    hi_inclusive=True) -> np.ndarray:
+        i0 = 0
+        i1 = len(self.values)
+        if lo is not None:
+            i0 = np.searchsorted(self.values, self._cast(lo),
+                                 side="left" if lo_inclusive else "right")
+        if hi is not None:
+            i1 = np.searchsorted(self.values, self._cast(hi),
+                                 side="right" if hi_inclusive else "left")
+        return np.sort(self.pos[i0:i1])
+
+    def query_prefix(self, prefix: str) -> np.ndarray:
+        """String prefix scan — serves LIKE 'abc%' (the reference's
+        attribute-index LIKE optimization)."""
+        if self.values.dtype.kind not in ("U", "S"):
+            raise TypeError("prefix queries require a string attribute")
+        lo = np.searchsorted(self.values, prefix, side="left")
+        hi = np.searchsorted(self.values, prefix + "￿", side="right")
+        return np.sort(self.pos[lo:hi])

@@ -1,0 +1,40 @@
+"""ID (record) index: feature-id point lookups.
+
+Analog of the reference's id index (geomesa-index-api/.../index/id/
+IdIndexKeySpace.scala — rows keyed by feature id, with UUID-optimized
+byte encoding).  Here: a sorted string-id column + permutation; lookups
+are binary searches."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["IdIndex"]
+
+
+class IdIndex:
+    def __init__(self, ids: np.ndarray, pos: np.ndarray):
+        self.ids = ids    # sorted string array
+        self.pos = pos
+
+    @classmethod
+    def build(cls, ids) -> "IdIndex":
+        ids = np.asarray(ids).astype(str)
+        order = np.argsort(ids, kind="stable")
+        return cls(ids[order], order.astype(np.int64))
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def query(self, ids) -> np.ndarray:
+        """Positions of the given feature ids (missing ids are skipped)."""
+        out = []
+        for fid in ids:
+            fid = str(fid)
+            lo = np.searchsorted(self.ids, fid, side="left")
+            hi = np.searchsorted(self.ids, fid, side="right")
+            out.append(self.pos[lo:hi])
+        if not out:
+            return np.empty(0, dtype=np.int64)
+        # unique: repeated ids (or AND'd id filters) must not duplicate rows
+        return np.unique(np.concatenate(out))

@@ -1,0 +1,100 @@
+"""XZ3 index: intersects + time queries over geometries with extent.
+
+Analog of the reference's XZ3 index (geomesa-index-api/.../index/z3/
+XZ3IndexKeySpace.scala — key = ``[shard][2B bin][8B code][id]``): sorted
+(bin, code) pair columns + permutation, per-bin time windows planned the
+same way as the Z3 point index.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import DEFAULT_MAX_RANGES
+from ..curve.binnedtime import TimePeriod, max_offset, to_binned_time
+from ..curve.xz3 import XZ3SFC, xz3_sfc
+from ..geometry.packed import PackedGeometry, pack_geometries
+from ..geometry.predicates import bbox_intersects, geometry_intersects
+from ..geometry.types import Geometry
+from .z3 import _time_windows_by_bin
+
+__all__ = ["XZ3Index"]
+
+
+class XZ3Index:
+    """Spatio-temporal index over non-point geometries with instant dtg."""
+
+    def __init__(self, period, g, bins, codes, pos, bbox, dtg, geoms):
+        self.period = TimePeriod.parse(period)
+        self.sfc: XZ3SFC = xz3_sfc(self.period, g)
+        self.bins = bins          # (N,) int32 sorted-major
+        self.codes = codes        # (N,) int64 sorted within bin
+        self.pos = pos
+        self.bbox = bbox          # original order
+        self.dtg = dtg            # (N,) int64 epoch ms, original order
+        self.geoms = geoms
+
+    @classmethod
+    def build(cls, geoms, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK,
+              g: int = 12) -> "XZ3Index":
+        packed = geoms if isinstance(geoms, PackedGeometry) else pack_geometries(geoms)
+        period = TimePeriod.parse(period)
+        sfc = xz3_sfc(period, g)
+        dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
+        bins, offs = to_binned_time(dtg_ms, period)
+        bb = packed.bbox
+        # index the bbox at the feature's time instant (zmin == zmax == offset)
+        offs_f = offs.astype(np.float64)
+        codes = sfc.index(bb[:, 0], bb[:, 1], offs_f, bb[:, 2], bb[:, 3],
+                          offs_f, xp=np).astype(np.int64)
+        order = np.lexsort((codes, bins))
+        return cls(period, g, bins[order].astype(np.int32), codes[order],
+                   order.astype(np.int32), bb, dtg_ms, packed)
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def query(self, geometry: Geometry, t_lo_ms: int, t_hi_ms: int,
+              max_ranges: int = DEFAULT_MAX_RANGES,
+              exact: bool = True) -> np.ndarray:
+        env = geometry.envelope
+        windows = _time_windows_by_bin(t_lo_ms, t_hi_ms, self.period)
+        if not windows or not len(self):
+            return np.empty(0, dtype=np.int64)
+        target = max(1, max_ranges // max(1, len(windows)))
+        # group whole-period bins to share one decomposition
+        by_window: dict[tuple, list[int]] = {}
+        for b, w in windows.items():
+            by_window.setdefault(w, []).append(b)
+        cands = []
+        for (wlo, whi), bs in by_window.items():
+            ranges = self.sfc.ranges(
+                [(env.xmin, env.ymin, float(wlo), env.xmax, env.ymax, float(whi))],
+                max_ranges=target,
+            )
+            if not len(ranges):
+                continue
+            for b in bs:
+                lo_i = np.searchsorted(self.bins, b, side="left")
+                hi_i = np.searchsorted(self.bins, b, side="right")
+                seg = self.codes[lo_i:hi_i]
+                starts = np.searchsorted(seg, ranges[:, 0], side="left") + lo_i
+                ends = np.searchsorted(seg, ranges[:, 1], side="right") + lo_i
+                for s, e in zip(starts, ends):
+                    if e > s:
+                        cands.append(self.pos[s:e])
+        if not cands:
+            return np.empty(0, dtype=np.int64)
+        cand = np.concatenate(cands)
+        keep = bbox_intersects(self.bbox[cand], env.as_tuple())
+        keep &= (self.dtg[cand] >= t_lo_ms) & (self.dtg[cand] <= t_hi_ms)
+        cand = cand[keep]
+        if exact and self.geoms is not None:
+            from .xz2 import _is_envelope
+            if not _is_envelope(geometry, env):
+                cand = np.asarray(
+                    [p for p in cand
+                     if geometry_intersects(self.geoms.geometry(int(p)), geometry)],
+                    dtype=np.int64,
+                )
+        return np.sort(cand).astype(np.int64)

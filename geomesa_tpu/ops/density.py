@@ -1,0 +1,45 @@
+"""Density (heatmap) kernel: weighted 2-D grid histograms on device.
+
+The aggregation the reference pushes to tablet servers as DensityScan /
+DensityIterator (geomesa-index-api/.../iterators/DensityScan.scala:31-109:
+snap each feature to a W×H grid over the query envelope via GridSnap,
+accumulate weights into a sparse (row, col) → weight map, merge partial
+grids client-side).  Here the grid is a dense device array built with one
+masked scatter-add — and the cross-shard merge is a ``psum`` over the mesh
+instead of a client reduce (SURVEY.md §2.7 "scatter-gather + client
+reduce").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["density_grid", "grid_snap"]
+
+
+def grid_snap(x, y, env, width: int, height: int):
+    """GridSnap semantics (geomesa-utils GridSnap): index of the cell
+    containing each point; points outside the envelope are clamped."""
+    xmin, ymin, xmax, ymax = env
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    ix = jnp.clip(jnp.floor((x - xmin) / dx).astype(jnp.int32), 0, width - 1)
+    iy = jnp.clip(jnp.floor((y - ymin) / dy).astype(jnp.int32), 0, height - 1)
+    return ix, iy
+
+
+@partial(jax.jit, static_argnames=("width", "height"))
+def density_grid(x, y, weights, mask, env, width: int, height: int):
+    """Masked weighted histogram: (N,) coords → (height, width) float64 grid.
+
+    ``mask`` selects the features that passed the query filter; ``weights``
+    is the DENSITY_WEIGHT expression column (ones for plain counts).
+    """
+    ix, iy = grid_snap(x, y, env, width, height)
+    flat = iy.astype(jnp.int32) * width + ix
+    w = jnp.where(mask, weights, 0.0)
+    grid = jnp.zeros(width * height, dtype=jnp.float64).at[flat].add(w)
+    return grid.reshape(height, width)

@@ -44,8 +44,14 @@ def searchsorted2(keys_hi, keys_lo, q_hi, q_lo, side: str = "left"):
     n = keys_hi.shape[0]
     q_hi = jnp.asarray(q_hi)
     q_lo = jnp.asarray(q_lo)
-    lo = jnp.zeros(q_hi.shape, jnp.int64)
-    hi = jnp.full(q_hi.shape, n, jnp.int64)
+    if n == 0:
+        return jnp.zeros(q_hi.shape, jnp.int64)
+    # anchor the carry to the keys so that under shard_map the loop carry is
+    # shard-varying from iteration 0 (matching the body's output type);
+    # scalar (0-d) anchor preserves the queries' shape
+    anchor = (keys_hi[0] * 0).astype(jnp.int64)
+    lo = jnp.zeros(q_hi.shape, jnp.int64) + anchor
+    hi = jnp.full(q_hi.shape, n, jnp.int64) + anchor
     nsteps = max(1, n.bit_length())
 
     def body(_, carry):

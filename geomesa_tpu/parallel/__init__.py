@@ -1,0 +1,23 @@
+"""Distributed execution: mesh sharding and ICI collectives.
+
+The reference scales by spreading key ranges over tablet servers and
+reducing partial results client-side over RPC (SURVEY.md §2.7).  Here the
+same roles map onto a JAX device mesh:
+
+* tablet/region assignment      → feature-axis sharding over ``Mesh``
+* server-side iterator compute  → per-shard kernels inside ``shard_map``
+* client-side reduce            → ``jax.lax.psum`` over ICI
+* batch-writer ingest fan-out   → sharded ``device_put`` + per-shard sort
+
+Multi-host scaling uses the same code: a mesh spanning hosts makes the
+psum ride ICI within a pod and DCN across pods, with no NCCL/MPI analog
+needed — the collective compiles into the program.
+"""
+
+from .mesh import device_mesh, shard_batch
+from .scan import ShardedZ3Index, sharded_density, sharded_range_count
+
+__all__ = [
+    "device_mesh", "shard_batch", "ShardedZ3Index", "sharded_density",
+    "sharded_range_count",
+]

@@ -1,0 +1,43 @@
+"""Mesh construction and batch sharding helpers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["device_mesh", "shard_batch"]
+
+
+def device_mesh(n_devices: int | None = None, axis: str = "shard") -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all).
+
+    The single ``shard`` axis plays the role of the reference's
+    tablet-server spread (ShardStrategy, api/ShardStrategy.scala:17-75) —
+    data parallelism over the feature axis.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(mesh_utils.create_device_mesh((n,), devices=devices[:n]), (axis,))
+
+
+def shard_batch(mesh: Mesh, *arrays, axis: str = "shard"):
+    """Pad arrays to a multiple of the mesh size and place them sharded on
+    the feature axis.  Returns (padded_arrays, valid_mask)."""
+    n_shards = mesh.shape[axis]
+    n = len(arrays[0])
+    padded_n = ((n + n_shards - 1) // n_shards) * n_shards
+    sharding = NamedSharding(mesh, P(axis))
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if padded_n != n:
+            pad = np.zeros((padded_n - n,) + a.shape[1:], dtype=a.dtype)
+            a = np.concatenate([a, pad])
+        out.append(jax.device_put(jnp.asarray(a), sharding))
+    valid = np.zeros(padded_n, dtype=bool)
+    valid[:n] = True
+    out.append(jax.device_put(jnp.asarray(valid), sharding))
+    return out[:-1], out[-1]

@@ -1,0 +1,143 @@
+"""Sharded index build, range counting and density over a device mesh.
+
+Per-shard sorted key segments + collective reductions — the mesh analog of
+the reference's range-partitioned parallel scans with client-side reduce
+(AccumuloQueryPlan.BatchScanPlan threads, QueryPlan.Reducer;
+SURVEY.md §2.7):
+
+* ``ShardedZ3Index.build``: each device encodes and locally sorts its
+  feature shard (per-tablet sorted layout), all inside one ``shard_map``.
+* ``sharded_range_count``: per-shard binary-search seeks over the local
+  sorted segment, counts summed with ``psum`` over ICI.
+* ``sharded_density``: per-shard masked grid histogram + ``psum`` — the
+  DensityScan + client-merge path as a single collective program
+  (BASELINE config 4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..curve.binnedtime import TimePeriod, to_binned_time
+from ..curve.sfc import z3_sfc
+from ..index.z3 import Z3QueryPlan, plan_z3_query
+from ..ops.density import density_grid
+from ..ops.search import searchsorted2
+from .mesh import device_mesh, shard_batch
+
+__all__ = ["ShardedZ3Index", "sharded_range_count", "sharded_density"]
+
+
+class ShardedZ3Index:
+    """Z3 point index sharded over the feature axis of a device mesh."""
+
+    def __init__(self, mesh: Mesh, period: TimePeriod, bins, z, x, y, dtg, valid):
+        self.mesh = mesh
+        self.period = period
+        self.sfc = z3_sfc(period)
+        # per-shard locally-sorted key columns
+        self.bins = bins
+        self.z = z
+        # sharded feature columns (original shard order)
+        self.x = x
+        self.y = y
+        self.dtg = dtg
+        self.valid = valid
+
+    @classmethod
+    def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK,
+              mesh: Mesh | None = None) -> "ShardedZ3Index":
+        mesh = mesh or device_mesh()
+        period = TimePeriod.parse(period)
+        sfc = z3_sfc(period)
+        dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
+        host_bins, host_offs = to_binned_time(dtg_ms, period)
+        (xd, yd, td, bind, offd), valid = shard_batch(
+            mesh,
+            np.asarray(x, np.float64), np.asarray(y, np.float64), dtg_ms,
+            host_bins.astype(np.int32), host_offs.astype(np.float64),
+        )
+
+        @partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard")),
+            out_specs=(P("shard"), P("shard")),
+        )
+        def encode_sort(xs, ys, bs, os_, vs):
+            z = sfc.index(xs, ys, os_)
+            # invalid (padding) rows get bin -1 so no query range matches
+            bs = jnp.where(vs, bs, -1)
+            order = jnp.lexsort((z, bs))
+            return bs[order], z[order]
+
+        bins_s, z_s = jax.jit(encode_sort)(xd, yd, bind, offd, valid)
+        return cls(mesh, period, bins_s, z_s, xd, yd, td, valid)
+
+    def total(self) -> int:
+        return int(np.asarray(jnp.sum(self.valid)))
+
+    # -- collective queries ----------------------------------------------
+    def range_count(self, boxes, t_lo_ms: int, t_hi_ms: int,
+                    max_ranges: int = 2000) -> int:
+        """Candidate count across all shards (index-key resolution)."""
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        if plan.num_ranges == 0:
+            return 0
+        return sharded_range_count(
+            self.mesh, self.bins, self.z,
+            jnp.asarray(plan.rbin), jnp.asarray(plan.rzlo),
+            jnp.asarray(plan.rzhi))
+
+    def density(self, boxes, t_lo_ms: int, t_hi_ms: int, env,
+                width: int = 256, height: int = 256,
+                weights=None) -> np.ndarray:
+        """Global density grid for bbox(es) + interval — per-shard masked
+        histogram + psum."""
+        boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+        w = weights if weights is not None else jnp.ones_like(self.x)
+        return sharded_density(
+            self.mesh, self.x, self.y, self.dtg, self.valid, w,
+            jnp.asarray(boxes), int(t_lo_ms), int(t_hi_ms),
+            tuple(float(v) for v in env), width, height)
+
+
+def sharded_range_count(mesh, bins, z, rbin, rzlo, rzhi) -> int:
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P(None), P(None), P(None)),
+        out_specs=P(None),
+    )
+    def count(local_bins, local_z, rb, rlo, rhi):
+        starts = searchsorted2(local_bins, local_z, rb, rlo, side="left")
+        ends = searchsorted2(local_bins, local_z, rb, rhi, side="right")
+        local = jnp.sum(jnp.maximum(ends - starts, 0))
+        return jax.lax.psum(local[None], "shard")
+
+    return int(np.asarray(jax.jit(count)(bins, z, rbin, rzlo, rzhi))[0])
+
+
+def sharded_density(mesh, x, y, dtg, valid, weights, boxes,
+                    t_lo_ms: int, t_hi_ms: int, env,
+                    width: int, height: int) -> np.ndarray:
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard"),
+                  P(None)),
+        out_specs=P(None, None),
+    )
+    def dens(xs, ys, ts, vs, ws, bx):
+        in_box = (
+            (xs[:, None] >= bx[None, :, 0]) & (ys[:, None] >= bx[None, :, 1])
+            & (xs[:, None] <= bx[None, :, 2]) & (ys[:, None] <= bx[None, :, 3])
+        ).any(axis=1)
+        mask = vs & in_box & (ts >= t_lo_ms) & (ts <= t_hi_ms)
+        grid = density_grid(xs, ys, ws, mask, env, width, height)
+        return jax.lax.psum(grid, "shard")
+
+    return np.asarray(jax.jit(dens)(x, y, dtg, valid, weights, boxes))

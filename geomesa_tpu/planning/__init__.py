@@ -1,0 +1,15 @@
+"""Query planning: strategy selection, plan assembly, explain tracing.
+
+The analog of the reference's planning stack
+(geomesa-index-api/.../index/planning/): QueryPlanner, FilterSplitter,
+StrategyDecider, Explainer, LocalQueryRunner.
+"""
+
+from .explain import ExplainLogging, ExplainNull, ExplainString, Explainer
+from .planner import QueryPlanner, QueryResult
+from .strategy import FilterStrategy, StrategyDecider
+
+__all__ = [
+    "Explainer", "ExplainString", "ExplainLogging", "ExplainNull",
+    "QueryPlanner", "QueryResult", "FilterStrategy", "StrategyDecider",
+]

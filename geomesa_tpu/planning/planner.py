@@ -1,0 +1,188 @@
+"""Query planner: strategy → index scan → residual filter → transforms.
+
+The orchestration layer mirroring the reference's QueryPlanner
+(geomesa-index-api/.../index/planning/QueryPlanner.scala:41-134): choose a
+strategy (StrategyDecider), run the chosen index's scan to get candidate
+positions, apply the full filter as a vectorized re-check (the reference's
+secondary-filter / FilterTransformIterator role), then projection, sort
+and max-features (configureQuery's hint handling, :157-230).
+
+Exactness contract: whatever the index strategy returns is treated as a
+*candidate superset*; the final mask is always the full filter evaluated
+on candidates, so results are oracle-equal regardless of strategy.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType
+from ..filters.ast import Filter, Include, _Include
+from ..filters.ecql import parse_ecql
+from ..filters.evaluate import evaluate_filter
+from .explain import Explainer, ExplainNull
+from .strategy import FilterStrategy, StrategyDecider
+
+__all__ = ["Query", "QueryPlanner", "QueryResult"]
+
+
+@dataclass
+class Query:
+    """A query against one schema (the GeoTools Query analog)."""
+
+    filter: Filter = Include
+    properties: list | None = None       # projection; None = all
+    sort_by: str | None = None           # attribute name
+    sort_desc: bool = False
+    max_features: int | None = None
+    hints: dict = field(default_factory=dict)
+
+    @classmethod
+    def of(cls, filter_or_ecql="INCLUDE", **kw) -> "Query":
+        f = (parse_ecql(filter_or_ecql)
+             if isinstance(filter_or_ecql, str) else filter_or_ecql)
+        return cls(filter=f, **kw)
+
+
+@dataclass
+class QueryResult:
+    batch: FeatureBatch
+    positions: np.ndarray
+    strategy: FilterStrategy
+    plan_time_ms: float
+    scan_time_ms: float
+
+
+class QueryPlanner:
+    """Plans and runs queries against a store's in-memory index set."""
+
+    def __init__(self, sft: FeatureType, store):
+        self.sft = sft
+        self.store = store  # _SchemaStore (datastore.py)
+
+    def run(self, query: Query, explain: Explainer | None = None) -> QueryResult:
+        explain = explain or ExplainNull()
+        store = self.store
+        batch = store.batch
+        explain.push(lambda: f"Planning query on '{self.sft.name}' "
+                             f"({len(batch)} features)")
+        explain(lambda: f"Filter: {query.filter!r}")
+
+        t0 = time.perf_counter()
+        decider = StrategyDecider(self.sft, store.stats_map(), len(batch))
+        strategy = decider.decide(query.filter, explain)
+        plan_ms = (time.perf_counter() - t0) * 1000
+
+        t1 = time.perf_counter()
+        candidates = self._scan(strategy, query, explain)
+        if candidates is None:  # full scan
+            mask = evaluate_filter(query.filter, batch)
+            positions = np.flatnonzero(mask)
+        else:
+            if len(candidates):
+                sub = batch.take(candidates)
+                mask = evaluate_filter(query.filter, sub)
+                positions = candidates[mask]
+            else:
+                positions = candidates
+        scan_ms = (time.perf_counter() - t1) * 1000
+        explain(lambda: f"Scan: {len(positions)} hits "
+                        f"(plan {plan_ms:.1f}ms, scan {scan_ms:.1f}ms)")
+
+        positions = self._sort_limit(positions, batch, query)
+        result_batch = batch.take(positions)
+        if query.properties is not None:
+            result_batch = _project(result_batch, query.properties)
+        explain.pop()
+        return QueryResult(result_batch, positions, strategy, plan_ms, scan_ms)
+
+    # -- strategy execution ----------------------------------------------
+    def _scan(self, strategy: FilterStrategy, query: Query,
+              explain: Explainer) -> np.ndarray | None:
+        store = self.store
+        name = strategy.index
+        if name == "none":
+            return np.empty(0, dtype=np.int64)
+        if name == "full":
+            explain("Executing full-table scan")
+            return None
+        explain(lambda: f"Executing {name} index scan")
+        if name == "id":
+            return store.id_index().query(strategy.ids)
+        if name.startswith("attr:"):
+            attr = name[5:]
+            idx = store.attribute_index(attr)
+            (a, kind, payload) = strategy.attr_values[0]
+            if kind == "equals":
+                return idx.query_equals(payload)
+            if kind == "in":
+                return idx.query_in(payload)
+            if kind == "range":
+                lo, hi, lo_inc, hi_inc = payload
+                return idx.query_range(lo, hi, lo_inc, hi_inc)
+            if kind == "prefix":
+                return idx.query_prefix(payload)
+        boxes = [g.envelope.as_tuple() for g in strategy.geometries] or [
+            (-180.0, -90.0, 180.0, 90.0)
+        ]
+        if name == "z3":
+            idx = store.z3_index()
+            parts = [idx.query(boxes, lo, hi) for lo, hi in strategy.intervals]
+            return _union(parts)
+        if name == "z2":
+            return store.z2_index().query(boxes)
+        if name == "xz3":
+            idx = store.xz3_index()
+            parts = []
+            for g in strategy.geometries or ():
+                for lo, hi in strategy.intervals:
+                    parts.append(idx.query(g, lo, hi, exact=False))
+            return _union(parts)
+        if name == "xz2":
+            idx = store.xz2_index()
+            parts = [idx.query(g, exact=False) for g in strategy.geometries or ()]
+            return _union(parts)
+        raise ValueError(f"unknown strategy {name!r}")
+
+    def _sort_limit(self, positions: np.ndarray, batch: FeatureBatch,
+                    query: Query) -> np.ndarray:
+        if query.sort_by:
+            keys = batch.column(query.sort_by)[positions]
+            order = np.argsort(keys, kind="stable")
+            if query.sort_desc:
+                order = order[::-1]
+            positions = positions[order]
+        if query.max_features is not None:
+            positions = positions[: query.max_features]
+        return positions
+
+
+def _union(parts: list[np.ndarray]) -> np.ndarray:
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        return np.empty(0, dtype=np.int64)
+    return np.unique(np.concatenate(parts))
+
+
+def _project(batch: FeatureBatch, properties: list) -> FeatureBatch:
+    """Column projection (the reference's transform schemas,
+    QueryPlanner.setQueryTransforms)."""
+    keep: dict = {}
+    for p in properties:
+        attr = batch.sft.attribute(p)
+        if attr.is_geometry:
+            for suffix in ("_x", "_y", "_bbox"):
+                if f"{p}{suffix}" in batch.columns:
+                    keep[f"{p}{suffix}"] = batch.columns[f"{p}{suffix}"]
+        else:
+            keep[p] = batch.columns[p]
+    sub_attrs = tuple(a for a in batch.sft.attributes if a.name in properties)
+    sub_sft = FeatureType(batch.sft.name, sub_attrs,
+                          batch.sft.default_geom if batch.sft.default_geom in properties else None,
+                          batch.sft.user_data)
+    return FeatureBatch(sub_sft, keep, batch.ids,
+                        batch.geoms if sub_sft.default_geom else None)

@@ -1,0 +1,200 @@
+"""Filter strategies and cost-based index selection.
+
+Mirrors the reference's strategy machinery: per-index applicability
+heuristics (geomesa-index-api/.../index/strategies/
+{SpatioTemporalFilterStrategy, SpatialFilterStrategy,
+AttributeFilterStrategy, IdFilterStrategy}.scala) and the cost-based
+decider (planning/StrategyDecider.scala:67-112,140-152) that estimates
+per-strategy feature counts from stats and picks the cheapest.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import QueryProperties
+from ..features.feature_type import FeatureType
+from ..filters.ast import (
+    And, Between, During, Filter, IdFilter, In, Like, Not, Or,
+    PropertyCompare, _Exclude, _Include,
+)
+from ..filters.extract import extract_geometries, extract_intervals
+from ..stats.stat import EnumerationStat, Frequency, Histogram, MinMax, TopK
+from .explain import Explainer, ExplainNull
+
+__all__ = ["FilterStrategy", "StrategyDecider"]
+
+
+@dataclass
+class FilterStrategy:
+    """A candidate execution strategy: which index serves the query and at
+    what estimated cost (feature count to scan)."""
+
+    index: str                  # 'z3' | 'z2' | 'xz3' | 'xz2' | 'id' | 'attr:<name>' | 'full'
+    cost: float
+    geometries: tuple = ()      # extracted query geometries
+    intervals: tuple = ()       # extracted (lo_ms, hi_ms)
+    ids: tuple = ()             # extracted feature ids
+    attr_values: tuple = ()     # attribute predicate descriptors
+
+    def __repr__(self):
+        return f"FilterStrategy({self.index}, cost={self.cost:.0f})"
+
+
+def _collect_id_filters(f: Filter) -> tuple:
+    if isinstance(f, IdFilter):
+        return tuple(f.ids)
+    if isinstance(f, And):
+        out = []
+        for p in f.filters:
+            out.extend(_collect_id_filters(p))
+        return tuple(out)
+    return ()
+
+
+def _collect_attr_predicates(f: Filter, indexed: set[str]) -> list:
+    """(attr, kind, payload) descriptors for indexed-attribute predicates
+    at the top AND level."""
+    out = []
+    if isinstance(f, And):
+        for p in f.filters:
+            out.extend(_collect_attr_predicates(p, indexed))
+        return out
+    if isinstance(f, PropertyCompare) and f.prop in indexed:
+        if f.op == "=":
+            out.append((f.prop, "equals", f.value))
+        elif f.op in ("<", "<="):
+            out.append((f.prop, "range", (None, f.value, True, f.op == "<=")))
+        elif f.op in (">", ">="):
+            out.append((f.prop, "range", (f.value, None, f.op == ">=", True)))
+    elif isinstance(f, Between) and f.prop in indexed:
+        out.append((f.prop, "range", (f.lo, f.hi, True, True)))
+    elif isinstance(f, In) and f.prop in indexed:
+        out.append((f.prop, "in", tuple(f.values)))
+    elif isinstance(f, Like) and f.prop in indexed and not f.case_insensitive:
+        pat = f.pattern
+        if pat and "%" not in pat[:-1] and pat.endswith("%") and "_" not in pat:
+            out.append((f.prop, "prefix", pat[:-1]))
+    return out
+
+
+class StrategyDecider:
+    """Enumerate viable strategies for a filter and pick the cheapest."""
+
+    def __init__(self, sft: FeatureType, stats: dict | None = None,
+                 total_count: int = 0):
+        self.sft = sft
+        self.stats = stats or {}
+        self.total = max(1, total_count)
+
+    # -- cost estimates (StatsBasedEstimator spirit) ----------------------
+    def _spatial_fraction(self, geometries) -> float:
+        if not geometries:
+            return 1.0
+        area = sum(g.envelope.area for g in geometries)
+        return min(1.0, area / (360.0 * 180.0))
+
+    def _temporal_fraction(self, intervals) -> float:
+        if not intervals:
+            return 1.0
+        mm: MinMax | None = self.stats.get("dtg_minmax")
+        if mm is None or mm.is_empty or mm.max == mm.min:
+            return 0.1
+        span = float(mm.max - mm.min)
+        covered = 0.0
+        for lo, hi in intervals:
+            lo = mm.min if lo is None else lo
+            hi = mm.max if hi is None else hi
+            covered += max(0.0, min(float(hi), float(mm.max)) - max(float(lo), float(mm.min)))
+        return min(1.0, covered / span)
+
+    def _attr_cost(self, attr: str, kind: str, payload) -> float:
+        enum: EnumerationStat | None = self.stats.get(f"{attr}_enumeration")
+        freq: Frequency | None = self.stats.get(f"{attr}_frequency")
+        hist: Histogram | None = self.stats.get(f"{attr}_histogram")
+        if kind == "equals":
+            if enum is not None and not enum.is_empty:
+                return float(enum.counts.get(payload, enum.counts.get(str(payload), 0)))
+            if freq is not None and not freq.is_empty:
+                return float(freq.count(payload))
+            return self.total / 10
+        if kind == "in":
+            return sum(self._attr_cost(attr, "equals", v) for v in payload)
+        if kind == "range" and hist is not None and not hist.is_empty:
+            lo, hi, *_ = payload
+            return float(hist.estimate_range(
+                float(lo) if lo is not None else hist.lo,
+                float(hi) if hi is not None else hist.hi))
+        return self.total / 4
+
+    # -- strategy enumeration ---------------------------------------------
+    def strategies(self, f: Filter) -> list[FilterStrategy]:
+        sft = self.sft
+        out: list[FilterStrategy] = []
+
+        ids = _collect_id_filters(f)
+        if ids:
+            out.append(FilterStrategy("id", float(len(ids)), ids=ids))
+
+        geom = sft.geom_field
+        dtg = sft.dtg_field
+        geoms = extract_geometries(f, geom) if geom else None
+        intervals = extract_intervals(f, dtg) if dtg else None
+
+        if geoms is not None and geoms.disjoint or intervals is not None and intervals.disjoint:
+            return [FilterStrategy("none", 0.0)]
+
+        spatial = bool(geoms and geoms.values)
+        # z3/xz3 need a *bounded* interval (the reference's
+        # SpatioTemporalFilterStrategy requirement)
+        bounded = tuple(
+            iv for iv in (intervals.values if intervals else ())
+            if iv[0] is not None and iv[1] is not None
+        )
+        temporal = bool(bounded)
+
+        sp_frac = self._spatial_fraction(geoms.values if geoms else ())
+        tm_frac = self._temporal_fraction(bounded)
+
+        if temporal and (spatial or True) and dtg:
+            idx = "z3" if sft.is_points else "xz3"
+            cost = self.total * sp_frac * tm_frac
+            out.append(FilterStrategy(
+                idx, max(1.0, cost),
+                geometries=tuple(geoms.values) if geoms else (),
+                intervals=bounded))
+        if spatial:
+            idx = "z2" if sft.is_points else "xz2"
+            cost = self.total * sp_frac
+            # de-prioritize pure-spatial when a tighter temporal plan exists
+            out.append(FilterStrategy(
+                idx, max(1.0, cost), geometries=tuple(geoms.values),
+                intervals=tuple(intervals.values) if intervals else ()))
+
+        indexed = {a.name for a in sft.attributes if a.indexed}
+        for attr, kind, payload in _collect_attr_predicates(f, indexed):
+            out.append(FilterStrategy(
+                f"attr:{attr}", max(1.0, self._attr_cost(attr, kind, payload)),
+                attr_values=((attr, kind, payload),)))
+
+        out.append(FilterStrategy("full", float(self.total)))
+        return out
+
+    def decide(self, f: Filter, explain: Explainer | None = None) -> FilterStrategy:
+        explain = explain or ExplainNull()
+        if isinstance(f, _Exclude):
+            return FilterStrategy("none", 0.0)
+        options = self.strategies(f)
+        explain.push("Strategy selection:")
+        for o in options:
+            explain(lambda o=o: f"option {o.index}: estimated cost {o.cost:.0f}")
+        chosen = min(options, key=lambda o: o.cost)
+        if chosen.index == "full" and QueryProperties.BLOCK_FULL_TABLE_SCANS.to_bool():
+            raise RuntimeError(
+                "full-table scan required but blocked "
+                "(geomesa.scan.block.full.table=true)")
+        explain(lambda: f"chosen: {chosen.index} (cost {chosen.cost:.0f})")
+        explain.pop()
+        return chosen

@@ -1,0 +1,29 @@
+"""Stats sketches: summary statistics for cost-based planning and
+distributed aggregation.
+
+Capability match for the reference's ``Stat`` algebra
+(geomesa-utils/.../stats/Stat.scala:31-90 — observe/merge/serialize — with
+implementations CountStat, MinMax, Histogram, Z3Histogram, Frequency
+(count-min), TopK, EnumerationStat, GroupBy, DescriptiveStats, and the
+``Stat("Count();MinMax(x)")`` parser DSL).  TPU-first difference: stats
+observe whole *columns* (vectorized numpy; device reductions for the hot
+ones), not one feature at a time, and every sketch is a mergeable monoid so
+per-shard partials combine with ``+`` — the same contract the reference's
+distributed StatsScan relies on (index/iterators/StatsScan.scala).
+"""
+
+from .stat import (
+    CountStat,
+    DescriptiveStats,
+    EnumerationStat,
+    Frequency,
+    GroupBy,
+    Histogram,
+    MinMax,
+    SeqStat,
+    Stat,
+    TopK,
+    Z3HistogramStat,
+    parse_stat,
+    stat_from_json,
+)

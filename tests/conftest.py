@@ -4,18 +4,36 @@ Tests run on a virtual 8-device CPU mesh so that every sharding/collective
 path (shard_map, psum over the mesh) is exercised without TPU hardware —
 the analog of the reference's in-memory `TestGeoMesaDataStore` +
 Accumulo MockInstance strategy (SURVEY.md §4): full stack, zero infra.
-The env vars must be set before jax initializes its backends.
+
+Two environment quirks handled here:
+* ``JAX_PLATFORMS`` is forced (not defaulted) to cpu — the container env
+  pins it to the axon TPU platform.
+* The axon PJRT plugin is registered by ``sitecustomize`` at interpreter
+  start (before this conftest); its client creation *blocks* whenever the
+  TPU tunnel is unavailable, and ``xla_bridge.backends()`` initializes
+  every registered factory.  Deregistering the factory keeps CPU test
+  runs hermetic and immune to tunnel outages.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-import numpy as np
-import pytest
+import jax  # noqa: E402
+
+# sitecustomize imported jax before this file ran, baking jax_platforms from
+# the env; update the live config as well as the env var
+jax.config.update("jax_platforms", "cpu")
+
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,188 @@
+"""End-to-end datastore tests against brute-force oracles — the analog of
+the reference's TestGeoMesaDataStore-based suite (full planner/keyspace/
+filter stack, zero infra; SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features import FeatureBatch
+from geomesa_tpu.filters import evaluate_filter, parse_ecql
+from geomesa_tpu.geometry import Polygon
+from geomesa_tpu.planning.planner import Query
+
+MS_2018 = 1514764800000
+N = 50_000
+
+
+@pytest.fixture(scope="module")
+def store(rng_mod):
+    rng = rng_mod
+    ds = TpuDataStore()
+    ds.create_schema(
+        "events",
+        "name:String:index=true,score:Double,dtg:Date,*geom:Point;"
+        "geomesa.z3.interval=week",
+    )
+    ds.write("events", {
+        "name": rng.choice(["alpha", "beta", "gamma", "delta"], N),
+        "score": rng.uniform(0, 100, N),
+        "dtg": rng.integers(MS_2018, MS_2018 + 21 * 86_400_000, N),
+        "geom": (rng.uniform(-75.0, -73.0, N), rng.uniform(40.0, 42.0, N)),
+    })
+    return ds
+
+
+@pytest.fixture(scope="module")
+def rng_mod():
+    return np.random.default_rng(1234)
+
+
+def oracle(store, ecql):
+    st = store._store("events")
+    return np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+
+
+QUERIES = [
+    # z3 path
+    "BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg DURING 2018-01-03T00:00:00Z/2018-01-10T00:00:00Z",
+    # z2 path (no time)
+    "BBOX(geom, -74.2, 40.8, -73.9, 41.1)",
+    # attribute path
+    "name = 'alpha'",
+    # attribute + residual
+    "name = 'beta' AND score > 90",
+    # temporal only (z3 whole-world)
+    "dtg DURING 2018-01-05T00:00:00Z/2018-01-06T00:00:00Z",
+    # OR of boxes
+    "BBOX(geom, -74.9, 40.1, -74.6, 40.4) OR BBOX(geom, -73.4, 41.6, -73.1, 41.9)",
+    # full scan (unindexed attribute predicate)
+    "score < 1.5",
+    # intersects polygon + time
+    "INTERSECTS(geom, POLYGON ((-74.5 40.5, -74 40.5, -74 41.5, -74.5 41.5, -74.5 40.5))) AND dtg AFTER 2018-01-10T00:00:00Z",
+]
+
+
+@pytest.mark.parametrize("ecql", QUERIES)
+def test_query_matches_oracle(store, ecql):
+    got = store.query_result("events", ecql)
+    np.testing.assert_array_equal(np.sort(got.positions), oracle(store, ecql))
+
+
+def test_strategy_selection(store):
+    r = store.query_result(
+        "events",
+        "BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND "
+        "dtg DURING 2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+    assert r.strategy.index == "z3"
+    r = store.query_result("events", "BBOX(geom, -74.2, 40.8, -73.9, 41.1)")
+    assert r.strategy.index == "z2"
+    r = store.query_result("events", "name = 'alpha'")
+    assert r.strategy.index == "attr:name"
+    r = store.query_result("events", "IN ('17', '23', '99999999')")
+    assert r.strategy.index == "id"
+    np.testing.assert_array_equal(r.positions, [17, 23])
+    r = store.query_result("events", "score < 1.5")
+    assert r.strategy.index == "full"
+
+
+def test_sort_and_limit(store):
+    q = Query.of("name = 'alpha'", sort_by="score", sort_desc=True,
+                 max_features=10)
+    batch = store.query("events", q)
+    assert len(batch) == 10
+    scores = batch.column("score")
+    assert np.all(np.diff(scores) <= 0)
+
+
+def test_projection(store):
+    q = Query.of("name = 'gamma'", properties=["name", "geom"])
+    batch = store.query("events", q)
+    assert set(batch.columns) == {"name", "geom_x", "geom_y"}
+
+
+def test_counts_and_bounds(store):
+    assert store.get_count("events") == N
+    env = store.get_bounds("events")
+    assert -75.0 <= env.xmin <= -74.9 and 41.9 <= env.ymax <= 42.0
+    lo, hi = store.get_attribute_bounds("events", "score")
+    assert 0 <= lo < 1 and 99 < hi <= 100
+
+
+def test_explain(store):
+    text = store.explain(
+        "events", "BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND "
+        "dtg DURING 2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+    assert "Strategy selection" in text
+    assert "chosen: z3" in text
+    assert "hits" in text
+
+
+def test_exclude(store):
+    assert len(store.query("events", "EXCLUDE")) == 0
+
+
+def test_polygon_schema_xz2(rng_mod):
+    rng = rng_mod
+    ds = TpuDataStore()
+    ds.create_schema("buildings", "kind:String,*geom:Polygon")
+    n = 5000
+    cx, cy = rng.uniform(-10, 10, n), rng.uniform(40, 50, n)
+    polys = [Polygon([[x - .05, y - .05], [x + .05, y - .05],
+                      [x + .05, y + .05], [x - .05, y + .05]])
+             for x, y in zip(cx, cy)]
+    batch = FeatureBatch.from_dict(ds.get_schema("buildings"),
+                                   {"kind": ["b"] * n, "geom": polys})
+    ds.write("buildings", batch)
+    ecql = "INTERSECTS(geom, POLYGON ((0 44, 3 44, 3 46, 0 46, 0 44)))"
+    r = ds.query_result("buildings", ecql)
+    assert r.strategy.index == "xz2"
+    st = ds._store("buildings")
+    expected = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+    np.testing.assert_array_equal(np.sort(r.positions), expected)
+    assert len(expected) > 0
+
+
+def test_polygon_schema_xz3(rng_mod):
+    rng = rng_mod
+    ds = TpuDataStore()
+    ds.create_schema("tracks", "kind:String,dtg:Date,*geom:Polygon")
+    n = 4000
+    cx, cy = rng.uniform(-10, 10, n), rng.uniform(40, 50, n)
+    polys = [Polygon([[x - .05, y - .05], [x + .05, y - .05],
+                      [x + .05, y + .05], [x - .05, y + .05]])
+             for x, y in zip(cx, cy)]
+    dtg = rng.integers(MS_2018, MS_2018 + 10 * 86_400_000, n)
+    batch = FeatureBatch.from_dict(
+        ds.get_schema("tracks"),
+        {"kind": ["t"] * n, "dtg": dtg, "geom": polys})
+    ds.write("tracks", batch)
+    ecql = ("INTERSECTS(geom, POLYGON ((0 44, 3 44, 3 46, 0 46, 0 44))) AND "
+            "dtg DURING 2018-01-02T00:00:00Z/2018-01-05T00:00:00Z")
+    r = ds.query_result("tracks", ecql)
+    assert r.strategy.index == "xz3"
+    st = ds._store("tracks")
+    expected = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+    np.testing.assert_array_equal(np.sort(r.positions), expected)
+    assert len(expected) > 0
+
+
+def test_catalog_persistence(tmp_path):
+    ds = TpuDataStore(str(tmp_path))
+    ds.create_schema("s1", "a:Int,dtg:Date,*geom:Point")
+    ds.write("s1", {"a": [1], "dtg": [MS_2018], "geom": (np.r_[0.0], np.r_[0.0])})
+    ds.persist_stats("s1")
+    ds2 = TpuDataStore(str(tmp_path))
+    assert ds2.type_names == ["s1"]
+    assert ds2.get_schema("s1").dtg_field == "dtg"
+    ds2.load_stats("s1")
+    assert ds2._store("s1")._stats["count"].count == 1
+
+
+def test_schema_lifecycle():
+    ds = TpuDataStore()
+    ds.create_schema("a", "x:Int,*geom:Point")
+    with pytest.raises(ValueError):
+        ds.create_schema("a", "x:Int,*geom:Point")
+    ds.remove_schema("a")
+    assert ds.type_names == []

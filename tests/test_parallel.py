@@ -1,0 +1,91 @@
+"""Sharded index/aggregation over the 8-device CPU mesh vs single-chip
+oracles (the reference's multi-node-without-a-cluster strategy,
+SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from geomesa_tpu.ops.density import density_grid
+from geomesa_tpu.parallel import ShardedZ3Index, device_mesh
+
+MS_2018 = 1514764800000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    n = 100_003  # deliberately not divisible by 8
+    x = rng.uniform(-75.0, -73.0, n)
+    y = rng.uniform(40.0, 42.0, n)
+    t = rng.integers(MS_2018, MS_2018 + 14 * 86_400_000, n)
+    return x, y, t
+
+
+@pytest.fixture(scope="module")
+def sharded(data):
+    assert len(jax.devices()) == 8
+    return ShardedZ3Index.build(*data, period="week", mesh=device_mesh())
+
+
+def test_total(sharded, data):
+    assert sharded.total() == len(data[0])
+
+
+def test_range_count_covers_true_hits(sharded, data):
+    x, y, t = data
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018 + 2 * 86_400_000, MS_2018 + 9 * 86_400_000
+    count = sharded.range_count([box], tlo, thi)
+    true = np.count_nonzero(
+        (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        & (t >= tlo) & (t <= thi))
+    # candidate count is a superset of the true hits, bounded by total
+    assert true <= count <= len(x)
+    assert count < len(x)  # the index actually prunes
+
+
+def test_density_matches_oracle(sharded, data):
+    x, y, t = data
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018, MS_2018 + 7 * 86_400_000
+    env = box
+    W = H = 64
+    grid = sharded.density([box], tlo, thi, env, W, H)
+    assert grid.shape == (H, W)
+    mask = ((x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+            & (t >= tlo) & (t <= thi))
+    assert grid.sum() == pytest.approx(mask.sum())
+    # oracle histogram
+    dx = (env[2] - env[0]) / W
+    dy = (env[3] - env[1]) / H
+    ix = np.clip(((x - env[0]) / dx).astype(int), 0, W - 1)
+    iy = np.clip(((y - env[1]) / dy).astype(int), 0, H - 1)
+    oracle = np.zeros((H, W))
+    np.add.at(oracle, (iy[mask], ix[mask]), 1.0)
+    np.testing.assert_allclose(grid, oracle)
+
+
+def test_density_weighted(sharded, data):
+    import jax.numpy as jnp
+    x, y, t = data
+    box = (-74.5, 40.5, -73.5, 41.5)
+    w_host = np.arange(len(x), dtype=np.float64) % 7
+    from geomesa_tpu.parallel.mesh import shard_batch
+    (w_sharded,), _ = shard_batch(sharded.mesh, w_host)
+    grid = sharded.density([box], MS_2018, MS_2018 + 7 * 86_400_000, box,
+                           32, 32, weights=w_sharded)
+    mask = ((x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+            & (t >= MS_2018) & (t <= MS_2018 + 7 * 86_400_000))
+    assert grid.sum() == pytest.approx(w_host[mask].sum())
+
+
+def test_single_device_density_kernel(data):
+    import jax.numpy as jnp
+    x, y, t = data
+    env = (-75.0, 40.0, -73.0, 42.0)
+    mask = np.ones(len(x), dtype=bool)
+    grid = np.asarray(density_grid(
+        jnp.asarray(x), jnp.asarray(y), jnp.ones(len(x)),
+        jnp.asarray(mask), env, 128, 128))
+    assert grid.sum() == pytest.approx(len(x))

@@ -1,0 +1,162 @@
+"""Stats sketches: observe/merge/serialize roundtrips and estimation
+accuracy (reference: geomesa-utils stats suite)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import FeatureBatch, parse_spec
+from geomesa_tpu.stats import (
+    CountStat,
+    DescriptiveStats,
+    EnumerationStat,
+    Frequency,
+    Histogram,
+    MinMax,
+    SeqStat,
+    TopK,
+    parse_stat,
+    stat_from_json,
+)
+
+MS_2018 = 1514764800000
+
+
+@pytest.fixture
+def batch(rng):
+    sft = parse_spec("t", "name:String,val:Double,dtg:Date,*geom:Point")
+    n = 10_000
+    return FeatureBatch.from_dict(
+        sft,
+        {
+            "name": rng.choice(["a", "b", "c", "d"], n, p=[0.5, 0.3, 0.15, 0.05]),
+            "val": rng.normal(50, 10, n),
+            "dtg": rng.integers(MS_2018, MS_2018 + 10 * 86_400_000, n),
+            "geom": (rng.uniform(-10, 10, n), rng.uniform(40, 50, n)),
+        },
+    )
+
+
+def halves(batch):
+    n = len(batch)
+    return batch.take(np.arange(n // 2)), batch.take(np.arange(n // 2, n))
+
+
+def test_count_merge(batch):
+    a, b = halves(batch)
+    s1, s2 = CountStat(), CountStat()
+    s1.observe(a)
+    s2.observe(b)
+    assert (s1 + s2).count == len(batch)
+
+
+def test_minmax(batch):
+    s = MinMax("val")
+    s.observe(batch)
+    col = batch.column("val")
+    assert s.min == col.min() and s.max == col.max()
+    a, b = halves(batch)
+    s1, s2 = MinMax("val"), MinMax("val")
+    s1.observe(a)
+    s2.observe(b)
+    m = s1 + s2
+    assert (m.min, m.max) == (s.min, s.max)
+
+
+def test_histogram_estimate(batch):
+    h = Histogram("val", 50, 0.0, 100.0)
+    h.observe(batch)
+    assert h.total == len(batch)
+    est = h.estimate_range(40.0, 60.0)
+    true = np.count_nonzero((batch.column("val") >= 40) & (batch.column("val") <= 60))
+    assert abs(est - true) / true < 0.1
+    # merge equals whole
+    a, b = halves(batch)
+    h1 = Histogram("val", 50, 0.0, 100.0)
+    h2 = Histogram("val", 50, 0.0, 100.0)
+    h1.observe(a)
+    h2.observe(b)
+    np.testing.assert_array_equal((h1 + h2).counts, h.counts)
+
+
+def test_frequency(batch):
+    f = Frequency("name")
+    f.observe(batch)
+    true_a = np.count_nonzero(batch.column("name") == "a")
+    # count-min overestimates but never underestimates
+    assert f.count("a") >= true_a
+    assert f.count("a") <= true_a * 1.2 + 100
+    a, b = halves(batch)
+    f1, f2 = Frequency("name"), Frequency("name")
+    f1.observe(a)
+    f2.observe(b)
+    np.testing.assert_array_equal((f1 + f2).table, f.table)
+
+
+def test_topk(batch):
+    t = TopK("name", k=2)
+    t.observe(batch)
+    top = t.topk()
+    assert top[0][0] == "a" and top[1][0] == "b"
+
+
+def test_enumeration(batch):
+    e = EnumerationStat("name")
+    e.observe(batch)
+    assert sum(e.counts.values()) == len(batch)
+    assert e.counts["a"] == np.count_nonzero(batch.column("name") == "a")
+
+
+def test_descriptive(batch):
+    d = DescriptiveStats("val")
+    d.observe(batch)
+    col = batch.column("val")
+    assert abs(d.mean - col.mean()) < 1e-9
+    assert abs(d.stddev - col.std(ddof=1)) < 1e-6
+    a, b = halves(batch)
+    d1, d2 = DescriptiveStats("val"), DescriptiveStats("val")
+    d1.observe(a)
+    d2.observe(b)
+    m = d1 + d2
+    assert abs(m.mean - d.mean) < 1e-9
+    assert abs(m.variance - d.variance) < 1e-6
+
+
+def test_parser_and_seq(batch):
+    s = parse_stat("Count();MinMax(val);Histogram(val,10,0,100)")
+    assert isinstance(s, SeqStat)
+    s.observe(batch)
+    assert s.stats[0].count == len(batch)
+    assert not s.is_empty
+
+
+def test_groupby(batch):
+    g = parse_stat("GroupBy(name,Count())")
+    g.observe(batch)
+    total = sum(sub.count for sub in g.groups.values())
+    assert total == len(batch)
+    assert g.groups["a"].count == np.count_nonzero(batch.column("name") == "a")
+
+
+def test_json_roundtrip(batch):
+    import json
+    for spec in ["Count()", "MinMax(val)", "Histogram(val,10,0,100)",
+                 "Frequency(name)", "TopK(name)", "Enumeration(name)",
+                 "DescriptiveStats(val)", "GroupBy(name,Count())",
+                 "Count();MinMax(val)"]:
+        s = parse_stat(spec)
+        s.observe(batch)
+        blob = json.dumps(s.to_json())
+        back = stat_from_json(json.loads(blob))
+        assert back.to_json() == s.to_json(), spec
+
+
+def test_z3_histogram(batch):
+    s = parse_stat("Z3Histogram(geom,dtg,week,8)")
+    s.observe(batch)
+    assert sum(s.counts.values()) == len(batch)
+    a, b = halves(batch)
+    s1 = parse_stat("Z3Histogram(geom,dtg,week,8)")
+    s2 = parse_stat("Z3Histogram(geom,dtg,week,8)")
+    s1.observe(a)
+    s2.observe(b)
+    assert (s1 + s2).counts == s.counts
