@@ -1,0 +1,256 @@
+"""geomesa-tpu CLI entry point.
+
+Usage examples (mirroring the reference's tools):
+
+    geomesa-tpu create-schema -c /data/cat -f gdelt \
+        -s "actor:String,dtg:Date,*geom:Point;geomesa.z3.interval=week"
+    geomesa-tpu ingest -c /data/cat -f gdelt -C conv.json events.csv
+    geomesa-tpu export -c /data/cat -f gdelt -q "BBOX(geom,-10,35,15,52)" -F geojson
+    geomesa-tpu explain -c /data/cat -f gdelt -q "..."
+    geomesa-tpu stats-count / stats-bounds / stats-top-k
+    geomesa-tpu get-type-names / describe-schema / remove-schema
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _store(args):
+    from ..datastore import TpuDataStore
+    return TpuDataStore(args.catalog)
+
+
+def cmd_create_schema(args):
+    ds = _store(args)
+    sft = ds.create_schema(args.feature_name, args.spec)
+    print(f"created schema {sft.name}: {sft.spec_string()}")
+
+
+def cmd_get_type_names(args):
+    for n in _store(args).type_names:
+        print(n)
+
+
+def cmd_describe_schema(args):
+    sft = _store(args).get_schema(args.feature_name)
+    print(f"{sft.name}")
+    for a in sft.attributes:
+        star = "*" if a.name == sft.default_geom else " "
+        opts = " ".join(f"{k}={v}" for k, v in a.options.items())
+        print(f"  {star}{a.name}: {a.type} {opts}".rstrip())
+    for k, v in sft.user_data.items():
+        print(f"  {k}={v}")
+
+
+def cmd_remove_schema(args):
+    _store(args).remove_schema(args.feature_name)
+    print(f"removed {args.feature_name}")
+
+
+def cmd_ingest(args):
+    ds = _store(args)
+    sft = ds.get_schema(args.feature_name)
+    from ..io.converters import EvaluationContext, converter_from_config
+
+    total = 0
+    ec = EvaluationContext()
+    if args.converter:
+        with open(args.converter) as f:
+            conv = converter_from_config(sft, json.load(f))
+        for path in args.files:
+            with open(path, "rb") as f:
+                batch = conv.convert(f.read(), ec)
+            if len(batch):
+                total += ds.write(args.feature_name, batch)
+    else:
+        from ..io.export import from_parquet
+        for path in args.files:
+            if not path.endswith(".parquet"):
+                raise SystemExit(
+                    "ingest without -C/--converter supports parquet only")
+            batch = from_parquet(path, sft)
+            total += ds.write(args.feature_name, batch)
+            ec.success += len(batch)
+    ds.flush(args.feature_name)
+    print(f"ingested {total} features ({ec.failure} failed)")
+
+
+def cmd_export(args):
+    ds = _store(args)
+    from ..planning.planner import Query
+    q = Query.of(args.cql, max_features=args.max_features)
+    batch = ds.query(args.feature_name, q)
+    fmt = args.format
+    if fmt == "csv":
+        from ..io.export import to_csv
+        out = to_csv(batch)
+        _write_out(args.output, out)
+    elif fmt == "geojson":
+        from ..io.export import to_geojson
+        _write_out(args.output, to_geojson(batch))
+    elif fmt == "parquet":
+        from ..io.export import to_parquet
+        if not args.output:
+            raise SystemExit("parquet export requires -o/--output")
+        to_parquet(batch, args.output)
+    elif fmt == "arrow":
+        import pyarrow as pa
+        from ..io.export import to_arrow
+        if not args.output:
+            raise SystemExit("arrow export requires -o/--output")
+        with pa.OSFile(args.output, "wb") as sink:
+            table = to_arrow(batch)
+            with pa.ipc.new_file(sink, table.schema) as w:
+                w.write_table(table)
+    elif fmt == "bin":
+        from ..io.bin_encoder import encode_bin
+        x, y = batch.geom_xy()
+        dtg = (batch.column(batch.sft.dtg_field)
+               if batch.sft.dtg_field else [0] * len(batch))
+        track = (batch.column(args.track) if args.track else None)
+        blob = encode_bin(x, y, dtg, track=track)
+        if not args.output:
+            sys.stdout.buffer.write(blob)
+        else:
+            with open(args.output, "wb") as f:
+                f.write(blob)
+    else:
+        raise SystemExit(f"unknown format {fmt!r}")
+    if args.output:
+        print(f"exported {len(batch)} features to {args.output}")
+
+
+def _write_out(path, text):
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+
+
+def cmd_explain(args):
+    print(_store(args).explain(args.feature_name, args.cql))
+
+
+def cmd_stats_count(args):
+    ds = _store(args)
+    q = args.cql if args.cql else None
+    print(ds.get_count(args.feature_name, q))
+
+
+def cmd_stats_bounds(args):
+    env = _store(args).get_bounds(args.feature_name)
+    print("none" if env is None else env.as_tuple())
+
+
+def cmd_stats_top_k(args):
+    ds = _store(args)
+    from ..process import stats_process
+    s = stats_process(ds, args.feature_name, args.cql or "INCLUDE",
+                      f"TopK({args.attribute})")
+    for v, c in s.topk(args.k):
+        print(f"{v}\t{c}")
+
+
+def cmd_stats_histogram(args):
+    ds = _store(args)
+    from ..process import stats_process
+    lo, hi = args.bounds.split(",") if args.bounds else (None, None)
+    if lo is None:
+        b = ds.get_attribute_bounds(args.feature_name, args.attribute)
+        if b is None:
+            raise SystemExit("no bounds available; pass --bounds lo,hi")
+        lo, hi = b
+    s = stats_process(ds, args.feature_name, args.cql or "INCLUDE",
+                      f"Histogram({args.attribute},{args.bins},{lo},{hi})")
+    for i, c in enumerate(s.counts):
+        print(f"bin {i}\t{c}")
+
+
+def cmd_version(args):
+    from .. import __version__
+    print(f"geomesa-tpu {__version__}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="geomesa-tpu",
+                                description="TPU-native spatio-temporal index tools")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, **kw):
+        sp = sub.add_parser(name, **kw)
+        sp.set_defaults(fn=fn)
+        return sp
+
+    def catalog(sp, feature=True):
+        sp.add_argument("-c", "--catalog", required=True,
+                        help="catalog directory")
+        if feature:
+            sp.add_argument("-f", "--feature-name", required=True)
+
+    sp = add("create-schema", cmd_create_schema, help="create a feature schema")
+    catalog(sp)
+    sp.add_argument("-s", "--spec", required=True, help="schema spec string")
+
+    sp = add("get-type-names", cmd_get_type_names, help="list schemas")
+    catalog(sp, feature=False)
+
+    sp = add("describe-schema", cmd_describe_schema, help="describe a schema")
+    catalog(sp)
+
+    sp = add("remove-schema", cmd_remove_schema, help="remove a schema")
+    catalog(sp)
+
+    sp = add("ingest", cmd_ingest, help="ingest files")
+    catalog(sp)
+    sp.add_argument("-C", "--converter", help="converter config (json)")
+    sp.add_argument("files", nargs="+")
+
+    sp = add("export", cmd_export, help="query + export features")
+    catalog(sp)
+    sp.add_argument("-q", "--cql", default="INCLUDE")
+    sp.add_argument("-F", "--format", default="csv",
+                    choices=["csv", "geojson", "parquet", "arrow", "bin"])
+    sp.add_argument("-o", "--output")
+    sp.add_argument("-m", "--max-features", type=int)
+    sp.add_argument("--track", help="track-id attribute for bin export")
+
+    sp = add("explain", cmd_explain, help="explain query planning")
+    catalog(sp)
+    sp.add_argument("-q", "--cql", required=True)
+
+    sp = add("stats-count", cmd_stats_count, help="feature count")
+    catalog(sp)
+    sp.add_argument("-q", "--cql")
+
+    sp = add("stats-bounds", cmd_stats_bounds, help="spatial bounds")
+    catalog(sp)
+
+    sp = add("stats-top-k", cmd_stats_top_k, help="top values of an attribute")
+    catalog(sp)
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("-k", type=int, default=10)
+    sp.add_argument("-q", "--cql")
+
+    sp = add("stats-histogram", cmd_stats_histogram, help="attribute histogram")
+    catalog(sp)
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("--bins", type=int, default=20)
+    sp.add_argument("--bounds", help="lo,hi")
+    sp.add_argument("-q", "--cql")
+
+    add("version", cmd_version, help="print version")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,9 +171,10 @@ class TpuDataStore:
     def remove_schema(self, name: str) -> None:
         self._schemas.pop(name, None)
         if self._catalog_dir:
-            path = os.path.join(self._catalog_dir, f"{name}.schema.json")
-            if os.path.exists(path):
-                os.remove(path)
+            for suffix in (".schema.json", ".parquet", ".stats.json"):
+                path = os.path.join(self._catalog_dir, f"{name}{suffix}")
+                if os.path.exists(path):
+                    os.remove(path)
 
     @property
     def type_names(self) -> list[str]:
@@ -190,8 +191,8 @@ class TpuDataStore:
         store = self._store(name)
         batch = (data if isinstance(data, FeatureBatch)
                  else FeatureBatch.from_dict(store.sft, data, ids=ids))
-        if ids is None and not isinstance(data, FeatureBatch):
-            # feature ids must be unique across writes
+        if not batch.ids_explicit:
+            # feature ids must be unique across writes: re-base auto ids
             base = 0 if store.batch is None else len(store.batch)
             batch.ids = np.array([str(base + i) for i in range(len(batch))],
                                  dtype=object)
@@ -273,6 +274,32 @@ class TpuDataStore:
             self._store(name)._stats = {
                 k: stat_from_json(v) for k, v in raw.items()}
 
+    # -- data persistence (FSDS-analog: parquet files under the catalog) --
+    def flush(self, name: str) -> None:
+        """Persist the schema's features as parquet under the catalog dir
+        (the durable-store role of the reference's FileSystemDataStore)."""
+        if not self._catalog_dir:
+            return
+        store = self._store(name)
+        if store.batch is None:
+            return
+        from .io.export import to_parquet
+        to_parquet(store.batch, os.path.join(self._catalog_dir, f"{name}.parquet"))
+        self.persist_stats(name)
+
+    def _load_data(self, name: str) -> None:
+        path = os.path.join(self._catalog_dir, f"{name}.parquet")
+        if os.path.exists(path):
+            from .io.export import from_parquet
+            store = self._schemas[name]
+            store.batch = from_parquet(path, store.sft)
+            store._dirty = True
+            self.load_stats(name)
+            # rebuild stats if none were persisted
+            if store._stats["count"].count == 0 and len(store.batch):
+                for s in store._stats.values():
+                    s.observe(store.batch)
+
     def _load_catalog(self) -> None:
         for fn in os.listdir(self._catalog_dir):
             if fn.endswith(".schema.json"):
@@ -280,3 +307,4 @@ class TpuDataStore:
                     meta = json.load(f)
                 sft = parse_spec(meta["name"], meta["spec"])
                 self._schemas[sft.name] = _SchemaStore(sft)
+                self._load_data(sft.name)

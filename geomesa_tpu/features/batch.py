@@ -44,6 +44,7 @@ class FeatureBatch:
     columns: dict                    # name -> np.ndarray (see module doc)
     ids: np.ndarray | None = None    # feature ids (object array of str) or None
     geoms: PackedGeometry | None = None  # packed non-point default geometry
+    ids_explicit: bool = True        # False when ids were auto-generated
 
     def __post_init__(self):
         n = len(self)
@@ -53,6 +54,7 @@ class FeatureBatch:
                     f"column {name!r} has length {len(col)}, expected {n}")
         if self.ids is None:
             self.ids = np.array([str(i) for i in range(n)], dtype=object)
+            self.ids_explicit = False
 
     def __len__(self) -> int:
         if self.columns:
@@ -99,7 +101,7 @@ class FeatureBatch:
             else:
                 columns[attr.name] = np.asarray(vals, dtype=_DTYPES[attr.type])
         ids_arr = None if ids is None else np.asarray(ids, dtype=object)
-        return cls(sft, columns, ids_arr, geoms)
+        return cls(sft, columns, ids_arr, geoms, ids_explicit=ids is not None)
 
     # -- access -----------------------------------------------------------
     def column(self, name: str) -> np.ndarray:

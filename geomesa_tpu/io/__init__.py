@@ -1,0 +1,25 @@
+"""IO layer: converter-based ingest and columnar export.
+
+Capability match for the reference's ``geomesa-convert`` framework
+(config-driven parse→transform→validate→feature pipelines with an
+expression language; geomesa-convert/.../AbstractConverter.scala) and the
+tools export formats (csv/json/arrow/bin; tools/export/formats/*) — but
+columnar: converters evaluate transform expressions over whole numpy
+columns, and exports ride pyarrow (Arrow/Parquet) instead of row codecs.
+"""
+
+from .bin_encoder import decode_bin, encode_bin
+from .converters import Converter, EvaluationContext, converter_from_config
+from .export import (
+    from_parquet,
+    to_arrow,
+    to_csv,
+    to_geojson,
+    to_parquet,
+)
+
+__all__ = [
+    "Converter", "EvaluationContext", "converter_from_config",
+    "encode_bin", "decode_bin",
+    "to_arrow", "to_csv", "to_geojson", "to_parquet", "from_parquet",
+]

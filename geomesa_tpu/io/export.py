@@ -1,0 +1,195 @@
+"""Export formats: Arrow, Parquet, CSV, GeoJSON.
+
+The reference exports via per-format encoders (tools/export/formats/*,
+geomesa-arrow's DeltaWriter record batches).  Columnar batches make this
+direct: FeatureBatch ↔ pyarrow Table, with geometry as WKT strings (CSV/
+GeoJSON) or x/y + WKT columns (Arrow/Parquet).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType, parse_spec
+from ..geometry.wkt import geometry_from_wkt, geometry_to_wkt
+
+__all__ = ["to_arrow", "to_parquet", "from_parquet", "to_csv", "to_geojson"]
+
+
+def _geom_wkt_column(batch: FeatureBatch) -> np.ndarray | None:
+    name = batch.sft.default_geom
+    if name is None:
+        return None
+    if batch.geoms is not None:
+        return np.asarray(
+            [geometry_to_wkt(batch.geoms.geometry(i)) for i in range(len(batch))],
+            dtype=object)
+    x, y = batch.geom_xy()
+    return np.asarray([f"POINT ({a} {b})" for a, b in zip(x, y)], dtype=object)
+
+
+def to_arrow(batch: FeatureBatch):
+    """FeatureBatch → pyarrow.Table (dates as timestamp[ms], geometry as
+    WKT plus x/y fast-path columns for points)."""
+    import pyarrow as pa
+
+    arrays, names = [], []
+    arrays.append(pa.array(batch.ids.astype(str)))
+    names.append("__fid__")
+    for attr in batch.sft.attributes:
+        if attr.is_geometry:
+            if f"{attr.name}_x" in batch.columns:
+                arrays.append(pa.array(batch.columns[f"{attr.name}_x"]))
+                names.append(f"{attr.name}_x")
+                arrays.append(pa.array(batch.columns[f"{attr.name}_y"]))
+                names.append(f"{attr.name}_y")
+            if attr.name == batch.sft.default_geom:
+                wkt = _geom_wkt_column(batch)
+                arrays.append(pa.array(wkt))
+                names.append(attr.name)
+            elif f"{attr.name}_bbox" in batch.columns:
+                # secondary non-point geometries are carried at bbox
+                # resolution (FeatureBatch stores packed vertices only for
+                # the default geometry)
+                bb = batch.columns[f"{attr.name}_bbox"]
+                for j, part in enumerate(("xmin", "ymin", "xmax", "ymax")):
+                    arrays.append(pa.array(bb[:, j]))
+                    names.append(f"{attr.name}_bbox_{part}")
+        elif attr.name in batch.columns:
+            col = batch.columns[attr.name]
+            if attr.type == "date":
+                arrays.append(pa.array(col).cast(pa.timestamp("ms")))
+            else:
+                arrays.append(pa.array(col))
+            names.append(attr.name)
+    table = pa.table(dict(zip(names, arrays)))
+    return table.replace_schema_metadata(
+        {"geomesa_tpu.sft": batch.sft.spec_string(),
+         "geomesa_tpu.name": batch.sft.name})
+
+
+def to_parquet(batch: FeatureBatch, path: str) -> None:
+    import pyarrow.parquet as pq
+
+    pq.write_table(to_arrow(batch), path)
+
+
+def from_parquet(path: str, sft: FeatureType | None = None) -> FeatureBatch:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path)
+    meta = table.schema.metadata or {}
+    if sft is None:
+        spec = meta.get(b"geomesa_tpu.sft")
+        name = meta.get(b"geomesa_tpu.name", b"imported")
+        if spec is None:
+            raise ValueError("parquet file lacks geomesa_tpu schema metadata; pass sft")
+        sft = parse_spec(name.decode(), spec.decode())
+    data: dict = {}
+    cols = {c: table.column(c) for c in table.column_names}
+    extra_bbox: dict = {}
+    for attr in sft.attributes:
+        if attr.is_geometry:
+            if attr.type == "point" and f"{attr.name}_x" in cols:
+                data[attr.name] = (
+                    cols[f"{attr.name}_x"].to_numpy(),
+                    cols[f"{attr.name}_y"].to_numpy(),
+                )
+            elif attr.name in cols:
+                wkt = cols[attr.name].to_numpy(zero_copy_only=False)
+                data[attr.name] = [geometry_from_wkt(w) for w in wkt]
+            elif f"{attr.name}_bbox_xmin" in cols:
+                extra_bbox[f"{attr.name}_bbox"] = np.stack(
+                    [cols[f"{attr.name}_bbox_{p}"].to_numpy()
+                     for p in ("xmin", "ymin", "xmax", "ymax")], axis=1)
+        elif attr.name in cols:
+            col = cols[attr.name]
+            if attr.type == "date":
+                data[attr.name] = col.cast("int64").to_numpy()
+            else:
+                data[attr.name] = col.to_numpy(zero_copy_only=False)
+    ids = (cols["__fid__"].to_numpy(zero_copy_only=False)
+           if "__fid__" in cols else None)
+    batch = FeatureBatch.from_dict(sft, data, ids=ids)
+    batch.columns.update(extra_bbox)
+    return batch
+
+
+def to_csv(batch: FeatureBatch) -> str:
+    """CSV export with WKT geometry (tools/export CSV format analog)."""
+    import csv as _csv
+    import io as _io
+
+    out = _io.StringIO()
+    w = _csv.writer(out)
+    header = ["id"] + [a.name for a in batch.sft.attributes]
+    w.writerow(header)
+    wkt = _geom_wkt_column(batch)
+    n = len(batch)
+    cols = []
+    for a in batch.sft.attributes:
+        if a.is_geometry and a.name == batch.sft.default_geom:
+            cols.append(wkt)
+        elif a.type == "date":
+            cols.append(np.datetime_as_string(
+                batch.columns[a.name].astype("M8[ms]"), unit="ms"))
+        elif a.name in batch.columns:
+            cols.append(batch.columns[a.name])
+        else:
+            cols.append(np.full(n, "", dtype=object))
+    for i in range(n):
+        w.writerow([batch.ids[i]] + [c[i] for c in cols])
+    return out.getvalue()
+
+
+def to_geojson(batch: FeatureBatch) -> str:
+    """GeoJSON FeatureCollection export."""
+    feats = []
+    name = batch.sft.default_geom
+    n = len(batch)
+    for i in range(n):
+        if batch.geoms is not None:
+            g = batch.geoms.geometry(i)
+            geom = _geom_to_geojson(g)
+        else:
+            x, y = batch.geom_xy()
+            geom = {"type": "Point", "coordinates": [float(x[i]), float(y[i])]}
+        props = {}
+        for a in batch.sft.attributes:
+            if a.is_geometry:
+                continue
+            v = batch.columns[a.name][i]
+            if a.type == "date":
+                v = str(np.datetime64(int(v), "ms")) + "Z"
+            elif hasattr(v, "item"):
+                v = v.item()
+            props[a.name] = v
+        feats.append({"type": "Feature", "id": str(batch.ids[i]),
+                      "geometry": geom, "properties": props})
+    return json.dumps({"type": "FeatureCollection", "features": feats})
+
+
+def _geom_to_geojson(g):
+    from ..geometry.types import (
+        LineString, MultiLineString, MultiPoint, MultiPolygon, Point, Polygon,
+    )
+    if isinstance(g, Point):
+        return {"type": "Point", "coordinates": [g.x, g.y]}
+    if isinstance(g, LineString):
+        return {"type": "LineString", "coordinates": g.coords.tolist()}
+    if isinstance(g, Polygon):
+        return {"type": "Polygon",
+                "coordinates": [g.shell.tolist()] + [h.tolist() for h in g.holes]}
+    if isinstance(g, MultiPoint):
+        return {"type": "MultiPoint", "coordinates": g.coords.tolist()}
+    if isinstance(g, MultiLineString):
+        return {"type": "MultiLineString",
+                "coordinates": [l.coords.tolist() for l in g.lines]}
+    if isinstance(g, MultiPolygon):
+        return {"type": "MultiPolygon",
+                "coordinates": [[p.shell.tolist()] + [h.tolist() for h in p.holes]
+                                for p in g.polygons]}
+    raise ValueError(g)

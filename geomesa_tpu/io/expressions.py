@@ -1,0 +1,203 @@
+"""Converter transform expressions, evaluated vectorized over columns.
+
+The reference's converter expression language (geomesa-convert-common/
+.../transforms/Expression.scala + ExpressionParser: ``$N`` field refs,
+function calls, literals) re-designed for columnar evaluation: every
+expression maps a dict of input columns to an output column in one numpy
+operation — no per-record interpretation.
+
+Grammar:  expr := func '(' expr (',' expr)* ')' | '$' ref | literal
+Functions cover the reference's common registry (date/geo/string/id/math).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import uuid as _uuid
+
+import numpy as np
+
+__all__ = ["parse_expression", "Expression"]
+
+
+class Expression:
+    def evaluate(self, cols: dict) -> np.ndarray:
+        raise NotImplementedError
+
+
+class _Ref(Expression):
+    def __init__(self, name: str):
+        self.name = name
+
+    def evaluate(self, cols):
+        return np.asarray(cols[self.name])
+
+
+class _Lit(Expression):
+    def __init__(self, value):
+        self.value = value
+
+    def evaluate(self, cols):
+        n = len(next(iter(cols.values()))) if cols else 1
+        return np.full(n, self.value, dtype=object if isinstance(self.value, str) else None)
+
+
+class _Call(Expression):
+    def __init__(self, fn: str, args: list):
+        self.fn = fn
+        self.args = args
+
+    def evaluate(self, cols):
+        impl = _FUNCTIONS.get(self.fn)
+        if impl is None:
+            raise ValueError(f"unknown converter function {self.fn!r}")
+        return impl(cols, *self.args)
+
+
+def _num(cols, e, dtype):
+    v = e.evaluate(cols)
+    if v.dtype == object or v.dtype.kind in ("U", "S"):
+        return np.asarray([dtype(x) for x in v])
+    return v.astype(dtype)
+
+
+def _strcol(cols, e):
+    v = e.evaluate(cols)
+    return v.astype(str) if v.dtype != object else np.asarray([str(x) for x in v])
+
+
+def _fn_date(cols, fmt_e, val_e):
+    fmt = fmt_e.value if isinstance(fmt_e, _Lit) else None
+    raw = val_e.evaluate(cols)
+    # the delimited reader may already have inferred a timestamp column
+    if raw.dtype.kind == "M":
+        return raw.astype("M8[ms]").astype(np.int64)
+    vals = _strcol(cols, val_e)
+    import pandas as pd
+    # java SimpleDateFormat-style patterns → strftime
+    if fmt:
+        fmt = (fmt.replace("yyyy", "%Y").replace("MM", "%m").replace("dd", "%d")
+               .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S")
+               .replace("SSS", "%f").replace("'T'", "T").replace("'Z'", "Z"))
+        ts = pd.to_datetime(vals, format=fmt, utc=True)
+    else:
+        ts = pd.to_datetime(vals, utc=True)
+    return (ts.astype(np.int64) // 1_000_000).to_numpy()
+
+
+def _fn_isodate(cols, val_e):
+    return _fn_date(cols, _Lit(None), val_e)
+
+
+def _fn_millis(cols, e):
+    return _num(cols, e, np.int64)
+
+
+def _fn_seconds(cols, e):
+    return _num(cols, e, np.int64) * 1000
+
+
+def _fn_point(cols, x_e, y_e):
+    return (_num(cols, x_e, np.float64), _num(cols, y_e, np.float64))
+
+
+def _fn_geometry(cols, wkt_e):
+    from ..geometry.wkt import geometry_from_wkt
+    return np.asarray([geometry_from_wkt(w) for w in _strcol(cols, wkt_e)],
+                      dtype=object)
+
+
+def _fn_concat(cols, *es):
+    parts = [_strcol(cols, e) for e in es]
+    out = parts[0]
+    for p in parts[1:]:
+        out = np.char.add(out.astype(str), p.astype(str))
+    return out.astype(object)
+
+
+def _fn_md5(cols, e):
+    return np.asarray([hashlib.md5(str(v).encode()).hexdigest()
+                       for v in e.evaluate(cols)], dtype=object)
+
+
+def _fn_uuid(cols):
+    n = len(next(iter(cols.values()))) if cols else 1
+    return np.asarray([str(_uuid.uuid4()) for _ in range(n)], dtype=object)
+
+
+_FUNCTIONS = {
+    "toint": lambda cols, e: _num(cols, e, np.int32),
+    "tolong": lambda cols, e: _num(cols, e, np.int64),
+    "todouble": lambda cols, e: _num(cols, e, np.float64),
+    "tofloat": lambda cols, e: _num(cols, e, np.float32),
+    "tostring": lambda cols, e: _strcol(cols, e).astype(object),
+    "trim": lambda cols, e: np.char.strip(_strcol(cols, e)).astype(object),
+    "lowercase": lambda cols, e: np.char.lower(_strcol(cols, e)).astype(object),
+    "uppercase": lambda cols, e: np.char.upper(_strcol(cols, e)).astype(object),
+    "date": _fn_date,
+    "isodate": _fn_isodate,
+    "datetime": _fn_isodate,
+    "millistodate": _fn_millis,
+    "secstodate": _fn_seconds,
+    "point": _fn_point,
+    "geometry": _fn_geometry,
+    "concat": _fn_concat,
+    "concatenate": _fn_concat,
+    "md5": _fn_md5,
+    "uuid": lambda cols: _fn_uuid(cols),
+}
+
+_TOKEN = re.compile(r"""\s*(?:
+      (?P<dollar>\$[A-Za-z0-9_.]+)
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<number>-?\d+\.?\d*)
+    | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<punct>[(),])
+)""", re.VERBOSE)
+
+
+def parse_expression(text: str) -> Expression:
+    toks = []
+    pos = 0
+    while pos < len(text):
+        if text[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN.match(text, pos)
+        if not m:
+            raise ValueError(f"bad expression at {text[pos:pos+20]!r}")
+        toks.append((m.lastgroup, m.group(m.lastgroup)))
+        pos = m.end()
+    expr, i = _parse(toks, 0)
+    if i != len(toks):
+        raise ValueError(f"trailing tokens in expression {text!r}")
+    return expr
+
+
+def _parse(toks, i):
+    kind, val = toks[i]
+    if kind == "dollar":
+        return _Ref(val[1:]), i + 1
+    if kind == "string":
+        return _Lit(val[1:-1].replace("''", "'")), i + 1
+    if kind == "number":
+        f = float(val)
+        return _Lit(int(f) if f.is_integer() and "." not in val else f), i + 1
+    if kind == "name":
+        fn = val.lower()
+        if i + 1 < len(toks) and toks[i + 1][1] == "(":
+            args = []
+            j = i + 2
+            if toks[j][1] != ")":
+                while True:
+                    arg, j = _parse(toks, j)
+                    args.append(arg)
+                    if toks[j][1] == ")":
+                        break
+                    if toks[j][1] != ",":
+                        raise ValueError("expected ',' in argument list")
+                    j += 1
+            return _Call(fn, args), j + 1
+        raise ValueError(f"bare name {val!r} in expression")
+    raise ValueError(f"unexpected token {val!r}")

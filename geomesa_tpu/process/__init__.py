@@ -1,0 +1,16 @@
+"""Analytic processes: the WPS-process capability surface
+(geomesa-process/geomesa-process-vector in the reference) re-expressed as
+vectorized query + device-aggregation pipelines over the datastore.
+"""
+
+from .density import density_process
+from .knn import knn_process
+from .proximity import proximity_process
+from .sampling import sample_positions
+from .stats_process import stats_process
+from .tube import tube_select
+
+__all__ = [
+    "density_process", "knn_process", "proximity_process",
+    "sample_positions", "stats_process", "tube_select",
+]

@@ -1,0 +1,31 @@
+"""Density process: heatmap grids over query results (the reference's
+DensityProcess / DENSITY_* query hints, process/analytic/
+DensityProcess.scala + iterators/DensityScan.scala)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.density import density_grid
+
+__all__ = ["density_process"]
+
+
+def density_process(store, schema: str, query, env,
+                    width: int = 256, height: int = 256,
+                    weight_attr: str | None = None) -> np.ndarray:
+    """Run ``query`` and accumulate matching features into a (height, width)
+    weighted grid over envelope ``env`` (xmin, ymin, xmax, ymax)."""
+    result = store.query_result(schema, query)
+    batch = result.batch
+    if len(batch) == 0:
+        return np.zeros((height, width))
+    x, y = batch.geom_xy()
+    w = (batch.column(weight_attr).astype(np.float64)
+         if weight_attr else np.ones(len(batch)))
+    mask = np.ones(len(batch), dtype=bool)
+    grid = density_grid(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(w), jnp.asarray(mask),
+        tuple(float(v) for v in env), width, height)
+    return np.asarray(grid)

@@ -1,0 +1,55 @@
+"""Proximity search: features within a distance of input geometries
+(the reference's ProximitySearchProcess)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..geometry.types import Point
+from .knn import EARTH_RADIUS_M, haversine_m
+
+__all__ = ["proximity_process"]
+
+
+def proximity_process(store, schema: str, geometries, distance_m: float):
+    """Positions of features within ``distance_m`` meters of any of the
+    input geometries (points / vertices of lines and polygons)."""
+    from ..planning.planner import Query
+    from ..filters.ast import BBox
+
+    sft = store.get_schema(schema)
+    geom = sft.geom_field
+    parts = []
+    for g in geometries:
+        env = g.envelope
+        dlat = np.degrees(distance_m / EARTH_RADIUS_M)
+        cos = max(0.01, np.cos(np.radians((env.ymin + env.ymax) / 2)))
+        dlon = dlat / cos
+        box = (env.xmin - dlon, env.ymin - dlat, env.xmax + dlon, env.ymax + dlat)
+        r = store.query_result(schema, Query.of(BBox(geom, *box)))
+        if not len(r.positions):
+            continue
+        bx, by = r.batch.geom_xy(geom)
+        if isinstance(g, Point):
+            d = haversine_m(g.x, g.y, bx, by)
+            parts.append(r.positions[d <= distance_m])
+        else:
+            from ..geometry.predicates import _segments, point_in_polygon
+            from ..geometry.types import MultiPolygon, Polygon
+            from .tube import _point_segment_dist_deg
+            # distance to the geometry's segments
+            segs = _segments(g)
+            dist_deg, t = _point_segment_dist_deg(
+                bx, by, segs[0][:, 0], segs[0][:, 1], segs[1][:, 0], segs[1][:, 1])
+            seg_idx = np.argmin(dist_deg, axis=1)
+            rows = np.arange(len(bx))
+            tb = t[rows, seg_idx]
+            cx = segs[0][seg_idx, 0] + tb * (segs[1][seg_idx, 0] - segs[0][seg_idx, 0])
+            cy = segs[0][seg_idx, 1] + tb * (segs[1][seg_idx, 1] - segs[0][seg_idx, 1])
+            keep = haversine_m(bx, by, cx, cy) <= distance_m
+            if isinstance(g, (Polygon, MultiPolygon)):
+                keep |= point_in_polygon(bx, by, g)
+            parts.append(r.positions[keep])
+    if not parts:
+        return np.empty(0, dtype=np.int64)
+    return np.unique(np.concatenate(parts))

@@ -1,0 +1,31 @@
+"""Result sampling: 1-in-n thinning, optionally per attribute group
+(the reference's SamplingIterator / SAMPLING query hints,
+index/iterators/SamplingIterator.scala + utils/FeatureSampler.scala)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sample_positions"]
+
+
+def sample_positions(positions: np.ndarray, n: int,
+                     group_keys: np.ndarray | None = None) -> np.ndarray:
+    """Keep every n-th position (deterministic stride, matching the
+    reference's modulo sampler); with ``group_keys``, sample 1-in-n
+    independently within each group (the per-attribute mode, e.g. one
+    point per track per interval)."""
+    if n <= 1 or len(positions) == 0:
+        return positions
+    if group_keys is None:
+        return positions[::n]
+    group_keys = np.asarray(group_keys)
+    order = np.argsort(group_keys, kind="stable")
+    sorted_keys = group_keys[order]
+    # index within each group
+    starts = np.ones(len(sorted_keys), dtype=bool)
+    starts[1:] = sorted_keys[1:] != sorted_keys[:-1]
+    group_start_idx = np.maximum.accumulate(np.where(starts, np.arange(len(sorted_keys)), 0))
+    within = np.arange(len(sorted_keys)) - group_start_idx
+    keep = within % n == 0
+    return np.sort(positions[order[keep]])

@@ -1,0 +1,19 @@
+"""Stats process: run a stat DSL over query results (the reference's
+StatsProcess / STATS_STRING hint, process/analytic/StatsProcess.scala +
+iterators/StatsScan.scala)."""
+
+from __future__ import annotations
+
+from ..stats.stat import Stat, parse_stat
+
+__all__ = ["stats_process"]
+
+
+def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
+    """Evaluate ``stat_spec`` (e.g. "Count();MinMax(score)") over the
+    features matching ``query``."""
+    result = store.query_result(schema, query)
+    stat = parse_stat(stat_spec)
+    if len(result.batch):
+        stat.observe(result.batch)
+    return stat

@@ -1,0 +1,117 @@
+"""CLI command tree: create-schema → ingest → export/explain/stats over a
+filesystem catalog (reference: geomesa-tools Runner commands)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.cli.main import main
+
+CSV = """2018-01-01 10:00:00,alice,-74.1,40.7
+2018-01-01 11:30:00,bob,2.35,48.85
+2018-01-02 09:15:00,carol,139.7,35.6
+"""
+
+CONV = {
+    "type": "delimited-text",
+    "format": "CSV",
+    "fields": [
+        {"name": "dtg", "transform": "date('yyyy-MM-dd HH:mm:ss', $0)"},
+        {"name": "name", "transform": "$1"},
+        {"name": "geom", "transform": "point($2, $3)"},
+    ],
+}
+
+
+@pytest.fixture
+def catalog(tmp_path):
+    cat = str(tmp_path / "cat")
+    csv_path = tmp_path / "data.csv"
+    csv_path.write_text(CSV)
+    conv_path = tmp_path / "conv.json"
+    conv_path.write_text(json.dumps(CONV))
+    main(["create-schema", "-c", cat, "-f", "people",
+          "-s", "name:String,dtg:Date,*geom:Point"])
+    main(["ingest", "-c", cat, "-f", "people", "-C", str(conv_path),
+          str(csv_path)])
+    return cat, tmp_path
+
+
+def test_roundtrip_and_counts(catalog, capsys):
+    cat, tmp = catalog
+    main(["get-type-names", "-c", cat])
+    main(["stats-count", "-c", cat, "-f", "people"])
+    out = capsys.readouterr().out
+    assert "people" in out and "3" in out
+
+
+def test_export_csv(catalog, capsys):
+    cat, tmp = catalog
+    main(["export", "-c", cat, "-f", "people", "-q",
+          "BBOX(geom, -80, 30, 10, 50)"])
+    out = capsys.readouterr().out
+    assert "alice" in out and "bob" in out and "carol" not in out
+
+
+def test_export_geojson_file(catalog):
+    cat, tmp = catalog
+    out_path = str(tmp / "out.geojson")
+    main(["export", "-c", cat, "-f", "people", "-F", "geojson",
+          "-o", out_path])
+    fc = json.loads(open(out_path).read())
+    assert len(fc["features"]) == 3
+
+
+def test_export_parquet_and_reingest(catalog, capsys):
+    cat, tmp = catalog
+    pq = str(tmp / "out.parquet")
+    main(["export", "-c", cat, "-f", "people", "-F", "parquet", "-o", pq])
+    cat2 = str(tmp / "cat2")
+    main(["create-schema", "-c", cat2, "-f", "people",
+          "-s", "name:String,dtg:Date,*geom:Point"])
+    main(["ingest", "-c", cat2, "-f", "people", pq])
+    capsys.readouterr()
+    main(["stats-count", "-c", cat2, "-f", "people"])
+    assert capsys.readouterr().out.strip() == "3"
+
+
+def test_explain_and_describe(catalog, capsys):
+    cat, tmp = catalog
+    main(["explain", "-c", cat, "-f", "people", "-q",
+          "BBOX(geom, -80, 30, 10, 50) AND dtg DURING 2018-01-01T00:00:00Z/2018-01-02T00:00:00Z"])
+    out = capsys.readouterr().out
+    assert "chosen: z3" in out
+    main(["describe-schema", "-c", cat, "-f", "people"])
+    out = capsys.readouterr().out
+    assert "*geom" in out
+
+
+def test_stats_commands(catalog, capsys):
+    cat, tmp = catalog
+    main(["stats-bounds", "-c", cat, "-f", "people"])
+    main(["stats-top-k", "-c", cat, "-f", "people", "-a", "name"])
+    out = capsys.readouterr().out
+    assert "alice" in out
+    main(["version"])
+    assert "geomesa-tpu" in capsys.readouterr().out
+
+
+def test_bin_export(catalog, tmp_path):
+    cat, tmp = catalog
+    out_path = str(tmp / "out.bin")
+    main(["export", "-c", cat, "-f", "people", "-F", "bin", "-o", out_path])
+    from geomesa_tpu.io import decode_bin
+    back = decode_bin(open(out_path, "rb").read())
+    assert len(back["lon"]) == 3
+
+
+def test_catalog_persists_across_processes(catalog, capsys):
+    cat, tmp = catalog
+    # a brand-new datastore instance (fresh "process") sees the data
+    main(["stats-count", "-c", cat, "-f", "people"])
+    assert capsys.readouterr().out.strip() == "3"
+    main(["remove-schema", "-c", cat, "-f", "people"])
+    capsys.readouterr()
+    main(["get-type-names", "-c", cat])
+    assert "people" not in capsys.readouterr().out

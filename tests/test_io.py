@@ -1,0 +1,176 @@
+"""Converters, exports and BIN encoding (reference: geomesa-convert,
+tools export formats, BinaryOutputEncoder)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features import parse_spec
+from geomesa_tpu.io import (
+    EvaluationContext,
+    converter_from_config,
+    decode_bin,
+    encode_bin,
+    from_parquet,
+    to_arrow,
+    to_csv,
+    to_geojson,
+    to_parquet,
+)
+
+CSV = """2018-01-01 10:00:00,alice,42,-74.1,40.7
+2018-01-01 11:30:00,bob,7,2.35,48.85
+2018-01-02 09:15:00,carol,99,139.7,35.6
+"""
+
+
+@pytest.fixture
+def sft():
+    return parse_spec("people", "name:String,age:Int,dtg:Date,*geom:Point")
+
+
+@pytest.fixture
+def csv_converter(sft):
+    return converter_from_config(sft, {
+        "type": "delimited-text",
+        "format": "CSV",
+        "id-field": "md5($1)",
+        "fields": [
+            {"name": "dtg", "transform": "date('yyyy-MM-dd HH:mm:ss', $0)"},
+            {"name": "name", "transform": "$1"},
+            {"name": "age", "transform": "toInt($2)"},
+            {"name": "geom", "transform": "point($3, $4)"},
+        ],
+    })
+
+
+def test_csv_converter(csv_converter):
+    ec = EvaluationContext()
+    batch = csv_converter.convert(CSV, ec)
+    assert len(batch) == 3 and ec.success == 3 and ec.failure == 0
+    assert batch.column("name")[1] == "bob"
+    assert batch.column("age")[2] == 99
+    x, y = batch.geom_xy()
+    np.testing.assert_allclose(x, [-74.1, 2.35, 139.7])
+    # 2018-01-01T10:00:00Z
+    assert batch.column("dtg")[0] == 1514764800000 + 10 * 3_600_000
+    # md5 ids are deterministic
+    assert batch.ids[0] == __import__("hashlib").md5(b"alice").hexdigest()
+
+
+def test_json_converter(sft):
+    conv = converter_from_config(sft, {
+        "type": "json",
+        "fields": [
+            {"name": "dtg", "transform": "millisToDate($ts)"},
+            {"name": "name", "transform": "$user.name"},
+            {"name": "age", "transform": "toInt($age)"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+    })
+    src = "\n".join(json.dumps(r) for r in [
+        {"ts": 1514764800000, "user": {"name": "a"}, "age": 1, "lon": 0.5, "lat": 1.5},
+        {"ts": 1514764800001, "user": {"name": "b"}, "age": 2, "lon": 2.5, "lat": 3.5},
+    ])
+    batch = conv.convert(src)
+    assert len(batch) == 2
+    assert list(batch.column("name")) == ["a", "b"]
+    np.testing.assert_allclose(batch.geom_xy()[1], [1.5, 3.5])
+
+
+def test_geojson_converter():
+    sft = parse_spec("places", "title:String,*geom:Point")
+    conv = converter_from_config(sft, {
+        "type": "geojson",
+        "fields": [
+            {"name": "title", "transform": "$title"},
+            {"name": "geom", "transform": "$geometry"},
+        ],
+    })
+    fc = {"type": "FeatureCollection", "features": [
+        {"type": "Feature", "id": "f1",
+         "geometry": {"type": "Point", "coordinates": [1.0, 2.0]},
+         "properties": {"title": "spot"}},
+    ]}
+    batch = conv.convert(json.dumps(fc))
+    assert len(batch) == 1
+    assert batch.column("title")[0] == "spot"
+
+
+def test_error_mode(sft):
+    conv = converter_from_config(sft, {
+        "type": "csv",
+        "fields": [{"name": "age", "transform": "toInt($1)"}],
+        "options": {"error-mode": "skip"},
+    })
+    ec = EvaluationContext()
+    batch = conv.convert("x,notanumber\n", ec)
+    assert len(batch) == 0 and ec.failure == 1
+    conv2 = converter_from_config(sft, {
+        "type": "csv",
+        "fields": [{"name": "age", "transform": "toInt($1)"}],
+        "options": {"error-mode": "raise"},
+    })
+    with pytest.raises(Exception):
+        conv2.convert("x,notanumber\n")
+
+
+@pytest.fixture
+def batch(sft):
+    return __import__("geomesa_tpu.features", fromlist=["FeatureBatch"]).FeatureBatch.from_dict(
+        sft,
+        {
+            "name": ["a", "b"],
+            "age": [1, 2],
+            "dtg": np.array([1514764800000, 1514764900000]),
+            "geom": (np.array([0.0, 1.0]), np.array([2.0, 3.0])),
+        },
+        ids=["f1", "f2"],
+    )
+
+
+def test_arrow_roundtrip(batch, tmp_path):
+    table = to_arrow(batch)
+    assert table.num_rows == 2
+    assert b"geomesa_tpu.sft" in (table.schema.metadata or {})
+    path = str(tmp_path / "out.parquet")
+    to_parquet(batch, path)
+    back = from_parquet(path)
+    assert len(back) == 2
+    np.testing.assert_array_equal(back.column("age"), batch.column("age"))
+    np.testing.assert_array_equal(back.column("dtg"), batch.column("dtg"))
+    np.testing.assert_allclose(back.geom_xy()[0], batch.geom_xy()[0])
+    assert list(back.ids) == ["f1", "f2"]
+
+
+def test_csv_export(batch):
+    text = to_csv(batch)
+    lines = text.strip().splitlines()
+    assert lines[0] == "id,name,age,dtg,geom"
+    assert "POINT (0.0 2.0)" in lines[1]
+    assert "2018-01-01T00:00:00.000" in lines[1]
+
+
+def test_geojson_export(batch):
+    fc = json.loads(to_geojson(batch))
+    assert fc["type"] == "FeatureCollection"
+    assert fc["features"][1]["geometry"]["coordinates"] == [1.0, 3.0]
+    assert fc["features"][0]["properties"]["name"] == "a"
+
+
+def test_bin_roundtrip():
+    x = np.array([-74.1, 2.35], dtype=np.float32)
+    y = np.array([40.7, 48.85], dtype=np.float32)
+    t = np.array([1514764800000, 1514764900000])
+    blob = encode_bin(x, y, t, track=np.array(["v1", "v2"]))
+    assert len(blob) == 32  # 2 × 16 bytes
+    back = decode_bin(blob)
+    np.testing.assert_allclose(back["lon"], x)
+    np.testing.assert_allclose(back["lat"], y)
+    np.testing.assert_array_equal(back["dtg_ms"], t // 1000 * 1000)
+    # labelled variant
+    blob24 = encode_bin(x, y, t, track=["v1", "v2"], label=["ab", "cdefghij"])
+    assert len(blob24) == 48
+    back24 = decode_bin(blob24, labelled=True)
+    assert list(back24["label"]) == ["ab", "cdefghij"]

@@ -1,0 +1,136 @@
+"""Analytic processes vs brute-force oracles (reference: geomesa-process)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.geometry import Point, Polygon
+from geomesa_tpu.process import (
+    density_process,
+    knn_process,
+    proximity_process,
+    sample_positions,
+    stats_process,
+    tube_select,
+)
+from geomesa_tpu.process.knn import haversine_m
+
+MS_2018 = 1514764800000
+N = 30_000
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(5)
+    ds = TpuDataStore()
+    ds.create_schema("ais", "vessel:String,dtg:Date,*geom:Point")
+    ds.write("ais", {
+        "vessel": rng.choice([f"v{i}" for i in range(50)], N),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * 86_400_000, N),
+        "geom": (rng.uniform(-5.0, 5.0, N), rng.uniform(45.0, 55.0, N)),
+    })
+    return ds
+
+
+def test_knn_matches_bruteforce(store):
+    x0, y0, k = 1.0, 50.0, 25
+    pos, dist = knn_process(store, "ais", x0, y0, k)
+    batch = store._store("ais").batch
+    bx, by = batch.geom_xy()
+    all_d = haversine_m(x0, y0, bx, by)
+    expected = np.sort(all_d)[:k]
+    np.testing.assert_allclose(np.sort(dist), expected)
+    assert len(pos) == k
+
+
+def test_knn_with_time(store):
+    tlo, thi = MS_2018, MS_2018 + 86_400_000
+    pos, dist = knn_process(store, "ais", 0.0, 50.0, 10, tlo, thi)
+    batch = store._store("ais").batch
+    t = batch.column("dtg")
+    assert np.all((t[pos] >= tlo) & (t[pos] <= thi))
+    bx, by = batch.geom_xy()
+    mask = (t >= tlo) & (t <= thi)
+    expected = np.sort(haversine_m(0.0, 50.0, bx[mask], by[mask]))[:10]
+    np.testing.assert_allclose(np.sort(dist), expected)
+
+
+def test_knn_sparse_area(store):
+    # far from the data cloud: expanding rounds must still find k
+    pos, dist = knn_process(store, "ais", 20.0, 50.0, 5)
+    assert len(pos) == 5
+    assert np.all(np.diff(dist) >= 0)
+
+
+def test_tube_select(store):
+    track = np.array([[-2.0, 47.0], [0.0, 50.0], [2.0, 53.0]])
+    times = np.array([MS_2018, MS_2018 + 3_600_000, MS_2018 + 7_200_000])
+    buffer_m, tbuf = 50_000.0, 1_800_000
+    got = tube_select(store, "ais", track, times, buffer_m, tbuf)
+    # oracle: exact distance to track + time interpolation
+    batch = store._store("ais").batch
+    bx, by = batch.geom_xy()
+    t = batch.column("dtg").astype(np.float64)
+    from geomesa_tpu.process.tube import _point_segment_dist_deg
+    dd, tt = _point_segment_dist_deg(bx, by, track[:-1, 0], track[:-1, 1],
+                                     track[1:, 0], track[1:, 1])
+    seg = np.argmin(dd, axis=1)
+    rows = np.arange(len(bx))
+    tb = tt[rows, seg]
+    cx = track[:-1, 0][seg] + tb * (track[1:, 0] - track[:-1, 0])[seg]
+    cy = track[:-1, 1][seg] + tb * (track[1:, 1] - track[:-1, 1])[seg]
+    dist_ok = haversine_m(bx, by, cx, cy) <= buffer_m
+    t_interp = times[:-1].astype(float)[seg] + tb * (times[1:] - times[:-1]).astype(float)[seg]
+    time_ok = np.abs(t - t_interp) <= tbuf
+    expected = np.flatnonzero(dist_ok & time_ok)
+    np.testing.assert_array_equal(got, expected)
+    assert len(expected) > 0
+
+
+def test_proximity_point(store):
+    got = proximity_process(store, "ais", [Point(0.0, 50.0)], 30_000.0)
+    batch = store._store("ais").batch
+    bx, by = batch.geom_xy()
+    expected = np.flatnonzero(haversine_m(0.0, 50.0, bx, by) <= 30_000.0)
+    np.testing.assert_array_equal(got, expected)
+    assert len(expected) > 0
+
+
+def test_proximity_polygon(store):
+    poly = Polygon([[-1.0, 49.0], [1.0, 49.0], [1.0, 51.0], [-1.0, 51.0]])
+    got = proximity_process(store, "ais", [poly], 10_000.0)
+    batch = store._store("ais").batch
+    bx, by = batch.geom_xy()
+    from geomesa_tpu.geometry.predicates import point_in_polygon
+    inside = point_in_polygon(bx, by, poly)
+    assert np.all(np.isin(np.flatnonzero(inside), got))
+
+
+def test_density_process(store):
+    env = (-5.0, 45.0, 5.0, 55.0)
+    grid = density_process(store, "ais", "INCLUDE", env, 64, 64)
+    assert grid.sum() == pytest.approx(N)
+    # weighted
+    grid_w = density_process(store, "ais", "INCLUDE", env, 64, 64,
+                             weight_attr="dtg")
+    assert grid_w.sum() > grid.sum()
+
+
+def test_stats_process(store):
+    s = stats_process(store, "ais", "BBOX(geom, -1, 49, 1, 51)",
+                      "Count();MinMax(dtg)")
+    batch = store._store("ais").batch
+    bx, by = batch.geom_xy()
+    mask = (bx >= -1) & (bx <= 1) & (by >= 49) & (by <= 51)
+    assert s.stats[0].count == mask.sum()
+    assert s.stats[1].min == batch.column("dtg")[mask].min()
+
+
+def test_sampling():
+    pos = np.arange(100)
+    assert len(sample_positions(pos, 10)) == 10
+    groups = np.repeat(np.arange(5), 20)
+    got = sample_positions(pos, 7, group_keys=groups)
+    # each group of 20 keeps ceil(20/7)=3
+    assert len(got) == 15
+    assert len(sample_positions(pos, 1)) == 100
