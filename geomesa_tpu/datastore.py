@@ -192,10 +192,14 @@ class TpuDataStore:
         batch = (data if isinstance(data, FeatureBatch)
                  else FeatureBatch.from_dict(store.sft, data, ids=ids))
         if not batch.ids_explicit:
-            # feature ids must be unique across writes: re-base auto ids
+            # feature ids must be unique across writes: re-base auto ids on
+            # a shallow copy so the caller's batch (and any prior-write
+            # alias held by the store) is never mutated
             base = 0 if store.batch is None else len(store.batch)
-            batch.ids = np.array([str(base + i) for i in range(len(batch))],
-                                 dtype=object)
+            batch = FeatureBatch(
+                batch.sft, dict(batch.columns), geoms=batch.geoms,
+                ids=np.array([str(base + i) for i in range(len(batch))],
+                             dtype=object))
         store.write(batch)
         return len(batch)
 

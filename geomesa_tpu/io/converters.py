@@ -89,7 +89,10 @@ class DelimitedTextConverter(Converter):
 
         default_fmt = "TSV" if self.config.get("type", "").lower() == "tsv" else "CSV"
         fmt = self.config.get("format", default_fmt).upper()
-        delim = {"CSV": ",", "TSV": "\t"}.get(fmt, self.config.get("delimiter", ","))
+        if "delimiter" in self.config:
+            delim = self.config["delimiter"]
+        else:
+            delim = {"CSV": ",", "TSV": "\t"}.get(fmt, ",")
         opts = self.config.get("options", {})
         skip = int(opts.get("skip-lines", 0))
         has_header = bool(opts.get("header", False))
@@ -129,6 +132,9 @@ class JsonConverter(Converter):
         for f in self.config.get("fields", []):
             for m in _json_refs(f.get("transform", "")):
                 paths.add(m)
+            if not f.get("transform"):
+                # transform-less fields read the raw column by name
+                paths.add(f["name"])
         if "id-field" in self.config:
             paths.update(_json_refs(self.config["id-field"]))
         cols: dict = {}

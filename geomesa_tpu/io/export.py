@@ -133,13 +133,13 @@ def to_csv(batch: FeatureBatch) -> str:
     for a in batch.sft.attributes:
         if a.is_geometry and a.name == batch.sft.default_geom:
             cols.append(wkt)
+        elif a.name not in batch.columns:
+            cols.append(np.full(n, "", dtype=object))
         elif a.type == "date":
             cols.append(np.datetime_as_string(
                 batch.columns[a.name].astype("M8[ms]"), unit="ms"))
-        elif a.name in batch.columns:
-            cols.append(batch.columns[a.name])
         else:
-            cols.append(np.full(n, "", dtype=object))
+            cols.append(batch.columns[a.name])
     for i in range(n):
         w.writerow([batch.ids[i]] + [c[i] for c in cols])
     return out.getvalue()
@@ -159,7 +159,7 @@ def to_geojson(batch: FeatureBatch) -> str:
             geom = {"type": "Point", "coordinates": [float(x[i]), float(y[i])]}
         props = {}
         for a in batch.sft.attributes:
-            if a.is_geometry:
+            if a.is_geometry or a.name not in batch.columns:
                 continue
             v = batch.columns[a.name][i]
             if a.type == "date":

@@ -17,7 +17,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-__all__ = ["density_grid", "grid_snap"]
+__all__ = ["density_grid", "density_grid_auto", "grid_snap"]
 
 
 def grid_snap(x, y, env, width: int, height: int):
@@ -43,3 +43,13 @@ def density_grid(x, y, weights, mask, env, width: int, height: int):
     w = jnp.where(mask, weights, 0.0)
     grid = jnp.zeros(width * height, dtype=jnp.float64).at[flat].add(w)
     return grid.reshape(height, width)
+
+
+def density_grid_auto(x, y, weights, mask, env, width: int, height: int):
+    """Dispatch to the Pallas MXU histogram on TPU (scatter-add lowers to a
+    serialized update loop there), the XLA scatter path elsewhere."""
+    from .pallas_kernels import density_grid_pallas, on_tpu
+
+    if on_tpu():
+        return density_grid_pallas(x, y, weights, mask, env, width, height)
+    return density_grid(x, y, weights, mask, env, width, height)

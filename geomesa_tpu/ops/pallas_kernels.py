@@ -1,0 +1,204 @@
+"""Pallas TPU kernels for the scan-side hot ops.
+
+The reference runs its aggregation hot loops row-at-a-time inside tablet
+servers (AggregatingScan.aggregate, geomesa-index-api/.../iterators/
+AggregatingScan.scala:80-102; DensityScan.writeGeom, DensityScan.scala:55-58).
+The XLA ports in :mod:`geomesa_tpu.ops.density` express the same math as
+scatter-adds, which TPU lowers to a serialized per-element update loop.
+These Pallas kernels re-shape the work for the hardware instead:
+
+* **density**: the weighted 2-D histogram becomes a one-hot contraction on
+  the MXU — each (chunk × grid-tile) program compares its chunk's flat cell
+  ids against the tile's cell ids (broadcasted iota), multiplies by the
+  weight column, and accumulates ``w @ onehot`` partials in a VMEM scratch
+  accumulator across chunk steps.  O(N·G) lane-parallel flops replace O(N)
+  serialized scatter updates; for GDELT-scale N and a 128-256² grid the MXU
+  does this in ~1ms.
+* **z3 candidate mask**: the push-down filter semantics of
+  Z3Filter.inBounds (index/filters/Z3Filter.scala:19-55) — de-interleave
+  each candidate z and compare the int-space coordinates against R query
+  boxes — fused into one VMEM-resident pass producing a packed bool mask.
+
+Both kernels are shape-polymorphic over padded inputs (pad with mask=0
+rows) and run in interpreter mode off-TPU, so the same tests cover CPU CI
+and real chips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["density_grid_pallas", "z3_mask_pallas", "on_tpu"]
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover - backend probing never fatal
+        return False
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# density: one-hot MXU histogram
+# ---------------------------------------------------------------------------
+
+_CHUNK = 512          # features per program along N
+_GTILE = 2048         # grid cells per program along G
+
+
+def _density_kernel(cells_ref, w_ref, out_ref, acc_ref):
+    """One (grid-tile j, chunk i) step: acc += w_i @ onehot(cells_i, tile_j).
+
+    The chunk axis i is the fastest grid dimension, so for each grid tile j
+    the accumulator is initialized at i == 0, summed over all chunks, and
+    flushed at the last chunk before the next tile reuses the scratch.
+    """
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    n_i = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cells = cells_ref[:]                       # (1, CHUNK) int32 flat cell ids
+    w = w_ref[:]                               # (1, CHUNK) f32 (0 where masked)
+    base = j * _GTILE
+    tile_ids = base + jax.lax.broadcasted_iota(jnp.int32, (_CHUNK, _GTILE), 1)
+    onehot = (cells.reshape(_CHUNK, 1) == tile_ids).astype(jnp.float32)
+    acc_ref[:] += jnp.dot(w, onehot, preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_i - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("width", "height"))
+def density_grid_pallas(x, y, weights, mask, env, width: int, height: int):
+    """Weighted masked 2-D histogram via MXU one-hot contraction.
+
+    Same contract as :func:`geomesa_tpu.ops.density.density_grid`
+    (DensityScan.writeGeom + client-side grid merge, DensityScan.scala:55-58,
+    115-139): snap (x, y) to a ``height × width`` grid over ``env``,
+    accumulate ``weights`` where ``mask``; returns float32 grid.
+    """
+    xmin, ymin, xmax, ymax = env
+    dx = (xmax - xmin) / width
+    dy = (ymax - ymin) / height
+    ix = jnp.clip(jnp.floor((x - xmin) / dx).astype(jnp.int32), 0, width - 1)
+    iy = jnp.clip(jnp.floor((y - ymin) / dy).astype(jnp.int32), 0, height - 1)
+    cells = iy * width + ix
+    # masked-out rows point at an id past every grid tile → contribute nowhere
+    cells = jnp.where(mask, cells, jnp.int32(width * height))
+    w = jnp.where(mask, weights, 0.0).astype(jnp.float32)
+
+    n = cells.shape[0]
+    n_pad = max(_CHUNK, ((n + _CHUNK - 1) // _CHUNK) * _CHUNK)
+    cells = jnp.pad(cells, (0, n_pad - n), constant_values=width * height)
+    w = jnp.pad(w, (0, n_pad - n))
+
+    g = width * height
+    g_pad = max(_GTILE, ((g + _GTILE - 1) // _GTILE) * _GTILE)
+
+    n_chunks = n_pad // _CHUNK
+    grid = (g_pad // _GTILE, n_chunks)
+    out = pl.pallas_call(
+        _density_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _CHUNK), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _CHUNK), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _GTILE), lambda j, i: (0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, g_pad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, _GTILE), jnp.float32)],
+        interpret=_interpret(),
+    )(cells.reshape(n_chunks, _CHUNK), w.reshape(n_chunks, _CHUNK))
+    return out[0, :g].reshape(height, width)
+
+
+# ---------------------------------------------------------------------------
+# z3 candidate mask: fused de-interleave + R-box bounds test
+# ---------------------------------------------------------------------------
+
+_ZCHUNK = 1024
+
+
+def _z3_mask_kernel(boxes_ref, z_ref, tlo_ref, thi_ref, out_ref):
+    """Per-chunk Z3Filter.inBounds: decode z, OR the R box tests, AND the
+    per-candidate time-offset bounds."""
+    z = z_ref[:].astype(jnp.uint64)                    # (1, ZCHUNK)
+
+    def combine3(v):
+        v = v & jnp.uint64(0x1249249249249249)
+        v = (v ^ (v >> jnp.uint64(2))) & jnp.uint64(0x10C30C30C30C30C3)
+        v = (v ^ (v >> jnp.uint64(4))) & jnp.uint64(0x100F00F00F00F00F)
+        v = (v ^ (v >> jnp.uint64(8))) & jnp.uint64(0x1F0000FF0000FF)
+        v = (v ^ (v >> jnp.uint64(16))) & jnp.uint64(0x1F00000000FFFF)
+        v = (v ^ (v >> jnp.uint64(32))) & jnp.uint64(0x1FFFFF)
+        return v
+
+    xs = combine3(z).astype(jnp.int32)
+    ys = combine3(z >> jnp.uint64(1)).astype(jnp.int32)
+    ts = combine3(z >> jnp.uint64(2)).astype(jnp.int32)
+
+    r = boxes_ref.shape[0]
+    hit = jnp.zeros(z.shape, jnp.bool_)
+    for k in range(r):                                 # R is static & small
+        ok = (xs >= boxes_ref[k, 0]) & (ys >= boxes_ref[k, 1])
+        ok &= (xs <= boxes_ref[k, 2]) & (ys <= boxes_ref[k, 3])
+        hit |= ok
+    out_ref[:] = hit & (ts >= tlo_ref[:]) & (ts <= thi_ref[:])
+
+
+@jax.jit
+def z3_mask_pallas(z, ixy, tlo, thi):
+    """Vectorized Z3Filter.inBounds over R int-space boxes.
+
+    ``z``: (N,) candidate z values; ``ixy``: (R, 4) int32 normalized
+    [xlo, ylo, xhi, yhi]; ``tlo``/``thi``: (N,) int32 per-candidate time
+    offset bounds (already gathered per owning range).  Returns bool (N,).
+    Mirrors index/filters/Z3Filter.scala:19-55 (pointInBounds +
+    timeInBounds per row) as one fused VMEM pass.
+    """
+    n = z.shape[0]
+    n_pad = max(_ZCHUNK, ((n + _ZCHUNK - 1) // _ZCHUNK) * _ZCHUNK)
+    zp = jnp.pad(z.astype(jnp.int64), (0, n_pad - n))
+    tlop = jnp.pad(jnp.asarray(tlo, jnp.int32), (0, n_pad - n),
+                   constant_values=1)
+    thip = jnp.pad(jnp.asarray(thi, jnp.int32), (0, n_pad - n))
+    grid_n = n_pad // _ZCHUNK
+    ixy = jnp.asarray(ixy, jnp.int32).reshape(-1, 4)
+    r = ixy.shape[0]
+
+    out = pl.pallas_call(
+        _z3_mask_kernel,
+        grid=(grid_n,),
+        in_specs=[
+            pl.BlockSpec((r, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((grid_n, _ZCHUNK), jnp.bool_),
+        interpret=_interpret(),
+    )(ixy, zp.reshape(grid_n, _ZCHUNK), tlop.reshape(grid_n, _ZCHUNK),
+      thip.reshape(grid_n, _ZCHUNK))
+    return out.reshape(-1)[:n]

@@ -7,7 +7,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.density import density_grid
+from ..ops.density import density_grid_auto as density_grid
 
 __all__ = ["density_process"]
 

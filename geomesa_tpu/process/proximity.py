@@ -37,8 +37,18 @@ def proximity_process(store, schema: str, geometries, distance_m: float):
             from ..geometry.predicates import _segments, point_in_polygon
             from ..geometry.types import MultiPolygon, Polygon
             from .tube import _point_segment_dist_deg
-            # distance to the geometry's segments
+            # distance to the geometry's segments; geometries with no
+            # segments (e.g. MultiPoint) reduce to per-vertex point checks
             segs = _segments(g)
+            if segs[0].shape[0] == 0:
+                verts = np.atleast_2d(getattr(g, "coords", np.empty((0, 2))))
+                if verts.shape[0] == 0:
+                    continue
+                d = np.min(
+                    np.stack([haversine_m(vx, vy, bx, by) for vx, vy in verts]),
+                    axis=0)
+                parts.append(r.positions[d <= distance_m])
+                continue
             dist_deg, t = _point_segment_dist_deg(
                 bx, by, segs[0][:, 0], segs[0][:, 1], segs[1][:, 0], segs[1][:, 1])
             seg_idx = np.argmin(dist_deg, axis=1)

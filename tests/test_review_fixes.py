@@ -1,0 +1,103 @@
+"""Regressions for review findings: converter delimiter/raw fields, batch
+id aliasing on rewrite, sparse-batch exports, MultiPoint proximity."""
+
+import numpy as np
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features import FeatureBatch
+from geomesa_tpu.geometry import MultiPoint
+from geomesa_tpu.io.converters import converter_from_config
+from geomesa_tpu.io.export import to_csv, to_geojson
+from geomesa_tpu.process.proximity import proximity_process
+
+MS_2018 = 1514764800000
+
+
+def _sft_store():
+    ds = TpuDataStore()
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    return ds
+
+
+def test_delimited_custom_delimiter():
+    ds = _sft_store()
+    conv = converter_from_config(ds.get_schema("t"), {
+        "type": "delimited-text",
+        "delimiter": "|",
+        "fields": [
+            {"name": "name", "transform": "$0"},
+            {"name": "dtg", "transform": "isoDate('2018-01-01T00:00:00Z')"},
+            {"name": "geom", "transform": "point($1, $2)"},
+        ],
+    })
+    batch = conv.convert("a|-75.0|40.0\nb|-74.0|41.0\n")
+    assert len(batch) == 2
+    assert list(batch.columns["name"]) == ["a", "b"]
+
+
+def test_json_transformless_field():
+    ds = _sft_store()
+    conv = converter_from_config(ds.get_schema("t"), {
+        "type": "json",
+        "fields": [
+            {"name": "name"},
+            {"name": "dtg", "transform": "isoDate('2018-01-01T00:00:00Z')"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+    })
+    batch = conv.convert('{"name": "x", "lon": -75.0, "lat": 40.0}\n')
+    assert len(batch) == 1
+    assert batch.columns["name"][0] == "x"
+
+
+def test_rewrite_same_batch_unique_ids():
+    ds = _sft_store()
+    b = FeatureBatch.from_dict(ds.get_schema("t"), {
+        "name": np.array(["a", "b"], dtype=object),
+        "dtg": np.array([MS_2018, MS_2018], dtype=np.int64),
+        "geom": (np.array([-75.0, -74.0]), np.array([40.0, 41.0])),
+    })
+    orig_ids = b.ids.copy()
+    ds.write("t", b)
+    np.testing.assert_array_equal(b.ids, orig_ids)  # caller batch untouched
+    ds.write("t", b)
+    stored = ds.query("t")
+    assert len(stored) == 4
+    assert len(set(stored.ids)) == 4
+
+
+def test_export_sparse_batch():
+    ds = _sft_store()
+    # write a batch missing the 'name' column entirely
+    ds.write("t", {
+        "dtg": np.array([MS_2018], dtype=np.int64),
+        "geom": (np.array([-75.0]), np.array([40.0])),
+    })
+    out = ds.query("t")
+    # must not have 'name' materialized
+    assert "name" not in out.columns
+    csv_text = to_csv(out)
+    assert "2018-01-01" in csv_text
+    gj = to_geojson(out)
+    assert '"type": "FeatureCollection"' in gj or "FeatureCollection" in gj
+
+
+def test_proximity_multipoint():
+    ds = _sft_store()
+    n = 500
+    rng = np.random.default_rng(5)
+    ds.write("t", {
+        "name": np.array(["p"] * n, dtype=object),
+        "dtg": np.full(n, MS_2018, dtype=np.int64),
+        "geom": (rng.uniform(-75.5, -74.5, n), rng.uniform(39.5, 40.5, n)),
+    })
+    mp = MultiPoint(np.array([[-75.0, 40.0], [-74.6, 40.4]]))
+    pos = proximity_process(ds, "t", [mp], 20_000.0)
+    # oracle: haversine to either point
+    x = ds.query("t").columns.get("geom")
+    bx, by = ds.query("t").geom_xy()
+    from geomesa_tpu.process.knn import haversine_m
+    d = np.minimum(haversine_m(-75.0, 40.0, bx, by),
+                   haversine_m(-74.6, 40.4, bx, by))
+    want = np.sort(np.nonzero(d <= 20_000.0)[0])
+    np.testing.assert_array_equal(np.sort(pos), want)
