@@ -1,0 +1,80 @@
+"""Query auditing: per-query event records.
+
+The analog of the reference's audit subsystem (index/audit/QueryEvent.scala,
+accumulo/audit/AccumuloAuditService.scala — async writes of per-query
+records with filter, hints, timings, hit counts into a store table, with
+REST readback via geomesa-web's QueryAuditEndpoint).  Here events go to a
+pluggable writer: in-memory ring (tests/inspection) or JSONL file.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+__all__ = ["QueryEvent", "AuditWriter", "InMemoryAuditWriter",
+           "JsonlAuditWriter"]
+
+
+@dataclass
+class QueryEvent:
+    """One executed query (QueryEvent.scala fields, minus the KV row)."""
+
+    store: str
+    type_name: str
+    user: str
+    filter: str
+    hints: dict = field(default_factory=dict)
+    plan_time_ms: float = 0.0
+    scan_time_ms: float = 0.0
+    hits: int = 0
+    timestamp: float = field(default_factory=time.time)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), default=str)
+
+
+class AuditWriter:
+    """Base: synchronous no-op; subclasses persist events."""
+
+    def write_event(self, event: QueryEvent) -> None:  # pragma: no cover
+        pass
+
+
+class InMemoryAuditWriter(AuditWriter):
+    """Bounded in-memory event log."""
+
+    def __init__(self, capacity: int = 10_000):
+        self.events: deque[QueryEvent] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def write_event(self, event: QueryEvent) -> None:
+        with self._lock:
+            self.events.append(event)
+
+    def query_events(self, type_name: str | None = None,
+                     since: float | None = None) -> list[QueryEvent]:
+        with self._lock:
+            out = list(self.events)
+        if type_name is not None:
+            out = [e for e in out if e.type_name == type_name]
+        if since is not None:
+            out = [e for e in out if e.timestamp >= since]
+        return out
+
+
+class JsonlAuditWriter(AuditWriter):
+    """Append events as JSON lines (the file-sink analog of the
+    reference's audit table writes)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def write_event(self, event: QueryEvent) -> None:
+        line = event.to_json()
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")

@@ -81,6 +81,9 @@ class QueryProperties:
         "geomesa.scan.block.full.table", False)
     #: cost strategy: 'stats' (cost-based) or 'index' (heuristic priority)
     COST_TYPE = SystemProperty("geomesa.query.cost.type", "stats")
+    #: use the Pallas candidate-filter kernel on TPU backends (falls back
+    #: to the fused XLA path automatically if lowering fails)
+    PALLAS_SCAN = SystemProperty("geomesa.scan.pallas", True)
 
 
 #: default scan-ranges budget (import-time snapshot users can override per

@@ -48,6 +48,8 @@ class _SchemaStore:
     def __init__(self, sft: FeatureType):
         self.sft = sft
         self.batch: FeatureBatch | None = None
+        self.visibilities: np.ndarray | None = None  # per-feature vis strings
+        self._vis_masks: dict = {}
         self._dirty = True
         self._indexes: dict = {}
         self._stats: dict[str, Stat] = {}
@@ -67,14 +69,35 @@ class _SchemaStore:
                 self._stats[f"{a.name}_topk"] = TopK(a.name)
                 self._stats[f"{a.name}_enumeration"] = EnumerationStat(a.name)
 
-    def write(self, batch: FeatureBatch):
+    def write(self, batch: FeatureBatch, visibility: str = ""):
+        vis = np.full(len(batch), visibility, dtype=object)
         if self.batch is None:
             self.batch = batch
+            self.visibilities = vis
         else:
+            if self.visibilities is None:  # pre-visibility data (e.g. reload)
+                self.visibilities = np.full(len(self.batch), "", dtype=object)
             self.batch = self.batch.concat(batch)
+            self.visibilities = np.concatenate([self.visibilities, vis])
         for s in self._stats.values():
             s.observe(batch)
+        self._vis_masks: dict = {}
         self._dirty = True
+
+    def vis_mask(self, auths) -> np.ndarray | None:
+        """Cached per-auth-set visibility mask over all features; None when
+        every label is empty (everything visible)."""
+        if self.visibilities is None:
+            return None
+        key = frozenset(auths)
+        cache = getattr(self, "_vis_masks", None)
+        if cache is None:
+            cache = self._vis_masks = {}
+        if key not in cache:
+            from .security import visibility_mask
+            mask = visibility_mask(self.visibilities, key)
+            cache[key] = None if mask.all() else mask
+        return cache[key]
 
     def stats_map(self) -> dict:
         return self._stats
@@ -135,9 +158,14 @@ class _SchemaStore:
 class TpuDataStore:
     """In-process spatio-temporal datastore over columnar TPU indexes."""
 
-    def __init__(self, catalog_dir: str | None = None):
+    def __init__(self, catalog_dir: str | None = None, *,
+                 auth_provider=None, audit_writer=None, user: str = "unknown"):
         self._schemas: dict[str, _SchemaStore] = {}
         self._catalog_dir = catalog_dir
+        self._auth_provider = auth_provider
+        self._audit_writer = audit_writer
+        self._user = user
+        self._interceptors: dict[str, list] = {}
         if catalog_dir:
             os.makedirs(catalog_dir, exist_ok=True)
             self._load_catalog()
@@ -164,14 +192,18 @@ class TpuDataStore:
         if [a.name for a in sft.attributes] != [a.name for a in store.sft.attributes]:
             raise ValueError("updateSchema cannot add/remove attributes")
         store.sft = sft
+        self._interceptors.pop(name, None)
         if sft.name != name:
             self._schemas[sft.name] = self._schemas.pop(name)
+            self._interceptors.pop(sft.name, None)
         self._persist_schema(sft)
 
     def remove_schema(self, name: str) -> None:
         self._schemas.pop(name, None)
+        self._interceptors.pop(name, None)
         if self._catalog_dir:
-            for suffix in (".schema.json", ".parquet", ".stats.json"):
+            for suffix in (".schema.json", ".parquet", ".stats.json",
+                           ".vis.json"):
                 path = os.path.join(self._catalog_dir, f"{name}{suffix}")
                 if os.path.exists(path):
                     os.remove(path)
@@ -186,8 +218,17 @@ class TpuDataStore:
         return self._schemas[name]
 
     # -- ingest -----------------------------------------------------------
-    def write(self, name: str, data, ids=None) -> int:
-        """Append features: a FeatureBatch or a dict of columns."""
+    def write(self, name: str, data, ids=None, visibility: str = "") -> int:
+        """Append features: a FeatureBatch or a dict of columns.
+
+        ``visibility`` is an optional visibility expression (e.g.
+        ``"admin&ops"``) applied to every feature in this write; queries
+        made with an auth provider only see features whose expression
+        their auths satisfy.
+        """
+        if visibility:
+            from .security import parse_visibility
+            parse_visibility(visibility)  # validate eagerly
         store = self._store(name)
         batch = (data if isinstance(data, FeatureBatch)
                  else FeatureBatch.from_dict(store.sft, data, ids=ids))
@@ -200,7 +241,9 @@ class TpuDataStore:
                 batch.sft, dict(batch.columns), geoms=batch.geoms,
                 ids=np.array([str(base + i) for i in range(len(batch))],
                              dtype=object))
-        store.write(batch)
+        store.write(batch, visibility=visibility)
+        from .metrics import registry as _metrics
+        _metrics.counter(f"write.{name}.features").inc(len(batch))
         return len(batch)
 
     # -- query ------------------------------------------------------------
@@ -212,15 +255,43 @@ class TpuDataStore:
                      explain: Explainer | None = None) -> QueryResult:
         store = self._store(name)
         q = query if isinstance(query, Query) else Query.of(query)
+        q = self._intercept(store.sft, q)
         if store.batch is None or len(store.batch) == 0:
             empty = FeatureBatch(store.sft, {
                 k: np.empty(0, dtype=v.dtype)
                 for k, v in (store.batch.columns.items() if store.batch else [])
             })
             from .planning.strategy import FilterStrategy
-            return QueryResult(empty, np.empty(0, dtype=np.int64),
-                               FilterStrategy("none", 0), 0.0, 0.0)
-        return QueryPlanner(store.sft, store).run(q, explain)
+            result = QueryResult(empty, np.empty(0, dtype=np.int64),
+                                 FilterStrategy("none", 0), 0.0, 0.0)
+            self._audit(name, q, result)
+            return result
+        allowed = (store.vis_mask(self._auth_provider.get_authorizations())
+                   if self._auth_provider is not None else None)
+        result = QueryPlanner(store.sft, store).run(q, explain, allowed=allowed)
+        self._audit(name, q, result)
+        return result
+
+    def _intercept(self, sft: FeatureType, q: Query) -> Query:
+        from .planning.interceptor import apply_interceptors, load_interceptors
+
+        if sft.name not in self._interceptors:
+            self._interceptors[sft.name] = load_interceptors(sft)
+        return apply_interceptors(self._interceptors[sft.name], sft, q)
+
+    def _audit(self, name: str, q: Query, result: QueryResult) -> None:
+        from .metrics import registry as _metrics
+        _metrics.counter(f"query.{name}.count").inc()
+        _metrics.timer(f"query.{name}.plan_ms").update(result.plan_time_ms)
+        _metrics.timer(f"query.{name}.scan_ms").update(result.scan_time_ms)
+        if self._audit_writer is not None:
+            from .audit import QueryEvent
+            self._audit_writer.write_event(QueryEvent(
+                store="tpu", type_name=name, user=self._user,
+                filter=repr(q.filter), hints=dict(q.hints),
+                plan_time_ms=result.plan_time_ms,
+                scan_time_ms=result.scan_time_ms,
+                hits=len(result.positions)))
 
     def explain(self, name: str, query="INCLUDE") -> str:
         from .planning.explain import ExplainString
@@ -289,6 +360,14 @@ class TpuDataStore:
             return
         from .io.export import to_parquet
         to_parquet(store.batch, os.path.join(self._catalog_dir, f"{name}.parquet"))
+        if store.visibilities is not None:
+            # dictionary-encoded: visibilities are low-cardinality
+            uniq, codes = np.unique(store.visibilities.astype(str),
+                                    return_inverse=True)
+            with open(os.path.join(self._catalog_dir,
+                                   f"{name}.vis.json"), "w") as f:
+                json.dump({"labels": uniq.tolist(),
+                           "codes": codes.tolist()}, f)
         self.persist_stats(name)
 
     def _load_data(self, name: str) -> None:
@@ -298,6 +377,15 @@ class TpuDataStore:
             store = self._schemas[name]
             store.batch = from_parquet(path, store.sft)
             store._dirty = True
+            vis_path = os.path.join(self._catalog_dir, f"{name}.vis.json")
+            if os.path.exists(vis_path):
+                with open(vis_path) as f:
+                    enc = json.load(f)
+                labels = np.asarray(enc["labels"], dtype=object)
+                store.visibilities = labels[np.asarray(enc["codes"], int)]
+            else:
+                store.visibilities = np.full(len(store.batch), "",
+                                             dtype=object)
             self.load_stats(name)
             # rebuild stats if none were persisted
             if store._stats["count"].count == 0 and len(store.batch):

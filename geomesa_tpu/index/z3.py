@@ -36,8 +36,17 @@ import numpy as np
 from ..curve.binnedtime import TimePeriod, max_date_ms, max_offset, to_binned_time
 from ..curve.sfc import Z3SFC, z3_sfc
 from ..curve.zorder import deinterleave3
-from ..config import DEFAULT_MAX_RANGES
+from ..config import DEFAULT_MAX_RANGES, QueryProperties
 from ..ops.search import expand_ranges, gather_capacity, searchsorted2
+
+
+def _use_pallas_scan() -> bool:
+    """Pallas candidate scan: on by default on TPU backends, off elsewhere
+    (interpret mode would be slower than the fused XLA path)."""
+    if not QueryProperties.PALLAS_SCAN.get():
+        return False
+    from ..ops.pallas_kernels import on_tpu
+    return on_tpu()
 
 __all__ = ["Z3PointIndex", "Z3QueryPlan", "plan_z3_query"]
 
@@ -204,6 +213,49 @@ def _scan_candidates(
     return posc, mask
 
 
+@partial(jax.jit, static_argnames=("capacity",))
+def _gather_candidates(z, pos, starts, counts, rtlo, rthi, capacity: int):
+    """Stage 1 of the pallas scan: fixed-capacity gather of candidate keys
+    plus per-candidate time bounds (by owning range)."""
+    idx, valid, rid = expand_ranges(starts, counts, capacity)
+    return z[idx], pos[idx], valid, rtlo[rid], rthi[rid]
+
+
+@partial(jax.jit, static_argnames=())
+def _exact_recheck(x, y, dtg, posc, boxes, t_lo_ms, t_hi_ms):
+    """Stage 3: exact double-precision predicate on the original columns
+    (the FilterTransformIterator re-check)."""
+    xc = x[posc]
+    yc = y[posc]
+    tc = dtg[posc]
+    in_box = (
+        (xc[:, None] >= boxes[None, :, 0])
+        & (yc[:, None] >= boxes[None, :, 1])
+        & (xc[:, None] <= boxes[None, :, 2])
+        & (yc[:, None] <= boxes[None, :, 3])
+    ).any(axis=1)
+    return in_box & (tc >= t_lo_ms) & (tc <= t_hi_ms)
+
+
+#: tri-state: None = untried, True = pallas scan works on this backend,
+#: False = failed once (e.g. Mosaic lowering) — stay on the XLA path
+_pallas_scan_ok: bool | None = None
+
+
+def _scan_candidates_pallas(bins, z, pos, x, y, dtg, starts, counts,
+                            rtlo, rthi, ixy, boxes, t_lo_ms, t_hi_ms,
+                            capacity: int):
+    """Pallas variant of :func:`_scan_candidates`: the z-decode +
+    int-bounds stage (Z3Filter.inBounds) runs as a fused VMEM kernel."""
+    from ..ops.pallas_kernels import z3_mask_pallas
+
+    zc, posc, valid, tlo_c, thi_c = _gather_candidates(
+        z, pos, starts, counts, rtlo, rthi, capacity)
+    mask_int = z3_mask_pallas(zc, ixy, tlo_c, thi_c)
+    mask_exact = _exact_recheck(x, y, dtg, posc, boxes, t_lo_ms, t_hi_ms)
+    return posc, valid & mask_int & mask_exact
+
+
 class Z3PointIndex:
     """Device-resident Z3 index over point features with timestamps."""
 
@@ -262,14 +314,24 @@ class Z3PointIndex:
         total = int(jnp.sum(counts))
         if total == 0:
             return np.empty(0, dtype=np.int64)
-        posc, mask = _scan_candidates(
+        args = (
             self.bins, self.z, self.pos, self.x, self.y, self.dtg,
             starts, counts,
             jnp.asarray(plan.rtlo), jnp.asarray(plan.rthi),
             jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
             plan.t_lo_ms, plan.t_hi_ms,
-            capacity=gather_capacity(total),
         )
+        capacity = gather_capacity(total)
+        global _pallas_scan_ok
+        if _pallas_scan_ok is not False and _use_pallas_scan():
+            try:
+                posc, mask = _scan_candidates_pallas(*args, capacity=capacity)
+                _pallas_scan_ok = True
+            except Exception:  # Mosaic lowering unavailable → XLA path
+                _pallas_scan_ok = False
+                posc, mask = _scan_candidates(*args, capacity=capacity)
+        else:
+            posc, mask = _scan_candidates(*args, capacity=capacity)
         posc = np.asarray(posc)
         mask = np.asarray(mask)
         return np.sort(posc[mask]).astype(np.int64)

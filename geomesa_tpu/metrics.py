@@ -1,0 +1,133 @@
+"""Metrics: counters / timers / histograms with pluggable reporters.
+
+The analog of the reference's geomesa-metrics module (dropwizard
+MetricRegistry with config-driven reporters — Ganglia, Graphite, SLF4J,
+delimited file; geomesa-metrics/.../config/MetricsConfig.scala:15-17,
+reporters/*.scala).  Network reporters are out of scope in this image;
+provided sinks are logging and delimited-file, behind the same reporter
+protocol so others can be plugged in.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["MetricRegistry", "Timer", "Counter", "HistogramMetric",
+           "LoggingReporter", "DelimitedFileReporter", "registry"]
+
+
+@dataclass
+class Counter:
+    count: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self.count += n
+
+
+@dataclass
+class HistogramMetric:
+    """Streaming count/mean/min/max (sufficient for reporting sinks)."""
+
+    count: int = 0
+    total: float = 0.0
+    min: float = float("inf")
+    max: float = float("-inf")
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def update(self, value: float):
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Timer(HistogramMetric):
+    """Histogram of durations (ms) usable as a context manager."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.update((time.perf_counter() - self._t0) * 1000.0)
+        return False
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def histogram(self, name: str) -> HistogramMetric:
+        return self._get(name, HistogramMetric)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {}
+            for name, m in sorted(self._metrics.items()):
+                if isinstance(m, Counter):
+                    out[name] = {"count": m.count}
+                else:
+                    out[name] = {"count": m.count, "mean": m.mean,
+                                 "min": m.min if m.count else 0.0,
+                                 "max": m.max if m.count else 0.0}
+            return out
+
+
+class LoggingReporter:
+    """SLF4J-reporter analog: dump the registry to a logger."""
+
+    def __init__(self, reg: MetricRegistry, logger=None,
+                 level: int = logging.INFO):
+        self.registry = reg
+        self.logger = logger or logging.getLogger("geomesa_tpu.metrics")
+        self.level = level
+
+    def report(self):
+        for name, vals in self.registry.snapshot().items():
+            self.logger.log(self.level, "%s %s", name, vals)
+
+
+class DelimitedFileReporter:
+    """Delimited-file-reporter analog: append CSV rows per metric."""
+
+    def __init__(self, reg: MetricRegistry, path: str, delimiter: str = ","):
+        self.registry = reg
+        self.path = path
+        self.delimiter = delimiter
+
+    def report(self):
+        ts = time.time()
+        with open(self.path, "a") as f:
+            for name, vals in self.registry.snapshot().items():
+                row = [f"{ts:.3f}", name] + [
+                    f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in vals.items()]
+                f.write(self.delimiter.join(row) + "\n")
+
+
+#: process-wide default registry (the reference's shared MetricRegistry)
+registry = MetricRegistry()

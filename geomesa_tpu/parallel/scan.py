@@ -27,7 +27,7 @@ from jax.experimental.shard_map import shard_map
 from ..curve.binnedtime import TimePeriod, to_binned_time
 from ..curve.sfc import z3_sfc
 from ..index.z3 import Z3QueryPlan, plan_z3_query
-from ..ops.density import density_grid
+from ..ops.density import density_grid, density_grid_auto
 from ..ops.search import searchsorted2
 from .mesh import device_mesh, shard_batch
 
@@ -137,7 +137,16 @@ def sharded_density(mesh, x, y, dtg, valid, weights, boxes,
             & (xs[:, None] <= bx[None, :, 2]) & (ys[:, None] <= bx[None, :, 3])
         ).any(axis=1)
         mask = vs & in_box & (ts >= t_lo_ms) & (ts <= t_hi_ms)
-        grid = density_grid(xs, ys, ws, mask, env, width, height)
+        grid = _dens_grid(xs, ys, ws, mask, env, width, height)
         return jax.lax.psum(grid, "shard")
 
-    return np.asarray(jax.jit(dens)(x, y, dtg, valid, weights, boxes))
+    _dens_grid = density_grid_auto
+    try:
+        return np.asarray(jax.jit(dens)(x, y, dtg, valid, weights, boxes))
+    except Exception:
+        # Pallas lowering may be unavailable under this backend/mesh —
+        # retry on the portable XLA scatter path
+        if _dens_grid is density_grid:
+            raise
+        _dens_grid = density_grid
+        return np.asarray(jax.jit(dens)(x, y, dtg, valid, weights, boxes))

@@ -1,0 +1,59 @@
+"""Query interceptors: user-pluggable query rewrites.
+
+The reference's QueryInterceptor SPI (index-api planning/
+QueryInterceptor.scala): per-schema classes loaded from the SFT user-data
+key ``geomesa.query.interceptors``, each given a chance to rewrite the
+query before planning (e.g. enforcing a default time range, injecting
+sampling hints, blocking expensive predicates).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Protocol, runtime_checkable
+
+__all__ = ["QueryInterceptor", "load_interceptors", "apply_interceptors",
+           "GuardedQueryInterceptor"]
+
+USER_DATA_KEY = "geomesa.query.interceptors"
+
+
+@runtime_checkable
+class QueryInterceptor(Protocol):
+    def rewrite(self, sft, query):  # pragma: no cover - protocol
+        """Return the (possibly modified) query."""
+        ...
+
+
+class GuardedQueryInterceptor:
+    """Example guard: reject full-table scans (Filter == INCLUDE) —
+    the QueryProperties.BlockFullTableScans behavior
+    (index/conf/QueryProperties.scala:37-44) expressed as an interceptor."""
+
+    def rewrite(self, sft, query):
+        from ..filters.ast import Include
+
+        if query.filter is Include or type(query.filter).__name__ == "Include":
+            raise ValueError(
+                f"full-table scan blocked on {sft.name!r} by interceptor")
+        return query
+
+
+def load_interceptors(sft) -> list:
+    """Instantiate the interceptor classes named in the SFT's user data
+    (comma-separated ``module:Class`` or ``module.Class`` paths)."""
+    raw = sft.user_data.get(USER_DATA_KEY, "")
+    out = []
+    for name in (n.strip() for n in str(raw).split(",") if n.strip()):
+        if ":" in name:
+            mod, cls = name.split(":", 1)
+        else:
+            mod, _, cls = name.rpartition(".")
+        out.append(getattr(importlib.import_module(mod), cls)())
+    return out
+
+
+def apply_interceptors(interceptors, sft, query):
+    for it in interceptors:
+        query = it.rewrite(sft, query)
+    return query

@@ -64,7 +64,11 @@ class QueryPlanner:
         self.sft = sft
         self.store = store  # _SchemaStore (datastore.py)
 
-    def run(self, query: Query, explain: Explainer | None = None) -> QueryResult:
+    def run(self, query: Query, explain: Explainer | None = None,
+            allowed: np.ndarray | None = None) -> QueryResult:
+        """Plan and execute.  ``allowed`` is an optional per-feature bool
+        mask (row-level security) applied before sort/limit so that
+        ``max_features`` fills from authorized rows only."""
         explain = explain or ExplainNull()
         store = self.store
         batch = store.batch
@@ -93,6 +97,8 @@ class QueryPlanner:
         explain(lambda: f"Scan: {len(positions)} hits "
                         f"(plan {plan_ms:.1f}ms, scan {scan_ms:.1f}ms)")
 
+        if allowed is not None and len(positions):
+            positions = positions[allowed[positions]]
         positions = self._sort_limit(positions, batch, query)
         result_batch = batch.take(positions)
         if query.properties is not None:

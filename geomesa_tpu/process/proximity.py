@@ -34,14 +34,14 @@ def proximity_process(store, schema: str, geometries, distance_m: float):
             d = haversine_m(g.x, g.y, bx, by)
             parts.append(r.positions[d <= distance_m])
         else:
-            from ..geometry.predicates import _segments, point_in_polygon
+            from ..geometry.predicates import _points_of, _segments, point_in_polygon
             from ..geometry.types import MultiPolygon, Polygon
             from .tube import _point_segment_dist_deg
             # distance to the geometry's segments; geometries with no
             # segments (e.g. MultiPoint) reduce to per-vertex point checks
             segs = _segments(g)
             if segs[0].shape[0] == 0:
-                verts = np.atleast_2d(getattr(g, "coords", np.empty((0, 2))))
+                verts = np.atleast_2d(_points_of(g))
                 if verts.shape[0] == 0:
                     continue
                 d = np.min(

@@ -1,0 +1,18 @@
+"""Visibility / authorization layer (the reference's geomesa-security
+module: AuthorizationsProvider SPI + VisibilityEvaluator,
+geomesa-security/src/main/scala/org/locationtech/geomesa/security/)."""
+
+from .visibility import (
+    VisibilityExpression,
+    parse_visibility,
+    visibility_mask,
+)
+from .auth import AuthorizationsProvider, StaticAuthorizationsProvider
+
+__all__ = [
+    "VisibilityExpression",
+    "parse_visibility",
+    "visibility_mask",
+    "AuthorizationsProvider",
+    "StaticAuthorizationsProvider",
+]

@@ -1,0 +1,224 @@
+"""Security (visibility/auth), audit, interceptors, metrics."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.audit import InMemoryAuditWriter, JsonlAuditWriter
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.metrics import (
+    DelimitedFileReporter, LoggingReporter, MetricRegistry,
+)
+from geomesa_tpu.security import (
+    StaticAuthorizationsProvider, parse_visibility, visibility_mask,
+)
+
+MS_2018 = 1514764800000
+
+
+# -- visibility expression grammar (VisibilityEvaluator.scala semantics) ----
+
+def test_visibility_parse_eval():
+    assert parse_visibility("").evaluate(set())
+    assert parse_visibility("admin").evaluate({"admin"})
+    assert not parse_visibility("admin").evaluate({"user"})
+    assert parse_visibility("admin&user").evaluate({"admin", "user"})
+    assert not parse_visibility("admin&user").evaluate({"admin"})
+    assert parse_visibility("admin|user").evaluate({"user"})
+    assert parse_visibility("(a&b)|c").evaluate({"c"})
+    assert parse_visibility("(a&b)|c").evaluate({"a", "b"})
+    assert not parse_visibility("(a&b)|c").evaluate({"a"})
+    assert parse_visibility('"od-1:x"&b').evaluate({"od-1:x", "b"})
+
+
+def test_visibility_mixed_ops_require_parens():
+    with pytest.raises(ValueError):
+        parse_visibility("a&b|c")
+    with pytest.raises(ValueError):
+        parse_visibility("a&(b")
+    with pytest.raises(ValueError):
+        parse_visibility("a &")
+
+
+def test_visibility_mask_vectorized():
+    col = np.array(["admin", "", "admin&user", "user|ops", "admin"],
+                   dtype=object)
+    mask = visibility_mask(col, {"admin"})
+    np.testing.assert_array_equal(mask, [True, True, False, False, True])
+    mask = visibility_mask(col, {"user"})
+    np.testing.assert_array_equal(mask, [False, True, False, True, False])
+
+
+# -- row-level security through the datastore -------------------------------
+
+def _store_with_vis(auths):
+    ds = TpuDataStore(
+        auth_provider=StaticAuthorizationsProvider(auths), user="tester")
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    n = 100
+    rng = np.random.default_rng(11)
+    cols = lambda: {
+        "name": np.array(["f"] * n, dtype=object),
+        "dtg": np.full(n, MS_2018, dtype=np.int64),
+        "geom": (rng.uniform(-75, -74, n), rng.uniform(40, 41, n)),
+    }
+    ds.write("t", cols(), visibility="admin")
+    ds.write("t", cols(), visibility="")
+    ds.write("t", cols(), visibility="secret&ops")
+    return ds
+
+
+def test_query_visibility_filtering():
+    ds = _store_with_vis({"admin"})
+    out = ds.query("t", "BBOX(geom,-76,39,-73,42)")
+    assert len(out) == 200  # admin rows + public rows
+    ds2 = _store_with_vis(set())
+    assert len(ds2.query("t", "BBOX(geom,-76,39,-73,42)")) == 100
+    ds3 = _store_with_vis({"secret", "ops", "admin"})
+    assert len(ds3.query("t", "BBOX(geom,-76,39,-73,42)")) == 300
+
+
+def test_write_invalid_visibility_rejected():
+    ds = TpuDataStore()
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    with pytest.raises(ValueError):
+        ds.write("t", {"dtg": np.array([MS_2018]),
+                       "geom": (np.array([-75.0]), np.array([40.0]))},
+                 visibility="a&b|c")
+
+
+# -- audit ------------------------------------------------------------------
+
+def test_audit_events(tmp_path):
+    mem = InMemoryAuditWriter()
+    ds = TpuDataStore(audit_writer=mem, user="alice")
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    n = 50
+    rng = np.random.default_rng(3)
+    ds.write("t", {"dtg": np.full(n, MS_2018, dtype=np.int64),
+                   "geom": (rng.uniform(-75, -74, n), rng.uniform(40, 41, n))})
+    ds.query("t", "BBOX(geom,-76,39,-73,42)")
+    events = mem.query_events("t")
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.user == "alice" and ev.hits == n
+    assert "BBox" in ev.filter or "bbox" in ev.filter.lower()
+    assert ev.plan_time_ms >= 0 and ev.scan_time_ms >= 0
+
+    jl = JsonlAuditWriter(str(tmp_path / "audit.jsonl"))
+    jl.write_event(ev)
+    line = (tmp_path / "audit.jsonl").read_text().strip()
+    assert '"user": "alice"' in line
+
+
+# -- interceptors -----------------------------------------------------------
+
+def test_guarded_interceptor_blocks_full_scan():
+    ds = TpuDataStore()
+    ds.create_schema(
+        "t",
+        "dtg:Date,*geom:Point;"
+        "geomesa.query.interceptors="
+        "geomesa_tpu.planning.interceptor:GuardedQueryInterceptor")
+    n = 10
+    rng = np.random.default_rng(5)
+    ds.write("t", {"dtg": np.full(n, MS_2018, dtype=np.int64),
+                   "geom": (rng.uniform(-75, -74, n), rng.uniform(40, 41, n))})
+    with pytest.raises(ValueError, match="full-table scan blocked"):
+        ds.query("t", "INCLUDE")
+    assert len(ds.query("t", "BBOX(geom,-76,39,-73,42)")) == n
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_metrics_registry_and_reporters(tmp_path, caplog):
+    reg = MetricRegistry()
+    reg.counter("c").inc(3)
+    with reg.timer("t"):
+        pass
+    reg.histogram("h").update(2.0)
+    reg.histogram("h").update(4.0)
+    snap = reg.snapshot()
+    assert snap["c"]["count"] == 3
+    assert snap["h"]["mean"] == 3.0 and snap["h"]["max"] == 4.0
+    assert snap["t"]["count"] == 1
+
+    path = tmp_path / "metrics.csv"
+    DelimitedFileReporter(reg, str(path)).report()
+    text = path.read_text()
+    assert "c" in text and "count=3" in text
+
+    import logging
+    with caplog.at_level(logging.INFO, logger="geomesa_tpu.metrics"):
+        LoggingReporter(reg).report()
+    assert any("c" in r.message for r in caplog.records)
+
+
+def test_query_metrics_increment():
+    from geomesa_tpu.metrics import registry
+    before = registry.counter("query.mt.count").count
+    ds = TpuDataStore()
+    ds.create_schema("mt", "dtg:Date,*geom:Point")
+    ds.write("mt", {"dtg": np.array([MS_2018]),
+                    "geom": (np.array([-75.0]), np.array([40.0]))})
+    ds.query("mt", "BBOX(geom,-76,39,-73,42)")
+    assert registry.counter("query.mt.count").count == before + 1
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_visibility_survives_flush_reload(tmp_path):
+    cat = str(tmp_path / "cat")
+    ds = TpuDataStore(cat, auth_provider=StaticAuthorizationsProvider(set()))
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    n = 20
+    rng = np.random.default_rng(2)
+    mk = lambda: {"dtg": np.full(n, MS_2018, dtype=np.int64),
+                  "geom": (rng.uniform(-75, -74, n), rng.uniform(40, 41, n))}
+    ds.write("t", mk(), visibility="admin")
+    ds.write("t", mk())
+    ds.flush("t")
+
+    ds2 = TpuDataStore(cat, auth_provider=StaticAuthorizationsProvider(set()))
+    assert len(ds2.query("t", "BBOX(geom,-76,39,-73,42)")) == n  # not 2n
+    ds3 = TpuDataStore(
+        cat, auth_provider=StaticAuthorizationsProvider({"admin"}))
+    assert len(ds3.query("t", "BBOX(geom,-76,39,-73,42)")) == 2 * n
+    # write after reload must not crash on missing visibilities
+    ds2.write("t", mk(), visibility="admin")
+    assert len(ds2.query("t", "BBOX(geom,-76,39,-73,42)")) == n
+
+
+def test_max_features_fills_from_authorized_rows():
+    from geomesa_tpu.planning.planner import Query
+
+    ds = _store_with_vis(set())  # only the public 100 visible
+    q = Query.of("BBOX(geom,-76,39,-73,42)", max_features=50)
+    out = ds.query("t", q)
+    assert len(out) == 50  # limit filled from authorized rows
+
+
+def test_interceptor_cache_invalidated_on_update_schema():
+    from geomesa_tpu.features.feature_type import parse_spec
+
+    ds = TpuDataStore()
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    n = 5
+    rng = np.random.default_rng(8)
+    ds.write("t", {"dtg": np.full(n, MS_2018, dtype=np.int64),
+                   "geom": (rng.uniform(-75, -74, n), rng.uniform(40, 41, n))})
+    assert len(ds.query("t", "INCLUDE")) == n  # caches empty interceptors
+    sft = parse_spec(
+        "t",
+        "dtg:Date,*geom:Point;geomesa.query.interceptors="
+        "geomesa_tpu.planning.interceptor:GuardedQueryInterceptor")
+    ds.update_schema("t", sft)
+    with pytest.raises(ValueError, match="full-table scan blocked"):
+        ds.query("t", "INCLUDE")
+
+
+def test_audit_covers_empty_store_queries():
+    mem = InMemoryAuditWriter()
+    ds = TpuDataStore(audit_writer=mem, user="bob")
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.query("t", "BBOX(geom,-76,39,-73,42)")  # empty store
+    assert len(mem.query_events("t")) == 1
