@@ -68,13 +68,27 @@ class InMemoryAuditWriter(AuditWriter):
 
 class JsonlAuditWriter(AuditWriter):
     """Append events as JSON lines (the file-sink analog of the
-    reference's audit table writes)."""
+    reference's async audit table writes).
+
+    The file handle stays open (line-buffered) so the query hot path pays
+    one buffered write, not an open/close round trip, and the JSON
+    serialization happens outside the lock.
+    """
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+        self._file = None
 
     def write_event(self, event: QueryEvent) -> None:
-        line = event.to_json()
-        with self._lock, open(self.path, "a") as f:
-            f.write(line + "\n")
+        line = event.to_json() + "\n"
+        with self._lock:
+            if self._file is None:
+                self._file = open(self.path, "a", buffering=1)
+            self._file.write(line)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None

@@ -300,27 +300,61 @@ class TpuDataStore:
         return str(ex)
 
     # -- stats (GeoMesaStats analog) --------------------------------------
+    def _restricted_mask(self, store: _SchemaStore) -> np.ndarray | None:
+        """Visibility mask when this caller cannot see every row (stats are
+        observed over ALL writes, so restricted callers must not read them
+        directly — that would leak counts/values/extents of hidden rows)."""
+        if self._auth_provider is None or store.batch is None:
+            return None
+        return store.vis_mask(self._auth_provider.get_authorizations())
+
     def get_count(self, name: str, query=None) -> int:
         store = self._store(name)
-        if query is None:
-            return store._stats["count"].count
-        return len(self.query(name, query))
+        if query is not None:
+            return len(self.query(name, query))
+        mask = self._restricted_mask(store)
+        if mask is not None:
+            return int(mask.sum())
+        return store._stats["count"].count
 
     def get_bounds(self, name: str):
         store = self._store(name)
         if store.batch is None or len(store.batch) == 0:
             return None
         bb = store.batch.geom_bbox()
+        mask = self._restricted_mask(store)
+        if mask is not None:
+            if not mask.any():
+                return None
+            bb = bb[mask]
         from .geometry.types import Envelope
         return Envelope(float(bb[:, 0].min()), float(bb[:, 1].min()),
                         float(bb[:, 2].max()), float(bb[:, 3].max()))
 
     def get_attribute_bounds(self, name: str, attr: str):
-        mm = self._store(name)._stats.get(f"{attr}_minmax")
+        store = self._store(name)
+        mask = self._restricted_mask(store)
+        if mask is not None:
+            col = store.batch.column(attr)[mask]
+            if not len(col):
+                return None
+            return col.min(), col.max()
+        mm = store._stats.get(f"{attr}_minmax")
         return None if mm is None or mm.is_empty else mm.bounds
 
     def stat(self, name: str, key: str) -> Stat | None:
-        return self._store(name)._stats.get(key)
+        """Sketches for this schema.  For restricted callers the global
+        sketches (observed over all rows) are recomputed over the visible
+        subset so hidden values cannot leak through TopK/enumeration."""
+        store = self._store(name)
+        mask = self._restricted_mask(store)
+        s = store._stats.get(key)
+        if mask is None or s is None:
+            return s
+        # rebuild the same stat type over the visible rows only
+        fresh = s.fresh_copy()
+        fresh.observe(store.batch.take(np.flatnonzero(mask)))
+        return fresh
 
     # -- metadata catalog persistence -------------------------------------
     def _persist_schema(self, sft: FeatureType) -> None:

@@ -1,0 +1,17 @@
+"""Filesystem datastore: partitioned parquet storage with pruning (the
+reference's geomesa-fs module)."""
+
+from .partitions import (
+    AttributeScheme,
+    CompositeScheme,
+    DateTimeScheme,
+    PartitionScheme,
+    Z2Scheme,
+    scheme_from_config,
+)
+from .storage import FileSystemDataStore
+
+__all__ = [
+    "PartitionScheme", "Z2Scheme", "DateTimeScheme", "AttributeScheme",
+    "CompositeScheme", "scheme_from_config", "FileSystemDataStore",
+]

@@ -1,0 +1,220 @@
+"""FileSystemDataStore: partitioned parquet storage with query pruning.
+
+The analog of the reference's geomesa-fs module (FileSystemDataStore over
+Parquet, partition schemes as the index, file-based metadata with
+compaction; geomesa-fs/geomesa-fs-storage/ + geomesa-fs-datastore/).
+Layout::
+
+    root/
+      <type>/
+        metadata.json              schema spec + scheme config + file list
+        <partition>/<file>.parquet
+
+Queries prune partitions via the scheme, scan only the surviving files,
+and evaluate the full filter per batch (there is no row index inside a
+partition — matching the reference, where Parquet row-group filters do
+the fine-grained work).  ``compact`` merges a partition's files into one
+(FileBasedMetadata compaction + FsManageMetadataCommand analog).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import threading
+import uuid
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType, parse_spec
+from ..filters.ecql import parse_ecql
+from ..filters.evaluate import evaluate_filter
+from ..planning.planner import Query
+from .partitions import PartitionScheme, scheme_from_config
+
+__all__ = ["FileSystemDataStore"]
+
+
+class _TypeStorage:
+    def __init__(self, root: str, sft: FeatureType, scheme: PartitionScheme):
+        self.root = root
+        self.sft = sft
+        self.scheme = scheme
+        self._lock = threading.Lock()
+        self._meta_path = os.path.join(root, "metadata.json")
+
+    # -- metadata ---------------------------------------------------------
+    def _load_meta(self) -> dict:
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                return json.load(f)
+        return {"spec": self.sft.spec_string(),
+                "scheme": self.scheme.to_config(), "partitions": {}}
+
+    def _save_meta(self, meta: dict) -> None:
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, self._meta_path)
+
+    # -- io ---------------------------------------------------------------
+    def write(self, batch: FeatureBatch) -> None:
+        from ..io.export import to_parquet
+
+        names = self.scheme.partitions_for_batch(self.sft, batch)
+        order = np.argsort(names, kind="stable")
+        sorted_names = names[order]
+        bounds = np.flatnonzero(
+            np.r_[True, sorted_names[1:] != sorted_names[:-1]])
+        with self._lock:
+            meta = self._load_meta()
+            for s, e in zip(bounds, np.r_[bounds[1:], len(sorted_names)]):
+                part = str(sorted_names[s])
+                sub = batch.take(order[s:e])
+                pdir = os.path.join(self.root, part)
+                os.makedirs(pdir, exist_ok=True)
+                fname = f"{uuid.uuid4().hex[:12]}.parquet"
+                to_parquet(sub, os.path.join(pdir, fname))
+                meta["partitions"].setdefault(part, []).append(
+                    {"file": fname, "count": len(sub)})
+            self._save_meta(meta)
+
+    def partitions(self) -> list:
+        return sorted(self._load_meta()["partitions"])
+
+    def count(self) -> int:
+        return sum(f["count"] for files in self._load_meta()["partitions"].values()
+                   for f in files)
+
+    def _select_partitions(self, filt) -> list:
+        meta = self._load_meta()
+        names = sorted(meta["partitions"])
+        pruned = self.scheme.partitions_for_filter(self.sft, filt)
+        if pruned is None:
+            return names
+        keep = []
+        for pat in pruned:
+            if "*" in pat:
+                keep.extend(n for n in names if fnmatch.fnmatch(n, pat))
+            elif pat in meta["partitions"]:
+                keep.append(pat)
+        return sorted(set(keep))
+
+    def query(self, query) -> FeatureBatch:
+        from ..io.export import from_parquet
+
+        q = query if isinstance(query, Query) else Query.of(query)
+        meta = self._load_meta()
+        parts = []
+        for part in self._select_partitions(q.filter):
+            for entry in meta["partitions"][part]:
+                path = os.path.join(self.root, part, entry["file"])
+                batch = from_parquet(path, self.sft)
+                mask = evaluate_filter(q.filter, batch)
+                if mask.any():
+                    parts.append(batch.take(np.flatnonzero(mask)))
+        if not parts:
+            return FeatureBatch(self.sft, {
+                a.name: np.empty(0) for a in self.sft.attributes
+                if not a.is_geometry})
+        out = parts[0]
+        for p in parts[1:]:
+            out = out.concat(p)
+        if q.max_features is not None:
+            out = out.take(np.arange(min(q.max_features, len(out))))
+        return out
+
+    def compact(self, partition: str) -> int:
+        """Merge a partition's files into one; returns resulting file count."""
+        from ..io.export import from_parquet, to_parquet
+
+        with self._lock:
+            meta = self._load_meta()
+            files = meta["partitions"].get(partition, [])
+            if len(files) <= 1:
+                return len(files)
+            pdir = os.path.join(self.root, partition)
+            batches = [from_parquet(os.path.join(pdir, f["file"]), self.sft)
+                       for f in files]
+            merged = batches[0]
+            for b in batches[1:]:
+                merged = merged.concat(b)
+            fname = f"{uuid.uuid4().hex[:12]}.parquet"
+            to_parquet(merged, os.path.join(pdir, fname))
+            for f in files:
+                os.remove(os.path.join(pdir, f["file"]))
+            meta["partitions"][partition] = [
+                {"file": fname, "count": len(merged)}]
+            self._save_meta(meta)
+            return 1
+
+
+class FileSystemDataStore:
+    """Multi-type partitioned parquet store rooted at a directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._types: dict[str, _TypeStorage] = {}
+        self._discover()
+
+    def _discover(self) -> None:
+        for name in os.listdir(self.root):
+            meta = os.path.join(self.root, name, "metadata.json")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    m = json.load(f)
+                sft = parse_spec(name, m["spec"])
+                self._types[name] = _TypeStorage(
+                    os.path.join(self.root, name), sft,
+                    scheme_from_config(m["scheme"]))
+
+    def create_schema(self, name: str, spec: str,
+                      scheme: PartitionScheme | dict | None = None) -> FeatureType:
+        if name in self._types:
+            raise ValueError(f"schema {name!r} already exists")
+        sft = parse_spec(name, spec)
+        if scheme is None:
+            scheme = scheme_from_config({"scheme": "datetime"})
+        elif isinstance(scheme, dict):
+            scheme = scheme_from_config(scheme)
+        ts = _TypeStorage(os.path.join(self.root, name), sft, scheme)
+        os.makedirs(ts.root, exist_ok=True)
+        ts._save_meta(ts._load_meta())
+        self._types[name] = ts
+        return sft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._storage(name).sft
+
+    @property
+    def type_names(self) -> list:
+        return sorted(self._types)
+
+    def _storage(self, name: str) -> _TypeStorage:
+        if name not in self._types:
+            raise KeyError(f"no such schema: {name!r}")
+        return self._types[name]
+
+    def write(self, name: str, data, ids=None) -> int:
+        ts = self._storage(name)
+        batch = (data if isinstance(data, FeatureBatch)
+                 else FeatureBatch.from_dict(ts.sft, data, ids=ids))
+        ts.write(batch)
+        return len(batch)
+
+    def query(self, name: str, query="INCLUDE") -> FeatureBatch:
+        return self._storage(name).query(query)
+
+    def partitions(self, name: str) -> list:
+        return self._storage(name).partitions()
+
+    def count(self, name: str) -> int:
+        return self._storage(name).count()
+
+    def compact(self, name: str, partition: str | None = None) -> None:
+        ts = self._storage(name)
+        for part in ([partition] if partition else ts.partitions()):
+            ts.compact(part)

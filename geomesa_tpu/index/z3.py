@@ -323,15 +323,20 @@ class Z3PointIndex:
         )
         capacity = gather_capacity(total)
         global _pallas_scan_ok
+        posc = mask = None
         if _pallas_scan_ok is not False and _use_pallas_scan():
             try:
                 posc, mask = _scan_candidates_pallas(*args, capacity=capacity)
+                # materialize INSIDE the try: dispatch is async, so kernel
+                # failures only surface when results are pulled to host
+                posc = np.asarray(posc)
+                mask = np.asarray(mask)
                 _pallas_scan_ok = True
-            except Exception:  # Mosaic lowering unavailable → XLA path
+            except Exception:  # Mosaic lowering/runtime failure → XLA path
                 _pallas_scan_ok = False
-                posc, mask = _scan_candidates(*args, capacity=capacity)
-        else:
+                posc = mask = None
+        if posc is None:
             posc, mask = _scan_candidates(*args, capacity=capacity)
-        posc = np.asarray(posc)
-        mask = np.asarray(mask)
+            posc = np.asarray(posc)
+            mask = np.asarray(mask)
         return np.sort(posc[mask]).astype(np.int64)

@@ -1,0 +1,93 @@
+"""LambdaDataStore: merged transient (stream) + persistent store.
+
+The reference's geomesa-lambda module: recent writes live in a Kafka-fed
+in-memory cache; a background persister periodically flushes features
+older than an expiry window into the durable store; queries merge both
+layers with the transient layer winning on id collisions
+(geomesa-lambda/.../LambdaDataStore.scala, stream/kafka/KafkaStore.scala,
+DataStorePersistence.scala).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .features.batch import FeatureBatch
+from .planning.planner import Query
+from .stream.store import StreamDataStore
+
+__all__ = ["LambdaDataStore"]
+
+
+class LambdaDataStore:
+    """Transient stream cache over a persistent TpuDataStore."""
+
+    def __init__(self, persistent, stream: StreamDataStore | None = None,
+                 expiry_ms: int = 60_000, clock=time.time):
+        self.persistent = persistent
+        self.stream = stream or StreamDataStore()
+        self.expiry_ms = expiry_ms
+        self._clock = clock
+        self._write_ms: dict[tuple, float] = {}   # (type, fid) → write time
+
+    # -- schema -----------------------------------------------------------
+    def create_schema(self, name: str, spec: str):
+        sft = self.persistent.create_schema(name, spec)
+        self.stream.create_schema(name, spec)
+        return sft
+
+    def get_schema(self, name: str):
+        return self.persistent.get_schema(name)
+
+    # -- writes go to the transient layer ---------------------------------
+    def write(self, name: str, fid: str, attributes: dict) -> None:
+        self.stream.write(name, fid, attributes)
+        self._write_ms[(name, fid)] = self._clock() * 1000.0
+
+    def delete(self, name: str, fid: str) -> None:
+        self.stream.delete(name, fid)
+        self._write_ms.pop((name, fid), None)
+
+    # -- persistence flusher (DataStorePersistence analog) ----------------
+    def persist(self, name: str, now_ms: float | None = None) -> int:
+        """Move expired transient features into the persistent store.
+        Returns the number persisted.  Call periodically (the reference
+        runs this on a scheduled executor per type)."""
+        self.stream.consume(name)
+        cache = self.stream.cache(name)
+        now = self._clock() * 1000.0 if now_ms is None else now_ms
+        expired = [fid for fid in cache.index.all_ids()
+                   if now - self._write_ms.get((name, fid), 0.0)
+                   >= self.expiry_ms]
+        if not expired:
+            return 0
+        batch = cache.snapshot(expired)
+        if len(batch):
+            self.persistent.write(name, batch)
+        for fid in expired:
+            cache.remove(fid)
+            self._write_ms.pop((name, fid), None)
+        return len(expired)
+
+    # -- merged reads ------------------------------------------------------
+    def query(self, name: str, query="INCLUDE") -> FeatureBatch:
+        """Union of transient + persistent hits; transient wins on id."""
+        self.stream.consume(name)
+        q = query if isinstance(query, Query) else Query.of(query)
+        transient = self.stream.query(name, q)
+        persistent = self.persistent.query(name, q)
+        if len(transient) == 0:
+            return persistent
+        if len(persistent) == 0:
+            return transient
+        t_ids = set(str(i) for i in transient.ids)
+        keep = np.array([str(i) not in t_ids for i in persistent.ids])
+        merged = transient.concat(persistent.take(np.flatnonzero(keep)))
+        if q.max_features is not None:
+            merged = merged.take(np.arange(min(q.max_features, len(merged))))
+        return merged
+
+    def count(self, name: str) -> int:
+        return len(self.query(name))

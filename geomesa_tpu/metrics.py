@@ -52,14 +52,28 @@ class HistogramMetric:
 
 
 class Timer(HistogramMetric):
-    """Histogram of durations (ms) usable as a context manager."""
+    """Histogram of durations (ms) usable as a context manager.
+
+    Registry timers are shared singletons, so start times live in a
+    thread-local stack — concurrent (even nested) ``with`` blocks on the
+    same timer record independent durations.
+    """
+
+    def _starts(self) -> list:
+        local = self.__dict__.get("_local")
+        if local is None:
+            local = self.__dict__["_local"] = threading.local()
+        if not hasattr(local, "stack"):
+            local.stack = []
+        return local.stack
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        self._starts().append(time.perf_counter())
         return self
 
     def __exit__(self, *exc):
-        self.update((time.perf_counter() - self._t0) * 1000.0)
+        t0 = self._starts().pop()
+        self.update((time.perf_counter() - t0) * 1000.0)
         return False
 
 

@@ -125,28 +125,32 @@ def sharded_range_count(mesh, bins, z, rbin, rzlo, rzhi) -> int:
 def sharded_density(mesh, x, y, dtg, valid, weights, boxes,
                     t_lo_ms: int, t_hi_ms: int, env,
                     width: int, height: int) -> np.ndarray:
-    @partial(
-        shard_map, mesh=mesh,
-        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard"),
-                  P(None)),
-        out_specs=P(None, None),
-    )
-    def dens(xs, ys, ts, vs, ws, bx):
-        in_box = (
-            (xs[:, None] >= bx[None, :, 0]) & (ys[:, None] >= bx[None, :, 1])
-            & (xs[:, None] <= bx[None, :, 2]) & (ys[:, None] <= bx[None, :, 3])
-        ).any(axis=1)
-        mask = vs & in_box & (ts >= t_lo_ms) & (ts <= t_hi_ms)
-        grid = _dens_grid(xs, ys, ws, mask, env, width, height)
-        return jax.lax.psum(grid, "shard")
+    def make(dens_grid):
+        @partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                      P("shard"), P(None)),
+            out_specs=P(None, None),
+        )
+        def dens(xs, ys, ts, vs, ws, bx):
+            in_box = (
+                (xs[:, None] >= bx[None, :, 0])
+                & (ys[:, None] >= bx[None, :, 1])
+                & (xs[:, None] <= bx[None, :, 2])
+                & (ys[:, None] <= bx[None, :, 3])
+            ).any(axis=1)
+            mask = vs & in_box & (ts >= t_lo_ms) & (ts <= t_hi_ms)
+            grid = dens_grid(xs, ys, ws, mask, env, width, height)
+            return jax.lax.psum(grid, "shard")
 
-    _dens_grid = density_grid_auto
-    try:
         return np.asarray(jax.jit(dens)(x, y, dtg, valid, weights, boxes))
-    except Exception:
-        # Pallas lowering may be unavailable under this backend/mesh —
-        # retry on the portable XLA scatter path
-        if _dens_grid is density_grid:
-            raise
-        _dens_grid = density_grid
-        return np.asarray(jax.jit(dens)(x, y, dtg, valid, weights, boxes))
+
+    from ..ops.pallas_kernels import on_tpu
+
+    if on_tpu():
+        # pallas histogram under shard_map; fall back if lowering fails
+        try:
+            return make(density_grid_auto)
+        except Exception:
+            pass
+    return make(density_grid)

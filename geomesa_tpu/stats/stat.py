@@ -57,6 +57,20 @@ class Stat:
     def to_json(self) -> dict:
         raise NotImplementedError
 
+    #: dataclass fields that configure a stat (vs accumulated state)
+    _CONFIG_FIELDS = frozenset({
+        "attr", "geom", "dtg", "period", "bits", "bins", "lo", "hi", "k",
+        "spec", "width", "depth"})
+
+    def fresh_copy(self) -> "Stat":
+        """A new, empty stat with the same configuration — used to
+        recompute sketches over row subsets (e.g. visibility-filtered)."""
+        import dataclasses
+        kwargs = {f.name: getattr(self, f.name)
+                  for f in dataclasses.fields(self)
+                  if f.name in self._CONFIG_FIELDS}
+        return type(self)(**kwargs)
+
 
 def _col(batch, name):
     if hasattr(batch, "column"):
@@ -461,6 +475,9 @@ class SeqStat(Stat):
 
     kind = "seq"
     stats: list = field(default_factory=list)
+
+    def fresh_copy(self) -> "Stat":
+        return SeqStat([s.fresh_copy() for s in self.stats])
 
     def observe(self, batch):
         for s in self.stats:

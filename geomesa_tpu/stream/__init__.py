@@ -1,0 +1,10 @@
+"""Streaming layer: message bus + live feature cache (the reference's
+geomesa-kafka: GeoMessage protocol, producers/consumers, in-memory
+spatially-indexed cache with feature events)."""
+
+from .messages import GeoMessage
+from .broker import InProcessBroker
+from .store import StreamDataStore, LiveFeatureCache
+
+__all__ = ["GeoMessage", "InProcessBroker", "StreamDataStore",
+           "LiveFeatureCache"]

@@ -1,0 +1,76 @@
+"""In-process message broker: topics, partitions, offsets.
+
+The test/embedded analog of the reference's Kafka dependency (its suites
+run EmbeddedKafka, geomesa-kafka/.../EmbeddedKafka.scala) — and the
+production seam: the broker interface (send / poll / commit) is what a
+real Kafka client would implement.  Messages are keyed by feature id and
+hashed over partitions, preserving the reference's per-key ordering
+guarantee (GeoMessageSerializer keys messages by id for exactly this).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+__all__ = ["InProcessBroker"]
+
+
+class _Topic:
+    def __init__(self, partitions: int):
+        self.partitions = [[] for _ in range(partitions)]
+        self.lock = threading.Lock()
+
+
+class InProcessBroker:
+    """Thread-safe topic → partition log store with consumer offsets."""
+
+    def __init__(self, num_partitions: int = 4):
+        self.num_partitions = num_partitions
+        self._topics: dict[str, _Topic] = {}
+        self._offsets: dict[tuple, int] = {}   # (group, topic, part) → offset
+        self._lock = threading.Lock()
+
+    def _topic(self, name: str) -> _Topic:
+        with self._lock:
+            t = self._topics.get(name)
+            if t is None:
+                t = self._topics[name] = _Topic(self.num_partitions)
+            return t
+
+    def create_topic(self, name: str) -> None:
+        self._topic(name)
+
+    def send(self, topic: str, key: str | None, value: bytes) -> tuple:
+        """Append; returns (partition, offset)."""
+        t = self._topic(topic)
+        part = (zlib.crc32((key or "").encode()) % self.num_partitions
+                if key is not None else 0)
+        with t.lock:
+            t.partitions[part].append(value)
+            return part, len(t.partitions[part]) - 1
+
+    def poll(self, group: str, topic: str, max_records: int = 1000) -> list:
+        """Fetch records past the group's committed offsets (at-least-once:
+        offsets advance only via :meth:`commit`)."""
+        t = self._topic(topic)
+        out = []
+        with t.lock:
+            for part in range(self.num_partitions):
+                off = self._offsets.get((group, topic, part), 0)
+                log = t.partitions[part]
+                take = log[off:off + max_records]
+                out.extend(((part, off + i), v) for i, v in enumerate(take))
+        return out
+
+    def commit(self, group: str, topic: str, positions: dict) -> None:
+        """positions: partition → next offset to read."""
+        with self._lock:
+            for part, off in positions.items():
+                key = (group, topic, part)
+                self._offsets[key] = max(self._offsets.get(key, 0), off)
+
+    def end_offsets(self, topic: str) -> dict:
+        t = self._topic(topic)
+        with t.lock:
+            return {p: len(log) for p, log in enumerate(t.partitions)}

@@ -1,0 +1,55 @@
+"""GeoMessage: the change/delete/clear wire protocol of the streaming
+layer (the reference's kafka GeoMessage + serialization,
+geomesa-kafka/.../data/GeoMessage.scala, GeoMessageSerializer.scala)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+__all__ = ["GeoMessage"]
+
+
+@dataclass(frozen=True)
+class GeoMessage:
+    """One mutation: kind in {"change", "delete", "clear"}.
+
+    ``change`` carries a feature payload (dict of attribute → value, plus
+    id); ``delete`` carries the feature id; ``clear`` drops everything.
+    """
+
+    kind: str
+    feature_id: str | None = None
+    attributes: dict = field(default_factory=dict)
+
+    KINDS = ("change", "delete", "clear")
+
+    def __post_init__(self):
+        if self.kind not in self.KINDS:
+            raise ValueError(f"bad message kind {self.kind!r}")
+        if self.kind == "change" and self.feature_id is None:
+            raise ValueError("change requires a feature id")
+        if self.kind == "delete" and self.feature_id is None:
+            raise ValueError("delete requires a feature id")
+
+    @classmethod
+    def change(cls, fid: str, attributes: dict) -> "GeoMessage":
+        return cls("change", fid, dict(attributes))
+
+    @classmethod
+    def delete(cls, fid: str) -> "GeoMessage":
+        return cls("delete", fid)
+
+    @classmethod
+    def clear(cls) -> "GeoMessage":
+        return cls("clear")
+
+    # -- wire codec (JSON; the reference uses a kryo-framed binary) -------
+    def to_bytes(self) -> bytes:
+        return json.dumps({"k": self.kind, "i": self.feature_id,
+                           "a": self.attributes}, default=str).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "GeoMessage":
+        d = json.loads(raw.decode())
+        return cls(d["k"], d.get("i"), d.get("a") or {})

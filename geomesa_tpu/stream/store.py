@@ -1,0 +1,195 @@
+"""StreamDataStore: live layer over the message bus.
+
+The reference's KafkaDataStore: producers publish GeoMessages; consumers
+maintain an in-memory spatially-indexed cache of current feature state
+(KafkaFeatureCacheImpl over BucketIndex grids, geomesa-kafka/.../index/
+KafkaFeatureCacheImpl.scala:30-45), fire feature events to listeners
+(GeoMesaFeatureListener), and serve queries from the cache via the local
+query runner (KafkaQueryRunner).  Here:
+
+* :class:`LiveFeatureCache` — id → attribute dict + BucketIndex grid.
+* :class:`StreamDataStore` — write side publishes messages; ``consume()``
+  drains the broker (call from a poll loop or a thread), applies
+  mutations, and notifies listeners.  Queries evaluate the full filter
+  over a columnar snapshot of the cache (LocalQueryRunner semantics —
+  no curve index; the live set is small and hot).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType, parse_spec
+from ..filters.evaluate import evaluate_filter
+from ..planning.planner import Query
+from ..utils.spatial_index import BucketIndex
+from .broker import InProcessBroker
+from .messages import GeoMessage
+
+__all__ = ["LiveFeatureCache", "StreamDataStore"]
+
+
+class LiveFeatureCache:
+    """Current state of a streamed feature type, queryable by bbox."""
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+        self.index = BucketIndex()
+        self._features: dict[str, dict] = {}
+        self._lock = threading.RLock()
+
+    def put(self, fid: str, attributes: dict) -> None:
+        with self._lock:
+            self._features[fid] = attributes
+            gx, gy = self._geom_of(attributes)
+            if gx is not None:
+                self.index.insert(fid, gx, gy)
+
+    def _geom_of(self, attributes: dict):
+        g = attributes.get(self.sft.geom_field)
+        if g is None:
+            return None, None
+        if isinstance(g, (tuple, list)) and len(g) == 2:
+            return float(g[0]), float(g[1])
+        x = getattr(g, "x", None)
+        y = getattr(g, "y", None)
+        return (float(x), float(y)) if x is not None else (None, None)
+
+    def remove(self, fid: str) -> bool:
+        with self._lock:
+            self.index.remove(fid)
+            return self._features.pop(fid, None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._features.clear()
+            self.index.clear()
+
+    def __len__(self) -> int:
+        return len(self._features)
+
+    def snapshot(self, fids=None) -> FeatureBatch:
+        """Columnar snapshot of (a subset of) the cache."""
+        with self._lock:
+            if fids is None:
+                fids = list(self._features)
+            feats = [self._features[f] for f in fids if f in self._features]
+            fids = [f for f in fids if f in self._features]
+        data: dict = {}
+        for a in self.sft.attributes:
+            vals = [f.get(a.name) for f in feats]
+            if a.is_geometry:
+                xs = np.array([v[0] if isinstance(v, (tuple, list))
+                               else getattr(v, "x", np.nan) for v in vals])
+                ys = np.array([v[1] if isinstance(v, (tuple, list))
+                               else getattr(v, "y", np.nan) for v in vals])
+                data[a.name] = (xs, ys)
+            elif a.type in ("int", "long", "date"):
+                data[a.name] = np.array(
+                    [0 if v is None else int(v) for v in vals], dtype=np.int64)
+            elif a.type in ("float", "double"):
+                data[a.name] = np.array(
+                    [np.nan if v is None else float(v) for v in vals])
+            else:
+                data[a.name] = np.array(vals, dtype=object)
+        return FeatureBatch.from_dict(
+            self.sft, data, ids=np.array(fids, dtype=object))
+
+
+class StreamDataStore:
+    """Kafka-analog live store: publish mutations, consume into a cache."""
+
+    def __init__(self, broker: InProcessBroker | None = None,
+                 group: str = "default"):
+        self.broker = broker or InProcessBroker()
+        self.group = group
+        self._schemas: dict[str, FeatureType] = {}
+        self._caches: dict[str, LiveFeatureCache] = {}
+        self._listeners: dict[str, list] = {}
+
+    # -- schema -----------------------------------------------------------
+    def create_schema(self, name: str, spec: str) -> FeatureType:
+        sft = parse_spec(name, spec)
+        self._schemas[name] = sft
+        self._caches[name] = LiveFeatureCache(sft)
+        self.broker.create_topic(name)
+        return sft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._schemas[name]
+
+    @property
+    def type_names(self) -> list:
+        return sorted(self._schemas)
+
+    def add_listener(self, name: str, fn) -> None:
+        """fn(GeoMessage) called after each applied mutation."""
+        self._listeners.setdefault(name, []).append(fn)
+
+    # -- producer side ----------------------------------------------------
+    def write(self, name: str, fid: str, attributes: dict) -> None:
+        msg = GeoMessage.change(fid, attributes)
+        self.broker.send(name, fid, msg.to_bytes())
+
+    def write_batch(self, name: str, batch: FeatureBatch) -> int:
+        sft = self._schemas[name]
+        x = y = None
+        if sft.geom_field:
+            x, y = batch.geom_xy()
+        for i in range(len(batch)):
+            attrs = {}
+            for a in sft.attributes:
+                if a.is_geometry:
+                    attrs[a.name] = (float(x[i]), float(y[i]))
+                elif a.name in batch.columns:
+                    v = batch.columns[a.name][i]
+                    attrs[a.name] = v.item() if hasattr(v, "item") else v
+            self.write(name, str(batch.ids[i]), attrs)
+        return len(batch)
+
+    def delete(self, name: str, fid: str) -> None:
+        self.broker.send(name, fid, GeoMessage.delete(fid).to_bytes())
+
+    def clear(self, name: str) -> None:
+        self.broker.send(name, None, GeoMessage.clear().to_bytes())
+
+    # -- consumer side ----------------------------------------------------
+    def consume(self, name: str, max_records: int = 10_000) -> int:
+        """Drain pending messages into the live cache; returns applied
+        count.  At-least-once: offsets commit after application."""
+        cache = self._caches[name]
+        records = self.broker.poll(self.group, name, max_records)
+        positions: dict = {}
+        for (part, off), raw in records:
+            msg = GeoMessage.from_bytes(raw)
+            if msg.kind == "change":
+                cache.put(msg.feature_id, msg.attributes)
+            elif msg.kind == "delete":
+                cache.remove(msg.feature_id)
+            else:
+                cache.clear()
+            for fn in self._listeners.get(name, ()):
+                fn(msg)
+            positions[part] = off + 1
+        if positions:
+            self.broker.commit(self.group, name, positions)
+        return len(records)
+
+    # -- query side (LocalQueryRunner semantics) --------------------------
+    def cache(self, name: str) -> LiveFeatureCache:
+        return self._caches[name]
+
+    def query(self, name: str, query="INCLUDE") -> FeatureBatch:
+        q = query if isinstance(query, Query) else Query.of(query)
+        cache = self._caches[name]
+        snap = cache.snapshot()
+        if len(snap) == 0:
+            return snap
+        mask = evaluate_filter(q.filter, snap)
+        out = snap.take(np.flatnonzero(mask))
+        if q.max_features is not None:
+            out = out.take(np.arange(min(q.max_features, len(out))))
+        return out

@@ -1,0 +1,8 @@
+"""Geo utilities: GeoHash, in-memory spatial grids (the reference's
+geomesa-utils geohash/ and index/ packages)."""
+
+from .geohash import geohash_decode, geohash_encode, geohash_neighbors
+from .spatial_index import BucketIndex
+
+__all__ = ["geohash_encode", "geohash_decode", "geohash_neighbors",
+           "BucketIndex"]

@@ -1,0 +1,106 @@
+"""GeoHash: base-32 interleaved-bit cell codes.
+
+The reference carries its own GeoHash implementation
+(geomesa-utils/.../geohash/GeoHash.scala) used by the KNN process's
+expanding-spiral search and by exports.  This is a vectorized numpy
+re-implementation: encode/decode arrays of points at once (the row-wise
+JVM loop becomes bit arithmetic over columns).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["geohash_encode", "geohash_decode", "geohash_neighbors"]
+
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+_DECODE = {c: i for i, c in enumerate(_BASE32)}
+
+
+def _interleave_bits(lon_bits: np.ndarray, lat_bits: np.ndarray,
+                     precision_bits: int) -> np.ndarray:
+    """Merge lon (even positions from the top) and lat (odd) bit streams."""
+    total = np.zeros(lon_bits.shape, dtype=np.uint64)
+    lon_n = (precision_bits + 1) // 2
+    lat_n = precision_bits // 2
+    for i in range(precision_bits):
+        if i % 2 == 0:  # lon bit
+            bit = (lon_bits >> np.uint64(lon_n - 1 - i // 2)) & np.uint64(1)
+        else:           # lat bit
+            bit = (lat_bits >> np.uint64(lat_n - 1 - i // 2)) & np.uint64(1)
+        total = (total << np.uint64(1)) | bit
+    return total
+
+
+def geohash_encode(lon, lat, precision: int = 9) -> np.ndarray:
+    """Vectorized geohash of ``precision`` base-32 characters."""
+    lon = np.atleast_1d(np.asarray(lon, dtype=np.float64))
+    lat = np.atleast_1d(np.asarray(lat, dtype=np.float64))
+    bits = precision * 5
+    lon_n = (bits + 1) // 2
+    lat_n = bits // 2
+    lon_q = np.clip(((lon + 180.0) / 360.0) * (1 << lon_n), 0,
+                    (1 << lon_n) - 1).astype(np.uint64)
+    lat_q = np.clip(((lat + 90.0) / 180.0) * (1 << lat_n), 0,
+                    (1 << lat_n) - 1).astype(np.uint64)
+    z = _interleave_bits(lon_q, lat_q, bits)
+    chars = np.empty((precision, z.shape[0]), dtype="U1")
+    for c in range(precision):
+        shift = np.uint64(5 * (precision - 1 - c))
+        idx = ((z >> shift) & np.uint64(31)).astype(int)
+        chars[c] = np.array(list(_BASE32))[idx]
+    out = np.array(["".join(chars[:, i]) for i in range(z.shape[0])],
+                   dtype=object)
+    return out
+
+
+def geohash_decode(hashes) -> tuple:
+    """Decode geohashes to (lon, lat) cell centers (+ per-axis errors)."""
+    hashes = np.atleast_1d(np.asarray(hashes, dtype=object))
+    lons = np.empty(hashes.shape, dtype=np.float64)
+    lats = np.empty(hashes.shape, dtype=np.float64)
+    lon_errs = np.empty(hashes.shape, dtype=np.float64)
+    lat_errs = np.empty(hashes.shape, dtype=np.float64)
+    for i, h in enumerate(hashes):
+        lon_lo, lon_hi = -180.0, 180.0
+        lat_lo, lat_hi = -90.0, 90.0
+        even = True
+        for ch in h:
+            val = _DECODE[ch]
+            for b in (16, 8, 4, 2, 1):
+                if even:
+                    mid = (lon_lo + lon_hi) / 2
+                    if val & b:
+                        lon_lo = mid
+                    else:
+                        lon_hi = mid
+                else:
+                    mid = (lat_lo + lat_hi) / 2
+                    if val & b:
+                        lat_lo = mid
+                    else:
+                        lat_hi = mid
+                even = not even
+        lons[i] = (lon_lo + lon_hi) / 2
+        lats[i] = (lat_lo + lat_hi) / 2
+        lon_errs[i] = (lon_hi - lon_lo) / 2
+        lat_errs[i] = (lat_hi - lat_lo) / 2
+    return lons, lats, lon_errs, lat_errs
+
+
+def geohash_neighbors(h: str) -> list:
+    """The 8 neighboring cells of a geohash (spiral-search building block,
+    the role of the reference's GeoHashSpiral)."""
+    lon, lat, lon_err, lat_err = geohash_decode([h])
+    lon, lat = lon[0], lat[0]
+    dlon, dlat = lon_err[0] * 2, lat_err[0] * 2
+    out = []
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            if dx == 0 and dy == 0:
+                continue
+            nlon = lon + dx * dlon
+            nlat = lat + dy * dlat
+            if -180 <= nlon <= 180 and -90 <= nlat <= 90:
+                out.append(str(geohash_encode([nlon], [nlat], len(h))[0]))
+    return out

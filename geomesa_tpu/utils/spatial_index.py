@@ -1,0 +1,86 @@
+"""In-memory spatial grid index for live (streaming) feature caches.
+
+The reference's Kafka consumer keeps features queryable in memory via
+grid-of-buckets indexes (geomesa-utils/.../index/BucketIndex.scala,
+SizeSeparatedBucketIndex.scala; used by KafkaFeatureCacheImpl,
+geomesa-kafka/.../index/KafkaFeatureCacheImpl.scala:43-45).  This is the
+same structure: a W×H grid of cell buckets over a fixed envelope, with
+insert/remove by id and bbox queries touching only overlapping cells.
+Thread-safe for the single-writer / many-reader streaming pattern.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["BucketIndex"]
+
+
+class BucketIndex:
+    """Grid-of-buckets point index: id → (x, y), bbox query → ids."""
+
+    def __init__(self, width: int = 360, height: int = 180,
+                 env=(-180.0, -90.0, 180.0, 90.0)):
+        self.width = width
+        self.height = height
+        self.env = env
+        self._cells: dict[tuple, dict] = {}
+        self._pos: dict = {}
+        self._lock = threading.RLock()
+
+    def _cell(self, x: float, y: float) -> tuple:
+        xmin, ymin, xmax, ymax = self.env
+        cx = int((x - xmin) / (xmax - xmin) * self.width)
+        cy = int((y - ymin) / (ymax - ymin) * self.height)
+        return (min(max(cx, 0), self.width - 1),
+                min(max(cy, 0), self.height - 1))
+
+    def insert(self, fid, x: float, y: float) -> None:
+        with self._lock:
+            old = self._pos.get(fid)
+            if old is not None:
+                self._cells.get(self._cell(*old), {}).pop(fid, None)
+            self._pos[fid] = (x, y)
+            self._cells.setdefault(self._cell(x, y), {})[fid] = (x, y)
+
+    def remove(self, fid) -> bool:
+        with self._lock:
+            old = self._pos.pop(fid, None)
+            if old is None:
+                return False
+            self._cells.get(self._cell(*old), {}).pop(fid, None)
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cells.clear()
+            self._pos.clear()
+
+    def __len__(self) -> int:
+        return len(self._pos)
+
+    def get(self, fid):
+        return self._pos.get(fid)
+
+    def query(self, xmin: float, ymin: float, xmax: float, ymax: float) -> list:
+        """Feature ids with points inside the bbox (inclusive)."""
+        exmin, eymin, exmax, eymax = self.env
+        cx0, cy0 = self._cell(xmin, ymin)
+        cx1, cy1 = self._cell(xmax, ymax)
+        out = []
+        with self._lock:
+            for cy in range(cy0, cy1 + 1):
+                for cx in range(cx0, cx1 + 1):
+                    bucket = self._cells.get((cx, cy))
+                    if not bucket:
+                        continue
+                    for fid, (x, y) in bucket.items():
+                        if xmin <= x <= xmax and ymin <= y <= ymax:
+                            out.append(fid)
+        return out
+
+    def all_ids(self) -> list:
+        with self._lock:
+            return list(self._pos)

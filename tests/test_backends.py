@@ -1,0 +1,284 @@
+"""Back-end analogs: filesystem store (partition schemes + pruning),
+streaming store (broker/cache/events), lambda merged store, merged views,
+geohash + bucket index utils."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.filters.ecql import parse_ecql
+from geomesa_tpu.fs import (
+    AttributeScheme, CompositeScheme, DateTimeScheme, FileSystemDataStore,
+    Z2Scheme, scheme_from_config,
+)
+from geomesa_tpu.lambda_store import LambdaDataStore
+from geomesa_tpu.stream import GeoMessage, InProcessBroker, StreamDataStore
+from geomesa_tpu.utils import (
+    BucketIndex, geohash_decode, geohash_encode, geohash_neighbors,
+)
+from geomesa_tpu.views import MergedDataStoreView
+
+MS_2018 = 1514764800000
+DAY = 86_400_000
+SPEC = "name:String,dtg:Date,*geom:Point"
+
+
+def _mk_cols(n, rng, t0=MS_2018, days=10, xr=(-75, -74), yr=(40, 41)):
+    return {
+        "name": np.array([f"n{i % 7}" for i in range(n)], dtype=object),
+        "dtg": rng.integers(t0, t0 + days * DAY, n),
+        "geom": (rng.uniform(*xr, n), rng.uniform(*yr, n)),
+    }
+
+
+# -- geohash ----------------------------------------------------------------
+
+def test_geohash_known_values():
+    # canonical example: (-5.6, 42.6) → "ezs42" at precision 5
+    assert geohash_encode([-5.6], [42.6], 5)[0] == "ezs42"
+    lon, lat, elon, elat = geohash_decode(["ezs42"])
+    assert abs(lon[0] - -5.6) < 0.05 and abs(lat[0] - 42.6) < 0.05
+
+
+def test_geohash_roundtrip_and_neighbors():
+    rng = np.random.default_rng(0)
+    lon = rng.uniform(-180, 180, 200)
+    lat = rng.uniform(-90, 90, 200)
+    h = geohash_encode(lon, lat, 9)
+    dlon, dlat, elon, elat = geohash_decode(h)
+    assert np.all(np.abs(dlon - lon) <= elon * 2.01)
+    assert np.all(np.abs(dlat - lat) <= elat * 2.01)
+    nbrs = geohash_neighbors("ezs42")
+    assert len(nbrs) == 8 and len(set(nbrs)) == 8
+    assert all(len(n) == 5 for n in nbrs)
+
+
+# -- bucket index -----------------------------------------------------------
+
+def test_bucket_index_insert_query_remove():
+    idx = BucketIndex()
+    rng = np.random.default_rng(1)
+    pts = {f"f{i}": (rng.uniform(-180, 180), rng.uniform(-90, 90))
+           for i in range(1000)}
+    for fid, (x, y) in pts.items():
+        idx.insert(fid, x, y)
+    assert len(idx) == 1000
+    got = set(idx.query(-50, -30, 50, 30))
+    want = {f for f, (x, y) in pts.items()
+            if -50 <= x <= 50 and -30 <= y <= 30}
+    assert got == want
+    # update moves the feature
+    idx.insert("f0", 0.0, 0.0)
+    assert "f0" in idx.query(-1, -1, 1, 1)
+    assert idx.remove("f0") and not idx.remove("f0")
+    assert len(idx) == 999
+
+
+# -- partition schemes ------------------------------------------------------
+
+def test_datetime_scheme_partitions_and_pruning():
+    ds = TpuDataStore()
+    sft = ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(2)
+    batch = FeatureBatch.from_dict(sft, _mk_cols(100, rng))
+    sch = DateTimeScheme("daily")
+    parts = sch.partitions_for_batch(sft, batch)
+    assert all(p.startswith("2018/01/") for p in parts)
+    pruned = sch.partitions_for_filter(
+        sft, parse_ecql(
+            "dtg DURING 2018-01-02T00:00:00Z/2018-01-03T00:00:00Z"))
+    assert "2018/01/02" in pruned and "2018/01/03" in pruned
+    assert "2018/01/09" not in pruned
+    # unbounded → no pruning
+    assert sch.partitions_for_filter(sft, parse_ecql("INCLUDE")) is None
+
+
+def test_z2_scheme_covers_queries():
+    ds = TpuDataStore()
+    sft = ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(3)
+    batch = FeatureBatch.from_dict(sft, _mk_cols(200, rng))
+    sch = Z2Scheme(bits=4)
+    parts = sch.partitions_for_batch(sft, batch)
+    pruned = sch.partitions_for_filter(
+        sft, parse_ecql("BBOX(geom,-75,40,-74,41)"))
+    assert pruned is not None
+    assert set(parts) <= set(pruned)  # every feature partition is covered
+
+
+def test_attribute_and_composite_schemes():
+    ds = TpuDataStore()
+    sft = ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(4)
+    batch = FeatureBatch.from_dict(sft, _mk_cols(50, rng))
+    sch = AttributeScheme("name")
+    parts = sch.partitions_for_batch(sft, batch)
+    assert parts[0] == f"name={batch.columns['name'][0]}"
+    assert sch.partitions_for_filter(sft, parse_ecql("name = 'n1'")) == [
+        "name=n1"]
+    assert sorted(sch.partitions_for_filter(
+        sft, parse_ecql("name IN ('n1','n2')"))) == ["name=n1", "name=n2"]
+
+    comp = CompositeScheme([DateTimeScheme("daily"), AttributeScheme("name")])
+    cparts = comp.partitions_for_batch(sft, batch)
+    assert cparts[0].count("/") == 3  # yyyy/mm/dd/name=v
+    pruned = comp.partitions_for_filter(sft, parse_ecql("name = 'n1'"))
+    assert pruned and all(p.endswith("name=n1") and p.startswith("*")
+                          for p in pruned)
+    # config round trip
+    again = scheme_from_config(comp.to_config())
+    assert isinstance(again, CompositeScheme)
+
+
+# -- filesystem datastore ---------------------------------------------------
+
+def test_fs_datastore_write_query_pruning(tmp_path):
+    fs = FileSystemDataStore(str(tmp_path))
+    fs.create_schema("ev", SPEC, {"scheme": "datetime",
+                                  "datetime-step": "daily"})
+    rng = np.random.default_rng(5)
+    cols = _mk_cols(500, rng)
+    fs.write("ev", cols)
+    assert fs.count("ev") == 500
+    assert len(fs.partitions("ev")) >= 9
+
+    q = ("BBOX(geom,-74.8,40.2,-74.2,40.8) AND "
+         "dtg DURING 2018-01-02T00:00:00Z/2018-01-05T00:00:00Z")
+    out = fs.query("ev", q)
+    x, y = cols["geom"]
+    t = cols["dtg"]
+    want = np.count_nonzero(
+        (x >= -74.8) & (x <= -74.2) & (y >= 40.2) & (y <= 40.8)
+        & (t >= MS_2018 + DAY) & (t <= MS_2018 + 4 * DAY))
+    assert len(out) == want
+
+    # rediscovery from disk
+    fs2 = FileSystemDataStore(str(tmp_path))
+    assert fs2.type_names == ["ev"]
+    assert len(fs2.query("ev", q)) == want
+
+
+def test_fs_compaction(tmp_path):
+    fs = FileSystemDataStore(str(tmp_path))
+    fs.create_schema("ev", SPEC)
+    rng = np.random.default_rng(6)
+    for _ in range(4):
+        fs.write("ev", _mk_cols(50, rng, days=1))
+    part = fs.partitions("ev")[0]
+    meta = fs._storage("ev")._load_meta()
+    assert len(meta["partitions"][part]) == 4
+    fs.compact("ev")
+    meta = fs._storage("ev")._load_meta()
+    assert all(len(files) == 1 for files in meta["partitions"].values())
+    assert fs.count("ev") == 200
+
+
+# -- streaming --------------------------------------------------------------
+
+def test_broker_ordering_and_offsets():
+    b = InProcessBroker(num_partitions=2)
+    for i in range(10):
+        b.send("t", "key", f"v{i}".encode())   # same key → same partition
+    recs = b.poll("g", "t")
+    assert [r[1] for r in recs] == [f"v{i}".encode() for i in range(10)]
+    b.commit("g", "t", {recs[-1][0][0]: recs[-1][0][1] + 1})
+    assert b.poll("g", "t") == []              # committed
+    assert b.poll("g2", "t") != []             # other group unaffected
+
+
+def test_stream_store_end_to_end():
+    st = StreamDataStore()
+    st.create_schema("live", SPEC)
+    events = []
+    st.add_listener("live", events.append)
+
+    st.write("live", "a", {"name": "x", "dtg": MS_2018,
+                           "geom": (-74.5, 40.5)})
+    st.write("live", "b", {"name": "y", "dtg": MS_2018,
+                           "geom": (-60.0, 10.0)})
+    assert len(st.query("live")) == 0          # not consumed yet
+    assert st.consume("live") == 2
+    assert len(events) == 2 and events[0].kind == "change"
+
+    out = st.query("live", "BBOX(geom,-75,40,-74,41)")
+    assert list(out.ids) == ["a"]
+    # update in place
+    st.write("live", "a", {"name": "x2", "dtg": MS_2018,
+                           "geom": (-74.4, 40.4)})
+    st.consume("live")
+    assert len(st.cache("live")) == 2
+    assert st.query("live", "name = 'x2'").ids[0] == "a"
+    # delete + clear
+    st.delete("live", "a")
+    st.consume("live")
+    assert len(st.cache("live")) == 1
+    st.clear("live")
+    st.consume("live")
+    assert len(st.cache("live")) == 0
+
+
+def test_geomessage_codec():
+    m = GeoMessage.change("f1", {"a": 1, "geom": (1.0, 2.0)})
+    m2 = GeoMessage.from_bytes(m.to_bytes())
+    assert m2.kind == "change" and m2.feature_id == "f1"
+    with pytest.raises(ValueError):
+        GeoMessage("bogus")
+    with pytest.raises(ValueError):
+        GeoMessage("change")
+
+
+# -- lambda store -----------------------------------------------------------
+
+def test_lambda_merged_and_persistence():
+    clock = [1000.0]
+    persistent = TpuDataStore()
+    lam = LambdaDataStore(persistent, expiry_ms=5000,
+                          clock=lambda: clock[0])
+    lam.create_schema("t", SPEC)
+    lam.write("t", "a", {"name": "x", "dtg": MS_2018, "geom": (-74.5, 40.5)})
+    clock[0] += 1.0
+    lam.write("t", "b", {"name": "y", "dtg": MS_2018, "geom": (-74.6, 40.6)})
+
+    out = lam.query("t", "BBOX(geom,-75,40,-74,41)")
+    assert sorted(str(i) for i in out.ids) == ["a", "b"]
+    assert persistent.get_count("t") == 0      # still transient
+
+    clock[0] += 4.5                             # expire "a" only (5.5s old)
+    n = lam.persist("t")
+    assert n == 1
+    assert persistent.get_count("t") == 1
+    out = lam.query("t", "BBOX(geom,-75,40,-74,41)")
+    assert sorted(str(i) for i in out.ids) == ["a", "b"]  # still merged
+
+    # transient wins on id collision: update "a" transiently
+    lam.write("t", "a", {"name": "x-new", "dtg": MS_2018,
+                         "geom": (-74.5, 40.5)})
+    out = lam.query("t", "BBOX(geom,-75,40,-74,41)")
+    names = {str(i): n for i, n in zip(out.ids, out.columns["name"])}
+    assert names["a"] == "x-new" and len(out) == 2
+
+
+# -- merged views -----------------------------------------------------------
+
+def test_merged_view_union_and_scope():
+    rng = np.random.default_rng(7)
+    a = TpuDataStore()
+    a.create_schema("t", SPEC)
+    a.write("t", _mk_cols(40, rng), ids=np.array(
+        [f"a{i}" for i in range(40)], dtype=object))
+    b = TpuDataStore()
+    b.create_schema("t", SPEC)
+    b.write("t", _mk_cols(60, rng), ids=np.array(
+        [f"b{i}" for i in range(60)], dtype=object))
+
+    view = MergedDataStoreView([a, b])
+    out = view.query("t", "BBOX(geom,-76,39,-73,42)")
+    assert len(out) == 100
+    assert view.count("t", "name = 'n1'") == (
+        a.get_count("t", "name = 'n1'") + b.get_count("t", "name = 'n1'"))
+
+    scoped = MergedDataStoreView([a, b],
+                                 [parse_ecql("name = 'n1'"), None])
+    out = scoped.query("t", "BBOX(geom,-76,39,-73,42)")
+    assert len(out) == a.get_count("t", "name = 'n1'") + 60

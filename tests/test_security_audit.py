@@ -222,3 +222,39 @@ def test_audit_covers_empty_store_queries():
     ds.create_schema("t", "dtg:Date,*geom:Point")
     ds.query("t", "BBOX(geom,-76,39,-73,42)")  # empty store
     assert len(mem.query_events("t")) == 1
+
+
+def test_stats_do_not_leak_restricted_rows():
+    ds = _store_with_vis(set())   # caller sees only the public 100
+    assert ds.get_count("t") == 100
+    env = ds.get_bounds("t")
+    assert env is not None
+    topk = ds.stat("t", "name_topk")
+    if topk is not None:
+        assert sum(topk.counters.values()) <= 100
+    lo, hi = ds.get_attribute_bounds("t", "dtg")
+    assert lo >= MS_2018
+
+    ds_all = _store_with_vis({"admin", "secret", "ops"})
+    assert ds_all.get_count("t") == 300
+
+
+def test_timer_concurrent_blocks():
+    import threading as th
+    reg = MetricRegistry()
+    t = reg.timer("shared")
+    errs = []
+
+    def work():
+        try:
+            for _ in range(50):
+                with t:
+                    pass
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [th.Thread(target=work) for _ in range(4)]
+    [x.start() for x in threads]
+    [x.join() for x in threads]
+    assert not errs
+    assert t.count == 200 and t.min >= 0.0
