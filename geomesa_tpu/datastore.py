@@ -246,6 +246,30 @@ class TpuDataStore:
         _metrics.counter(f"write.{name}.features").inc(len(batch))
         return len(batch)
 
+    def delete(self, name: str, ids) -> int:
+        """Remove features by id (the reference's modifying writer /
+        removeFeatures path).  Stats are recomputed from the surviving
+        rows — sketches are not invertible."""
+        store = self._store(name)
+        if store.batch is None or len(store.batch) == 0:
+            return 0
+        drop = set(str(i) for i in np.atleast_1d(np.asarray(ids, dtype=object)))
+        keep = np.array([str(i) not in drop for i in store.batch.ids])
+        removed = int((~keep).sum())
+        if removed == 0:
+            return 0
+        store.batch = store.batch.take(np.flatnonzero(keep))
+        if store.visibilities is not None:
+            store.visibilities = store.visibilities[keep]
+        store._vis_masks = {}
+        store._dirty = True
+        store._stats = {}
+        store._init_stats()
+        if len(store.batch):
+            for s in store._stats.values():
+                s.observe(store.batch)
+        return removed
+
     # -- query ------------------------------------------------------------
     def query(self, name: str, query="INCLUDE",
               explain: Explainer | None = None) -> FeatureBatch:
@@ -257,10 +281,7 @@ class TpuDataStore:
         q = query if isinstance(query, Query) else Query.of(query)
         q = self._intercept(store.sft, q)
         if store.batch is None or len(store.batch) == 0:
-            empty = FeatureBatch(store.sft, {
-                k: np.empty(0, dtype=v.dtype)
-                for k, v in (store.batch.columns.items() if store.batch else [])
-            })
+            empty = FeatureBatch.empty(store.sft)
             from .planning.strategy import FilterStrategy
             result = QueryResult(empty, np.empty(0, dtype=np.int64),
                                  FilterStrategy("none", 0), 0.0, 0.0)

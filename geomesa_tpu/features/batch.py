@@ -103,6 +103,24 @@ class FeatureBatch:
         ids_arr = None if ids is None else np.asarray(ids, dtype=object)
         return cls(sft, columns, ids_arr, geoms, ids_explicit=ids is not None)
 
+    @classmethod
+    def empty(cls, sft: FeatureType) -> "FeatureBatch":
+        """Zero-row batch with correctly-typed columns for every attribute
+        (including the geometry x/y fast path) — safe to geom_xy/concat."""
+        data: dict = {}
+        for attr in sft.attributes:
+            if attr.is_geometry:
+                if attr.name == sft.default_geom:
+                    data[attr.name] = ((np.empty(0), np.empty(0))
+                                       if attr.type == "point" else [])
+            elif attr.type == "date":
+                data[attr.name] = np.empty(0, dtype=np.int64)
+            elif attr.type in ("string", "bytes"):
+                data[attr.name] = np.empty(0, dtype=object)
+            else:
+                data[attr.name] = np.empty(0, dtype=_DTYPES[attr.type])
+        return cls.from_dict(sft, data, ids=np.empty(0, dtype=object))
+
     # -- access -----------------------------------------------------------
     def column(self, name: str) -> np.ndarray:
         return self.columns[name]

@@ -63,6 +63,8 @@ class _TypeStorage:
     def write(self, batch: FeatureBatch) -> None:
         from ..io.export import to_parquet
 
+        if len(batch) == 0:
+            return
         names = self.scheme.partitions_for_batch(self.sft, batch)
         order = np.argsort(names, kind="stable")
         sorted_names = names[order]
@@ -116,9 +118,7 @@ class _TypeStorage:
                 if mask.any():
                     parts.append(batch.take(np.flatnonzero(mask)))
         if not parts:
-            return FeatureBatch(self.sft, {
-                a.name: np.empty(0) for a in self.sft.attributes
-                if not a.is_geometry})
+            return FeatureBatch.empty(self.sft)
         out = parts[0]
         for p in parts[1:]:
             out = out.concat(p)

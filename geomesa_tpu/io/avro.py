@@ -1,0 +1,239 @@
+"""Avro object-container-file export/import for feature batches.
+
+The reference ships Avro serializers + versioned data files as its
+interop format (geomesa-features/geomesa-feature-avro/.../avro/*,
+AvroDataFileWriter/Reader).  No Avro library is in this image, so this is
+a self-contained implementation of the Avro 1.x spec subset needed:
+binary encoding (zigzag-varint longs, little-endian doubles, length-
+prefixed strings/bytes, nullable unions) and the object container file
+format (magic, metadata map with embedded JSON schema, sync-marker-framed
+blocks, null codec).  Readable by any standard Avro tooling.
+
+Geometries ride as WKB ``bytes`` fields (the reference encodes geometries
+inside Avro records the same way); dates as timestamp-millis longs.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType
+from ..geometry.wkb import wkb_decode, wkb_encode
+from ..geometry.types import Point
+
+__all__ = ["to_avro", "from_avro", "avro_schema"]
+
+_MAGIC = b"Obj\x01"
+
+_AVRO_TYPES = {
+    "string": "string", "int": "int", "long": "long", "float": "float",
+    "double": "double", "bool": "boolean", "date": "long",
+}
+
+
+def avro_schema(sft: FeatureType) -> dict:
+    fields = [{"name": "__fid__", "type": "string"}]
+    for a in sft.attributes:
+        if a.is_geometry:
+            t = "bytes"
+        else:
+            t = _AVRO_TYPES.get(a.type, "string")
+        fields.append({"name": a.name, "type": [t, "null"]})
+    return {"type": "record", "name": sft.name or "feature",
+            "namespace": "geomesa.tpu", "fields": fields}
+
+
+# -- binary primitive encoders ----------------------------------------------
+
+def _w_long(v: int, out: bytearray) -> None:
+    v = (v << 1) ^ (v >> 63)
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_bytes(b: bytes, out: bytearray) -> None:
+    _w_long(len(b), out)
+    out += b
+
+
+def _w_str(s: str, out: bytearray) -> None:
+    _w_bytes(s.encode("utf-8"), out)
+
+
+def _r_long(buf, pos: int):
+    shift = val = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return (val >> 1) ^ -(val & 1), pos
+        shift += 7
+
+
+def _r_bytes(buf, pos: int):
+    n, pos = _r_long(buf, pos)
+    return bytes(buf[pos:pos + n]), pos + n
+
+
+# -- writer -----------------------------------------------------------------
+
+def to_avro(batch: FeatureBatch, path_or_buf) -> None:
+    sft = batch.sft
+    schema = avro_schema(sft)
+    sync = os.urandom(16)
+
+    header = bytearray()
+    header += _MAGIC
+    _w_long(2, header)  # metadata map: one block of 2 entries
+    _w_str("avro.schema", header)
+    _w_bytes(json.dumps(schema).encode(), header)
+    _w_str("avro.codec", header)
+    _w_bytes(b"null", header)
+    _w_long(0, header)  # end of map
+    header += sync
+
+    body = bytearray()
+    n = len(batch)
+    geoms = batch.geoms
+    xy = batch.geom_xy() if sft.geom_field else None
+    for i in range(n):
+        _w_str(str(batch.ids[i]), body)
+        for a in sft.attributes:
+            if a.is_geometry and a.name == sft.default_geom:
+                _w_long(0, body)  # union branch 0 (value)
+                if geoms is not None:
+                    _w_bytes(wkb_encode(geoms.geometry(i)), body)
+                else:
+                    _w_bytes(wkb_encode(Point(float(xy[0][i]),
+                                              float(xy[1][i]))), body)
+                continue
+            col = batch.columns.get(a.name)
+            v = None if col is None else col[i]
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                _w_long(1, body)  # union branch 1 (null)
+                continue
+            _w_long(0, body)
+            t = _AVRO_TYPES.get(a.type, "string")
+            if t in ("long", "int"):
+                _w_long(int(v), body)
+            elif t == "double":
+                body += struct.pack("<d", float(v))
+            elif t == "float":
+                body += struct.pack("<f", float(v))
+            elif t == "boolean":
+                body.append(1 if v else 0)
+            else:
+                _w_str(str(v), body)
+
+    block = bytearray()
+    _w_long(n, block)
+    _w_long(len(body), block)
+    block += body
+    block += sync
+
+    data = bytes(header) + bytes(block)
+    if isinstance(path_or_buf, (str, os.PathLike)):
+        with open(path_or_buf, "wb") as f:
+            f.write(data)
+    else:
+        path_or_buf.write(data)
+
+
+# -- reader -----------------------------------------------------------------
+
+def from_avro(path_or_buf, sft: FeatureType) -> FeatureBatch:
+    if isinstance(path_or_buf, (str, os.PathLike)):
+        with open(path_or_buf, "rb") as f:
+            raw = f.read()
+    else:
+        raw = path_or_buf.read()
+    buf = memoryview(raw)
+    if bytes(buf[:4]) != _MAGIC:
+        raise ValueError("not an Avro object container file")
+    pos = 4
+    meta = {}
+    while True:
+        count, pos = _r_long(buf, pos)
+        if count == 0:
+            break
+        if count < 0:  # block with byte size prefix
+            count = -count
+            _, pos = _r_long(buf, pos)
+        for _ in range(count):
+            k, pos = _r_bytes(buf, pos)
+            v, pos = _r_bytes(buf, pos)
+            meta[k.decode()] = v
+    if meta.get("avro.codec", b"null") not in (b"null", b""):
+        raise ValueError("only null codec supported")
+    sync = bytes(buf[pos:pos + 16])
+    pos += 16
+
+    ids: list = []
+    cols: dict = {a.name: [] for a in sft.attributes}
+    while pos < len(buf):
+        n, pos = _r_long(buf, pos)
+        _, pos = _r_long(buf, pos)  # byte length
+        for _ in range(n):
+            fid, pos = _r_bytes(buf, pos)
+            ids.append(fid.decode())
+            for a in sft.attributes:
+                branch, pos = _r_long(buf, pos)
+                if branch == 1:
+                    cols[a.name].append(None)
+                    continue
+                if a.is_geometry:
+                    b, pos = _r_bytes(buf, pos)
+                    cols[a.name].append(wkb_decode(b))
+                    continue
+                t = _AVRO_TYPES.get(a.type, "string")
+                if t in ("long", "int"):
+                    v, pos = _r_long(buf, pos)
+                    cols[a.name].append(v)
+                elif t == "double":
+                    (v,) = struct.unpack_from("<d", buf, pos)
+                    pos += 8
+                    cols[a.name].append(v)
+                elif t == "float":
+                    (v,) = struct.unpack_from("<f", buf, pos)
+                    pos += 4
+                    cols[a.name].append(v)
+                elif t == "boolean":
+                    cols[a.name].append(bool(buf[pos]))
+                    pos += 1
+                else:
+                    s, pos = _r_bytes(buf, pos)
+                    cols[a.name].append(s.decode())
+        if bytes(buf[pos:pos + 16]) != sync:
+            raise ValueError("sync marker mismatch")
+        pos += 16
+
+    data: dict = {}
+    for a in sft.attributes:
+        vals = cols[a.name]
+        if a.is_geometry:
+            data[a.name] = vals
+        elif a.type in ("int", "long", "date"):
+            data[a.name] = np.array(
+                [0 if v is None else int(v) for v in vals], dtype=np.int64)
+        elif a.type in ("float", "double"):
+            data[a.name] = np.array(
+                [np.nan if v is None else float(v) for v in vals])
+        elif a.type == "bool":
+            data[a.name] = np.array([bool(v) for v in vals])
+        else:
+            data[a.name] = np.array(vals, dtype=object)
+    return FeatureBatch.from_dict(sft, data,
+                                  ids=np.array(ids, dtype=object))

@@ -58,13 +58,17 @@ class LambdaDataStore:
         self.stream.consume(name)
         cache = self.stream.cache(name)
         now = self._clock() * 1000.0 if now_ms is None else now_ms
-        expired = [fid for fid in cache.index.all_ids()
+        expired = [fid for fid in cache.all_feature_ids()
                    if now - self._write_ms.get((name, fid), 0.0)
                    >= self.expiry_ms]
         if not expired:
             return 0
         batch = cache.snapshot(expired)
         if len(batch):
+            # upsert: a feature persisted earlier and then re-written
+            # transiently must replace, not duplicate, its stored row
+            if hasattr(self.persistent, "delete"):
+                self.persistent.delete(name, batch.ids)
             self.persistent.write(name, batch)
         for fid in expired:
             cache.remove(fid)

@@ -51,21 +51,23 @@ class HistogramMetric:
         return self.total / self.count if self.count else 0.0
 
 
+@dataclass
 class Timer(HistogramMetric):
     """Histogram of durations (ms) usable as a context manager.
 
     Registry timers are shared singletons, so start times live in a
     thread-local stack — concurrent (even nested) ``with`` blocks on the
-    same timer record independent durations.
+    same timer record independent durations.  The thread-local is an
+    eagerly-created dataclass field: no lazy init race on first use.
     """
 
+    _local: threading.local = field(default_factory=threading.local,
+                                    repr=False)
+
     def _starts(self) -> list:
-        local = self.__dict__.get("_local")
-        if local is None:
-            local = self.__dict__["_local"] = threading.local()
-        if not hasattr(local, "stack"):
-            local.stack = []
-        return local.stack
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
 
     def __enter__(self):
         self._starts().append(time.perf_counter())

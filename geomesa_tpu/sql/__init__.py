@@ -1,0 +1,14 @@
+"""Spatial analytics API: the Spark-analog layer.
+
+The reference's geomesa-spark stack contributes JTS UDTs + ~40 ``st_*``
+UDFs and a SQL relation with spatial-predicate push-down
+(geomesa-spark/geomesa-spark-jts/.../udf/*, geomesa-spark-sql/.../
+SQLRules.scala).  Here: :mod:`functions` is the vectorized st_* library
+over columns, and :class:`SpatialFrame` is the datastore-backed frame
+whose ``where`` pushes ECQL predicates into the query planner.
+"""
+
+from . import functions as st
+from .frame import SpatialFrame
+
+__all__ = ["st", "SpatialFrame"]

@@ -1,0 +1,111 @@
+"""SpatialFrame: datastore-backed columnar frame with predicate push-down.
+
+The role of the reference's Spark integration (SpatialRDDProvider →
+GeoMesaSparkSQL relation + SQLRules catalyst push-down,
+geomesa-spark/geomesa-spark-sql/.../GeoMesaSparkSQL.scala, SQLRules.scala):
+a lazy frame over one schema whose ``where`` clauses accumulate and are
+pushed into the datastore's query planner as one ECQL conjunction at
+``collect`` time — the index does the spatial work, not the frame.
+Post-scan transforms (select / with_column / group_by aggregation) run
+vectorized on the result columns; ``to_arrow`` hands off to the Arrow
+interchange path for downstream analytics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..filters.ast import And, Filter, Include
+from ..filters.ecql import parse_ecql
+from ..planning.planner import Query
+
+__all__ = ["SpatialFrame"]
+
+
+class SpatialFrame:
+    """Lazy query-frame over one schema of a datastore."""
+
+    def __init__(self, store, type_name: str, _filter: Filter = Include,
+                 _props: list | None = None, _limit: int | None = None):
+        self.store = store
+        self.type_name = type_name
+        self._filter = _filter
+        self._props = _props
+        self._limit = _limit
+
+    # -- lazy builders (push-down accumulators) ---------------------------
+    def where(self, predicate) -> "SpatialFrame":
+        """AND an ECQL string (or Filter) into the pushed-down query."""
+        f = parse_ecql(predicate) if isinstance(predicate, str) else predicate
+        combined = f if self._filter is Include else And((self._filter, f))
+        return SpatialFrame(self.store, self.type_name, combined,
+                            self._props, self._limit)
+
+    filter = where
+
+    def select(self, *props) -> "SpatialFrame":
+        return SpatialFrame(self.store, self.type_name, self._filter,
+                            list(props), self._limit)
+
+    def limit(self, n: int) -> "SpatialFrame":
+        return SpatialFrame(self.store, self.type_name, self._filter,
+                            self._props, n)
+
+    # -- execution --------------------------------------------------------
+    def _query(self) -> Query:
+        return Query(filter=self._filter, properties=self._props,
+                     max_features=self._limit)
+
+    def collect(self) -> FeatureBatch:
+        return self.store.query(self.type_name, self._query())
+
+    def count(self) -> int:
+        return len(self.collect())
+
+    def explain(self) -> str:
+        return self.store.explain(self.type_name, self._query())
+
+    # -- post-scan vectorized ops ----------------------------------------
+    def with_column(self, name: str, fn) -> dict:
+        """Collect and add a computed column: fn(batch) → np.ndarray."""
+        batch = self.collect()
+        cols = dict(batch.columns)
+        cols[name] = np.asarray(fn(batch))
+        return cols
+
+    def group_by(self, key: str, aggs: dict) -> dict:
+        """Aggregate: ``aggs`` maps output name → (column, fn) with fn in
+        {"count", "sum", "min", "max", "mean"}."""
+        batch = self.collect()
+        keys = batch.column(key)
+        keys = keys.astype(str) if keys.dtype == object else keys
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        out: dict = {key: uniq}
+        for name, (col, fn) in aggs.items():
+            if fn == "count":
+                out[name] = np.bincount(inverse, minlength=len(uniq))
+                continue
+            vals = batch.column(col).astype(np.float64)
+            if fn == "sum":
+                out[name] = np.bincount(inverse, weights=vals,
+                                        minlength=len(uniq))
+            elif fn == "mean":
+                s = np.bincount(inverse, weights=vals, minlength=len(uniq))
+                c = np.bincount(inverse, minlength=len(uniq))
+                out[name] = s / np.maximum(c, 1)
+            elif fn in ("min", "max"):
+                red = np.full(len(uniq), np.inf if fn == "min" else -np.inf)
+                np.minimum.at(red, inverse, vals) if fn == "min" else \
+                    np.maximum.at(red, inverse, vals)
+                out[name] = red
+            else:
+                raise ValueError(f"unknown aggregation {fn!r}")
+        return out
+
+    def to_arrow(self):
+        from ..io.export import to_arrow
+        return to_arrow(self.collect())
+
+    def to_pandas(self):  # pragma: no cover - convenience
+        return self.to_arrow().to_pandas()

@@ -1,0 +1,339 @@
+"""Vectorized st_* spatial functions.
+
+The ~40 UDFs the reference registers for Spark SQL
+(geomesa-spark/geomesa-spark-jts/src/main/scala/.../udf/
+{GeometricConstructorFunctions, GeometricAccessorFunctions,
+GeometricPredicateFunctions, GeometricOutputFunctions,
+SpatialRelationFunctions, GeometricCastFunctions}.scala), re-expressed as
+numpy-vectorized column functions.  Point columns are ``(x, y)`` array
+pairs; geometry columns are object arrays of
+:class:`~geomesa_tpu.geometry.types.Geometry`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..geometry.types import (
+    Envelope, Geometry, LineString, MultiPoint, MultiPolygon, Point, Polygon,
+)
+from ..geometry.wkt import geometry_from_wkt as parse_wkt
+from ..geometry.wkt import geometry_to_wkt as to_wkt
+from ..geometry.wkb import wkb_decode, wkb_encode
+from ..geometry.predicates import point_in_polygon
+from ..process.knn import EARTH_RADIUS_M, haversine_m
+
+__all__ = [
+    # constructors
+    "st_point", "st_makePoint", "st_geomFromWKT", "st_geomFromWKB",
+    "st_makeBBOX", "st_makeBox2D", "st_makePolygon", "st_makeLine",
+    # accessors
+    "st_x", "st_y", "st_envelope", "st_exteriorRing", "st_numPoints",
+    "st_pointN", "st_isValid", "st_geometryType", "st_centroid",
+    # outputs / casts
+    "st_asText", "st_asBinary", "st_castToPoint", "st_castToPolygon",
+    "st_castToLineString",
+    # predicates
+    "st_contains", "st_within", "st_intersects", "st_disjoint", "st_equals",
+    "st_crosses", "st_bbox_intersects", "st_dwithin",
+    # relations / measures
+    "st_distance", "st_distanceSphere", "st_area", "st_length",
+    "st_lengthSphere", "st_translate", "st_bufferPoint",
+]
+
+
+def _geoms(col) -> np.ndarray:
+    return np.atleast_1d(np.asarray(col, dtype=object))
+
+
+# -- constructors -----------------------------------------------------------
+
+def st_point(x, y):
+    """Point column as an (x, y) array pair."""
+    return np.atleast_1d(np.asarray(x, np.float64)), \
+        np.atleast_1d(np.asarray(y, np.float64))
+
+
+st_makePoint = st_point
+
+
+def st_geomFromWKT(col) -> np.ndarray:
+    return np.array([parse_wkt(s) for s in np.atleast_1d(col)], dtype=object)
+
+
+def st_geomFromWKB(col) -> np.ndarray:
+    return np.array([wkb_decode(b) for b in np.atleast_1d(col)], dtype=object)
+
+
+def st_makeBBOX(xmin, ymin, xmax, ymax) -> np.ndarray:
+    args = np.broadcast_arrays(*(np.atleast_1d(np.asarray(a, np.float64))
+                                 for a in (xmin, ymin, xmax, ymax)))
+    return np.array(
+        [Polygon.from_envelope(Envelope(*vals)) for vals in zip(*args)],
+        dtype=object)
+
+
+st_makeBox2D = st_makeBBOX
+
+
+def st_makePolygon(shell_lines) -> np.ndarray:
+    return np.array([Polygon(l.coords if isinstance(l, LineString) else l)
+                     for l in _geoms(shell_lines)], dtype=object)
+
+
+def st_makeLine(points_list) -> LineString:
+    pts = [(p.x, p.y) if isinstance(p, Point) else tuple(p)
+           for p in points_list]
+    return LineString(np.asarray(pts))
+
+
+# -- accessors --------------------------------------------------------------
+
+def st_x(col) -> np.ndarray:
+    if isinstance(col, tuple):
+        return np.asarray(col[0], np.float64)
+    return np.array([g.x if isinstance(g, Point) else np.nan
+                     for g in _geoms(col)])
+
+
+def st_y(col) -> np.ndarray:
+    if isinstance(col, tuple):
+        return np.asarray(col[1], np.float64)
+    return np.array([g.y if isinstance(g, Point) else np.nan
+                     for g in _geoms(col)])
+
+
+def st_envelope(col) -> np.ndarray:
+    return np.array([g.envelope for g in _geoms(col)], dtype=object)
+
+
+def st_exteriorRing(col) -> np.ndarray:
+    return np.array(
+        [LineString(g.shell) if isinstance(g, Polygon) else None
+         for g in _geoms(col)], dtype=object)
+
+
+def st_numPoints(col) -> np.ndarray:
+    def npts(g):
+        if isinstance(g, Point):
+            return 1
+        if isinstance(g, (LineString, MultiPoint)):
+            return len(g.coords)
+        if isinstance(g, Polygon):
+            return len(g.shell) + sum(len(h) for h in g.holes)
+        if isinstance(g, MultiPolygon):
+            return sum(len(p.shell) + sum(len(h) for h in p.holes)
+                       for p in g.polygons)
+        return sum(len(l.coords) for l in getattr(g, "lines", ()))
+    return np.array([npts(g) for g in _geoms(col)], dtype=np.int64)
+
+
+def st_pointN(col, n: int) -> np.ndarray:
+    def pick(g):
+        coords = g.coords if isinstance(g, (LineString, MultiPoint)) else (
+            g.shell if isinstance(g, Polygon) else None)
+        if coords is None:
+            return None
+        i = n - 1 if n > 0 else len(coords) + n   # 1-based, negatives wrap
+        if 0 <= i < len(coords):
+            return Point(float(coords[i, 0]), float(coords[i, 1]))
+        return None
+    return np.array([pick(g) for g in _geoms(col)], dtype=object)
+
+
+def st_isValid(col) -> np.ndarray:
+    def ok(g):
+        try:
+            return bool(g is not None and g.envelope is not None)
+        except Exception:
+            return False
+    return np.array([ok(g) for g in _geoms(col)])
+
+
+def st_geometryType(col) -> np.ndarray:
+    return np.array([g.geom_type for g in _geoms(col)], dtype=object)
+
+
+def st_centroid(col) -> np.ndarray:
+    def cen(g):
+        if isinstance(g, Point):
+            return g
+        if isinstance(g, (LineString, MultiPoint)):
+            c = g.coords.mean(axis=0)
+        elif isinstance(g, Polygon):
+            c = g.shell[:-1].mean(axis=0)
+        elif isinstance(g, MultiPolygon):
+            c = np.vstack([p.shell[:-1] for p in g.polygons]).mean(axis=0)
+        else:
+            c = np.vstack([l.coords for l in g.lines]).mean(axis=0)
+        return Point(float(c[0]), float(c[1]))
+    return np.array([cen(g) for g in _geoms(col)], dtype=object)
+
+
+# -- outputs / casts --------------------------------------------------------
+
+def st_asText(col) -> np.ndarray:
+    return np.array([to_wkt(g) for g in _geoms(col)], dtype=object)
+
+
+def st_asBinary(col) -> np.ndarray:
+    return np.array([wkb_encode(g) for g in _geoms(col)], dtype=object)
+
+
+def _cast(col, cls) -> np.ndarray:
+    return np.array([g if isinstance(g, cls) else None for g in _geoms(col)],
+                    dtype=object)
+
+
+def st_castToPoint(col):
+    return _cast(col, Point)
+
+
+def st_castToPolygon(col):
+    return _cast(col, Polygon)
+
+
+def st_castToLineString(col):
+    return _cast(col, LineString)
+
+
+# -- predicates -------------------------------------------------------------
+
+def _points_xy(col):
+    if isinstance(col, tuple):
+        return (np.atleast_1d(np.asarray(col[0], np.float64)),
+                np.atleast_1d(np.asarray(col[1], np.float64)))
+    gs = _geoms(col)
+    return (np.array([g.x for g in gs]), np.array([g.y for g in gs]))
+
+
+def st_contains(geom: Geometry, col) -> np.ndarray:
+    """geom contains points/geoms of ``col`` (vectorized over the column)."""
+    x, y = _points_xy(col)
+    if isinstance(geom, (Polygon, MultiPolygon)):
+        return point_in_polygon(x, y, geom)
+    env = geom.envelope
+    return (x >= env.xmin) & (x <= env.xmax) & (y >= env.ymin) & (y <= env.ymax)
+
+
+def st_within(col, geom: Geometry) -> np.ndarray:
+    return st_contains(geom, col)
+
+
+def st_intersects(geom: Geometry, col) -> np.ndarray:
+    return st_contains(geom, col)
+
+
+def st_disjoint(geom: Geometry, col) -> np.ndarray:
+    return ~st_contains(geom, col)
+
+
+def st_equals(col_a, col_b) -> np.ndarray:
+    ax, ay = _points_xy(col_a)
+    bx, by = _points_xy(col_b)
+    return (ax == bx) & (ay == by)
+
+
+def st_crosses(geom: Geometry, col) -> np.ndarray:
+    # point columns: crosses degenerates to intersects-boundary ≈ contains
+    return st_contains(geom, col)
+
+
+def st_bbox_intersects(env: Envelope, col) -> np.ndarray:
+    x, y = _points_xy(col)
+    return (x >= env.xmin) & (x <= env.xmax) & (y >= env.ymin) & (y <= env.ymax)
+
+
+def st_dwithin(geom: Geometry, col, distance_m: float) -> np.ndarray:
+    x, y = _points_xy(col)
+    if isinstance(geom, Point):
+        return haversine_m(geom.x, geom.y, x, y) <= distance_m
+    # non-point: envelope-expand test then centroid distance (approximate)
+    c = st_centroid([geom])[0]
+    return haversine_m(c.x, c.y, x, y) <= distance_m
+
+
+# -- relations / measures ---------------------------------------------------
+
+def st_distance(col_a, col_b) -> np.ndarray:
+    """Cartesian (degree-space) distance between point columns."""
+    ax, ay = _points_xy(col_a)
+    bx, by = _points_xy(col_b)
+    return np.hypot(ax - bx, ay - by)
+
+
+def st_distanceSphere(col_a, col_b) -> np.ndarray:
+    ax, ay = _points_xy(col_a)
+    bx, by = _points_xy(col_b)
+    return haversine_m(ax, ay, bx, by)
+
+
+def st_area(col) -> np.ndarray:
+    def area(g):
+        if isinstance(g, Polygon):
+            return _ring_area(g.shell) - sum(_ring_area(h) for h in g.holes)
+        if isinstance(g, MultiPolygon):
+            return sum(_ring_area(p.shell)
+                       - sum(_ring_area(h) for h in p.holes)
+                       for p in g.polygons)
+        return 0.0
+    return np.array([area(g) for g in _geoms(col)])
+
+
+def _ring_area(ring: np.ndarray) -> float:
+    x, y = ring[:, 0], ring[:, 1]
+    return 0.5 * abs(np.sum(x[:-1] * y[1:] - x[1:] * y[:-1]))
+
+
+def st_length(col) -> np.ndarray:
+    def length(g):
+        if isinstance(g, LineString):
+            d = np.diff(g.coords, axis=0)
+            return float(np.hypot(d[:, 0], d[:, 1]).sum())
+        if hasattr(g, "lines"):
+            return sum(length(l) for l in g.lines)
+        return 0.0
+    return np.array([length(g) for g in _geoms(col)])
+
+
+def st_lengthSphere(col) -> np.ndarray:
+    def length(g):
+        if isinstance(g, LineString):
+            c = g.coords
+            return float(haversine_m(c[:-1, 0], c[:-1, 1],
+                                     c[1:, 0], c[1:, 1]).sum())
+        if hasattr(g, "lines"):
+            return sum(length(l) for l in g.lines)
+        return 0.0
+    return np.array([length(g) for g in _geoms(col)])
+
+
+def st_translate(col, dx: float, dy: float):
+    if isinstance(col, tuple):
+        return (np.asarray(col[0]) + dx, np.asarray(col[1]) + dy)
+
+    def move(g):
+        if isinstance(g, Point):
+            return Point(g.x + dx, g.y + dy)
+        if isinstance(g, LineString):
+            return LineString(g.coords + [dx, dy])
+        if isinstance(g, Polygon):
+            return Polygon(g.shell + [dx, dy],
+                           tuple(h + [dx, dy] for h in g.holes))
+        raise ValueError(f"st_translate: unsupported {g.geom_type}")
+    return np.array([move(g) for g in _geoms(col)], dtype=object)
+
+
+def st_bufferPoint(col, distance_m: float, segments: int = 32) -> np.ndarray:
+    """Geodesic point buffer → polygon (the reference's st_bufferPoint,
+    used for dwithin-style joins)."""
+    x, y = _points_xy(col)
+    ang = np.linspace(0, 2 * np.pi, segments, endpoint=False)
+    dlat = np.degrees(distance_m / EARTH_RADIUS_M)
+    out = []
+    for xi, yi in zip(x, y):
+        cos = max(0.01, np.cos(np.radians(yi)))
+        ring = np.stack([xi + dlat / cos * np.cos(ang),
+                         yi + dlat * np.sin(ang)], axis=1)
+        out.append(Polygon(ring))
+    return np.array(out, dtype=object)

@@ -71,6 +71,12 @@ class LiveFeatureCache:
     def __len__(self) -> int:
         return len(self._features)
 
+    def all_feature_ids(self) -> list:
+        """Every cached feature id — including features without geometry
+        (which are absent from the spatial index)."""
+        with self._lock:
+            return list(self._features)
+
     def snapshot(self, fids=None) -> FeatureBatch:
         """Columnar snapshot of (a subset of) the cache."""
         with self._lock:

@@ -99,8 +99,9 @@ def geohash_neighbors(h: str) -> list:
         for dx in (-1, 0, 1):
             if dx == 0 and dy == 0:
                 continue
-            nlon = lon + dx * dlon
+            # wrap at the antimeridian so spiral searches cross it
+            nlon = ((lon + dx * dlon) + 180.0) % 360.0 - 180.0
             nlat = lat + dy * dlat
-            if -180 <= nlon <= 180 and -90 <= nlat <= 90:
+            if -90 <= nlat <= 90:
                 out.append(str(geohash_encode([nlon], [nlat], len(h))[0]))
     return out

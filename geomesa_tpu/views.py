@@ -48,10 +48,7 @@ class MergedDataStoreView:
             if len(out):
                 parts.append(out)
         if not parts:
-            sft = self.get_schema(name)
-            return FeatureBatch(sft, {
-                a.name: np.empty(0) for a in sft.attributes
-                if not a.is_geometry})
+            return FeatureBatch.empty(self.get_schema(name))
         merged = parts[0]
         for p in parts[1:]:
             merged = merged.concat(p)

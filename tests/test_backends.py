@@ -282,3 +282,57 @@ def test_merged_view_union_and_scope():
                                  [parse_ecql("name = 'n1'"), None])
     out = scoped.query("t", "BBOX(geom,-76,39,-73,42)")
     assert len(out) == a.get_count("t", "name = 'n1'") + 60
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_lambda_repersist_upserts_no_duplicates():
+    clock = [1000.0]
+    persistent = TpuDataStore()
+    lam = LambdaDataStore(persistent, expiry_ms=1000, clock=lambda: clock[0])
+    lam.create_schema("t", SPEC)
+    lam.write("t", "a", {"name": "v1", "dtg": MS_2018, "geom": (-74.5, 40.5)})
+    clock[0] += 2.0
+    assert lam.persist("t") == 1
+    lam.write("t", "a", {"name": "v2", "dtg": MS_2018, "geom": (-74.5, 40.5)})
+    clock[0] += 2.0
+    assert lam.persist("t") == 1
+    out = lam.query("t", "BBOX(geom,-75,40,-74,41)")
+    assert len(out) == 1 and out.columns["name"][0] == "v2"
+
+
+def test_fs_empty_write_and_empty_result(tmp_path):
+    fs = FileSystemDataStore(str(tmp_path))
+    fs.create_schema("ev", SPEC)
+    fs.write("ev", {"name": np.empty(0, dtype=object),
+                    "dtg": np.empty(0, dtype=np.int64),
+                    "geom": (np.empty(0), np.empty(0))})
+    out = fs.query("ev", "name = 'nothing'")
+    assert len(out) == 0
+    out.geom_xy()                      # typed empty batch works
+    assert out.columns["dtg"].dtype == np.int64
+    rng = np.random.default_rng(1)
+    fs.write("ev", _mk_cols(10, rng))
+    assert len(out.concat(fs.query("ev"))) == 10
+
+
+def test_datastore_delete_by_id():
+    ds = TpuDataStore()
+    ds.create_schema("t", SPEC)
+    rng = np.random.default_rng(9)
+    ds.write("t", _mk_cols(30, rng),
+             ids=np.array([f"f{i}" for i in range(30)], dtype=object))
+    assert ds.delete("t", ["f1", "f2", "nope"]) == 2
+    assert ds.get_count("t") == 28
+    out = ds.query("t", "BBOX(geom,-76,39,-73,42)")
+    assert "f1" not in set(out.ids) and len(out) == 28
+
+
+def test_geohash_neighbors_antimeridian():
+    from geomesa_tpu.utils import geohash_encode, geohash_neighbors
+    h = str(geohash_encode([179.99], [0.0], 5)[0])
+    nbrs = geohash_neighbors(h)
+    assert len(nbrs) == 8
+    from geomesa_tpu.utils import geohash_decode
+    lons = geohash_decode(nbrs)[0]
+    assert (lons < -179).any()          # wrapped across the antimeridian

@@ -1,0 +1,163 @@
+"""WKB/TWKB codecs, Avro container files, st_* functions, SpatialFrame."""
+
+import io
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.geometry.types import (
+    Envelope, LineString, MultiLineString, MultiPoint, MultiPolygon, Point,
+    Polygon,
+)
+from geomesa_tpu.geometry.wkb import (
+    twkb_decode, twkb_encode, wkb_decode, wkb_encode,
+)
+from geomesa_tpu.io.avro import avro_schema, from_avro, to_avro
+from geomesa_tpu.sql import SpatialFrame, st
+
+MS_2018 = 1514764800000
+
+GEOMS = [
+    Point(-75.1, 40.2),
+    LineString([[0, 0], [1, 1], [2, 0.5]]),
+    Polygon([[0, 0], [4, 0], [4, 4], [0, 4]], ([[1, 1], [2, 1], [2, 2], [1, 2]],)),
+    MultiPoint([[1, 2], [3, 4]]),
+    MultiLineString(([[0, 0], [1, 1]], [[2, 2], [3, 3]])),
+    MultiPolygon(([[0, 0], [1, 0], [1, 1]], [[5, 5], [6, 5], [6, 6]])),
+]
+
+
+@pytest.mark.parametrize("g", GEOMS, ids=[g.geom_type for g in GEOMS])
+def test_wkb_roundtrip(g):
+    out = wkb_decode(wkb_encode(g))
+    assert out.geom_type == g.geom_type
+    assert out.envelope.as_tuple() == pytest.approx(g.envelope.as_tuple())
+
+
+@pytest.mark.parametrize("g", GEOMS, ids=[g.geom_type for g in GEOMS])
+def test_twkb_roundtrip(g):
+    raw = twkb_encode(g, precision=7)
+    out = twkb_decode(raw)
+    assert out.geom_type == g.geom_type
+    np.testing.assert_allclose(out.envelope.as_tuple(),
+                               g.envelope.as_tuple(), atol=1e-6)
+
+
+def test_twkb_smaller_than_wkb_for_tracks():
+    rng = np.random.default_rng(0)
+    track = LineString(np.cumsum(rng.uniform(-0.001, 0.001, (500, 2)),
+                                 axis=0) + [-75, 40])
+    assert len(twkb_encode(track)) < 0.5 * len(wkb_encode(track))
+
+
+def test_wkb_known_point_bytes():
+    # standard WKB for POINT(1 2), little endian
+    raw = wkb_encode(Point(1.0, 2.0))
+    assert raw == (b"\x01\x01\x00\x00\x00"
+                   b"\x00\x00\x00\x00\x00\x00\xf0?"
+                   b"\x00\x00\x00\x00\x00\x00\x00@")
+
+
+def test_avro_roundtrip_and_schema():
+    ds = TpuDataStore()
+    sft = ds.create_schema("t", "name:String,score:Double,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(1)
+    n = 100
+    ds.write("t", {
+        "name": np.array([f"n{i}" for i in range(n)], dtype=object),
+        "score": rng.uniform(0, 10, n),
+        "dtg": np.full(n, MS_2018, dtype=np.int64),
+        "geom": (rng.uniform(-75, -74, n), rng.uniform(40, 41, n)),
+    })
+    batch = ds.query("t")
+    buf = io.BytesIO()
+    to_avro(batch, buf)
+    buf.seek(0)
+    back = from_avro(buf, sft)
+    assert len(back) == n
+    assert list(back.ids) == list(batch.ids)
+    np.testing.assert_allclose(back.columns["score"], batch.columns["score"])
+    np.testing.assert_array_equal(back.columns["dtg"], batch.columns["dtg"])
+    bx, by = back.geom_xy()
+    ox, oy = batch.geom_xy()
+    np.testing.assert_allclose(bx, ox)
+    sch = avro_schema(sft)
+    assert sch["type"] == "record"
+    assert any(f["name"] == "geom" for f in sch["fields"])
+
+
+def test_st_functions():
+    x = np.array([-75.0, -74.5, 0.0])
+    y = np.array([40.0, 40.5, 0.0])
+    pts = st.st_point(x, y)
+    np.testing.assert_array_equal(st.st_x(pts), x)
+
+    poly = st.st_geomFromWKT(["POLYGON((-76 39, -74 39, -74 41, -76 41, -76 39))"])[0]
+    mask = st.st_contains(poly, pts)
+    np.testing.assert_array_equal(mask, [True, True, False])
+    np.testing.assert_array_equal(st.st_within(pts, poly), mask)
+    np.testing.assert_array_equal(st.st_disjoint(poly, pts), ~mask)
+
+    bbox = st.st_makeBBOX(-76, 39, -74, 41)[0]
+    assert st.st_area([bbox])[0] == pytest.approx(4.0)
+
+    line = LineString([[0, 0], [3, 4]])
+    assert st.st_length([line])[0] == pytest.approx(5.0)
+    assert st.st_numPoints([line])[0] == 2
+    c = st.st_centroid([line])[0]
+    assert (c.x, c.y) == pytest.approx((1.5, 2.0))
+
+    d = st.st_distanceSphere(st.st_point([-75.0], [40.0]),
+                             st.st_point([-75.0], [41.0]))
+    assert d[0] == pytest.approx(111_195, rel=0.01)   # 1 deg lat
+
+    buf = st.st_bufferPoint(st.st_point([-75.0], [40.0]), 10_000.0)[0]
+    assert st.st_contains(buf, st.st_point([-75.05], [40.0]))[0]
+    assert not st.st_contains(buf, st.st_point([-75.5], [40.0]))[0]
+
+    wkt = st.st_asText([poly])[0]
+    assert wkt.startswith("POLYGON")
+    wkb = st.st_asBinary([poly])[0]
+    assert st.st_geomFromWKB([wkb])[0].geom_type == "Polygon"
+
+    assert st.st_dwithin(Point(-75.0, 40.0), pts, 100_000)[0]
+    assert st.st_geometryType([poly])[0] == "Polygon"
+    p9 = st.st_pointN([line], 2)[0]
+    assert (p9.x, p9.y) == (3.0, 4.0)
+
+
+def test_spatial_frame_pushdown_and_aggregation():
+    ds = TpuDataStore()
+    ds.create_schema("ev", "name:String,score:Double,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(4)
+    n = 2000
+    ds.write("ev", {
+        "name": np.array([f"n{i % 3}" for i in range(n)], dtype=object),
+        "score": rng.uniform(0, 10, n),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * 86_400_000, n),
+        "geom": (rng.uniform(-76, -73, n), rng.uniform(39, 42, n)),
+    })
+    frame = (SpatialFrame(ds, "ev")
+             .where("BBOX(geom,-75,40,-74,41)")
+             .where("name = 'n1'"))
+    out = frame.collect()
+    x, y = out.geom_xy()
+    assert np.all((x >= -75) & (x <= -74) & (y >= 40) & (y <= 41))
+    assert all(v == "n1" for v in out.columns["name"])
+    # push-down happened: explain mentions an index, not a full scan
+    plan = frame.explain()
+    assert "z2" in plan.lower() or "z3" in plan.lower()
+
+    assert frame.limit(5).count() == 5
+    sel = frame.select("name", "score").collect()
+    assert set(sel.columns) == {"name", "score"}
+
+    groups = SpatialFrame(ds, "ev").group_by(
+        "name", {"n": ("name", "count"), "avg": ("score", "mean"),
+                 "hi": ("score", "max")})
+    assert groups["n"].sum() == n
+    assert np.all(groups["hi"] <= 10.0)
+
+    tbl = frame.to_arrow()
+    assert tbl.num_rows == len(out)
