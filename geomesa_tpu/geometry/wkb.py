@@ -81,21 +81,32 @@ def _wkb_read(buf: memoryview, pos: int):
     pos += 1
     fmt = "<I" if little else ">I"
     (t,) = struct.unpack_from(fmt, buf, pos)
-    t &= 0xFF  # mask any SRID/dimensionality flags
     pos += 4
+    # EWKB (PostGIS) flag bits + ISO WKB 1000/2000/3000 dimension offsets
+    has_z = bool(t & 0x80000000)
+    has_m = bool(t & 0x40000000)
+    if t & 0x20000000:  # SRID present: consume (and discard) the 4-byte SRID
+        pos += 4
+    t &= 0x1FFFFFFF
+    if t >= 1000:
+        iso_dim = t // 1000
+        has_z = has_z or iso_dim in (1, 3)
+        has_m = has_m or iso_dim in (2, 3)
+        t %= 1000
+    ndim = 2 + has_z + has_m
     dfmt = "<" if little else ">"
     if t == 1:
-        x, y = struct.unpack_from(dfmt + "dd", buf, pos)
-        return Point(x, y), pos + 16
+        vals = struct.unpack_from(dfmt + "d" * ndim, buf, pos)
+        return Point(vals[0], vals[1]), pos + 8 * ndim
     if t == 2:
-        coords, pos = _wkb_read_coords(buf, pos, little)
+        coords, pos = _wkb_read_coords(buf, pos, little, ndim)
         return LineString(coords), pos
     if t == 3:
         (n,) = struct.unpack_from(fmt, buf, pos)
         pos += 4
         rings = []
         for _ in range(n):
-            r, pos = _wkb_read_coords(buf, pos, little)
+            r, pos = _wkb_read_coords(buf, pos, little, ndim)
             rings.append(r)
         return Polygon(rings[0], tuple(rings[1:])), pos
     if t in (4, 5, 6):
@@ -113,13 +124,14 @@ def _wkb_read(buf: memoryview, pos: int):
     raise ValueError(f"unsupported WKB type {t}")
 
 
-def _wkb_read_coords(buf: memoryview, pos: int, little: bool):
+def _wkb_read_coords(buf: memoryview, pos: int, little: bool, ndim: int = 2):
     fmt = "<I" if little else ">I"
     (n,) = struct.unpack_from(fmt, buf, pos)
     pos += 4
     dt = "<f8" if little else ">f8"
-    coords = np.frombuffer(buf[pos:pos + 16 * n], dtype=dt).reshape(n, 2)
-    return coords.astype(np.float64), pos + 16 * n
+    size = 8 * ndim * n
+    coords = np.frombuffer(buf[pos:pos + size], dtype=dt).reshape(n, ndim)
+    return coords[:, :2].astype(np.float64), pos + size
 
 
 # ---------------------------------------------------------------------------
@@ -180,6 +192,8 @@ class _TwkbWriter:
 
 
 def twkb_encode(geom: Geometry, precision: int = 7) -> bytes:
+    if not -8 <= precision <= 7:  # zigzag(precision) must fit the header nibble
+        raise ValueError(f"TWKB precision must be in [-8, 7], got {precision}")
     w = _TwkbWriter(precision)
     t = _WKB_TYPES[geom.geom_type]
     w.header(t, precision)

@@ -108,17 +108,20 @@ def to_avro(batch: FeatureBatch, path_or_buf) -> None:
     body = bytearray()
     n = len(batch)
     geoms = batch.geoms
-    xy = batch.geom_xy() if sft.geom_field else None
     for i in range(n):
         _w_str(str(batch.ids[i]), body)
         for a in sft.attributes:
-            if a.is_geometry and a.name == sft.default_geom:
-                _w_long(0, body)  # union branch 0 (value)
-                if geoms is not None:
+            if a.is_geometry:
+                if a.name == sft.default_geom and geoms is not None:
+                    _w_long(0, body)  # union branch 0 (value)
                     _w_bytes(wkb_encode(geoms.geometry(i)), body)
+                elif f"{a.name}_x" in batch.columns:
+                    x, y = batch.geom_xy(a.name)
+                    _w_long(0, body)
+                    _w_bytes(wkb_encode(Point(float(x[i]), float(y[i]))),
+                             body)
                 else:
-                    _w_bytes(wkb_encode(Point(float(xy[0][i]),
-                                              float(xy[1][i]))), body)
+                    _w_long(1, body)  # no geometry data: null branch
                 continue
             col = batch.columns.get(a.name)
             v = None if col is None else col[i]
@@ -224,7 +227,10 @@ def from_avro(path_or_buf, sft: FeatureType) -> FeatureBatch:
     for a in sft.attributes:
         vals = cols[a.name]
         if a.is_geometry:
-            data[a.name] = vals
+            if all(v is None for v in vals):
+                continue  # geometry never written: leave the column absent
+            data[a.name] = [Point(float("nan"), float("nan"))
+                            if v is None else v for v in vals]
         elif a.type in ("int", "long", "date"):
             data[a.name] = np.array(
                 [0 if v is None else int(v) for v in vals], dtype=np.int64)

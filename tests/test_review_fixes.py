@@ -2,9 +2,11 @@
 id aliasing on rewrite, sparse-batch exports, MultiPoint proximity."""
 
 import numpy as np
+import pytest
 
 from geomesa_tpu.datastore import TpuDataStore
 from geomesa_tpu.features import FeatureBatch
+from geomesa_tpu.features.feature_type import parse_spec
 from geomesa_tpu.geometry import MultiPoint
 from geomesa_tpu.io.converters import converter_from_config
 from geomesa_tpu.io.export import to_csv, to_geojson
@@ -101,3 +103,65 @@ def test_proximity_multipoint():
                    haversine_m(-74.6, 40.4, bx, by))
     want = np.sort(np.nonzero(d <= 20_000.0)[0])
     np.testing.assert_array_equal(np.sort(pos), want)
+
+
+# -- round-2 review fixes ---------------------------------------------------
+
+def test_wkb_decode_ewkb_srid_and_z():
+    """PostGIS EWKB (SRID flag + payload) and ISO WKB Z types decode to the
+    correct 2-D coordinates instead of reading the SRID as doubles."""
+    import struct
+    from geomesa_tpu.geometry.wkb import wkb_decode
+    ewkb_pt = (bytes([1]) + struct.pack("<I", 0x20000001)
+               + struct.pack("<I", 4326) + struct.pack("<dd", 1.0, 2.0))
+    g = wkb_decode(ewkb_pt)
+    assert (g.x, g.y) == (1.0, 2.0)
+    ewkb_ls = (bytes([1]) + struct.pack("<I", 0x20000002)
+               + struct.pack("<I", 4326) + struct.pack("<I", 2)
+               + struct.pack("<dddd", 0.0, 0.0, 1.0, 1.0))
+    g = wkb_decode(ewkb_ls)
+    assert g.coords.shape == (2, 2) and g.coords[1, 1] == 1.0
+    iso_pz = (bytes([1]) + struct.pack("<I", 1001)
+              + struct.pack("<ddd", 3.0, 4.0, 5.0))
+    g = wkb_decode(iso_pz)
+    assert (g.x, g.y) == (3.0, 4.0)
+
+
+def test_twkb_precision_out_of_range_rejected():
+    from geomesa_tpu.geometry.types import Point
+    from geomesa_tpu.geometry.wkb import twkb_decode, twkb_encode
+    with pytest.raises(ValueError):
+        twkb_encode(Point(1.5, 2.5), precision=8)
+    with pytest.raises(ValueError):
+        twkb_encode(Point(1.5, 2.5), precision=-9)
+    g = twkb_decode(twkb_encode(Point(1.5, 2.5), precision=7))
+    assert (g.x, g.y) == (1.5, 2.5)
+
+
+def test_avro_polygon_and_secondary_geometry_roundtrip():
+    import io as _io
+    from geomesa_tpu.geometry.types import Polygon
+    from geomesa_tpu.io.avro import from_avro, to_avro
+
+    sft = parse_spec("poly", "name:String,*geom:Polygon")
+    poly = Polygon(np.array([[0, 0], [1, 0], [1, 1], [0, 0]], dtype=float))
+    b = FeatureBatch.from_dict(sft, {"name": ["a"], "geom": [poly]},
+                               ids=["f1"])
+    buf = _io.BytesIO()
+    to_avro(b, buf)
+    buf.seek(0)
+    rt = from_avro(buf, sft)
+    assert len(rt) == 1 and rt.geoms.geometry(0).geom_type == "Polygon"
+
+    sft2 = parse_spec("t2", "name:String,*geom:Point,geom2:Point")
+    b2 = FeatureBatch.from_dict(sft2, {
+        "name": ["a"],
+        "geom": (np.array([1.0]), np.array([2.0])),
+        "geom2": (np.array([3.0]), np.array([4.0])),
+    }, ids=["f1"])
+    buf = _io.BytesIO()
+    to_avro(b2, buf)
+    buf.seek(0)
+    rt2 = from_avro(buf, sft2)
+    x2, y2 = rt2.geom_xy("geom2")
+    assert (x2[0], y2[0]) == (3.0, 4.0)
