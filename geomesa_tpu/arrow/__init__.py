@@ -1,0 +1,30 @@
+"""Arrow interchange subsystem.
+
+TPU-native re-expression of the reference's ``geomesa-arrow`` module
+(geomesa-arrow-gt/src/main/scala/org/locationtech/geomesa/arrow/):
+
+- SFT → Arrow schema with dictionary-encoded attributes
+  (``vector/SimpleFeatureVector.scala``) → :mod:`.schema`
+- ``DeltaWriter`` incremental record batches with growing delta
+  dictionaries, sorted within batch so clients k-way merge
+  (``io/DeltaWriter.scala``) → :mod:`.delta`
+- file/stream readers + sorted batch merge
+  (``io/SimpleFeatureArrowFileReader.scala``) → :mod:`.reader`
+- ``ArrowDataStore`` over IPC files (``data/ArrowDataStore.scala``) →
+  :mod:`.store`
+
+Where the reference builds Arrow vectors row-by-row inside iterators, here
+query results are already columnar device arrays — the Arrow batch is a
+zero-ish-copy host view of the gathered shard output, and dictionary code
+assignment is a vectorized ``np.searchsorted`` rather than a per-row map.
+"""
+
+from .delta import DeltaWriter
+from .reader import merge_deltas, read_feature_batch
+from .schema import encode_record_batch, sft_to_arrow_schema
+from .store import ArrowDataStore
+
+__all__ = [
+    "ArrowDataStore", "DeltaWriter", "encode_record_batch",
+    "merge_deltas", "read_feature_batch", "sft_to_arrow_schema",
+]

@@ -1,0 +1,142 @@
+"""Arrow IPC readers + sorted batch merge (client-side reduce).
+
+Reference: ``io/SimpleFeatureArrowFileReader.scala`` (streaming/caching
+readers over the delta-dictionary format) and the merge-sort reduce in
+``io/SimpleFeatureArrowIO.scala`` — the ``QueryPlan.Reducer`` step that
+combines distributed scan outputs (api/QueryPlan.scala:16-18).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType, parse_spec
+from ..geometry.wkb import wkb_decode
+from .schema import FID_FIELD
+
+__all__ = ["read_feature_batch", "read_table", "merge_deltas"]
+
+
+def _pa():
+    import pyarrow as pa
+    return pa
+
+
+def read_table(source):
+    """Read an Arrow IPC stream or file (auto-sniffed) into a pa.Table."""
+    pa = _pa()
+    if isinstance(source, (bytes, bytearray, memoryview)):
+        source = pa.BufferReader(bytes(source))
+    if isinstance(source, str):
+        with open(source, "rb") as f:
+            head = f.read(6)
+        opener = (pa.ipc.open_file if head == b"ARROW1"
+                  else pa.ipc.open_stream)
+        with opener(source) as r:
+            return r.read_all()
+    try:
+        return pa.ipc.open_stream(source).read_all()
+    except pa.ArrowInvalid:
+        if hasattr(source, "seek"):
+            source.seek(0)
+        return pa.ipc.open_file(source).read_all()
+
+
+def table_to_feature_batch(table, sft: FeatureType | None = None) -> FeatureBatch:
+    """pa.Table (delta-writer layout) → FeatureBatch."""
+    pa = _pa()
+    meta = table.schema.metadata or {}
+    if sft is None:
+        spec = meta.get(b"geomesa_tpu.sft")
+        if spec is None:
+            raise ValueError("arrow data lacks geomesa_tpu schema metadata; "
+                             "pass sft explicitly")
+        name = (meta.get(b"geomesa_tpu.name") or b"imported").decode()
+        sft = parse_spec(name or "imported", spec.decode())
+    table = table.combine_chunks()
+    data: dict = {}
+    for attr in sft.attributes:
+        if attr.name not in table.column_names:
+            continue
+        col = table.column(attr.name)
+        if isinstance(col.type, pa.DictionaryType):
+            col = col.cast(col.type.value_type)
+        if attr.is_geometry:
+            if pa.types.is_fixed_size_list(col.type):
+                arr = col.combine_chunks()
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = (arr.chunk(0) if arr.num_chunks
+                           else pa.array([], type=arr.type))
+                if arr.null_count:
+                    if arr.null_count == len(arr):
+                        continue  # never populated: leave the column absent
+                    # flatten() drops null slots; scatter values back and
+                    # leave NaN at the nulls
+                    valid = arr.is_valid().to_numpy(zero_copy_only=False)
+                    flat = arr.flatten().to_numpy()
+                    x = np.full(len(arr), np.nan)
+                    y = np.full(len(arr), np.nan)
+                    x[valid] = flat[0::2]
+                    y[valid] = flat[1::2]
+                    data[attr.name] = (x, y)
+                else:
+                    flat = arr.flatten().to_numpy()
+                    data[attr.name] = (flat[0::2].copy(), flat[1::2].copy())
+            else:
+                raw = col.to_pylist()
+                if all(b is None for b in raw):
+                    continue  # never populated: leave the column absent
+                from ..geometry.types import Point
+                data[attr.name] = [Point(float("nan"), float("nan"))
+                                   if b is None else wkb_decode(b)
+                                   for b in raw]
+        elif attr.type == "date":
+            data[attr.name] = col.cast(pa.int64()).to_numpy()
+        elif attr.type in ("string", "bytes"):
+            data[attr.name] = np.asarray(col.to_pylist(), dtype=object)
+        else:
+            data[attr.name] = col.to_numpy()
+    ids = (np.asarray(table.column(FID_FIELD).to_pylist(), dtype=object)
+           if FID_FIELD in table.column_names else None)
+    return FeatureBatch.from_dict(sft, data, ids=ids)
+
+
+def read_feature_batch(source, sft: FeatureType | None = None) -> FeatureBatch:
+    """Arrow IPC stream/file → FeatureBatch."""
+    return table_to_feature_batch(read_table(source), sft)
+
+
+def merge_deltas(streams, sort_field: str | None = None,
+                 reverse: bool = False):
+    """Merge N delta-writer IPC streams into one pa.Table, k-way merged on
+    ``sort_field`` when given (each input batch is already internally
+    sorted — the DeltaWriter contract).
+
+    This is the client-side reduce of the reference's Arrow scan
+    (ArrowScan reduce step merging per-tablet batches). Dictionary columns
+    are decoded to plain values before concatenation: the per-stream
+    dictionaries index *different* accumulations, so their codes are not
+    comparable across streams.
+    """
+    pa = _pa()
+    tables = [t if isinstance(t, pa.Table) else read_table(t)
+              for t in streams]
+    tables = [t for t in tables if t.num_rows]
+    if not tables:
+        return None
+    decoded = []
+    for t in tables:
+        cols = []
+        for name in t.column_names:
+            c = t.column(name)
+            if isinstance(c.type, pa.DictionaryType):
+                c = c.cast(c.type.value_type)
+            cols.append(c)
+        decoded.append(pa.table(dict(zip(t.column_names, cols)),
+                                metadata=t.schema.metadata))
+    merged = pa.concat_tables(decoded)
+    if sort_field is not None:
+        merged = merged.sort_by([(sort_field,
+                                  "descending" if reverse else "ascending")])
+    return merged

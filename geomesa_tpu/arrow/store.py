@@ -1,0 +1,138 @@
+"""ArrowDataStore: a datastore over Arrow IPC files.
+
+Reference: ``data/ArrowDataStore.scala`` (geomesa-arrow-gt) — a GeoTools
+DataStore whose backing "table" is a single Arrow file (local or URL),
+supporting append writes (delta-dictionary batches) and full reads with
+client-side filtering (ArrowSystemProperties caching reader).
+
+Here: one ``<type>.arrow`` IPC stream file per feature type under a root
+directory. Appends stream new batches through :class:`..arrow.delta
+.DeltaWriter`; queries read the file into a columnar FeatureBatch and
+evaluate the full filter (LocalQueryRunner semantics,
+index/planning/LocalQueryRunner.scala:44-130 — the path the reference
+uses for stores with no server-side index push-down).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType, parse_spec
+from ..filters.evaluate import evaluate_filter
+from ..planning.planner import Query
+from .delta import DeltaWriter
+from .reader import read_feature_batch
+
+__all__ = ["ArrowDataStore"]
+
+
+class ArrowDataStore:
+    def __init__(self, root: str,
+                 dictionary_fields: tuple[str, ...] = (),
+                 sort_field: str | None = None):
+        self.root = root
+        self.dictionary_fields = tuple(dictionary_fields)
+        self.sort_field = sort_field
+        os.makedirs(root, exist_ok=True)
+        self._sfts: dict[str, FeatureType] = {}
+        self._writers: dict[str, DeltaWriter] = {}
+        meta = self._meta_path()
+        if os.path.exists(meta):
+            with open(meta) as f:
+                for name, spec in json.load(f).items():
+                    self._sfts[name] = parse_spec(name, spec)
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.root, "schemas.json")
+
+    def _data_path(self, name: str) -> str:
+        return os.path.join(self.root, f"{name}.arrow")
+
+    def _save_meta(self) -> None:
+        with open(self._meta_path(), "w") as f:
+            json.dump({n: s.spec_string() for n, s in self._sfts.items()},
+                      f, indent=1)
+
+    # -- schema lifecycle --------------------------------------------------
+    def create_schema(self, name: str, spec: str) -> FeatureType:
+        if name in self._sfts:
+            raise ValueError(f"schema {name!r} already exists")
+        sft = parse_spec(name, spec)
+        self._sfts[name] = sft
+        self._save_meta()
+        return sft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._sfts[name]
+
+    @property
+    def type_names(self) -> list[str]:
+        return sorted(self._sfts)
+
+    def remove_schema(self, name: str) -> None:
+        self.flush(name)
+        self._sfts.pop(name)
+        self._writers.pop(name, None)
+        if os.path.exists(self._data_path(name)):
+            os.remove(self._data_path(name))
+        self._save_meta()
+
+    # -- write (append) ----------------------------------------------------
+    def write(self, name: str, data, ids=None) -> int:
+        sft = self._sfts[name]
+        batch = (data if isinstance(data, FeatureBatch)
+                 else FeatureBatch.from_dict(sft, data, ids=ids))
+        w = self._writers.get(name)
+        if w is None:
+            # One growing IPC stream per type; dictionaries accumulate for
+            # the life of the writer (the DeltaWriter contract).
+            sink = open(self._data_path(name), "ab")
+            if sink.tell() != 0:
+                # a previous writer closed this stream; rewrite by merging
+                sink.close()
+                existing = self.query(name)
+                os.remove(self._data_path(name))
+                sink = open(self._data_path(name), "ab")
+                w = DeltaWriter(sft, self.dictionary_fields,
+                                self.sort_field, sink=sink)
+                if len(existing):
+                    w.write(existing)
+            else:
+                w = DeltaWriter(sft, self.dictionary_fields,
+                                self.sort_field, sink=sink)
+            self._writers[name] = w
+        w.write(batch)
+        return len(batch)
+
+    def flush(self, name: str | None = None) -> None:
+        names = [name] if name else list(self._writers)
+        for n in names:
+            w = self._writers.pop(n, None)
+            if w is not None:
+                w.close()
+                w.sink.close()
+
+    # -- read (LocalQueryRunner semantics) ---------------------------------
+    def query(self, name: str, query="INCLUDE") -> FeatureBatch:
+        sft = self._sfts[name]
+        self.flush(name)
+        path = self._data_path(name)
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            return FeatureBatch.empty(sft)
+        batch = read_feature_batch(path, sft)
+        q = query if isinstance(query, Query) else Query.of(query)
+        mask = evaluate_filter(q.filter, batch)
+        out = batch.take(np.flatnonzero(mask))
+        if q.max_features is not None:
+            out = out.take(np.arange(min(q.max_features, len(out))))
+        return out
+
+    def count(self, name: str) -> int:
+        return len(self.query(name))
+
+    def close(self) -> None:
+        self.flush()

@@ -314,6 +314,38 @@ class TpuDataStore:
                 scan_time_ms=result.scan_time_ms,
                 hits=len(result.positions)))
 
+    def query_arrow(self, name: str, query="INCLUDE", *,
+                    dictionary_fields: tuple[str, ...] = (),
+                    sort_field: str | None = None, reverse: bool = False,
+                    batch_size: int = 65536):
+        """Run a query and return a pyarrow Table via the Arrow scan
+        protocol (the reference's ArrowScan, index/iterators/
+        ArrowScan.scala:35): sorted dictionary-encoded record batches of
+        ``batch_size`` rows — the per-device shard chunk analog — built
+        in-process (no IPC round trip; serialize with
+        process.arrow_conversion_process for the wire format)."""
+        import pyarrow as pa
+
+        from .arrow.schema import (
+            encode_record_batch, sft_to_arrow_schema,
+        )
+
+        sft = self._store(name).sft
+        schema = sft_to_arrow_schema(sft, dictionary_fields)
+        batch = self.query(name, query)
+        if len(batch) == 0:
+            return schema.empty_table()
+        if sort_field is not None:
+            order = np.argsort(np.asarray(batch.columns[sort_field]),
+                               kind="stable")
+            batch = batch.take(order[::-1] if reverse else order)
+        dicts: dict = {}
+        rbs = [encode_record_batch(
+                   batch.take(np.arange(s, min(s + batch_size, len(batch)))),
+                   schema, dicts)
+               for s in range(0, len(batch), batch_size)]
+        return pa.Table.from_batches(rbs)
+
     def explain(self, name: str, query="INCLUDE") -> str:
         from .planning.explain import ExplainString
         ex = ExplainString()

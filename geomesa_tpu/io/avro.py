@@ -108,6 +108,13 @@ def to_avro(batch: FeatureBatch, path_or_buf) -> None:
     body = bytearray()
     n = len(batch)
     geoms = batch.geoms
+    # hoist per-attribute geometry sources out of the row loop
+    geom_xy: dict = {}
+    for a in sft.attributes:
+        if a.is_geometry and not (a.name == sft.default_geom
+                                  and geoms is not None):
+            if f"{a.name}_x" in batch.columns:
+                geom_xy[a.name] = batch.geom_xy(a.name)
     for i in range(n):
         _w_str(str(batch.ids[i]), body)
         for a in sft.attributes:
@@ -115,8 +122,8 @@ def to_avro(batch: FeatureBatch, path_or_buf) -> None:
                 if a.name == sft.default_geom and geoms is not None:
                     _w_long(0, body)  # union branch 0 (value)
                     _w_bytes(wkb_encode(geoms.geometry(i)), body)
-                elif f"{a.name}_x" in batch.columns:
-                    x, y = batch.geom_xy(a.name)
+                elif a.name in geom_xy:
+                    x, y = geom_xy[a.name]
                     _w_long(0, body)
                     _w_bytes(wkb_encode(Point(float(x[i]), float(y[i]))),
                              body)

@@ -3,6 +3,7 @@
 vectorized query + device-aggregation pipelines over the datastore.
 """
 
+from .conversion import arrow_conversion_process, bin_conversion_process
 from .density import density_process
 from .knn import knn_process
 from .proximity import proximity_process
@@ -11,6 +12,7 @@ from .stats_process import stats_process
 from .tube import tube_select
 
 __all__ = [
+    "arrow_conversion_process", "bin_conversion_process",
     "density_process", "knn_process", "proximity_process",
     "sample_positions", "stats_process", "tube_select",
 ]

@@ -1,0 +1,63 @@
+"""Arrow / BIN conversion processes.
+
+Reference: ``ArrowConversionProcess`` and ``BinConversionProcess``
+(geomesa-process/geomesa-process-vector/.../process/transform/
+ArrowConversionProcess.scala, BinConversionProcess.scala) — WPS processes
+that run a query and encode the results into the Arrow IPC or the compact
+16/24-byte BIN track formats for transport to map clients.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["arrow_conversion_process", "bin_conversion_process"]
+
+
+def arrow_conversion_process(ds, type_name: str, query="INCLUDE", *,
+                             dictionary_fields: tuple[str, ...] = (),
+                             sort_field: str | None = None,
+                             reverse: bool = False,
+                             batch_size: int = 65536) -> bytes:
+    """Query → Arrow IPC stream bytes (delta-dictionary record batches).
+
+    Matches ArrowConversionProcess.execute's knobs: includeFids is always
+    on (ids ride as ``__fid__``), dictionaryFields, sortField,
+    sortReverse, batchSize.
+    """
+    from ..arrow import DeltaWriter
+
+    sft = ds.get_schema(type_name)
+    batch = ds.query(type_name, query)
+    writer = DeltaWriter(sft, dictionary_fields, sort_field, reverse)
+    for start in range(0, len(batch), batch_size):
+        writer.write(batch.take(
+            np.arange(start, min(start + batch_size, len(batch)))))
+    return writer.finish()
+
+
+def bin_conversion_process(ds, type_name: str, query="INCLUDE", *,
+                           track: str | None = None,
+                           label: str | None = None,
+                           axis_order: str = "LonLat") -> bytes:
+    """Query → packed BIN bytes (16B/point, 24B with label).
+
+    Matches BinConversionProcess.execute(track, geom, dtg, label,
+    axisOrder); geometry/dtg come from the schema's defaults.
+    """
+    from ..io.bin_encoder import encode_bin
+
+    sft = ds.get_schema(type_name)
+    batch = ds.query(type_name, query)
+    if len(batch) == 0:
+        return b""
+    x, y = batch.geom_xy()
+    if axis_order not in ("LonLat", "LatLon"):
+        raise ValueError(f"unknown axis order {axis_order!r}")
+    if axis_order == "LatLon":
+        x, y = y, x
+    dtg = (batch.columns[sft.dtg_field] if sft.dtg_field
+           else np.zeros(len(batch), dtype=np.int64))
+    track_vals = batch.columns[track] if track else batch.ids
+    label_vals = batch.columns[label] if label else None
+    return encode_bin(x, y, dtg, track=track_vals, label=label_vals)

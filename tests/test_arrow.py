@@ -1,0 +1,166 @@
+"""Arrow subsystem tests: schema mapping, delta-dictionary writer/reader
+round trips, sorted merge, ArrowDataStore, datastore query_arrow."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from geomesa_tpu.arrow import (
+    ArrowDataStore, DeltaWriter, merge_deltas, read_feature_batch,
+    sft_to_arrow_schema,
+)
+from geomesa_tpu.arrow.schema import encode_record_batch
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.batch import FeatureBatch
+from geomesa_tpu.features.feature_type import parse_spec
+from geomesa_tpu.geometry.types import Polygon
+
+MS0 = 1514764800000  # 2018-01-01
+
+
+def _sft():
+    return parse_spec("tracks", "name:String,age:Int,dtg:Date,*geom:Point")
+
+
+def _batch(sft, n, seed=0, names=("alice", "bob", "carol")):
+    rng = np.random.default_rng(seed)
+    return FeatureBatch.from_dict(sft, {
+        "name": np.array([names[i % len(names)] for i in range(n)],
+                         dtype=object),
+        "age": rng.integers(0, 90, n).astype(np.int32),
+        "dtg": rng.integers(MS0, MS0 + 7 * 86_400_000, n),
+        "geom": (rng.uniform(-75, -74, n), rng.uniform(40, 41, n)),
+    }, ids=[f"s{seed}-{i}" for i in range(n)])
+
+
+def test_schema_mapping():
+    sft = _sft()
+    schema = sft_to_arrow_schema(sft, dictionary_fields=("name",))
+    assert schema.field("name").type == pa.dictionary(pa.int32(), pa.utf8())
+    assert schema.field("age").type == pa.int32()
+    assert schema.field("dtg").type == pa.timestamp("ms")
+    assert schema.field("geom").type == pa.list_(pa.float64(), 2)
+    assert schema.field("__fid__").type == pa.utf8()
+    meta = schema.metadata
+    assert b"geomesa_tpu.sft" in meta
+
+
+def test_encode_record_batch_dictionary_codes():
+    sft = _sft()
+    schema = sft_to_arrow_schema(sft, dictionary_fields=("name",))
+    b = _batch(sft, 6)
+    rb = encode_record_batch(b, schema, {})
+    col = rb.column(rb.schema.get_field_index("name"))
+    assert isinstance(col, pa.DictionaryArray)
+    decoded = col.dictionary_decode().to_pylist()
+    assert decoded == list(b.columns["name"])
+
+
+def test_delta_writer_growing_dictionary_standard_readable():
+    """Dictionaries grow across batches; the IPC stream stays readable by
+    stock pyarrow and decodes to the concatenated input."""
+    sft = _sft()
+    w = DeltaWriter(sft, dictionary_fields=("name",))
+    b1 = _batch(sft, 5, seed=1, names=("alice", "bob"))
+    b2 = _batch(sft, 5, seed=2, names=("carol", "alice", "dave"))
+    w.write(b1)
+    w.write(b2)
+    data = w.finish()
+
+    table = pa.ipc.open_stream(io.BytesIO(data)).read_all()
+    assert table.num_rows == 10
+    names = table.column("name").to_pylist()
+    assert names == list(b1.columns["name"]) + list(b2.columns["name"])
+    # reader path → FeatureBatch
+    rt = read_feature_batch(data, sft)
+    assert len(rt) == 10
+    x, y = rt.geom_xy()
+    ex = np.concatenate([b1.columns["geom_x"], b2.columns["geom_x"]])
+    np.testing.assert_allclose(x, ex)
+    assert list(rt.ids) == list(b1.ids) + list(b2.ids)
+
+
+def test_delta_writer_sorted_batches_and_merge():
+    sft = _sft()
+    streams = []
+    for seed in (1, 2, 3):
+        w = DeltaWriter(sft, dictionary_fields=("name",), sort_field="dtg")
+        w.write(_batch(sft, 20, seed=seed))
+        streams.append(w.finish())
+    # each stream's batch is internally sorted
+    t0 = pa.ipc.open_stream(io.BytesIO(streams[0])).read_all()
+    dtg = t0.column("dtg").cast(pa.int64()).to_numpy()
+    assert (np.diff(dtg) >= 0).all()
+    merged = merge_deltas(streams, sort_field="dtg")
+    assert merged.num_rows == 60
+    md = merged.column("dtg").cast(pa.int64()).to_numpy()
+    assert (np.diff(md) >= 0).all()
+    # dictionary columns are decoded to plain values in the merge
+    assert merged.schema.field("name").type == pa.utf8()
+
+
+def test_merge_deltas_reverse_and_empty():
+    sft = _sft()
+    w = DeltaWriter(sft, sort_field="dtg", reverse=True)
+    w.write(_batch(sft, 10))
+    merged = merge_deltas([w.finish()], sort_field="dtg", reverse=True)
+    md = merged.column("dtg").cast(pa.int64()).to_numpy()
+    assert (np.diff(md) <= 0).all()
+    empty = DeltaWriter(sft)
+    assert merge_deltas([empty.finish()]) is None
+
+
+def test_non_point_geometry_rides_as_wkb():
+    sft = parse_spec("polys", "name:String,*geom:Polygon")
+    poly = Polygon(np.array([[0, 0], [2, 0], [2, 2], [0, 0]], dtype=float))
+    b = FeatureBatch.from_dict(sft, {"name": ["a"], "geom": [poly]},
+                               ids=["p1"])
+    w = DeltaWriter(sft)
+    w.write(b)
+    rt = read_feature_batch(w.finish(), sft)
+    g = rt.geoms.geometry(0)
+    assert g.geom_type == "Polygon"
+    np.testing.assert_allclose(g.shell, poly.shell)
+
+
+def test_arrow_datastore_roundtrip(tmp_path):
+    root = str(tmp_path / "arrow_store")
+    ds = ArrowDataStore(root, dictionary_fields=("name",), sort_field="dtg")
+    sft = ds.create_schema("tracks", "name:String,age:Int,dtg:Date,*geom:Point")
+    ds.write("tracks", _batch(sft, 30, seed=1))
+    ds.write("tracks", _batch(sft, 20, seed=2))
+    out = ds.query("tracks")
+    assert len(out) == 50
+    hits = ds.query("tracks", "bbox(geom, -74.8, 40.2, -74.2, 40.8)")
+    bx, by = out.geom_xy()
+    want = int(np.count_nonzero((bx >= -74.8) & (bx <= -74.2)
+                                & (by >= 40.2) & (by <= 40.8)))
+    assert len(hits) == want
+    ds.close()
+
+    # reopen: schemas persist, appends merge with existing data
+    ds2 = ArrowDataStore(root)
+    assert ds2.type_names == ["tracks"]
+    sft2 = ds2.get_schema("tracks")
+    ds2.write("tracks", _batch(sft2, 5, seed=3))
+    assert ds2.count("tracks") == 55
+    ds2.remove_schema("tracks")
+    assert ds2.type_names == []
+
+
+def test_datastore_query_arrow():
+    ds = TpuDataStore()
+    sft = ds.create_schema("t", "name:String,age:Int,dtg:Date,*geom:Point")
+    ds.write("t", _batch(sft, 200, seed=4))
+    table = ds.query_arrow("t", "bbox(geom, -74.9, 40.1, -74.1, 40.9)",
+                           dictionary_fields=("name",), sort_field="dtg",
+                           batch_size=64)
+    assert table.num_rows > 0
+    dtg = table.column("dtg").cast(pa.int64()).to_numpy()
+    assert (np.diff(dtg) >= 0).all()
+    # empty result returns an empty table with the right schema
+    empty = ds.query_arrow("t", "bbox(geom, 10, 10, 11, 11)")
+    assert empty.num_rows == 0
+    assert "geom" in empty.schema.names

@@ -134,3 +134,36 @@ def test_sampling():
     # each group of 20 keeps ceil(20/7)=3
     assert len(got) == 15
     assert len(sample_positions(pos, 1)) == 100
+
+
+def test_arrow_conversion_process(store):
+    import io as _io
+
+    import pyarrow as pa
+
+    from geomesa_tpu.process import arrow_conversion_process
+
+    flt = "bbox(geom, -2, 47, 2, 53)"
+    data = arrow_conversion_process(ds=store, type_name="ais", query=flt,
+                                    dictionary_fields=("vessel",),
+                                    sort_field="dtg")
+    table = pa.ipc.open_stream(_io.BytesIO(data)).read_all()
+    want = len(store.query("ais", flt))
+    assert table.num_rows == want > 0
+    dtg = table.column("dtg").cast(pa.int64()).to_numpy()
+    assert (np.diff(dtg) >= 0).all()
+
+
+def test_bin_conversion_process(store):
+    from geomesa_tpu.io.bin_encoder import decode_bin
+    from geomesa_tpu.process import bin_conversion_process
+
+    data = bin_conversion_process(store, "ais")
+    n = len(store.query("ais"))
+    assert len(data) == 16 * n
+    cols = decode_bin(data)
+    bx, by = store.query("ais").geom_xy()
+    np.testing.assert_allclose(cols["lon"], bx.astype(np.float32))
+    np.testing.assert_allclose(cols["lat"], by.astype(np.float32))
+    assert bin_conversion_process(store, "ais",
+                                  "bbox(geom, 100, 10, 101, 11)") == b""
