@@ -5,14 +5,28 @@ vectorized query + device-aggregation pipelines over the datastore.
 
 from .conversion import arrow_conversion_process, bin_conversion_process
 from .density import density_process
+from .join import join_process
 from .knn import knn_process
 from .proximity import proximity_process
+from .query import query_process
+from .route import route_search_process
 from .sampling import sample_positions
 from .stats_process import stats_process
+from .track import point2point_process, track_label_process
+from .transform import (
+    date_offset_process,
+    hash_attribute_color_process,
+    hash_attribute_process,
+)
 from .tube import tube_select
+from .unique import min_max_process, unique_process
 
 __all__ = [
     "arrow_conversion_process", "bin_conversion_process",
-    "density_process", "knn_process", "proximity_process",
-    "sample_positions", "stats_process", "tube_select",
+    "date_offset_process", "density_process",
+    "hash_attribute_color_process", "hash_attribute_process",
+    "join_process", "knn_process", "min_max_process",
+    "point2point_process", "proximity_process", "query_process",
+    "route_search_process", "sample_positions", "stats_process",
+    "track_label_process", "tube_select", "unique_process",
 ]

@@ -1,0 +1,47 @@
+"""Attribute join: query one schema by attribute values drawn from another
+(the reference's JoinProcess, geomesa-process/.../query/JoinProcess.scala:
+30-120 — "Queries a feature type based on attributes from a second feature
+type").
+
+TPU-native shape: instead of per-feature lookups, the primary side's join
+values become ONE ``In`` filter served by the secondary schema's attribute
+index, so the join is two batched scans + a vectorized semi-join mask.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..filters.ast import And, Filter, In
+from ..planning.planner import Query
+
+__all__ = ["join_process"]
+
+
+def join_process(store, primary: str, secondary: str, join_attribute: str,
+                 primary_filter="INCLUDE", join_filter=None,
+                 properties=None):
+    """Join ``secondary`` against the ``join_attribute`` values of the
+    features matched in ``primary``.
+
+    Returns ``(secondary_batch, join_values)`` where ``join_values`` is the
+    deduplicated value set that drove the join.
+    """
+    pbatch = store.query(
+        primary, Query.of(primary_filter, properties=[join_attribute]))
+    if join_attribute not in pbatch.columns:
+        raise KeyError(f"{join_attribute!r} not an attribute of {primary!r}")
+    vals = pbatch.column(join_attribute)
+    uniq = np.unique(vals[vals != np.array(None)]) if vals.dtype == object \
+        else np.unique(vals)
+    if len(uniq) == 0:
+        from ..features.batch import FeatureBatch
+        return FeatureBatch.empty(store.get_schema(secondary)), uniq
+
+    f: Filter = In(join_attribute, tuple(uniq.tolist()))
+    if join_filter is not None:
+        extra = join_filter if isinstance(join_filter, Filter) else \
+            Query.of(join_filter).filter
+        f = And((f, extra))
+    q = Query(filter=f, properties=list(properties) if properties else None)
+    return store.query(secondary, q), uniq

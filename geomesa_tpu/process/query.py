@@ -1,0 +1,21 @@
+"""Query process: filtered + projected query through the indexed planner
+(the reference's QueryProcess, geomesa-process/.../query/QueryProcess.scala:
+25-62 — "Performs a Geomesa optimized query using spatiotemporal indexes"
+so WPS chains hit the index instead of post-filtering)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..planning.planner import Query
+
+__all__ = ["query_process"]
+
+
+def query_process(store, schema: str, filter="INCLUDE", properties=None):
+    """Run ``filter`` (ECQL string or Filter AST) against ``schema`` with
+    optional attribute projection, returning the result FeatureBatch."""
+    q = filter if isinstance(filter, Query) else Query.of(filter)
+    if properties is not None:
+        q = dataclasses.replace(q, properties=list(properties))
+    return store.query(schema, q)

@@ -1,0 +1,96 @@
+"""Track aggregation processes.
+
+* :func:`point2point_process` — aggregate point features into per-track
+  line segments (the reference's Point2PointProcess,
+  geomesa-process/.../analytic/Point2PointProcess.scala:26-51: group by a
+  field, sort by a date field, connect consecutive points, optionally
+  breaking on day boundaries and dropping zero-length segments).
+* :func:`track_label_process` — one label feature per track (the
+  reference's TrackLabelProcess, .../analytic/TrackLabelProcess.scala:
+  25-40: the newest feature of each group).
+
+Both operate on columnar batches with a single argsort over
+``(group, time)`` instead of per-feature visitor loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import FeatureType, parse_spec
+from ..geometry.types import LineString
+
+__all__ = ["point2point_process", "track_label_process"]
+
+_DAY_MS = 86_400_000
+
+
+def _group_sort(batch: FeatureBatch, group_field: str, sort_field: str):
+    groups = batch.column(group_field)
+    times = batch.column(sort_field)
+    # stable lexicographic (group, time) ordering; object columns sort as str
+    keys = groups.astype(str) if groups.dtype == object else groups
+    order = np.lexsort((times, keys))
+    return groups[order], times[order], order
+
+
+def point2point_process(batch: FeatureBatch, group_field: str,
+                        sort_field: str, *, min_points: int = 2,
+                        break_on_day: bool = False,
+                        filter_singular_points: bool = True) -> FeatureBatch:
+    """Connect each group's time-ordered points into 2-point line segments.
+
+    Returns a batch of schema ``<name>_points2lines`` with attributes
+    ``(geom: linestring, <group_field>, dtg_start: date, dtg_end: date)``.
+    """
+    gname = batch.sft.default_geom or "geom"
+    x, y = batch.geom_xy(gname)
+    groups, times, order = _group_sort(batch, group_field, sort_field)
+    xs, ys = x[order], y[order]
+
+    gkey = groups.astype(str) if groups.dtype == object else groups
+    same_group = gkey[1:] == gkey[:-1]
+    if break_on_day:
+        same_group &= (times[1:] // _DAY_MS) == (times[:-1] // _DAY_MS)
+    seg = np.flatnonzero(same_group)  # segment i connects row i -> i+1
+
+    if min_points > 2:
+        # group sizes via run-length over the sorted keys
+        starts = np.flatnonzero(np.concatenate(
+            [[True], gkey[1:] != gkey[:-1]]))
+        sizes = np.diff(np.append(starts, len(gkey)))
+        size_of = np.repeat(sizes, sizes)
+        seg = seg[size_of[seg] >= min_points]
+    if filter_singular_points:
+        seg = seg[(xs[seg] != xs[seg + 1]) | (ys[seg] != ys[seg + 1])]
+
+    gtype = ("string" if groups.dtype == object
+             else {"int32": "int", "int64": "long",
+                   "float32": "float"}.get(str(groups.dtype), "double"))
+    out_sft = parse_spec(
+        f"{batch.sft.name}_points2lines",
+        f"{group_field}:{gtype},dtg_start:date,dtg_end:date,*geom:linestring")
+    lines = [LineString(np.array([[xs[i], ys[i]], [xs[i + 1], ys[i + 1]]]))
+             for i in seg]
+    return FeatureBatch.from_dict(out_sft, {
+        group_field: groups[seg],
+        "dtg_start": times[seg],
+        "dtg_end": times[seg + 1],
+        "geom": lines,
+    })
+
+
+def track_label_process(batch: FeatureBatch, track_field: str,
+                        dtg_field: str | None = None) -> np.ndarray:
+    """Row positions of the label feature for each track — the last
+    (newest, when ``dtg_field`` given) feature per group."""
+    groups = batch.column(track_field)
+    gkey = groups.astype(str) if groups.dtype == object else groups
+    if dtg_field is None:
+        order = np.argsort(gkey, kind="stable")
+    else:
+        order = np.lexsort((batch.column(dtg_field), gkey))
+    sorted_keys = gkey[order]
+    last = np.concatenate([sorted_keys[1:] != sorted_keys[:-1], [True]])
+    return np.sort(order[last])

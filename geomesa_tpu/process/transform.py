@@ -1,0 +1,96 @@
+"""Per-feature derived-attribute processes, vectorized over columns.
+
+* :func:`hash_attribute_process` / :func:`hash_attribute_color_process` —
+  the reference's HashAttributeProcess / HashAttributeColorProcess
+  (geomesa-process/.../transform/HashAttributeProcess.scala:20-90): append
+  ``hash(attribute) % modulo`` (or a stable color derived from it) to each
+  feature, used to partition/color features for rendering.
+* :func:`date_offset_process` — the reference's DateOffsetProcess
+  (.../transform/DateOffsetProcess.scala:25-50): shift a date attribute by
+  an ISO-8601 period.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..features.batch import FeatureBatch
+from ..features.feature_type import AttributeSpec, FeatureType
+
+__all__ = [
+    "hash_attribute_process",
+    "hash_attribute_color_process",
+    "date_offset_process",
+    "parse_iso_duration_ms",
+]
+
+# reference palette: HashAttributeColorProcess.scala colorList
+_COLORS = ("#6495ED", "#B0C4DE", "#00FFFF", "#9ACD32", "#00FA9A",
+           "#FFF8DC", "#F5DEB3")
+
+
+def _with_column(batch: FeatureBatch, name: str, type_: str,
+                 values: np.ndarray) -> FeatureBatch:
+    attrs = tuple(batch.sft.attributes) + (AttributeSpec(name, type_),)
+    sft = FeatureType(batch.sft.name, attrs, batch.sft.default_geom,
+                      dict(batch.sft.user_data))
+    cols = dict(batch.columns)
+    cols[name] = values
+    return FeatureBatch(sft, cols, batch.ids, batch.geoms)
+
+
+def _hashes(batch: FeatureBatch, attribute: str, modulo: int) -> np.ndarray:
+    if modulo <= 0:
+        raise ValueError("modulo must be positive")
+    col = batch.column(attribute)
+    if col.dtype == object:
+        # FNV-1a over the string form: stable across runs (unlike hash())
+        out = np.empty(len(col), dtype=np.int64)
+        for i, v in enumerate(col):
+            h = np.uint64(0xCBF29CE484222325)
+            for b in str(v).encode():
+                h = np.uint64((int(h) ^ b) * 0x100000001B3 & 0xFFFFFFFFFFFFFFFF)
+            out[i] = int(h) % modulo
+        return out
+    return np.abs(col.astype(np.int64)) % modulo
+
+
+def hash_attribute_process(batch: FeatureBatch, attribute: str,
+                           modulo: int) -> FeatureBatch:
+    """Append an int ``hash`` column = stable-hash(attribute) % modulo."""
+    return _with_column(
+        batch, "hash", "long", _hashes(batch, attribute, modulo))
+
+
+def hash_attribute_color_process(batch: FeatureBatch, attribute: str,
+                                 modulo: int) -> FeatureBatch:
+    """Append a ``hash`` column holding a stable hex color per hash value."""
+    idx = _hashes(batch, attribute, modulo) % len(_COLORS)
+    colors = np.array([_COLORS[i] for i in idx], dtype=object)
+    return _with_column(batch, "hash", "string", colors)
+
+
+_DUR = re.compile(
+    r"^(?P<sign>-)?P(?:(?P<d>\d+)D)?"
+    r"(?:T(?:(?P<h>\d+)H)?(?:(?P<m>\d+)M)?(?:(?P<s>\d+)S)?)?$")
+
+
+def parse_iso_duration_ms(text: str) -> int:
+    """ISO-8601 day/time duration → signed milliseconds (P1D, PT2H30M, -PT10S)."""
+    m = _DUR.match(text.strip())
+    if not m or all(m.group(g) is None for g in ("d", "h", "m", "s")):
+        raise ValueError(f"bad ISO-8601 duration {text!r}")
+    ms = (int(m.group("d") or 0) * 86_400_000 + int(m.group("h") or 0) * 3_600_000
+          + int(m.group("m") or 0) * 60_000 + int(m.group("s") or 0) * 1000)
+    return -ms if m.group("sign") else ms
+
+
+def date_offset_process(batch: FeatureBatch, date_field: str,
+                        offset: str) -> FeatureBatch:
+    """Shift ``date_field`` by an ISO-8601 duration (one vector add)."""
+    delta = parse_iso_duration_ms(offset)
+    cols = dict(batch.columns)
+    cols[date_field] = batch.column(date_field) + np.int64(delta)
+    return FeatureBatch(batch.sft, cols, batch.ids, batch.geoms)

@@ -1,0 +1,67 @@
+"""Unique-values and attribute-bounds processes.
+
+* :func:`unique_process` — the reference's UniqueProcess
+  (geomesa-process/.../analytic/UniqueProcess.scala:35-120): distinct
+  values of an attribute under a filter, with optional histogram counts
+  and sorting — one vectorized ``np.unique`` over the scanned column
+  (exact; the reference also answers from cached stats when exactness
+  isn't required).
+* :func:`min_max_process` — the reference's MinMaxProcess
+  (.../analytic/MinMaxProcess.scala:28-64): attribute bounds, preferring
+  the cached stats catalog over a scan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..planning.planner import Query
+
+__all__ = ["unique_process", "min_max_process"]
+
+
+def unique_process(store, schema: str, attribute: str, filter="INCLUDE", *,
+                   histogram: bool = False, sort: str | None = None,
+                   sort_by_count: bool = False):
+    """Distinct values of ``attribute`` matching ``filter``.
+
+    Returns values (ndarray), or ``(values, counts)`` when histogram=True.
+    ``sort``: "ASC" | "DESC" on values; ``sort_by_count`` overrides to
+    order by descending histogram count (the reference's precedence).
+    """
+    batch = store.query(schema, Query.of(filter, properties=[attribute]))
+    col = batch.column(attribute)
+    if col.dtype == object:
+        col = col[col != np.array(None)].astype(str)
+    values, counts = np.unique(col, return_counts=True)
+    if sort_by_count:
+        order = np.argsort(-counts, kind="stable")
+    elif sort == "DESC":
+        order = np.arange(len(values))[::-1]
+    else:
+        order = np.arange(len(values))
+    values, counts = values[order], counts[order]
+    return (values, counts) if histogram else values
+
+
+def min_max_process(store, schema: str, attribute: str, *,
+                    cached: bool = True, filter="INCLUDE"):
+    """(min, max) bounds for ``attribute``; cached stats when allowed and
+    the filter is INCLUDE, else an exact scan."""
+    from ..filters.ast import Include
+
+    q = Query.of(filter)
+    if cached and q.filter is Include:
+        bounds = store.get_attribute_bounds(schema, attribute)
+        if bounds is not None:
+            return bounds
+    batch = store.query(schema, Query(filter=q.filter,
+                                      properties=[attribute]))
+    col = batch.column(attribute)
+    if len(col) == 0:
+        return None
+    if col.dtype == object:
+        col = col[col != np.array(None)].astype(str)
+        if len(col) == 0:
+            return None
+    return col.min(), col.max()

@@ -1,0 +1,201 @@
+"""Query/join/track/transform/unique/route processes vs brute-force oracles
+(reference: geomesa-process QueryProcess, JoinProcess, Point2PointProcess,
+TrackLabelProcess, HashAttributeProcess, DateOffsetProcess, UniqueProcess,
+MinMaxProcess, RouteSearchProcess)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.geometry import LineString
+from geomesa_tpu.process import (
+    date_offset_process,
+    hash_attribute_color_process,
+    hash_attribute_process,
+    join_process,
+    min_max_process,
+    point2point_process,
+    query_process,
+    route_search_process,
+    track_label_process,
+    unique_process,
+)
+from geomesa_tpu.process.route import bearing_deg
+from geomesa_tpu.process.transform import parse_iso_duration_ms
+
+MS_2018 = 1514764800000
+N = 5_000
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(11)
+    ds = TpuDataStore()
+    ds.create_schema(
+        "ships", "vessel:String:index=true,kind:Int,dtg:Date,*geom:Point")
+    ds.write("ships", {
+        "vessel": rng.choice([f"v{i}" for i in range(20)], N),
+        "kind": rng.integers(0, 5, N).astype(np.int32),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * 86_400_000, N),
+        "geom": (rng.uniform(-5.0, 5.0, N), rng.uniform(45.0, 55.0, N)),
+    })
+    ds.create_schema("meta", "vessel:String:index=true,flag:String,*geom:Point")
+    ds.write("meta", {
+        "vessel": np.array([f"v{i}" for i in range(30)], dtype=object),
+        "flag": np.array(["ok" if i % 2 == 0 else "bad" for i in range(30)],
+                         dtype=object),
+        "geom": (np.zeros(30), np.zeros(30)),
+    })
+    return ds
+
+
+def test_query_process_projects_and_filters(store):
+    batch = query_process(store, "ships", "kind = 2", properties=["vessel"])
+    assert set(batch.columns) == {"vessel"}
+    oracle = store._store("ships").batch
+    assert len(batch) == int(np.sum(oracle.column("kind") == 2))
+
+
+def test_join_process(store):
+    sec, vals = join_process(store, "ships", "meta", "vessel",
+                             primary_filter="kind = 1")
+    prim = store._store("ships").batch
+    expect = np.unique(prim.column("vessel")[prim.column("kind") == 1]
+                       .astype(str))
+    np.testing.assert_array_equal(np.sort(vals.astype(str)), expect)
+    assert set(sec.column("vessel").astype(str)) <= set(expect)
+    # every joined vessel that exists in meta is present
+    meta_vessels = set(store._store("meta").batch.column("vessel").astype(str))
+    assert set(sec.column("vessel").astype(str)) == set(expect) & meta_vessels
+
+
+def test_join_process_with_filter(store):
+    sec, _ = join_process(store, "ships", "meta", "vessel",
+                          join_filter="flag = 'ok'")
+    assert np.all(sec.column("flag").astype(str) == "ok")
+
+
+def test_unique_process_histogram(store):
+    values, counts = unique_process(store, "ships", "vessel",
+                                    histogram=True, sort_by_count=True)
+    oracle = store._store("ships").batch.column("vessel").astype(str)
+    ev, ec = np.unique(oracle, return_counts=True)
+    assert sorted(values.tolist()) == sorted(ev.tolist())
+    assert np.all(np.diff(counts) <= 0)
+    assert counts.sum() == N
+
+
+def test_unique_process_filtered_sorted(store):
+    values = unique_process(store, "ships", "vessel", "kind = 0", sort="DESC")
+    oracle = store._store("ships").batch
+    ev = np.unique(
+        oracle.column("vessel")[oracle.column("kind") == 0].astype(str))
+    np.testing.assert_array_equal(values, ev[::-1])
+
+
+def test_min_max_process(store):
+    lo, hi = min_max_process(store, "ships", "dtg", cached=False)
+    col = store._store("ships").batch.column("dtg")
+    assert (lo, hi) == (col.min(), col.max())
+    cached = min_max_process(store, "ships", "dtg", cached=True)
+    assert cached is not None
+
+
+def test_point2point_and_track_label():
+    sft_spec = "vessel:String,dtg:Date,*geom:Point"
+    ds = TpuDataStore()
+    ds.create_schema("trk", sft_spec)
+    ds.write("trk", {
+        "vessel": np.array(["a", "b", "a", "b", "a"], dtype=object),
+        "dtg": np.array([3, 1, 1, 2, 2]) * 3_600_000 + MS_2018,
+        "geom": (np.array([3.0, 1.0, 1.0, 2.0, 2.0]),
+                 np.array([30.0, 10.0, 10.0, 20.0, 20.0])),
+    })
+    batch = ds._store("trk").batch
+    lines = point2point_process(batch, "vessel", "dtg")
+    # a: (1,10)->(2,20)->(3,30); b: (1,10)->(2,20)  => 3 segments
+    assert len(lines) == 3
+    assert set(lines.column("vessel").astype(str)) == {"a", "b"}
+    assert np.all(lines.column("dtg_start") < lines.column("dtg_end"))
+    # geometry endpoints follow time order
+    g0 = lines.geoms.geometry(0)
+    assert g0.coords.shape == (2, 2)
+
+    # break on day: same points, times split across days
+    ds.create_schema("trk2", sft_spec)
+    ds.write("trk2", {
+        "vessel": np.array(["a", "a", "a"], dtype=object),
+        "dtg": MS_2018 + np.array([0, 3_600_000, 86_400_000 + 3_600_000]),
+        "geom": (np.array([0.0, 1.0, 2.0]), np.array([0.0, 1.0, 2.0])),
+    })
+    b2 = ds._store("trk2").batch
+    assert len(point2point_process(b2, "vessel", "dtg")) == 2
+    assert len(point2point_process(b2, "vessel", "dtg", break_on_day=True)) == 1
+    # min_points prunes small groups
+    assert len(point2point_process(b2, "vessel", "dtg", min_points=4)) == 0
+
+    labels = track_label_process(batch, "vessel", "dtg")
+    assert len(labels) == 2
+    lv = batch.column("vessel")[labels].astype(str)
+    lt = batch.column("dtg")[labels]
+    assert set(lv) == {"a", "b"}
+    for v in ("a", "b"):
+        mask = batch.column("vessel").astype(str) == v
+        assert lt[lv == v][0] == batch.column("dtg")[mask].max()
+
+
+def test_hash_attribute_process(store):
+    batch = store._store("ships").batch
+    out = hash_attribute_process(batch, "vessel", 7)
+    h = out.column("hash")
+    assert h.dtype == np.int64 and np.all((h >= 0) & (h < 7))
+    # deterministic and equal for equal values
+    v = batch.column("vessel").astype(str)
+    for val in np.unique(v)[:3]:
+        assert len(np.unique(h[v == val])) == 1
+    colored = hash_attribute_color_process(batch, "vessel", 7)
+    assert all(str(c).startswith("#") for c in colored.column("hash")[:10])
+
+
+def test_date_offset_process(store):
+    batch = store._store("ships").batch
+    out = date_offset_process(batch, "dtg", "P1D")
+    np.testing.assert_array_equal(
+        out.column("dtg"), batch.column("dtg") + 86_400_000)
+    assert parse_iso_duration_ms("-PT2H30M") == -9_000_000
+    assert parse_iso_duration_ms("PT10S") == 10_000
+    with pytest.raises(ValueError):
+        parse_iso_duration_ms("1 day")
+
+
+def test_route_search():
+    # route due north along lon=0; ships with matching/opposing headings
+    ds = TpuDataStore()
+    ds.create_schema("fleet", "heading:Double,*geom:Point")
+    x = np.array([0.001, 0.001, 0.001, 2.0, 0.001])
+    y = np.array([50.0, 50.5, 51.0, 50.0, 50.2])
+    heading = np.array([0.0, 180.0, 90.0, 0.0, 350.0])
+    ds.write("fleet", {"heading": heading, "geom": (x, y)})
+    route = LineString(np.array([[0.0, 49.5], [0.0, 51.5]]))
+
+    hits = route_search_process(
+        ds, "fleet", [route], buffer_m=5_000.0, heading_threshold_deg=30.0,
+        heading_field="heading")
+    # northbound ships near the route: indices 0 and 4 (350° within 30° of 0°)
+    np.testing.assert_array_equal(hits, [0, 4])
+
+    both = route_search_process(
+        ds, "fleet", [route], buffer_m=5_000.0, heading_threshold_deg=30.0,
+        heading_field="heading", bidirectional=True)
+    np.testing.assert_array_equal(both, [0, 1, 4])  # southbound matches too
+
+    none = route_search_process(
+        ds, "fleet", [], buffer_m=5_000.0, heading_threshold_deg=30.0,
+        heading_field="heading")
+    assert len(none) == 0
+
+
+def test_bearing_deg():
+    assert abs(bearing_deg(0.0, 0.0, 0.0, 1.0) - 0.0) < 1e-9      # north
+    assert abs(bearing_deg(0.0, 0.0, 1.0, 0.0) - 90.0) < 1e-6     # east
+    assert abs(bearing_deg(0.0, 0.0, 0.0, -1.0) - 180.0) < 1e-9   # south
