@@ -105,6 +105,17 @@ def cmd_export(args):
             table = to_arrow(batch)
             with pa.ipc.new_file(sink, table.schema) as w:
                 w.write_table(table)
+    elif fmt == "gml":
+        from ..io.export import to_gml
+        _write_out(args.output, to_gml(batch))
+    elif fmt == "leaflet":
+        from ..io.export import to_leaflet
+        _write_out(args.output, to_leaflet(batch))
+    elif fmt == "avro":
+        from ..io.avro import to_avro
+        if not args.output:
+            raise SystemExit("avro export requires -o/--output")
+        to_avro(batch, args.output)
     elif fmt == "bin":
         from ..io.bin_encoder import encode_bin
         x, y = batch.geom_xy()
@@ -213,7 +224,8 @@ def build_parser() -> argparse.ArgumentParser:
     catalog(sp)
     sp.add_argument("-q", "--cql", default="INCLUDE")
     sp.add_argument("-F", "--format", default="csv",
-                    choices=["csv", "geojson", "parquet", "arrow", "bin"])
+                    choices=["csv", "geojson", "parquet", "arrow", "bin",
+                             "gml", "leaflet", "avro"])
     sp.add_argument("-o", "--output")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("--track", help="track-id attribute for bin export")

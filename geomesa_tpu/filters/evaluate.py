@@ -169,17 +169,20 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
         return (col >= f.lo) & (col <= f.hi)
     if isinstance(f, In):
         col = batch.column(f.prop)
-        if len(f.values) <= 4:
-            mask = np.zeros(n, dtype=bool)
-            for v in f.values:
-                mask |= col == v
-            return mask
-        # one hashed pass instead of a scan per value (object columns
-        # compare as str; high-cardinality joins feed thousands of values)
-        if col.dtype == object:
-            return np.isin(col.astype(str),
-                           np.array([str(v) for v in f.values]))
-        return np.isin(col, np.array(list(f.values), dtype=col.dtype))
+        # one hashed pass instead of a scan per value (high-cardinality
+        # joins feed thousands of values); np.isin promotes dtypes the
+        # same way `col == v` does, so semantics match the loop below
+        if len(f.values) > 4:
+            if col.dtype == object:
+                return np.isin(col.astype(str),
+                               np.array([str(v) for v in f.values]))
+            vals = np.array(list(f.values))
+            if vals.dtype != object:
+                return np.isin(col, vals)
+        mask = np.zeros(n, dtype=bool)
+        for v in f.values:
+            mask |= col == v
+        return mask
     if isinstance(f, IdFilter):
         wanted = set(f.ids)
         return np.array([str(v) in wanted for v in batch.ids], dtype=bool)

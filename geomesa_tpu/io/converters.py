@@ -151,8 +151,8 @@ class JsonConverter(Converter):
 
 
 def _json_refs(expr_text: str):
-    import re
-    return [m[1:] for m in re.findall(r"\$[A-Za-z0-9_.]+", expr_text)]
+    from .expressions import expr_refs
+    return expr_refs(expr_text)
 
 
 def _dig(record: dict, path: str):
@@ -222,3 +222,25 @@ def converter_from_config(sft: FeatureType, config: dict) -> Converter:
     if cls is None:
         raise ValueError(f"unknown converter type {ctype!r}")
     return cls(sft, config)
+
+
+# additional formats register themselves on import (xml, fixed-width,
+# avro, jdbc, shp, osm — one module per format in the reference)
+from .formats import (  # noqa: E402  (registry must exist first)
+    AvroConverter,
+    FixedWidthConverter,
+    JdbcConverter,
+    OsmConverter,
+    ShapefileConverter,
+    XmlConverter,
+)
+
+_TYPES.update({
+    "xml": XmlConverter,
+    "fixed-width": FixedWidthConverter,
+    "avro": AvroConverter,
+    "jdbc": JdbcConverter,
+    "shp": ShapefileConverter,
+    "shapefile": ShapefileConverter,
+    "osm": OsmConverter,
+})

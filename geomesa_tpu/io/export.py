@@ -193,3 +193,119 @@ def _geom_to_geojson(g):
                 "coordinates": [[p.shell.tolist()] + [h.tolist() for h in p.holes]
                                 for p in g.polygons]}
     raise ValueError(g)
+
+
+def to_gml(batch: FeatureBatch, *, srs: str = "urn:ogc:def:crs:EPSG::4326") -> str:
+    """GML 3 FeatureCollection export (tools/export GML format analog,
+    tools/export/formats/GmlExporter.scala in the reference).
+
+    Coordinates are emitted lon lat (EPSG:4326 axis order follows the
+    reference's GML2 srsName convention of x y)."""
+    from xml.sax.saxutils import escape, quoteattr
+
+    ns = ("xmlns:gml=\"http://www.opengis.net/gml\" "
+          "xmlns:geomesa=\"http://geomesa.org\"")
+    name = batch.sft.name
+    out = ["<?xml version=\"1.0\" encoding=\"UTF-8\"?>",
+           f"<gml:FeatureCollection {ns}>"]
+
+    def pos_list(coords):
+        return " ".join(f"{c[0]:.10g} {c[1]:.10g}" for c in coords)
+
+    def gml_geom(g) -> str:
+        from ..geometry.types import (
+            LineString, MultiLineString, MultiPoint, MultiPolygon, Point, Polygon,
+        )
+        if isinstance(g, Point):
+            return (f"<gml:Point srsName=\"{srs}\"><gml:pos>{g.x:.10g} "
+                    f"{g.y:.10g}</gml:pos></gml:Point>")
+        if isinstance(g, LineString):
+            return (f"<gml:LineString srsName=\"{srs}\"><gml:posList>"
+                    f"{pos_list(g.coords)}</gml:posList></gml:LineString>")
+        if isinstance(g, Polygon):
+            rings = (f"<gml:exterior><gml:LinearRing><gml:posList>"
+                     f"{pos_list(g.shell)}</gml:posList></gml:LinearRing>"
+                     f"</gml:exterior>")
+            for h in g.holes:
+                rings += (f"<gml:interior><gml:LinearRing><gml:posList>"
+                          f"{pos_list(h)}</gml:posList></gml:LinearRing>"
+                          f"</gml:interior>")
+            return f"<gml:Polygon srsName=\"{srs}\">{rings}</gml:Polygon>"
+        if isinstance(g, MultiPoint):
+            members = "".join(
+                f"<gml:pointMember>{gml_geom(Point(c[0], c[1]))}</gml:pointMember>"
+                for c in g.coords)
+            return f"<gml:MultiPoint srsName=\"{srs}\">{members}</gml:MultiPoint>"
+        if isinstance(g, MultiLineString):
+            members = "".join(
+                f"<gml:lineStringMember>{gml_geom(l)}</gml:lineStringMember>"
+                for l in g.lines)
+            return f"<gml:MultiLineString srsName=\"{srs}\">{members}</gml:MultiLineString>"
+        if isinstance(g, MultiPolygon):
+            members = "".join(
+                f"<gml:polygonMember>{gml_geom(p)}</gml:polygonMember>"
+                for p in g.polygons)
+            return f"<gml:MultiPolygon srsName=\"{srs}\">{members}</gml:MultiPolygon>"
+        raise ValueError(g)
+
+    from ..geometry.types import Point as _Pt
+
+    gname = batch.sft.default_geom
+    x = y = None
+    if batch.geoms is None and gname is not None:
+        x, y = batch.geom_xy()
+    for i in range(len(batch)):
+        out.append("<gml:featureMember>")
+        out.append(f"<geomesa:{name} gml:id={quoteattr(str(batch.ids[i]))}>")
+        for a in batch.sft.attributes:
+            if a.is_geometry:
+                if a.name != gname:
+                    continue
+                g = batch.geoms.geometry(i) if batch.geoms is not None \
+                    else _Pt(float(x[i]), float(y[i]))
+                out.append(f"<geomesa:{a.name}>{gml_geom(g)}</geomesa:{a.name}>")
+            elif a.name in batch.columns:
+                v = batch.columns[a.name][i]
+                if v is None:
+                    continue
+                if a.type == "date":
+                    v = str(np.datetime64(int(v), "ms")) + "Z"
+                out.append(f"<geomesa:{a.name}>{escape(str(v))}</geomesa:{a.name}>")
+        out.append(f"</geomesa:{name}>")
+        out.append("</gml:featureMember>")
+    out.append("</gml:FeatureCollection>")
+    return "\n".join(out)
+
+
+_LEAFLET_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"/><title>{title}</title>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>html,body,#map{{height:100%;margin:0}}</style></head>
+<body><div id="map"></div><script>
+var map = L.map('map');
+L.tileLayer('https://{{s}}.tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+  {{attribution: '&copy; OpenStreetMap contributors'}}).addTo(map);
+var data = {geojson};
+var layer = L.geoJSON(data, {{
+  pointToLayer: function (f, latlng) {{
+    return L.circleMarker(latlng, {{radius: 4}});
+  }}
+}}).addTo(map);
+var b = layer.getBounds();
+if (b.isValid()) {{ map.fitBounds(b); }} else {{ map.setView([0, 0], 2); }}
+</script></body></html>
+"""
+
+
+def to_leaflet(batch: FeatureBatch, *, title: str | None = None) -> str:
+    """Standalone Leaflet HTML map of the batch (the reference's
+    LeafletMapExporter, tools/export/formats/LeafletMapExporter.scala, and
+    the geomesa-jupyter Leaflet helper)."""
+    from xml.sax.saxutils import escape
+
+    # '<' must not appear raw inside the inline <script> (a string value
+    # containing '</script>' would terminate the block / inject markup)
+    geojson = to_geojson(batch).replace("<", "\\u003c")
+    return _LEAFLET_PAGE.format(
+        title=escape(title or batch.sft.name), geojson=geojson)

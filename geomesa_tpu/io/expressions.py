@@ -18,7 +18,15 @@ import uuid as _uuid
 
 import numpy as np
 
-__all__ = ["parse_expression", "Expression"]
+__all__ = ["parse_expression", "Expression", "expr_refs"]
+
+# the $-reference charset; keep in sync with the tokenizer's dollar group
+_REF_RE = re.compile(r"\$([A-Za-z0-9_./@-]+)")
+
+
+def expr_refs(expr_text: str) -> list[str]:
+    """All ``$name`` column references in a transform expression."""
+    return _REF_RE.findall(expr_text or "")
 
 
 class Expression:
@@ -149,7 +157,7 @@ _FUNCTIONS = {
 }
 
 _TOKEN = re.compile(r"""\s*(?:
-      (?P<dollar>\$[A-Za-z0-9_.]+)
+      (?P<dollar>\$[A-Za-z0-9_./@-]+)
     | (?P<string>'(?:[^']|'')*')
     | (?P<number>-?\d+\.?\d*)
     | (?P<name>[A-Za-z_][A-Za-z0-9_]*)

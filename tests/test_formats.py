@@ -1,0 +1,230 @@
+"""Converter formats: XML, fixed-width, Avro, JDBC, Shapefile, OSM
+(reference: geomesa-convert-{xml,fixedwidth,avro,jdbc,shp,osm})."""
+
+import sqlite3
+import struct
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.features.feature_type import parse_spec
+from geomesa_tpu.io.converters import converter_from_config
+from geomesa_tpu.io.formats import read_shapefile
+
+SFT = parse_spec("obs", "name:String,value:Int,dtg:Date,*geom:Point")
+
+
+def test_xml_converter():
+    xml = """<doc>
+      <feature station="A"><name>alpha</name><v>3</v>
+        <pos><lon>1.5</lon><lat>50.5</lat></pos>
+        <when>2018-01-01T00:00:00Z</when></feature>
+      <feature station="B"><name>beta</name><v>4</v>
+        <pos><lon>2.5</lon><lat>51.5</lat></pos>
+        <when>2018-01-02T00:00:00Z</when></feature>
+    </doc>"""
+    conv = converter_from_config(SFT, {
+        "type": "xml", "feature-path": "feature",
+        "id-field": "$@station",
+        "fields": [
+            {"name": "name", "transform": "$name"},
+            {"name": "value", "transform": "toint($v)"},
+            {"name": "dtg", "transform": "isodate($when)"},
+            {"name": "geom",
+             "transform": "point(todouble($pos/lon), todouble($pos/lat))"},
+        ],
+    })
+    batch = conv.convert(xml)
+    assert len(batch) == 2
+    assert list(batch.ids) == ["A", "B"]
+    assert list(batch.column("name")) == ["alpha", "beta"]
+    np.testing.assert_array_equal(batch.column("value"), [3, 4])
+    np.testing.assert_allclose(batch.geom_xy()[0], [1.5, 2.5])
+
+
+def test_fixed_width_converter():
+    text = "alpha 003 1.50 50.50\nbeta  004 2.50 51.50\n"
+    conv = converter_from_config(SFT, {
+        "type": "fixed-width",
+        "fields": [
+            {"name": "name", "start": 0, "width": 6},
+            {"name": "value", "start": 6, "width": 3,
+             "transform": "toint($value)"},
+            {"name": "geom", "start": 0, "width": 0,
+             "transform": "point(todouble($x), todouble($y))"},
+            {"name": "x", "start": 10, "width": 4},
+            {"name": "y", "start": 15, "width": 5},
+        ],
+    })
+    batch = conv.convert(text)
+    assert len(batch) == 2
+    assert list(batch.column("name")) == ["alpha", "beta"]
+    np.testing.assert_array_equal(batch.column("value"), [3, 4])
+    np.testing.assert_allclose(batch.geom_xy()[1], [50.5, 51.5])
+
+
+def test_avro_converter(tmp_path):
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.io.avro import to_avro
+
+    batch = FeatureBatch.from_dict(SFT, {
+        "name": np.array(["a", "b"], dtype=object),
+        "value": np.array([1, 2], dtype=np.int32),
+        "dtg": np.array([1000, 2000], dtype=np.int64),
+        "geom": (np.array([1.0, 2.0]), np.array([10.0, 20.0])),
+    }, ids=["f1", "f2"])
+    path = str(tmp_path / "obs.avro")
+    to_avro(batch, path)
+    conv = converter_from_config(SFT, {"type": "avro"})
+    out = conv.convert(path)
+    assert len(out) == 2
+    assert list(out.ids) == ["f1", "f2"]
+    np.testing.assert_array_equal(out.column("value"), [1, 2])
+
+
+def test_jdbc_converter(tmp_path):
+    db = str(tmp_path / "obs.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE obs (name TEXT, value INT, t INT, x REAL, y REAL)")
+    conn.executemany("INSERT INTO obs VALUES (?,?,?,?,?)",
+                     [("a", 1, 1000, 1.0, 10.0), ("b", 2, 2000, 2.0, 20.0)])
+    conn.commit()
+    conn.close()
+    conv = converter_from_config(SFT, {
+        "type": "jdbc",
+        "query": "SELECT name, value, t, x, y FROM obs ORDER BY name",
+        "fields": [
+            {"name": "name"},
+            {"name": "value", "transform": "toint($2)"},
+            {"name": "dtg", "transform": "millistodate($t)"},
+            {"name": "geom", "transform": "point(todouble($x), todouble($y))"},
+        ],
+    })
+    batch = conv.convert(db)
+    assert len(batch) == 2
+    np.testing.assert_array_equal(batch.column("value"), [1, 2])
+    np.testing.assert_array_equal(batch.column("dtg"), [1000, 2000])
+
+
+def _write_test_shapefile(path, geoms_points, dbf_rows):
+    """Hand-rolled tiny .shp (point type) + .dbf for the reader test."""
+    recs = b""
+    for i, (x, y) in enumerate(geoms_points):
+        content = struct.pack("<i", 1) + struct.pack("<dd", x, y)
+        recs += struct.pack(">ii", i + 1, len(content) // 2) + content
+    total_words = (100 + len(recs)) // 2
+    hdr = struct.pack(">i", 9994) + b"\x00" * 20 + struct.pack(">i", total_words)
+    hdr += struct.pack("<ii", 1000, 1)  # version, shape type point
+    hdr += struct.pack("<8d", 0, 0, 0, 0, 0, 0, 0, 0)
+    with open(path, "wb") as f:
+        f.write(hdr + recs)
+    # dbf: one C field "name" width 8, one N field "v" width 4
+    nrec = len(dbf_rows)
+    fields = [("name", "C", 8, 0), ("v", "N", 4, 0)]
+    hdr_size = 32 + 32 * len(fields) + 1
+    rec_size = 1 + 8 + 4
+    out = bytearray()
+    out += bytes([3, 118, 1, 1]) + struct.pack("<ihh", nrec, hdr_size, rec_size)
+    out += b"\x00" * 20
+    for name, t, ln, dec in fields:
+        out += name.encode().ljust(11, b"\x00") + t.encode()
+        out += b"\x00" * 4 + bytes([ln, dec]) + b"\x00" * 14
+    out += b"\x0d"
+    for name, v in dbf_rows:
+        out += b" " + name.encode().ljust(8)[:8] + str(v).rjust(4).encode()
+    with open(str(path)[:-4] + ".dbf", "wb") as f:
+        f.write(bytes(out))
+
+
+def test_shapefile_reader_and_converter(tmp_path):
+    shp = str(tmp_path / "pts.shp")
+    _write_test_shapefile(shp, [(1.0, 10.0), (2.0, 20.0)],
+                          [("a", 1), ("b", 2)])
+    geoms, attrs = read_shapefile(shp)
+    assert len(geoms) == 2 and geoms[0].x == 1.0 and geoms[1].y == 20.0
+    assert list(attrs["name"]) == ["a", "b"]
+    assert list(attrs["v"]) == [1, 2]
+
+    sft = parse_spec("pts", "name:String,v:Int,*geom:Point")
+    conv = converter_from_config(sft, {
+        "type": "shp",
+        "fields": [
+            {"name": "name"},
+            {"name": "v", "transform": "toint($v)"},
+            {"name": "geom", "transform": "$geometry"},
+        ],
+    })
+    batch = conv.convert(shp)
+    assert len(batch) == 2
+    np.testing.assert_allclose(batch.geom_xy()[0], [1.0, 2.0])
+
+
+def test_osm_converter():
+    osm = """<osm version="0.6">
+      <node id="101" lat="50.5" lon="1.5">
+        <tag k="amenity" v="cafe"/><tag k="name" v="First"/></node>
+      <node id="102" lat="51.5" lon="2.5">
+        <tag k="name" v="Second"/></node>
+    </osm>"""
+    sft = parse_spec("poi", "name:String,*geom:Point")
+    conv = converter_from_config(sft, {
+        "type": "osm",
+        "id-field": "$id",
+        "fields": [
+            {"name": "name"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+    })
+    batch = conv.convert(osm)
+    assert len(batch) == 2
+    assert list(batch.ids) == ["101", "102"]
+    assert list(batch.column("name")) == ["First", "Second"]
+    np.testing.assert_allclose(batch.geom_xy()[0], [1.5, 2.5])
+
+
+def test_unknown_type_rejected():
+    with pytest.raises(ValueError):
+        converter_from_config(SFT, {"type": "nope"})
+
+
+def test_gml_and_leaflet_export():
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.io.export import to_gml, to_leaflet
+    import xml.etree.ElementTree as ET
+
+    batch = FeatureBatch.from_dict(SFT, {
+        "name": np.array(["a", "<b>"], dtype=object),
+        "value": np.array([1, 2], dtype=np.int32),
+        "dtg": np.array([1000, 2000], dtype=np.int64),
+        "geom": (np.array([1.0, 2.0]), np.array([10.0, 20.0])),
+    }, ids=["f1", "f2"])
+    gml = to_gml(batch)
+    root = ET.fromstring(gml)  # well-formed
+    ns = {"gml": "http://www.opengis.net/gml", "geomesa": "http://geomesa.org"}
+    members = root.findall("gml:featureMember", ns)
+    assert len(members) == 2
+    pos = members[0].find(".//gml:pos", ns).text
+    assert pos == "1 10"
+    assert members[1].find(".//geomesa:name", ns).text == "<b>"
+
+    html = to_leaflet(batch)
+    assert "leaflet" in html and '"FeatureCollection"' in html
+
+
+def test_gml_polygon_roundtrip_wellformed():
+    from geomesa_tpu.features.batch import FeatureBatch
+    from geomesa_tpu.geometry.types import Polygon
+    from geomesa_tpu.io.export import to_gml
+    import xml.etree.ElementTree as ET
+
+    sft = parse_spec("areas", "name:String,*geom:Polygon")
+    shell = np.array([[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]], dtype=float)
+    hole = np.array([[1, 1], [2, 1], [2, 2], [1, 2], [1, 1]], dtype=float)
+    batch = FeatureBatch.from_dict(sft, {
+        "name": np.array(["p"], dtype=object),
+        "geom": [Polygon(shell, (hole,))],
+    })
+    root = ET.fromstring(to_gml(batch))
+    ns = {"gml": "http://www.opengis.net/gml"}
+    assert root.find(".//gml:exterior", ns) is not None
+    assert root.find(".//gml:interior", ns) is not None
