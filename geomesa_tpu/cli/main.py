@@ -61,8 +61,12 @@ def cmd_ingest(args):
         with open(args.converter) as f:
             conv = converter_from_config(sft, json.load(f))
         for path in args.files:
-            with open(path, "rb") as f:
-                batch = conv.convert(f.read(), ec)
+            if conv.wants_path:
+                # shapefile/jdbc sources are paths (sidecar files, db handles)
+                batch = conv.convert(path, ec)
+            else:
+                with open(path, "rb") as f:
+                    batch = conv.convert(f.read(), ec)
             if len(batch):
                 total += ds.write(args.feature_name, batch)
     else:

@@ -77,8 +77,22 @@ class FeatureBatch:
                 continue
             vals = data[attr.name]
             if attr.is_geometry:
-                if attr.type == "point" and isinstance(vals, tuple):
-                    x, y = vals
+                if attr.type == "point":
+                    # canonical point layout is the x/y fast path — whether
+                    # given as (x, y) arrays or Point objects — so batches
+                    # concat regardless of construction style
+                    if isinstance(vals, tuple):
+                        x, y = vals
+                    else:
+                        pts = (vals if isinstance(vals, PackedGeometry)
+                               else pack_geometries(vals))
+                        if pts.kinds.size and not (pts.kinds == 0).all():
+                            raise ValueError(
+                                f"attribute {attr.name!r} is typed Point but "
+                                "got non-point geometries")
+                        xy = pts.coords[pts.ring_offsets[:-1]] if pts.kinds.size \
+                            else np.empty((0, 2))
+                        x, y = xy[:, 0], xy[:, 1]
                     columns[f"{attr.name}_x"] = np.asarray(x, dtype=np.float64)
                     columns[f"{attr.name}_y"] = np.asarray(y, dtype=np.float64)
                 else:

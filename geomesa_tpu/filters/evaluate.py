@@ -177,7 +177,13 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
                 return np.isin(col.astype(str),
                                np.array([str(v) for v in f.values]))
             vals = np.array(list(f.values))
-            if vals.dtype != object:
+            # only when value dtype is compatible with the column: a mixed
+            # list like [1, 'a'] promotes to '<U21', and np.isin would then
+            # compare numbers to strings and silently match nothing
+            if (vals.dtype != object
+                    and (vals.dtype.kind == col.dtype.kind
+                         or (vals.dtype.kind in "iuf"
+                             and col.dtype.kind in "iuf"))):
                 return np.isin(col, vals)
         mask = np.zeros(n, dtype=bool)
         for v in f.values:

@@ -168,6 +168,8 @@ def from_avro(path_or_buf, sft: FeatureType) -> FeatureBatch:
     if isinstance(path_or_buf, (str, os.PathLike)):
         with open(path_or_buf, "rb") as f:
             raw = f.read()
+    elif isinstance(path_or_buf, (bytes, bytearray, memoryview)):
+        raw = bytes(path_or_buf)
     else:
         raw = path_or_buf.read()
     buf = memoryview(raw)

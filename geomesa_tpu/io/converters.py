@@ -47,9 +47,29 @@ class Converter:
             self.fields.append((f["name"], parse_expression(f["transform"])
                                 if "transform" in f else None))
 
+    #: converters whose raw source is a file path (shapefile sidecars,
+    #: jdbc databases) rather than the file's bytes
+    wants_path = False
+
     # -- subclass hook ----------------------------------------------------
     def raw_columns(self, source) -> dict:
         raise NotImplementedError
+
+    def referenced_paths(self) -> set:
+        """Raw-column names/paths referenced by the configured field
+        transforms and id-field ($-refs, shared by json/xml converters)."""
+        from .expressions import expr_refs
+
+        paths: set = set()
+        for f in self.config.get("fields", []):
+            t = f.get("transform")
+            if t:
+                paths.update(expr_refs(t))
+            else:
+                # transform-less fields read the raw column by name
+                paths.add(f["name"])
+        paths.update(expr_refs(self.config.get("id-field", "")))
+        return paths
 
     # -- shared pipeline --------------------------------------------------
     def convert(self, source, ec: EvaluationContext | None = None) -> FeatureBatch:
@@ -128,15 +148,7 @@ class JsonConverter(Converter):
             records = json.loads(text)
         else:
             records = [json.loads(line) for line in text.splitlines() if line.strip()]
-        paths = set()
-        for f in self.config.get("fields", []):
-            for m in _json_refs(f.get("transform", "")):
-                paths.add(m)
-            if not f.get("transform"):
-                # transform-less fields read the raw column by name
-                paths.add(f["name"])
-        if "id-field" in self.config:
-            paths.update(_json_refs(self.config["id-field"]))
+        paths = self.referenced_paths()
         cols: dict = {}
         for p in paths:
             cols[p] = np.asarray([_dig(r, p) for r in records], dtype=object)
@@ -148,11 +160,6 @@ class JsonConverter(Converter):
             for k in keys:
                 cols[k] = np.asarray([r.get(k) for r in records], dtype=object)
         return cols
-
-
-def _json_refs(expr_text: str):
-    from .expressions import expr_refs
-    return expr_refs(expr_text)
 
 
 def _dig(record: dict, path: str):

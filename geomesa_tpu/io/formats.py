@@ -45,7 +45,7 @@ class XmlConverter(Converter):
         root = ET.fromstring(source)
         tag = self.config.get("feature-path", "feature")
         elems = [e for e in root.iter() if _local(e.tag) == tag]
-        paths = self._referenced_paths()
+        paths = self.referenced_paths()
         cols: dict = {}
         for p in paths:
             cols[p] = np.asarray([_xml_get(e, p) for e in elems], dtype=object)
@@ -58,19 +58,6 @@ class XmlConverter(Converter):
                 cols[k] = np.asarray([_xml_get(e, k) for e in elems],
                                      dtype=object)
         return cols
-
-    def _referenced_paths(self) -> set:
-        from .expressions import expr_refs
-
-        paths: set = set()
-        for f in self.config.get("fields", []):
-            t = f.get("transform")
-            if t:
-                paths.update(expr_refs(t))
-            else:
-                paths.add(f["name"])
-        paths.update(expr_refs(self.config.get("id-field", "")))
-        return paths
 
 
 def _local(tag: str) -> str:
@@ -123,6 +110,22 @@ class AvroConverter(Converter):
         batch = from_avro(source, self.sft)
         cols = dict(batch.columns)
         cols["id"] = batch.ids
+        # expose the default geometry as an object column so transforms can
+        # reference it (point batches only carry the x/y fast-path columns)
+        # — but only when a transform actually references it: the per-row
+        # object materialization is pure overhead otherwise
+        gname = self.sft.default_geom
+        if gname is not None and gname in self.referenced_paths():
+            if batch.geoms is not None:
+                cols[gname] = np.asarray(
+                    [batch.geoms.geometry(i) for i in range(len(batch.geoms))],
+                    dtype=object)
+            elif f"{gname}_x" in cols:
+                from ..geometry.types import Point
+                cols[gname] = np.asarray(
+                    [Point(float(x), float(y)) for x, y in
+                     zip(cols[f"{gname}_x"], cols[f"{gname}_y"])],
+                    dtype=object)
         return cols
 
     def convert(self, source, ec: EvaluationContext | None = None) -> FeatureBatch:
@@ -143,6 +146,8 @@ class JdbcConverter(Converter):
     config ``query`` selects the rows.  Raw columns are result columns by
     name and by position (``$1`` = first selected column, matching the
     reference's positional refs)."""
+
+    wants_path = True
 
     def raw_columns(self, source) -> dict:
         import sqlite3
@@ -256,7 +261,10 @@ def _read_dbf(path: str) -> dict:
                 if not raw:
                     cols[name].append(None)
                 elif decimals or ftype == "F" or "." in raw:
-                    cols[name].append(float(raw))
+                    try:
+                        cols[name].append(float(raw))
+                    except ValueError:  # dBASE pads overflow with asterisks
+                        cols[name].append(None)
                 else:
                     try:
                         cols[name].append(int(raw))
@@ -274,8 +282,16 @@ class ShapefileConverter(Converter):
     """Shapefiles → columns: ``geometry`` plus the DBF attribute columns
     (geomesa-convert-shp analog)."""
 
+    wants_path = True
+
     def raw_columns(self, source) -> dict:
         geoms, attrs = read_shapefile(source, self.config.get("dbf"))
+        # null shapes (type 0) are legal records; drop them (with their
+        # attribute rows) rather than crash the whole batch in packing
+        keep = [i for i, g in enumerate(geoms) if g is not None]
+        if len(keep) != len(geoms):
+            geoms = [geoms[i] for i in keep]
+            attrs = {k: v[keep] for k, v in attrs.items()}
         cols = {"geometry": np.asarray(geoms, dtype=object)}
         cols.update(attrs)
         return cols
@@ -297,7 +313,9 @@ class OsmConverter(Converter):
         # one pass: per-node tag dict, then one column per distinct key
         tags = [{t.get("k"): t.get("v") for t in n if _local(t.tag) == "tag"}
                 for n in nodes]
-        tag_keys = set().union(*tags) if tags else set()
+        # tag keys must not clobber the core node columns (real imports
+        # contain nodes tagged e.g. k="lat")
+        tag_keys = (set().union(*tags) if tags else set()) - set(cols)
         for k in tag_keys:
             cols[k] = np.asarray([d.get(k) for d in tags], dtype=object)
         return cols

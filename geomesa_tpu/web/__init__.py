@@ -1,0 +1,5 @@
+"""REST layer (geomesa-web analog): WSGI app over a TpuDataStore."""
+
+from .app import WebApp, serve
+
+__all__ = ["WebApp", "serve"]

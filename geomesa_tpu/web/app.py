@@ -1,0 +1,280 @@
+"""REST endpoints over a TpuDataStore.
+
+The analog of the reference's geomesa-web module (Scalatra servlets:
+data endpoint `geomesa-web/geomesa-web-data`, stats endpoint
+`geomesa-web/.../stats/GeoMesaStatsEndpoint.scala`, audit readback
+`geomesa-web/.../QueryAuditEndpoint.scala`), re-expressed as a plain
+WSGI application (stdlib only — runnable under ``wsgiref`` or any WSGI
+container) instead of JVM servlets.
+
+Routes::
+
+    GET    /api/version
+    GET    /api/schemas                      list type names
+    POST   /api/schemas                      {"name":..., "spec":...}
+    GET    /api/schemas/{name}               schema description
+    DELETE /api/schemas/{name}
+    GET    /api/data/{name}?cql=&max=&format=geojson|csv|gml   query
+    POST   /api/data/{name}                  ingest GeoJSON FeatureCollection
+    GET    /api/stats/{name}/count?cql=      estimated/exact counts
+    GET    /api/stats/{name}/bounds
+    GET    /api/stats/{name}/minmax?attribute=
+    GET    /api/stats/{name}/histogram?attribute=&bins=
+    GET    /api/stats/{name}/topk?attribute=
+    GET    /api/audit/{name}?since=          query-event readback
+    GET    /api/metrics                      request + store metrics dump
+
+Per-request metrics are recorded in the global registry (the reference's
+servlet-level ``AggregatedMetricsFilter``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+import traceback
+from urllib.parse import parse_qs
+
+from ..metrics import registry as _metrics
+
+__all__ = ["WebApp", "serve"]
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+_STATUS = {200: "200 OK", 201: "201 Created", 204: "204 No Content",
+           400: "400 Bad Request", 404: "404 Not Found",
+           405: "405 Method Not Allowed", 500: "500 Internal Server Error"}
+
+
+class WebApp:
+    """WSGI application exposing a TpuDataStore over HTTP."""
+
+    def __init__(self, store, audit_writer=None):
+        self.store = store
+        # prefer an explicitly-passed audit writer, else the store's
+        self.audit = audit_writer or getattr(store, "_audit_writer", None)
+        self._routes = [
+            (re.compile(r"^/api/version$"), self._version),
+            (re.compile(r"^/api/schemas$"), self._schemas),
+            (re.compile(r"^/api/schemas/([^/]+)$"), self._schema),
+            (re.compile(r"^/api/data/([^/]+)$"), self._data),
+            (re.compile(r"^/api/stats/([^/]+)/([a-z]+)$"), self._stats),
+            (re.compile(r"^/api/audit/([^/]+)$"), self._audit_events),
+            (re.compile(r"^/api/metrics$"), self._metrics_dump),
+        ]
+
+    # -- WSGI entry point --------------------------------------------------
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        method = environ.get("REQUEST_METHOD", "GET")
+        params = {k: v[0] for k, v in
+                  parse_qs(environ.get("QUERY_STRING", "")).items()}
+        t0 = time.perf_counter()
+        try:
+            for pattern, handler in self._routes:
+                m = pattern.match(path)
+                if m:
+                    status, body, ctype = handler(
+                        method, params, environ, *m.groups())
+                    break
+            else:
+                raise _HttpError(404, f"no such route: {path}")
+        except _HttpError as e:
+            status = e.status
+            body = json.dumps({"error": e.message})
+            ctype = "application/json"
+        except Exception as e:  # noqa: BLE001 — surface as a 500
+            status = 500
+            body = json.dumps({"error": f"{type(e).__name__}: {e}",
+                               "trace": traceback.format_exc(limit=5)})
+            ctype = "application/json"
+        _metrics.counter(f"web.{status}").inc()
+        _metrics.timer("web.request_ms").update(
+            (time.perf_counter() - t0) * 1e3)
+        payload = body.encode() if isinstance(body, str) else body
+        start_response(_STATUS.get(status, f"{status} Error"), [
+            ("Content-Type", ctype),
+            ("Content-Length", str(len(payload)))])
+        return [payload]
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _read_json(environ) -> dict:
+        try:
+            n = int(environ.get("CONTENT_LENGTH") or 0)
+            raw = environ["wsgi.input"].read(n) if n else b"{}"
+            return json.loads(raw)
+        except (ValueError, KeyError) as e:
+            raise _HttpError(400, f"bad request body: {e}")
+
+    def _query(self, name: str, params: dict):
+        from ..planning.planner import Query
+        cql = params.get("cql", "INCLUDE")
+        kw = {}
+        if "max" in params:
+            kw["max_features"] = int(params["max"])
+        try:
+            return self.store.query(name, Query.of(cql, **kw))
+        except KeyError:
+            raise _HttpError(404, f"no such schema: {name!r}")
+
+    # -- handlers ----------------------------------------------------------
+    def _version(self, method, params, environ):
+        from .. import __version__
+        return 200, json.dumps({"version": __version__,
+                                "framework": "geomesa-tpu"}), "application/json"
+
+    def _schemas(self, method, params, environ):
+        if method == "GET":
+            return 200, json.dumps(self.store.type_names), "application/json"
+        if method == "POST":
+            body = self._read_json(environ)
+            if "name" not in body or "spec" not in body:
+                raise _HttpError(400, "need 'name' and 'spec'")
+            try:
+                sft = self.store.create_schema(body["name"], body["spec"])
+            except ValueError as e:
+                raise _HttpError(400, str(e))
+            return 201, json.dumps({"name": sft.name,
+                                    "spec": sft.spec_string()}), "application/json"
+        raise _HttpError(405, method)
+
+    def _schema(self, method, params, environ, name):
+        try:
+            sft = self.store.get_schema(name)
+        except KeyError:
+            raise _HttpError(404, f"no such schema: {name!r}")
+        if method == "GET":
+            return 200, json.dumps({
+                "name": sft.name,
+                "spec": sft.spec_string(),
+                "attributes": [{"name": a.name, "type": a.type,
+                                "indexed": a.indexed,
+                                "default": a.name == sft.default_geom}
+                               for a in sft.attributes],
+                "dtg": sft.dtg_field,
+            }), "application/json"
+        if method == "DELETE":
+            self.store.remove_schema(name)
+            return 204, "", "application/json"
+        raise _HttpError(405, method)
+
+    def _data(self, method, params, environ, name):
+        if method == "GET":
+            batch = self._query(name, params)
+            fmt = params.get("format", "geojson")
+            from ..io import export
+            if fmt == "geojson":
+                return 200, export.to_geojson(batch), "application/geo+json"
+            if fmt == "csv":
+                return 200, export.to_csv(batch), "text/csv"
+            if fmt == "gml":
+                return 200, export.to_gml(batch), "application/gml+xml"
+            raise _HttpError(400, f"unknown format: {fmt!r}")
+        if method == "POST":
+            body = self._read_json(environ)
+            feats = body.get("features")
+            if feats is None:
+                raise _HttpError(400, "expected GeoJSON FeatureCollection")
+            try:
+                sft = self.store.get_schema(name)
+            except KeyError:
+                raise _HttpError(404, f"no such schema: {name!r}")
+            from ..io.converters import EvaluationContext, converter_from_config
+            fields = [{"name": a.name,
+                       "transform": ("$geometry" if a.is_geometry
+                                     else f"${a.name}")}
+                      for a in sft.attributes]
+            config = {"type": "geojson", "fields": fields}
+            if all("id" in f for f in feats):
+                config["id-field"] = "$id"
+            conv = converter_from_config(sft, config)
+            ec = EvaluationContext()
+            batch = conv.convert(json.dumps(body), ec)
+            n = self.store.write(name, batch) if len(batch) else 0
+            return 200, json.dumps({"ingested": n, "errors": ec.errors}), \
+                "application/json"
+        raise _HttpError(405, method)
+
+    def _stats(self, method, params, environ, name, which):
+        if method != "GET":
+            raise _HttpError(405, method)
+        try:
+            self.store.get_schema(name)
+        except KeyError:
+            raise _HttpError(404, f"no such schema: {name!r}")
+        if which == "count":
+            cql = params.get("cql")
+            return 200, json.dumps(
+                {"count": self.store.get_count(name, cql)}), "application/json"
+        if which == "bounds":
+            env = self.store.get_bounds(name)
+            body = (None if env is None else
+                    {"minx": env.xmin, "miny": env.ymin,
+                     "maxx": env.xmax, "maxy": env.ymax})
+            return 200, json.dumps({"bounds": body}), "application/json"
+        attr = params.get("attribute")
+        if which in ("minmax", "histogram", "topk") and not attr:
+            raise _HttpError(400, "need ?attribute=")
+        if which == "minmax":
+            mm = self.store.get_attribute_bounds(name, attr)
+            return 200, json.dumps(
+                {"attribute": attr,
+                 "bounds": None if mm is None else
+                 [_jsonable(mm[0]), _jsonable(mm[1])]}), "application/json"
+        if which == "histogram":
+            from ..stats.stat import Histogram
+            bins = int(params.get("bins", 20))
+            store = self.store._store(name)
+            if store.batch is None or len(store.batch) == 0:
+                raise _HttpError(404, "no data")
+            col = store.batch.column(attr).astype(float)
+            h = Histogram(attr, bins=bins,
+                          lo=float(col.min()), hi=float(col.max()))
+            h.observe(store.batch)
+            return 200, json.dumps(h.to_json()), "application/json"
+        if which == "topk":
+            s = self.store.stat(name, f"{attr}_topk")
+            if s is None:
+                raise _HttpError(404, f"no topk stat for {attr!r}")
+            return 200, json.dumps(s.to_json()), "application/json"
+        raise _HttpError(404, f"unknown stat: {which!r}")
+
+    def _audit_events(self, method, params, environ, name):
+        if method != "GET":
+            raise _HttpError(405, method)
+        if self.audit is None or not hasattr(self.audit, "query_events"):
+            raise _HttpError(404, "no queryable audit writer configured")
+        since = float(params["since"]) if "since" in params else None
+        events = self.audit.query_events(type_name=name, since=since)
+        return 200, json.dumps(
+            [json.loads(e.to_json()) for e in events]), "application/json"
+
+    def _metrics_dump(self, method, params, environ):
+        return 200, json.dumps(_metrics.snapshot()), "application/json"
+
+
+def _jsonable(v):
+    """Numpy scalars / datetimes → JSON-safe values."""
+    try:
+        import numpy as np
+        if isinstance(v, np.generic):
+            return v.item()
+    except ImportError:  # pragma: no cover
+        pass
+    return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)
+
+
+def serve(app: WebApp, host: str = "127.0.0.1", port: int = 8765):
+    """Run the app under wsgiref (dev/demo server)."""
+    from wsgiref.simple_server import make_server
+    with make_server(host, port, app) as httpd:
+        print(f"geomesa-tpu web on http://{host}:{port}/api/version")
+        httpd.serve_forever()

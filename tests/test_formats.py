@@ -228,3 +228,44 @@ def test_gml_polygon_roundtrip_wellformed():
     ns = {"gml": "http://www.opengis.net/gml"}
     assert root.find(".//gml:exterior", ns) is not None
     assert root.find(".//gml:interior", ns) is not None
+
+
+def test_in_filter_mixed_type_values():
+    """Mixed-type In lists must not silently match nothing (np.array
+    promotes [1,'a'] to a string dtype; the isin fast path must bail)."""
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.filters.ast import In
+    from geomesa_tpu.filters.evaluate import evaluate_filter
+
+    ds = TpuDataStore()
+    sft = ds.create_schema("mix", "v:Int,*geom:Point")
+    ds.write("mix", {"v": np.arange(10), "geom": (np.zeros(10), np.zeros(10))})
+    batch = ds._store("mix").batch
+    mask = evaluate_filter(In("v", (1, 2, 3, 4, "a")), batch)
+    assert mask.sum() == 4 and mask[1] and mask[4]
+
+
+def test_shapefile_null_shapes(tmp_path):
+    """Null-shape (type 0) records are dropped, not fatal."""
+    import struct
+
+    from geomesa_tpu.io.formats import ShapefileConverter
+    from geomesa_tpu.features.feature_type import parse_spec
+
+    def rec(num, content):
+        return struct.pack(">ii", num, len(content) // 2) + content
+
+    pt = struct.pack("<i dd", 1, 3.0, 4.0)
+    null = struct.pack("<i", 0)
+    body = rec(1, pt) + rec(2, null) + rec(3, struct.pack("<i dd", 1, 5.0, 6.0))
+    header = struct.pack(">i", 9994) + b"\x00" * 20 + struct.pack(
+        ">i", (100 + len(body)) // 2) + struct.pack("<ii", 1000, 1) + b"\x00" * 64
+    path = tmp_path / "t.shp"
+    path.write_bytes(header + body)
+    sft = parse_spec("shp", "*geom:Point")
+    conv = ShapefileConverter(sft, {
+        "type": "shp", "fields": [{"name": "geom", "transform": "$geometry"}]})
+    batch = conv.convert(str(path))
+    assert len(batch) == 2
+    x, y = batch.geom_xy()
+    np.testing.assert_allclose(x, [3.0, 5.0])

@@ -1,0 +1,149 @@
+"""REST layer tests: exercise the WSGI app without sockets."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.audit import InMemoryAuditWriter
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.web import WebApp
+
+MS_2018 = 1514764800000
+
+
+def call(app, method, path, body=None):
+    """Invoke the WSGI app directly; returns (status:int, parsed-or-text)."""
+    raw = json.dumps(body).encode() if body is not None else b""
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = int(status.split()[0])
+        captured["headers"] = dict(headers)
+
+    qs = ""
+    if "?" in path:
+        path, qs = path.split("?", 1)
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": qs,
+        "CONTENT_LENGTH": str(len(raw)),
+        "wsgi.input": io.BytesIO(raw),
+    }
+    chunks = app(environ, start_response)
+    text = b"".join(chunks).decode()
+    ctype = captured["headers"].get("Content-Type", "")
+    parsed = json.loads(text) if "json" in ctype and text else text
+    return captured["status"], parsed
+
+
+@pytest.fixture
+def app():
+    audit = InMemoryAuditWriter()
+    ds = TpuDataStore(audit_writer=audit, user="tester")
+    ds.create_schema("pts", "name:String:index=true,age:Int,"
+                            "dtg:Date,*geom:Point")
+    rng = np.random.default_rng(7)
+    n = 200
+    ds.write("pts", {
+        "name": np.asarray([f"n{i % 5}" for i in range(n)], dtype=object),
+        "age": rng.integers(0, 90, n),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * 86_400_000, n),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(40, 50, n)),
+    })
+    return WebApp(ds, audit_writer=audit)
+
+
+def test_version_and_schemas(app):
+    status, body = call(app, "GET", "/api/version")
+    assert status == 200 and body["framework"] == "geomesa-tpu"
+    status, body = call(app, "GET", "/api/schemas")
+    assert status == 200 and body == ["pts"]
+    status, body = call(app, "GET", "/api/schemas/pts")
+    assert status == 200 and body["dtg"] == "dtg"
+    assert any(a["default"] for a in body["attributes"])
+    status, body = call(app, "GET", "/api/schemas/nope")
+    assert status == 404
+
+
+def test_schema_create_delete(app):
+    status, body = call(app, "POST", "/api/schemas",
+                        {"name": "t2", "spec": "a:Int,*geom:Point"})
+    assert status == 201 and body["name"] == "t2"
+    # duplicate -> 400
+    status, _ = call(app, "POST", "/api/schemas",
+                     {"name": "t2", "spec": "a:Int,*geom:Point"})
+    assert status == 400
+    status, _ = call(app, "DELETE", "/api/schemas/t2")
+    assert status == 204
+    status, body = call(app, "GET", "/api/schemas")
+    assert body == ["pts"]
+
+
+def test_data_query(app):
+    status, body = call(app, "GET", "/api/data/pts?cql=BBOX(geom,-10,40,0,50)")
+    assert status == 200 and body["type"] == "FeatureCollection"
+    assert 0 < len(body["features"]) < 200
+    for f in body["features"]:
+        x, y = f["geometry"]["coordinates"]
+        assert -10 <= x <= 0 and 40 <= y <= 50
+    # csv + max
+    status, text = call(app, "GET", "/api/data/pts?format=csv&max=5")
+    assert status == 200 and len(text.strip().splitlines()) == 6
+    status, _ = call(app, "GET", "/api/data/pts?format=nope")
+    assert status == 400
+    status, _ = call(app, "GET", "/api/data/missing")
+    assert status == 404
+
+
+def test_data_ingest(app):
+    fc = {"type": "FeatureCollection", "features": [
+        {"type": "Feature", "id": f"new{i}",
+         "geometry": {"type": "Point", "coordinates": [100.0 + i, 0.5]},
+         "properties": {"name": "added", "age": 33,
+                        "dtg": MS_2018}}
+        for i in range(3)
+    ]}
+    status, body = call(app, "POST", "/api/data/pts", fc)
+    assert status == 200 and body["ingested"] == 3, body
+    status, got = call(app, "GET", "/api/data/pts?cql=name='added'")
+    assert len(got["features"]) == 3
+    ids = {f["id"] for f in got["features"]}
+    assert ids == {"new0", "new1", "new2"}
+
+
+def test_stats_endpoints(app):
+    status, body = call(app, "GET", "/api/stats/pts/count")
+    assert status == 200 and body["count"] == 200
+    status, body = call(app, "GET",
+                        "/api/stats/pts/count?cql=BBOX(geom,-10,40,0,50)")
+    assert 0 < body["count"] < 200
+    status, body = call(app, "GET", "/api/stats/pts/bounds")
+    b = body["bounds"]
+    assert -10 <= b["minx"] <= b["maxx"] <= 10
+    status, body = call(app, "GET", "/api/stats/pts/minmax?attribute=age")
+    assert 0 <= body["bounds"][0] <= body["bounds"][1] < 90
+    status, body = call(app, "GET",
+                        "/api/stats/pts/histogram?attribute=age&bins=10")
+    assert sum(body["counts"]) == 200
+    status, body = call(app, "GET", "/api/stats/pts/topk?attribute=name")
+    assert status == 200
+    status, _ = call(app, "GET", "/api/stats/pts/minmax")
+    assert status == 400
+
+
+def test_audit_and_metrics(app):
+    call(app, "GET", "/api/data/pts?cql=BBOX(geom,-10,40,0,50)")
+    status, events = call(app, "GET", "/api/audit/pts")
+    assert status == 200 and len(events) >= 1
+    assert events[-1]["user"] == "tester"
+    assert events[-1]["hits"] > 0
+    status, snap = call(app, "GET", "/api/metrics")
+    assert status == 200 and any(k.startswith("web.") for k in snap)
+
+
+def test_unknown_route(app):
+    status, body = call(app, "GET", "/api/nope")
+    assert status == 404
