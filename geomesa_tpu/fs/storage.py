@@ -104,6 +104,22 @@ class _TypeStorage:
                 keep.append(pat)
         return sorted(set(keep))
 
+    def read_partition(self, name: str) -> FeatureBatch | None:
+        """All of one partition's files as a single batch (no filtering) —
+        the per-split read used by the RDD provider."""
+        from ..io.export import from_parquet
+
+        meta = self._load_meta()
+        entries = meta["partitions"].get(name, [])
+        parts = [from_parquet(os.path.join(self.root, name, e["file"]),
+                              self.sft) for e in entries]
+        if not parts:
+            return None
+        out = parts[0]
+        for p in parts[1:]:
+            out = out.concat(p)
+        return out
+
     def query(self, query) -> FeatureBatch:
         from ..io.export import from_parquet
 

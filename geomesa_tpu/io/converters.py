@@ -179,29 +179,11 @@ class GeoJsonConverter(Converter):
             source = source.decode()
         fc = json.loads(source)
         feats = fc.get("features", [])
-        from ..geometry.types import (
-            LineString, MultiLineString, MultiPoint, MultiPolygon, Point, Polygon,
-        )
+        from ..geometry.geojson import geojson_to_geometry
 
-        def to_geom(g):
-            t = g["type"]
-            c = g["coordinates"]
-            if t == "Point":
-                return Point(c[0], c[1])
-            if t == "LineString":
-                return LineString(c)
-            if t == "Polygon":
-                return Polygon(c[0], tuple(c[1:]))
-            if t == "MultiPoint":
-                return MultiPoint(c)
-            if t == "MultiLineString":
-                return MultiLineString(tuple(LineString(l) for l in c))
-            if t == "MultiPolygon":
-                return MultiPolygon(tuple(Polygon(p[0], tuple(p[1:])) for p in c))
-            raise ValueError(f"unsupported GeoJSON geometry {t}")
-
-        cols: dict = {"geometry": np.asarray([to_geom(f["geometry"]) for f in feats],
-                                             dtype=object)}
+        cols: dict = {"geometry": np.asarray(
+            [geojson_to_geometry(f["geometry"]) for f in feats],
+            dtype=object)}
         keys = set()
         for f in feats:
             keys.update((f.get("properties") or {}).keys())

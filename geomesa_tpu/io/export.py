@@ -173,26 +173,8 @@ def to_geojson(batch: FeatureBatch) -> str:
 
 
 def _geom_to_geojson(g):
-    from ..geometry.types import (
-        LineString, MultiLineString, MultiPoint, MultiPolygon, Point, Polygon,
-    )
-    if isinstance(g, Point):
-        return {"type": "Point", "coordinates": [g.x, g.y]}
-    if isinstance(g, LineString):
-        return {"type": "LineString", "coordinates": g.coords.tolist()}
-    if isinstance(g, Polygon):
-        return {"type": "Polygon",
-                "coordinates": [g.shell.tolist()] + [h.tolist() for h in g.holes]}
-    if isinstance(g, MultiPoint):
-        return {"type": "MultiPoint", "coordinates": g.coords.tolist()}
-    if isinstance(g, MultiLineString):
-        return {"type": "MultiLineString",
-                "coordinates": [l.coords.tolist() for l in g.lines]}
-    if isinstance(g, MultiPolygon):
-        return {"type": "MultiPolygon",
-                "coordinates": [[p.shell.tolist()] + [h.tolist() for h in p.holes]
-                                for p in g.polygons]}
-    raise ValueError(g)
+    from ..geometry.geojson import geometry_to_geojson
+    return geometry_to_geojson(g)
 
 
 def to_gml(batch: FeatureBatch, *, srs: str = "urn:ogc:def:crs:EPSG::4326") -> str:

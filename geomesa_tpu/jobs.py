@@ -1,0 +1,84 @@
+"""Bulk ingest/export jobs: the geomesa-jobs + tools/ingest analog.
+
+The reference runs converter ingest either locally (thread pool over
+files — tools/ingest/LocalConverterIngest.scala) or distributed
+(MapReduce with ConverterInputFormat mappers writing through
+GeoMesaOutputFormat — tools/ingest/DistributedConverterIngest.scala,
+jobs/mapreduce/GeoMesaOutputFormat.scala).  Here "mappers" are a thread
+pool parsing files into columnar batches concurrently (host-bound
+parse), and the "output format" is a single writer thread appending to
+the store — keeping the store's append path single-writer the way a
+BatchWriter serializes mutations.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from dataclasses import dataclass, field
+
+__all__ = ["IngestJob", "IngestResult", "run_ingest"]
+
+
+@dataclass
+class IngestResult:
+    """Counters the reference reports per ingest (EvaluationContext
+    metrics + job counters)."""
+
+    ingested: int = 0
+    failed: int = 0
+    files: int = 0
+    errors: list = field(default_factory=list)
+
+
+@dataclass
+class IngestJob:
+    """Converter ingest over many files with parallel parse.
+
+    ``store`` — TpuDataStore (or anything with ``write(name, batch)``);
+    ``converter_config`` — converter definition dict;
+    ``workers`` — parse parallelism (the mapper count).
+    """
+
+    store: object
+    type_name: str
+    converter_config: dict
+    workers: int = 4
+
+    def run(self, paths: list[str]) -> IngestResult:
+        from .io.converters import EvaluationContext, converter_from_config
+
+        sft = self.store.get_schema(self.type_name)
+        result = IngestResult()
+
+        def parse(path: str):
+            conv = converter_from_config(sft, self.converter_config)
+            ec = EvaluationContext()
+            if conv.wants_path:
+                batch = conv.convert(path, ec)
+            else:
+                with open(path, "rb") as f:
+                    batch = conv.convert(f.read(), ec)
+            return batch, ec
+
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+            futures = {pool.submit(parse, p): p for p in paths}
+            for fut in as_completed(futures):
+                path = futures[fut]
+                result.files += 1
+                try:
+                    batch, ec = fut.result()
+                except Exception as e:  # noqa: BLE001 — count, keep going
+                    result.errors.append(f"{path}: {e!r}")
+                    result.failed += 1
+                    continue
+                result.failed += ec.failure
+                result.errors.extend(ec.errors)
+                if len(batch):
+                    # single-writer append (BatchWriter role)
+                    result.ingested += self.store.write(self.type_name, batch)
+        return result
+
+
+def run_ingest(store, type_name: str, converter_config: dict,
+               paths: list[str], workers: int = 4) -> IngestResult:
+    return IngestJob(store, type_name, converter_config, workers).run(paths)

@@ -1,0 +1,155 @@
+"""Simplified typed index API, no FeatureBatch/schema model required.
+
+The analog of the reference's geomesa-native-api ("native" = plain-Java,
+not native code): GeoMesaIndex<T>
+(geomesa-native-api/.../api/GeoMesaIndex.java:25-93 —
+insert/update/delete/query of arbitrary values with a geometry + date),
+GeoMesaQuery's builder (GeoMesaQuery.java:29-141: within / before /
+after / during / allTime + extra filter), and the BaseBigTableIndex
+entry point.  Values are serialized with a pluggable codec
+(ValueSerializer SPI analog); queries run through the full planner.
+"""
+
+from __future__ import annotations
+
+import pickle
+import uuid
+from dataclasses import dataclass
+
+import numpy as np
+
+from .datastore import TpuDataStore
+from .features.feature_type import parse_spec
+from .filters import ast as fast
+
+__all__ = ["NativeIndex", "NativeQuery", "PickleSerializer"]
+
+
+class PickleSerializer:
+    """Default value codec (the reference uses Gson/Kryo serializers)."""
+
+    def to_bytes(self, value) -> bytes:
+        return pickle.dumps(value)
+
+    def from_bytes(self, data: bytes):
+        return pickle.loads(data)
+
+
+@dataclass
+class NativeQuery:
+    """GeoMesaQuery builder analog: bbox + time interval + extra filter."""
+
+    xmin: float | None = None
+    ymin: float | None = None
+    xmax: float | None = None
+    ymax: float | None = None
+    start_ms: int | None = None
+    end_ms: int | None = None
+    extra: fast.Filter | None = None
+
+    @classmethod
+    def include(cls) -> "NativeQuery":
+        return cls()
+
+    def within(self, lx, ly, ux, uy) -> "NativeQuery":
+        self.xmin, self.ymin, self.xmax, self.ymax = lx, ly, ux, uy
+        return self
+
+    def before(self, end_ms: int) -> "NativeQuery":
+        self.end_ms = end_ms
+        return self
+
+    def after(self, start_ms: int) -> "NativeQuery":
+        self.start_ms = start_ms
+        return self
+
+    def during(self, start_ms: int, end_ms: int) -> "NativeQuery":
+        self.start_ms, self.end_ms = start_ms, end_ms
+        return self
+
+    def all_time(self) -> "NativeQuery":
+        self.start_ms = self.end_ms = None
+        return self
+
+    def filter(self, f: fast.Filter) -> "NativeQuery":
+        self.extra = f
+        return self
+
+    def to_filter(self, geom: str = "geom", dtg: str = "dtg") -> fast.Filter:
+        parts = []
+        if self.xmin is not None:
+            parts.append(fast.BBox(geom, self.xmin, self.ymin,
+                                   self.xmax, self.ymax))
+        if self.start_ms is not None or self.end_ms is not None:
+            parts.append(fast.During(dtg, self.start_ms, self.end_ms))
+        if self.extra is not None:
+            parts.append(self.extra)
+        if not parts:
+            return fast.Include
+        return parts[0] if len(parts) == 1 else fast.And(tuple(parts))
+
+
+class NativeIndex:
+    """Spatial index of arbitrary Python values (GeoMesaIndex<T> analog).
+
+    Supported indexes: z3 (point + time), z2 (point), xz2/xz3 for
+    non-point geometries, id — i.e. the same families as the reference's
+    IndexType enum, chosen by the planner.
+    """
+
+    SUPPORTED_INDEXES = ("z2", "z3", "xz2", "xz3", "id")
+
+    def __init__(self, name: str = "native",
+                 serializer: PickleSerializer | None = None,
+                 store: TpuDataStore | None = None, points: bool = True):
+        self.name = name
+        self.serializer = serializer or PickleSerializer()
+        self.store = store if store is not None else TpuDataStore()
+        geom_type = "Point" if points else "Geometry"
+        if name not in self.store.type_names:
+            self.store.create_schema(parse_spec(
+                name, f"payload:Bytes,dtg:Date,*geom:{geom_type}"))
+        self._values: dict[str, object] = {}
+
+    def supported_indexes(self) -> tuple[str, ...]:
+        return self.SUPPORTED_INDEXES
+
+    # -- writes ------------------------------------------------------------
+    def insert(self, value, geometry, dtg_ms: int | None = None,
+               fid: str | None = None) -> str:
+        fid = fid or uuid.uuid4().hex
+        payload = self.serializer.to_bytes(value)
+        self.store.write(self.name, {
+            "payload": np.asarray([payload], dtype=object),
+            "dtg": np.asarray([int(dtg_ms or 0)], dtype=np.int64),
+            "geom": ([geometry] if not isinstance(geometry, tuple)
+                     else (np.asarray([geometry[0]]), np.asarray([geometry[1]]))),
+        }, ids=np.asarray([fid], dtype=object))
+        return fid
+
+    def update(self, fid: str, value, geometry, dtg_ms: int | None = None):
+        self.store.delete(self.name, [fid])
+        self.insert(value, geometry, dtg_ms, fid=fid)
+
+    def delete(self, fid: str):
+        self.store.delete(self.name, [fid])
+
+    # -- reads -------------------------------------------------------------
+    def query(self, query: NativeQuery | None = None) -> list:
+        """Returns deserialized values matching the query."""
+        f = (query or NativeQuery.include()).to_filter()
+        batch = self.store.query(self.name, f)
+        return [self.serializer.from_bytes(p)
+                for p in batch.column("payload")]
+
+    def query_with_ids(self, query: NativeQuery | None = None) -> list:
+        f = (query or NativeQuery.include()).to_filter()
+        batch = self.store.query(self.name, f)
+        return [(str(i), self.serializer.from_bytes(p))
+                for i, p in zip(batch.ids, batch.column("payload"))]
+
+    def flush(self):
+        pass  # writes are immediately visible
+
+    def close(self):
+        pass

@@ -15,9 +15,15 @@ needed — the collective compiles into the program.
 """
 
 from .mesh import device_mesh, shard_batch
+from .rdd import (
+    ConverterRDDProvider, FileSystemRDDProvider, SpatialRDD,
+    SpatialRDDProvider, TpuStoreRDDProvider, save_rdd, spatial_rdd,
+)
 from .scan import ShardedZ3Index, sharded_density, sharded_range_count
 
 __all__ = [
     "device_mesh", "shard_batch", "ShardedZ3Index", "sharded_density",
-    "sharded_range_count",
+    "sharded_range_count", "SpatialRDD", "SpatialRDDProvider",
+    "TpuStoreRDDProvider", "ConverterRDDProvider", "FileSystemRDDProvider",
+    "spatial_rdd", "save_rdd",
 ]

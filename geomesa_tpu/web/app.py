@@ -31,128 +31,105 @@ servlet-level ``AggregatedMetricsFilter``).
 from __future__ import annotations
 
 import json
-import re
 import time
-import traceback
-from urllib.parse import parse_qs
+
+import numpy as np
 
 from ..metrics import registry as _metrics
+from .wsgi import HttpError, Router, float_param, int_param, read_json_body
 
 __all__ = ["WebApp", "serve"]
-
-
-class _HttpError(Exception):
-    def __init__(self, status: int, message: str):
-        super().__init__(message)
-        self.status = status
-        self.message = message
-
-
-_STATUS = {200: "200 OK", 201: "201 Created", 204: "204 No Content",
-           400: "400 Bad Request", 404: "404 Not Found",
-           405: "405 Method Not Allowed", 500: "500 Internal Server Error"}
 
 
 class WebApp:
     """WSGI application exposing a TpuDataStore over HTTP."""
 
-    def __init__(self, store, audit_writer=None):
+    def __init__(self, store, audit_writer=None, geojson=None):
         self.store = store
         # prefer an explicitly-passed audit writer, else the store's
         self.audit = audit_writer or getattr(store, "_audit_writer", None)
-        self._routes = [
-            (re.compile(r"^/api/version$"), self._version),
-            (re.compile(r"^/api/schemas$"), self._schemas),
-            (re.compile(r"^/api/schemas/([^/]+)$"), self._schema),
-            (re.compile(r"^/api/data/([^/]+)$"), self._data),
-            (re.compile(r"^/api/stats/([^/]+)/([a-z]+)$"), self._stats),
-            (re.compile(r"^/api/audit/([^/]+)$"), self._audit_events),
-            (re.compile(r"^/api/metrics$"), self._metrics_dump),
-        ]
+        # optional schemaless GeoJSON API mounted under /geojson/
+        self.geojson_app = None
+        if geojson is not None:
+            from ..geojson.servlet import GeoJsonApp
+            self.geojson_app = (geojson if isinstance(geojson, GeoJsonApp)
+                                else GeoJsonApp(geojson))
+        self._router = Router([
+            (r"^/api/version$", self._version),
+            (r"^/api/schemas$", self._schemas),
+            (r"^/api/schemas/([^/]+)$", self._schema),
+            (r"^/api/data/([^/]+)$", self._data),
+            (r"^/api/stats/([^/]+)/([a-z]+)$", self._stats),
+            (r"^/api/audit/([^/]+)$", self._audit_events),
+            (r"^/api/metrics$", self._metrics_dump),
+        ])
 
     # -- WSGI entry point --------------------------------------------------
     def __call__(self, environ, start_response):
-        path = environ.get("PATH_INFO", "/")
-        method = environ.get("REQUEST_METHOD", "GET")
-        params = {k: v[0] for k, v in
-                  parse_qs(environ.get("QUERY_STRING", "")).items()}
+        if (self.geojson_app is not None
+                and environ.get("PATH_INFO", "/").startswith("/geojson/")):
+            return self.geojson_app(environ, start_response)
         t0 = time.perf_counter()
-        try:
-            for pattern, handler in self._routes:
-                m = pattern.match(path)
-                if m:
-                    status, body, ctype = handler(
-                        method, params, environ, *m.groups())
-                    break
-            else:
-                raise _HttpError(404, f"no such route: {path}")
-        except _HttpError as e:
-            status = e.status
-            body = json.dumps({"error": e.message})
-            ctype = "application/json"
-        except Exception as e:  # noqa: BLE001 — surface as a 500
-            status = 500
-            body = json.dumps({"error": f"{type(e).__name__}: {e}",
-                               "trace": traceback.format_exc(limit=5)})
-            ctype = "application/json"
-        _metrics.counter(f"web.{status}").inc()
-        _metrics.timer("web.request_ms").update(
-            (time.perf_counter() - t0) * 1e3)
-        payload = body.encode() if isinstance(body, str) else body
-        start_response(_STATUS.get(status, f"{status} Error"), [
-            ("Content-Type", ctype),
-            ("Content-Length", str(len(payload)))])
-        return [payload]
+
+        def on_metrics(status: int):
+            _metrics.counter(f"web.{status}").inc()
+            _metrics.timer("web.request_ms").update(
+                (time.perf_counter() - t0) * 1e3)
+
+        return self._router.dispatch(environ, start_response, on_metrics)
 
     # -- helpers -----------------------------------------------------------
-    @staticmethod
-    def _read_json(environ) -> dict:
+    def _sft(self, name: str):
         try:
-            n = int(environ.get("CONTENT_LENGTH") or 0)
-            raw = environ["wsgi.input"].read(n) if n else b"{}"
-            return json.loads(raw)
-        except (ValueError, KeyError) as e:
-            raise _HttpError(400, f"bad request body: {e}")
+            return self.store.get_schema(name)
+        except KeyError:
+            raise HttpError(404, f"no such schema: {name!r}")
 
     def _query(self, name: str, params: dict):
         from ..planning.planner import Query
+        self._sft(name)
         cql = params.get("cql", "INCLUDE")
         kw = {}
-        if "max" in params:
-            kw["max_features"] = int(params["max"])
-        try:
-            return self.store.query(name, Query.of(cql, **kw))
-        except KeyError:
-            raise _HttpError(404, f"no such schema: {name!r}")
+        max_features = int_param(params, "max")
+        if max_features is not None:
+            kw["max_features"] = max_features
+        return self.store.query(name, Query.of(cql, **kw))
+
+    def _visible_batch(self, name: str):
+        """The schema's batch restricted to rows this caller may see
+        (mirrors the datastore's _restricted_mask so no stats route can
+        leak hidden rows)."""
+        store = self.store._store(name)
+        if store.batch is None or len(store.batch) == 0:
+            return None
+        mask = self.store._restricted_mask(store)
+        if mask is None:
+            return store.batch
+        return store.batch.take(np.flatnonzero(mask))
 
     # -- handlers ----------------------------------------------------------
     def _version(self, method, params, environ):
         from .. import __version__
-        return 200, json.dumps({"version": __version__,
-                                "framework": "geomesa-tpu"}), "application/json"
+        return 200, {"version": __version__, "framework": "geomesa-tpu"}
 
     def _schemas(self, method, params, environ):
         if method == "GET":
-            return 200, json.dumps(self.store.type_names), "application/json"
+            return 200, self.store.type_names
         if method == "POST":
-            body = self._read_json(environ)
+            body = read_json_body(environ)
             if "name" not in body or "spec" not in body:
-                raise _HttpError(400, "need 'name' and 'spec'")
+                raise HttpError(400, "need 'name' and 'spec'")
             try:
                 sft = self.store.create_schema(body["name"], body["spec"])
             except ValueError as e:
-                raise _HttpError(400, str(e))
-            return 201, json.dumps({"name": sft.name,
-                                    "spec": sft.spec_string()}), "application/json"
-        raise _HttpError(405, method)
+                raise HttpError(400, str(e))
+            return 201, {"name": sft.name, "spec": sft.spec_string()}
+        raise HttpError(405, method)
 
     def _schema(self, method, params, environ, name):
-        try:
-            sft = self.store.get_schema(name)
-        except KeyError:
-            raise _HttpError(404, f"no such schema: {name!r}")
+        sft = self._sft(name)
         if method == "GET":
-            return 200, json.dumps({
+            return 200, {
                 "name": sft.name,
                 "spec": sft.spec_string(),
                 "attributes": [{"name": a.name, "type": a.type,
@@ -160,11 +137,11 @@ class WebApp:
                                 "default": a.name == sft.default_geom}
                                for a in sft.attributes],
                 "dtg": sft.dtg_field,
-            }), "application/json"
+            }
         if method == "DELETE":
             self.store.remove_schema(name)
-            return 204, "", "application/json"
-        raise _HttpError(405, method)
+            return 204, None
+        raise HttpError(405, method)
 
     def _data(self, method, params, environ, name):
         if method == "GET":
@@ -177,16 +154,13 @@ class WebApp:
                 return 200, export.to_csv(batch), "text/csv"
             if fmt == "gml":
                 return 200, export.to_gml(batch), "application/gml+xml"
-            raise _HttpError(400, f"unknown format: {fmt!r}")
+            raise HttpError(400, f"unknown format: {fmt!r}")
         if method == "POST":
-            body = self._read_json(environ)
+            body = read_json_body(environ)
             feats = body.get("features")
             if feats is None:
-                raise _HttpError(400, "expected GeoJSON FeatureCollection")
-            try:
-                sft = self.store.get_schema(name)
-            except KeyError:
-                raise _HttpError(404, f"no such schema: {name!r}")
+                raise HttpError(400, "expected GeoJSON FeatureCollection")
+            sft = self._sft(name)
             from ..io.converters import EvaluationContext, converter_from_config
             fields = [{"name": a.name,
                        "transform": ("$geometry" if a.is_geometry
@@ -199,76 +173,71 @@ class WebApp:
             ec = EvaluationContext()
             batch = conv.convert(json.dumps(body), ec)
             n = self.store.write(name, batch) if len(batch) else 0
-            return 200, json.dumps({"ingested": n, "errors": ec.errors}), \
-                "application/json"
-        raise _HttpError(405, method)
+            return 200, {"ingested": n, "errors": ec.errors}
+        raise HttpError(405, method)
 
     def _stats(self, method, params, environ, name, which):
         if method != "GET":
-            raise _HttpError(405, method)
-        try:
-            self.store.get_schema(name)
-        except KeyError:
-            raise _HttpError(404, f"no such schema: {name!r}")
+            raise HttpError(405, method)
+        sft = self._sft(name)
         if which == "count":
             cql = params.get("cql")
-            return 200, json.dumps(
-                {"count": self.store.get_count(name, cql)}), "application/json"
+            return 200, {"count": self.store.get_count(name, cql)}
         if which == "bounds":
             env = self.store.get_bounds(name)
             body = (None if env is None else
                     {"minx": env.xmin, "miny": env.ymin,
                      "maxx": env.xmax, "maxy": env.ymax})
-            return 200, json.dumps({"bounds": body}), "application/json"
+            return 200, {"bounds": body}
         attr = params.get("attribute")
-        if which in ("minmax", "histogram", "topk") and not attr:
-            raise _HttpError(400, "need ?attribute=")
+        if which in ("minmax", "histogram", "topk"):
+            if not attr:
+                raise HttpError(400, "need ?attribute=")
+            if attr not in sft.attribute_names:
+                raise HttpError(404, f"no such attribute: {attr!r}")
         if which == "minmax":
             mm = self.store.get_attribute_bounds(name, attr)
-            return 200, json.dumps(
-                {"attribute": attr,
-                 "bounds": None if mm is None else
-                 [_jsonable(mm[0]), _jsonable(mm[1])]}), "application/json"
+            return 200, {"attribute": attr,
+                         "bounds": None if mm is None else
+                         [_jsonable(mm[0]), _jsonable(mm[1])]}
         if which == "histogram":
             from ..stats.stat import Histogram
-            bins = int(params.get("bins", 20))
-            store = self.store._store(name)
-            if store.batch is None or len(store.batch) == 0:
-                raise _HttpError(404, "no data")
-            col = store.batch.column(attr).astype(float)
+            bins = int_param(params, "bins", 20)
+            batch = self._visible_batch(name)
+            if batch is None or len(batch) == 0:
+                raise HttpError(404, "no data")
+            try:
+                col = batch.column(attr).astype(float)
+            except (ValueError, TypeError):
+                raise HttpError(400, f"attribute {attr!r} is not numeric")
             h = Histogram(attr, bins=bins,
                           lo=float(col.min()), hi=float(col.max()))
-            h.observe(store.batch)
-            return 200, json.dumps(h.to_json()), "application/json"
+            h.observe(batch)
+            return 200, h.to_json()
         if which == "topk":
             s = self.store.stat(name, f"{attr}_topk")
             if s is None:
-                raise _HttpError(404, f"no topk stat for {attr!r}")
-            return 200, json.dumps(s.to_json()), "application/json"
-        raise _HttpError(404, f"unknown stat: {which!r}")
+                raise HttpError(404, f"no topk stat for {attr!r}")
+            return 200, s.to_json()
+        raise HttpError(404, f"unknown stat: {which!r}")
 
     def _audit_events(self, method, params, environ, name):
         if method != "GET":
-            raise _HttpError(405, method)
+            raise HttpError(405, method)
         if self.audit is None or not hasattr(self.audit, "query_events"):
-            raise _HttpError(404, "no queryable audit writer configured")
-        since = float(params["since"]) if "since" in params else None
+            raise HttpError(404, "no queryable audit writer configured")
+        since = float_param(params, "since")
         events = self.audit.query_events(type_name=name, since=since)
-        return 200, json.dumps(
-            [json.loads(e.to_json()) for e in events]), "application/json"
+        return 200, [json.loads(e.to_json()) for e in events]
 
     def _metrics_dump(self, method, params, environ):
-        return 200, json.dumps(_metrics.snapshot()), "application/json"
+        return 200, _metrics.snapshot()
 
 
 def _jsonable(v):
     """Numpy scalars / datetimes → JSON-safe values."""
-    try:
-        import numpy as np
-        if isinstance(v, np.generic):
-            return v.item()
-    except ImportError:  # pragma: no cover
-        pass
+    if isinstance(v, np.generic):
+        return v.item()
     return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)
 
 

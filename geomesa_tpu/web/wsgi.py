@@ -1,0 +1,99 @@
+"""Shared WSGI plumbing for the REST apps (web data/stats app, GeoJSON
+servlet): status lines, regex-route dispatch, param/body parsing."""
+
+from __future__ import annotations
+
+import json
+import re
+from urllib.parse import parse_qs, unquote
+
+__all__ = ["HttpError", "STATUS", "read_json_body", "Router"]
+
+STATUS = {200: "200 OK", 201: "201 Created", 204: "204 No Content",
+          400: "400 Bad Request", 404: "404 Not Found",
+          405: "405 Method Not Allowed", 500: "500 Internal Server Error"}
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def read_json_body(environ) -> dict:
+    try:
+        n = int(environ.get("CONTENT_LENGTH") or 0)
+        raw = environ["wsgi.input"].read(n) if n else b""
+        if not raw:
+            raise ValueError("empty request body")
+        return json.loads(raw)
+    except (ValueError, KeyError) as e:
+        raise HttpError(400, f"bad request body: {e}")
+
+
+def int_param(params: dict, name: str, default=None) -> int | None:
+    if name not in params:
+        return default
+    try:
+        return int(params[name])
+    except ValueError:
+        raise HttpError(400, f"bad {name!r} parameter: {params[name]!r}")
+
+
+def float_param(params: dict, name: str, default=None) -> float | None:
+    if name not in params:
+        return default
+    try:
+        return float(params[name])
+    except ValueError:
+        raise HttpError(400, f"bad {name!r} parameter: {params[name]!r}")
+
+
+class Router:
+    """Regex-route table with shared dispatch/error handling.
+
+    Handlers receive ``(method, params, environ, *groups)`` and return
+    ``(status, body, content_type)`` — body str/bytes/None, or any
+    JSON-serializable object when content_type is omitted.
+    """
+
+    def __init__(self, routes):
+        self.routes = [(re.compile(p), h) for p, h in routes]
+
+    def dispatch(self, environ, start_response, on_metrics=None):
+        path = environ.get("PATH_INFO", "/")
+        method = environ.get("REQUEST_METHOD", "GET")
+        params = {k: v[0] for k, v in
+                  parse_qs(environ.get("QUERY_STRING", "")).items()}
+        ctype = "application/json"
+        try:
+            for pattern, handler in self.routes:
+                m = pattern.match(path)
+                if m:
+                    out = handler(method, params, environ,
+                                  *[unquote(g) for g in m.groups()])
+                    status, body = out[0], out[1]
+                    if len(out) > 2:
+                        ctype = out[2]
+                    break
+            else:
+                raise HttpError(404, f"no such route: {path}")
+        except HttpError as e:
+            status, body = e.status, {"error": e.message}
+        except (ValueError,) as e:
+            status, body = 400, {"error": str(e)}
+        except KeyError as e:
+            status, body = 404, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 — no internals in the response
+            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        if not isinstance(body, (str, bytes, type(None))):
+            body = json.dumps(body)
+        payload = (body.encode() if isinstance(body, str)
+                   else (body or b""))
+        if on_metrics is not None:
+            on_metrics(status)
+        start_response(STATUS.get(status, f"{status} Error"), [
+            ("Content-Type", ctype),
+            ("Content-Length", str(len(payload)))])
+        return [payload]

@@ -1,0 +1,98 @@
+"""Blob store + native (simplified typed) API tests."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.blob import GeoIndexedBlobStore, wkt_handler
+from geomesa_tpu.geometry.types import Point
+from geomesa_tpu.native_api import NativeIndex, NativeQuery
+
+MS_2018 = 1514764800000
+DAY = 86_400_000
+
+
+class TestBlobStore:
+    def test_put_get_roundtrip(self):
+        bs = GeoIndexedBlobStore()
+        bid = bs.put(b"payload-bytes", geometry=Point(10.0, 20.0),
+                     dtg=MS_2018, filename="a.bin")
+        data, filename = bs.get(bid)
+        assert data == b"payload-bytes" and filename == "a.bin"
+        assert bs.get("missing") is None
+
+    def test_spatial_query_and_delete(self):
+        bs = GeoIndexedBlobStore()
+        east = bs.put(b"east", geometry=Point(10, 0), dtg=MS_2018)
+        west = bs.put(b"west", geometry=Point(-10, 0), dtg=MS_2018)
+        ids = bs.query_ids("BBOX(geom, 5, -5, 15, 5)")
+        assert ids == [east]
+        bs.delete_blob(east)
+        assert bs.get(east) is None
+        assert bs.query_ids() == [west]
+
+    def test_wkt_handler(self):
+        bs = GeoIndexedBlobStore()
+        bid = bs.put(b"x", handler=wkt_handler,
+                     params={"wkt": "POINT (3 4)"}, dtg=MS_2018)
+        assert bs.query_ids("BBOX(geom, 2, 3, 4, 5)") == [bid]
+        with pytest.raises(ValueError):
+            bs.put(b"nogeom", handler=wkt_handler, params={})
+
+    def test_file_backed(self, tmp_path):
+        bs = GeoIndexedBlobStore(blob_dir=str(tmp_path / "blobs"))
+        bid = bs.put(b"on-disk", geometry=Point(0, 0), filename="f.txt")
+        data, name = bs.get(bid)
+        assert data == b"on-disk" and name == "f.txt"
+        bs.delete_blob(bid)
+        assert bs.get(bid) is None
+
+    def test_delete_blob_store(self):
+        bs = GeoIndexedBlobStore()
+        bs.put(b"a", geometry=Point(0, 0))
+        bs.put(b"b", geometry=Point(1, 1))
+        bs.delete_blob_store()
+        assert "blob" not in bs.store.type_names
+
+
+class TestNativeIndex:
+    def test_insert_query_typed_values(self):
+        idx = NativeIndex("vals")
+        idx.insert({"k": 1}, Point(10, 10), MS_2018)
+        idx.insert({"k": 2}, Point(20, 20), MS_2018 + DAY)
+        idx.insert([1, 2, 3], Point(-10, -10), MS_2018 + 2 * DAY)
+        got = idx.query(NativeQuery().within(5, 5, 25, 25))
+        assert sorted(v["k"] for v in got) == [1, 2]
+        assert idx.query(NativeQuery.include()) and len(idx.query()) == 3
+
+    def test_temporal_builder(self):
+        idx = NativeIndex("times")
+        a = idx.insert("early", Point(0, 0), MS_2018)
+        idx.insert("late", Point(0, 0), MS_2018 + 10 * DAY)
+        got = idx.query(NativeQuery().within(-1, -1, 1, 1)
+                        .during(MS_2018 - DAY, MS_2018 + DAY))
+        assert got == ["early"]
+        got = idx.query(NativeQuery().after(MS_2018 + 5 * DAY))
+        assert got == ["late"]
+        got = idx.query(NativeQuery().before(MS_2018 + 5 * DAY))
+        assert got == ["early"]
+        with_ids = idx.query_with_ids(NativeQuery().before(MS_2018 + DAY))
+        assert with_ids == [(a, "early")]
+
+    def test_update_delete(self):
+        idx = NativeIndex("ud")
+        fid = idx.insert("v1", Point(1, 1), MS_2018)
+        idx.update(fid, "v2", Point(1, 1), MS_2018)
+        assert idx.query() == ["v2"]
+        idx.delete(fid)
+        assert idx.query() == []
+
+    def test_non_point_geometries(self):
+        from geomesa_tpu.geometry.types import Polygon
+        idx = NativeIndex("polys", points=False)
+        idx.insert("square", Polygon([(0, 0), (4, 0), (4, 4), (0, 4), (0, 0)]))
+        idx.insert("far", Point(50, 50))
+        got = idx.query(NativeQuery().within(1, 1, 2, 2))
+        assert got == ["square"]
+
+    def test_supported_indexes(self):
+        assert "z3" in NativeIndex("s").supported_indexes()

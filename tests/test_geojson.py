@@ -1,0 +1,201 @@
+"""GeoJSON API tests (reference: GeoJsonQueryTest / GeoJsonGtIndexTest /
+GeoJsonServletTest behaviors)."""
+
+import json
+
+import pytest
+
+from geomesa_tpu.geojson import GeoJsonApp, GeoJsonIndex
+from geomesa_tpu.geojson.query import json_path_get
+
+
+def feat(fid, x, y, props=None, geom=None):
+    return {"type": "Feature", "id": fid,
+            "geometry": geom or {"type": "Point", "coordinates": [x, y]},
+            "properties": props or {}}
+
+
+@pytest.fixture
+def idx():
+    gj = GeoJsonIndex()
+    gj.create_index("test", dtg_path="$.properties.dtg", points=True)
+    gj.add("test", {"type": "FeatureCollection", "features": [
+        feat("0", 30, 10, {"name": "n0", "score": 1,
+                           "dtg": "2018-01-01T00:00:00Z"}),
+        feat("1", 31, 10, {"name": "n1", "score": 5,
+                           "dtg": "2018-01-02T00:00:00Z"}),
+        feat("2", 32, 10, {"name": "n2", "score": 9,
+                           "dtg": "2018-01-03T00:00:00Z",
+                           "nested": {"tag": "x"}}),
+    ]})
+    return gj
+
+
+def test_json_path_get():
+    d = {"properties": {"a": {"b": [1, 2, {"c": 7}]}}, "id": "z"}
+    assert json_path_get(d, "$.id") == "z"
+    assert json_path_get(d, "a.b[2].c") == 7
+    assert json_path_get(d, "$.properties.a.b[0]") == 1
+    assert json_path_get(d, "missing") is None
+
+
+def test_add_get_delete(idx):
+    assert idx.get("test", "1")[0]["properties"]["name"] == "n1"
+    assert idx.get("test", ["0", "2"])[0]["id"] == "0"
+    assert idx.delete("test", "1") == 1
+    assert idx.get("test", "1") == []
+    assert len(idx.query("test", "{}")) == 2
+
+
+def test_add_assigns_and_rejects_dup_ids(idx):
+    ids = idx.add("test", feat("99", 0, 0, {"dtg": 0}))
+    assert ids == ["99"]
+    with pytest.raises(ValueError):
+        idx.add("test", feat("99", 0, 0, {"dtg": 0}))
+
+
+def test_query_equality_and_compare(idx):
+    assert [f["id"] for f in idx.query("test", '{"name": "n1"}')] == ["1"]
+    assert [f["id"] for f in
+            idx.query("test", '{"score": {"$gte": 5}}')] == ["1", "2"]
+    assert [f["id"] for f in
+            idx.query("test", '{"score": {"$lt": 5}}')] == ["0"]
+    # implicit AND of multiple keys
+    assert [f["id"] for f in
+            idx.query("test", '{"score": {"$gt": 0}, "name": "n2"}')] == ["2"]
+    # json-path equality from document root
+    assert [f["id"] for f in
+            idx.query("test", '{"$.properties.nested.tag": "x"}')] == ["2"]
+
+
+def test_query_spatial(idx):
+    q = '{"geometry": {"$bbox": [30.5, 9, 32.5, 11]}}'
+    assert [f["id"] for f in idx.query("test", q)] == ["1", "2"]
+    q = ('{"geometry": {"$intersects": {"$geometry": '
+         '{"type": "Point", "coordinates": [30, 10]}}}}')
+    assert [f["id"] for f in idx.query("test", q)] == ["0"]
+    q = ('{"geometry": {"$within": {"$geometry": {"type": "Polygon", '
+         '"coordinates": [[[29,9],[31.5,9],[31.5,11],[29,11],[29,9]]]}}}}')
+    assert [f["id"] for f in idx.query("test", q)] == ["0", "1"]
+    q = ('{"geometry": {"$dwithin": {"$geometry": '
+         '{"type": "Point", "coordinates": [30, 10]}, '
+         '"$dist": 120, "$unit": "kilometers"}}}')
+    assert [f["id"] for f in idx.query("test", q)] == ["0", "1"]
+
+
+def test_query_or_and_combined(idx):
+    q = '{"$or": [{"name": "n0"}, {"name": "n2"}]}'
+    assert [f["id"] for f in idx.query("test", q)] == ["0", "2"]
+    q = ('{"$or": [{"geometry": {"$bbox": [31.5, 9, 33, 11]}}, '
+         '{"score": {"$lt": 2}}]}')
+    assert [f["id"] for f in idx.query("test", q)] == ["0", "2"]
+
+
+def test_query_transform(idx):
+    out = idx.query("test", '{"score": {"$gt": 4}}',
+                    transform={"n": "name", "fid": "$.id"})
+    assert out == [{"n": "n1", "fid": "1"}, {"n": "n2", "fid": "2"}]
+
+
+def test_update_via_id_path():
+    gj = GeoJsonIndex()
+    gj.create_index("u", id_path="$.properties.pk")
+    gj.add("u", feat(None, 1, 1, {"pk": "a", "v": 1}))
+    gj.update("u", feat(None, 2, 2, {"pk": "a", "v": 2}))
+    assert gj.get("u", "a")[0]["properties"]["v"] == 2
+    with pytest.raises(KeyError):
+        gj.update("u", feat(None, 3, 3, {"pk": "nope"}))
+
+
+def test_non_point_extents_index():
+    gj = GeoJsonIndex()
+    gj.create_index("polys")
+    poly = {"type": "Polygon",
+            "coordinates": [[[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]]]}
+    gj.add("polys", feat("p", 0, 0, {}, geom=poly))
+    gj.add("polys", feat("q", 0, 0, {},
+                         geom={"type": "Point", "coordinates": [10, 10]}))
+    hits = gj.query("polys", '{"geometry": {"$bbox": [1, 1, 2, 2]}}')
+    assert [f["id"] for f in hits] == ["p"]
+
+
+def wsgi(app, method, path, body=None):
+    import io
+    raw = json.dumps(body).encode() if body is not None else b""
+    cap = {}
+
+    def sr(status, headers):
+        cap["status"] = int(status.split()[0])
+
+    qs = ""
+    if "?" in path:
+        path, qs = path.split("?", 1)
+    out = b"".join(app({
+        "REQUEST_METHOD": method, "PATH_INFO": path, "QUERY_STRING": qs,
+        "CONTENT_LENGTH": str(len(raw)), "wsgi.input": io.BytesIO(raw),
+    }, sr))
+    return cap["status"], (json.loads(out) if out else None)
+
+
+def test_servlet_roundtrip():
+    from urllib.parse import quote
+    app = GeoJsonApp()
+    s, _ = wsgi(app, "POST", "/geojson/index/t?points=true")
+    assert s == 201
+    s, body = wsgi(app, "POST", "/geojson/index/t/features",
+                   feat("f1", 5, 5, {"kind": "a"}))
+    assert s == 201 and body["ids"] == ["f1"]
+    s, body = wsgi(app, "GET", "/geojson/index/t/features/f1")
+    assert s == 200 and body["properties"]["kind"] == "a"
+    q = quote(json.dumps({"geometry": {"$bbox": [0, 0, 10, 10]}}))
+    s, body = wsgi(app, "GET", f"/geojson/index/t/query?q={q}")
+    assert s == 200 and len(body["features"]) == 1
+    s, _ = wsgi(app, "DELETE", "/geojson/index/t/features/f1")
+    assert s == 204
+    s, body = wsgi(app, "GET", "/geojson/index/t/features/f1")
+    assert s == 404
+    s, body = wsgi(app, "GET", "/geojson/index")
+    assert body == ["t"]
+    s, _ = wsgi(app, "DELETE", "/geojson/index/t")
+    assert s == 204
+
+
+def test_servlet_mounted_under_webapp():
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.web import WebApp
+    app = WebApp(TpuDataStore(), geojson=GeoJsonIndex())
+    s, _ = wsgi(app, "POST", "/geojson/index/m")
+    assert s == 201
+    s, body = wsgi(app, "GET", "/geojson/index")
+    assert body == ["m"]
+
+
+def test_mongo_range_idiom_multiple_ops(idx):
+    """{"$gte": a, "$lt": b} — both operators must apply (AND)."""
+    hits = idx.query("test", '{"score": {"$gte": 5, "$lt": 9}}')
+    assert [f["id"] for f in hits] == ["1"]
+
+
+def test_add_is_atomic(idx):
+    """A failing feature mid-collection must leave the index unchanged."""
+    idx.query("test", '{"geometry": {"$bbox": [0, 0, 60, 60]}}')  # cache batch
+    bad = {"type": "FeatureCollection", "features": [
+        feat("ok1", 1, 1, {"dtg": 0}),
+        {"type": "Feature", "id": "broken", "geometry": None,
+         "properties": {}},
+    ]}
+    with pytest.raises(ValueError):
+        idx.add("test", bad)
+    assert idx.get("test", "ok1") == []
+    # index still consistent: spatial query works and sees only original rows
+    hits = idx.query("test", '{"geometry": {"$bbox": [29, 9, 33, 11]}}')
+    assert len(hits) == 3
+
+
+def test_auto_ids_survive_delete():
+    gj = GeoJsonIndex()
+    gj.create_index("auto")
+    a, b = (gj.add("auto", feat(None, i, i))[0] for i in range(2))
+    gj.delete("auto", a)
+    c = gj.add("auto", feat(None, 5, 5))[0]
+    assert c not in (a, b) and len(gj.query("auto", "{}")) == 2

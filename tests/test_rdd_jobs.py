@@ -1,0 +1,147 @@
+"""SpatialRDD provider + ingest job tests (geomesa-spark-core /
+geomesa-jobs analogs)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.jobs import run_ingest
+from geomesa_tpu.parallel import save_rdd, spatial_rdd
+
+MS_2018 = 1514764800000
+DAY = 86_400_000
+
+
+@pytest.fixture
+def store():
+    ds = TpuDataStore()
+    ds.create_schema("pts", "name:String,v:Int,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(3)
+    n = 1000
+    ds.write("pts", {
+        "name": np.asarray([f"n{i % 3}" for i in range(n)], dtype=object),
+        "v": rng.integers(0, 100, n),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * DAY, n),
+        "geom": (rng.uniform(-120, 120, n), rng.uniform(-50, 50, n)),
+    })
+    return ds
+
+
+def test_store_rdd_partitions_and_collect(store):
+    rdd = spatial_rdd({"store": store}, "pts", num_partitions=4)
+    assert rdd.num_partitions == 4
+    assert rdd.count() == 1000
+    assert len(rdd.collect()) == 1000
+    # query filter applies before partitioning
+    rdd = spatial_rdd({"store": store}, "pts",
+                      "BBOX(geom, 0, -50, 120, 50)", num_partitions=3)
+    x, _ = store._store("pts").batch.geom_xy()
+    assert rdd.count() == int((x >= 0).sum())
+
+
+def test_rdd_spatial_locality(store):
+    """Z-ordered partitioning: partitions are contiguous key-space slabs,
+    so per-partition bboxes overlap much less than random splits."""
+    rdd = spatial_rdd({"store": store}, "pts", num_partitions=4)
+    boxes = []
+    for p in rdd.partitions:
+        x, y = p.geom_xy()
+        boxes.append((x.min(), y.min(), x.max(), y.max()))
+    # not all partitions should span the whole world
+    spans = [(b[2] - b[0]) * (b[3] - b[1]) for b in boxes]
+    world = 240.0 * 100.0
+    assert min(spans) < 0.5 * world
+
+
+def test_rdd_aggregate(store):
+    rdd = spatial_rdd({"store": store}, "pts", num_partitions=4)
+    total = rdd.aggregate(lambda b: int(b.column("v").sum()),
+                          lambda a, b: a + b)
+    assert total == int(store._store("pts").batch.column("v").sum())
+
+
+def test_rdd_to_arrow(store):
+    table = spatial_rdd({"store": store}, "pts", num_partitions=4).to_arrow()
+    assert table.num_rows == 1000
+    assert "name" in table.column_names
+
+
+def test_rdd_save_roundtrip(store):
+    rdd = spatial_rdd({"store": store}, "pts", "name = 'n1'")
+    dst = TpuDataStore()
+    n = save_rdd(rdd, {"store": dst}, "pts")
+    assert n == rdd.count() > 0
+    assert dst.get_count("pts") == n
+
+
+def test_converter_rdd(tmp_path, store):
+    for i in range(3):
+        (tmp_path / f"f{i}.csv").write_text(
+            "\n".join(f"a{j},{j},{MS_2018},-{i}.5,4{i}.0"
+                      for j in range(10)) + "\n")
+    params = {
+        "paths": [str(tmp_path / f"f{i}.csv") for i in range(3)],
+        "sft": store.get_schema("pts"),
+        "converter": {
+            "type": "csv",
+            "fields": [
+                {"name": "name", "transform": "$0"},
+                {"name": "v", "transform": "toInt($1)"},
+                {"name": "dtg", "transform": "toLong($2)"},
+                {"name": "geom", "transform": "point($3,$4)"},
+            ],
+        },
+    }
+    rdd = spatial_rdd(params, "pts")
+    assert rdd.num_partitions == 3 and rdd.count() == 30
+    # filtered read
+    rdd = spatial_rdd(params, "pts", "BBOX(geom,-1,39,0,41)")
+    assert rdd.count() == 10
+
+
+def test_fs_rdd(tmp_path):
+    from geomesa_tpu.fs import FileSystemDataStore
+    fs = FileSystemDataStore(str(tmp_path))
+    fs.create_schema("evt", "dtg:Date,*geom:Point",
+                     scheme={"scheme": "datetime", "datetime-step": "daily"})
+    rng = np.random.default_rng(5)
+    n = 200
+    fs.write("evt", {
+        "dtg": rng.integers(MS_2018, MS_2018 + 3 * DAY, n),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)),
+    })
+    rdd = spatial_rdd({"fs": fs}, "evt")
+    assert rdd.count() == n
+    assert rdd.num_partitions >= 3  # one per day partition
+    # temporal pruning reduces partitions read
+    rdd2 = spatial_rdd(
+        {"fs": fs}, "evt",
+        "dtg DURING 2018-01-01T00:00:00Z/2018-01-01T23:59:59Z")
+    assert rdd2.num_partitions <= 2 and 0 < rdd2.count() < n
+
+
+def test_ingest_job(tmp_path, store):
+    files = []
+    for i in range(6):
+        p = tmp_path / f"in{i}.csv"
+        p.write_text("\n".join(
+            f"x{j},{j},{MS_2018 + j},{i}.25,1.5" for j in range(20)) + "\n")
+        files.append(str(p))
+    bad = tmp_path / "bad.csv"
+    bad.write_text("x,notanint,0,0.0,0.0\n")
+    files.append(str(bad))
+    config = {
+        "type": "csv",
+        "fields": [
+            {"name": "name", "transform": "$0"},
+            {"name": "v", "transform": "toInt($1)"},
+            {"name": "dtg", "transform": "toLong($2)"},
+            {"name": "geom", "transform": "point($3,$4)"},
+        ],
+        "options": {"error-mode": "skip"},
+    }
+    before = store.get_count("pts")
+    result = run_ingest(store, "pts", config, files, workers=3)
+    assert result.ingested == 120 and result.files == 7
+    assert result.failed >= 1
+    assert store.get_count("pts") == before + 120

@@ -147,3 +147,35 @@ def test_audit_and_metrics(app):
 def test_unknown_route(app):
     status, body = call(app, "GET", "/api/nope")
     assert status == 404
+
+
+def test_bad_params_return_400(app):
+    status, _ = call(app, "GET", "/api/data/pts?max=abc")
+    assert status == 400
+    status, _ = call(app, "GET", "/api/stats/pts/histogram?attribute=age&bins=x")
+    assert status == 400
+    status, _ = call(app, "GET", "/api/audit/pts?since=notafloat")
+    assert status == 400
+    # non-numeric attribute -> 400, unknown attribute -> 404
+    status, _ = call(app, "GET", "/api/stats/pts/histogram?attribute=name")
+    assert status == 400
+    status, _ = call(app, "GET", "/api/stats/pts/histogram?attribute=nope")
+    assert status == 404
+
+
+def test_histogram_respects_visibility():
+    """The histogram endpoint must not leak rows the caller cannot see."""
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.security import StaticAuthorizationsProvider
+
+    ds = TpuDataStore(auth_provider=StaticAuthorizationsProvider(["user"]))
+    ds.create_schema("v", "age:Int,dtg:Date,*geom:Point")
+    ds.write("v", {"age": np.asarray([10, 20]), "dtg": np.asarray([0, 0]),
+                   "geom": (np.zeros(2), np.zeros(2))}, visibility="user")
+    ds.write("v", {"age": np.asarray([1000]), "dtg": np.asarray([0]),
+                   "geom": (np.zeros(1), np.zeros(1))}, visibility="admin")
+    app2 = WebApp(ds)
+    status, body = call(app2, "GET",
+                        "/api/stats/v/histogram?attribute=age&bins=4")
+    assert status == 200
+    assert sum(body["counts"]) == 2 and body["hi"] <= 20.0
