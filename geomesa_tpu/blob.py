@@ -13,6 +13,7 @@ from the file) are the pluggable ``handler`` callables here.
 from __future__ import annotations
 
 import os
+import re
 import uuid
 
 import numpy as np
@@ -24,6 +25,14 @@ __all__ = ["GeoIndexedBlobStore", "wkt_handler"]
 
 BLOB_SFT_SPEC = ("filename:String,storeId:String:index=true,dtg:Date,"
                  "*geom:Geometry")
+
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def _safe_id(bid: str) -> bool:
+    """Ids become file names under blob_dir — reject path separators and
+    dot-runs so a caller-supplied id can never escape the directory."""
+    return bool(_ID_RE.match(bid)) and ".." not in bid
 
 
 def wkt_handler(data: bytes, params: dict):
@@ -66,6 +75,8 @@ class GeoIndexedBlobStore:
             geometry = handler(data, params or {})
         if geometry is None:
             raise ValueError("no geometry: pass geometry= or a handler")
+        if blob_id is not None and not _safe_id(blob_id):
+            raise ValueError(f"invalid blob id {blob_id!r}")
         bid = blob_id or uuid.uuid4().hex
         self._store_bytes(bid, filename, data)
         self.store.write(self.type_name, {
@@ -88,6 +99,8 @@ class GeoIndexedBlobStore:
     # -- reads -------------------------------------------------------------
     def get(self, blob_id: str):
         """Returns (bytes, filename) or None."""
+        if not _safe_id(blob_id):
+            return None
         if self.blob_dir:
             path = os.path.join(self.blob_dir, blob_id)
             if not os.path.exists(path):
@@ -112,6 +125,8 @@ class GeoIndexedBlobStore:
 
     # -- deletes -----------------------------------------------------------
     def delete_blob(self, blob_id: str):
+        if not _safe_id(blob_id):
+            return
         self.store.delete(self.type_name, [blob_id])
         if self.blob_dir:
             for suffix in ("", ".name"):

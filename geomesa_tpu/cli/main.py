@@ -185,6 +185,19 @@ def cmd_stats_histogram(args):
         print(f"bin {i}\t{c}")
 
 
+def cmd_age_off(args):
+    """Expire old rows (tools age-off command analog)."""
+    from ..age_off import age_off
+    ds = _store(args)
+    n = age_off(ds, args.feature_name, retention=args.retention,
+                dry_run=args.dry_run)
+    if args.dry_run:
+        print(f"would age off {n} features from {args.feature_name}")
+    else:
+        ds.flush(args.feature_name)
+        print(f"aged off {n} features from {args.feature_name}")
+
+
 def cmd_version(args):
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -233,6 +246,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("--track", help="track-id attribute for bin export")
+
+    sp = add("age-off", cmd_age_off, help="expire rows older than a "
+                                          "retention period")
+    catalog(sp)
+    sp.add_argument("-r", "--retention", required=True,
+                    help='e.g. "7 days", "12 hours"')
+    sp.add_argument("--dry-run", action="store_true")
 
     sp = add("explain", cmd_explain, help="explain query planning")
     catalog(sp)

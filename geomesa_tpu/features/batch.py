@@ -109,11 +109,20 @@ class FeatureBatch:
                 vals = np.asarray(vals)
                 if vals.dtype.kind == "M":
                     vals = vals.astype("M8[ms]").astype(np.int64)
-                columns[attr.name] = vals.astype(np.int64)
-            elif attr.type in ("string", "bytes"):
+                if vals.dtype == object and any(v is None for v in vals):
+                    # sparse values (live-cache partial attrs): stay object;
+                    # filter evaluation treats None as non-matching
+                    columns[attr.name] = vals
+                else:
+                    columns[attr.name] = vals.astype(np.int64)
+            elif attr.type in ("string", "bytes", "json"):
                 columns[attr.name] = np.asarray(vals, dtype=object)
             else:
-                columns[attr.name] = np.asarray(vals, dtype=_DTYPES[attr.type])
+                arr = np.asarray(vals)
+                if arr.dtype == object and any(v is None for v in arr):
+                    columns[attr.name] = arr
+                else:
+                    columns[attr.name] = arr.astype(_DTYPES[attr.type])
         ids_arr = None if ids is None else np.asarray(ids, dtype=object)
         return cls(sft, columns, ids_arr, geoms, ids_explicit=ids is not None)
 
@@ -129,7 +138,7 @@ class FeatureBatch:
                                        if attr.type == "point" else [])
             elif attr.type == "date":
                 data[attr.name] = np.empty(0, dtype=np.int64)
-            elif attr.type in ("string", "bytes"):
+            elif attr.type in ("string", "bytes", "json"):
                 data[attr.name] = np.empty(0, dtype=object)
             else:
                 data[attr.name] = np.empty(0, dtype=_DTYPES[attr.type])

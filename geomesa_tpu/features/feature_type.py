@@ -29,6 +29,7 @@ _TYPES = {
     "date": "date", "timestamp": "date",
     "uuid": "string",
     "bytes": "bytes",
+    "json": "json",
     "point": "point",
     "linestring": "linestring",
     "polygon": "polygon",
@@ -87,6 +88,27 @@ class FeatureType:
         return self.default_geom
 
     @property
+    def column_groups(self) -> dict:
+        """Named attribute subsets from per-attribute ``column-groups``
+        options (``|``-separated), the reference's ColumnGroups
+        (index/conf/ColumnGroups.scala:27-78): queries hinting a group
+        read only that group's columns.  The default geometry and dtg
+        are members of every group (the reference always writes them to
+        each column family)."""
+        groups: dict = {}
+        for a in self.attributes:
+            raw = a.options.get("column-groups", "")
+            for g in (x.strip() for x in raw.split("|") if x.strip()):
+                groups.setdefault(g, []).append(a.name)
+        if groups:
+            always = [n for n in (self.default_geom, self.dtg_field) if n]
+            for names in groups.values():
+                for n in reversed(always):
+                    if n not in names:
+                        names.insert(0, n)
+        return groups
+
+    @property
     def dtg_field(self) -> str | None:
         """Default date attribute: explicit ``geomesa.index.dtg`` user-data
         or the first Date attribute (the reference's convention)."""
@@ -136,6 +158,7 @@ class FeatureType:
                 "linestring": "LineString", "polygon": "Polygon",
                 "multipoint": "MultiPoint", "multilinestring": "MultiLineString",
                 "multipolygon": "MultiPolygon", "geometry": "Geometry",
+                "json": "Json",
             }[type_name]
             parts.append(f"{star}{a.name}:{pretty}{opts}")
         spec = ",".join(parts)

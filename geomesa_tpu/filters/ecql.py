@@ -32,7 +32,8 @@ _TOKEN_RE = re.compile(
       | (?P<number>-?\d+\.?\d*(?:[eE][+-]?\d+)?)
       | (?P<op><=|>=|<>|!=|=|<|>)
       | (?P<punct>[(),/])
-      | (?P<word>[A-Za-z_][A-Za-z0-9_.:]*)
+      | (?P<qword>"[^"]*")
+      | (?P<word>[$A-Za-z_][A-Za-z0-9_.:\[\]]*)
     )""",
     re.VERBOSE,
 )
@@ -78,6 +79,11 @@ class _Tokens:
                 raise ValueError(f"cannot tokenize ECQL at: {text[pos:pos+30]!r}")
             kind = m.lastgroup
             val = m.group(kind)
+            if kind == "qword":
+                # double-quoted property name (json-path props, reserved
+                # words as attributes): stays a distinct token kind so
+                # keyword matching never applies to it
+                val = val[1:-1]
             self.toks.append((kind, val))
             pos = m.end()
         self.i = 0
@@ -207,8 +213,11 @@ def _parse_literal_list(toks: _Tokens, what: str) -> list:
 
 def _parse_predicate(toks: _Tokens) -> Filter:
     kind, val = toks.next()
-    if kind != "word":
+    if kind not in ("word", "qword"):
         raise ValueError(f"expected predicate, got {val!r}")
+    if kind == "qword":
+        # quoted: always a property name, never a keyword
+        return _parse_property_predicate(toks, val)
     upper = val.upper()
 
     if upper == "INCLUDE":
@@ -257,7 +266,10 @@ def _parse_predicate(toks: _Tokens) -> Filter:
         return DWithin(prop, geom, dist)
 
     # property-led predicates
-    prop = val
+    return _parse_property_predicate(toks, val)
+
+
+def _parse_property_predicate(toks: _Tokens, prop: str) -> Filter:
     kind, val = toks.next()
     if kind == "word":
         upper = val.upper()

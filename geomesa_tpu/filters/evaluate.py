@@ -102,6 +102,54 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
     return out
 
 
+def _prop_column(batch: FeatureBatch, prop: str) -> np.ndarray:
+    """Resolve a property reference to a column.
+
+    ``$.attr.path.to.value`` digs into a json-typed attribute (the
+    reference's json-path attribute queries, features/kryo/json/*):
+    the first path segment names the attribute, the rest walks the
+    parsed document of each row.
+    """
+    if not prop.startswith("$."):
+        return batch.column(prop)
+    import json as _json
+
+    from ..geojson.query import json_path_get
+    rest = prop[2:]
+    first, _, inner = rest.partition(".")
+    # a bracket on the first segment indexes into the attribute's value:
+    # $.props[0].name → attribute "props", path "[0].name"
+    attr, bracket, idx = first.partition("[")
+    if bracket:
+        inner = f"[{idx}.{inner}" if inner else f"[{idx}"
+    col = batch.column(attr)
+    docs = [(_json.loads(v) if isinstance(v, (str, bytes)) and v else v)
+            for v in col]
+    if not inner:
+        return np.asarray(docs, dtype=object)
+    return np.asarray([None if d is None else json_path_get(d, "$." + inner)
+                       for d in docs], dtype=object)
+
+
+def _safe_compare(col: np.ndarray, value, op: str) -> np.ndarray:
+    """Ordering comparison tolerant of None/mixed entries in object
+    columns (json-path results): non-comparable rows are False."""
+    if col.dtype != object:
+        return {"<": col < value, "<=": col <= value,
+                ">": col > value, ">=": col >= value}[op]
+    import operator as _op
+    fn = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge}[op]
+    out = np.zeros(len(col), dtype=bool)
+    for i, v in enumerate(col):
+        if v is None:
+            continue
+        try:
+            out[i] = fn(v, value)
+        except TypeError:
+            pass
+    return out
+
+
 def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
     """Evaluate a filter to a boolean mask over the batch."""
     n = len(batch)
@@ -146,29 +194,25 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
                 return d2 <= f.distance ** 2
         raise NotImplementedError("DWITHIN currently supports point-to-point")
     if isinstance(f, During):
-        col = batch.column(f.prop)
+        col = _prop_column(batch, f.prop)
         mask = np.ones(n, dtype=bool)
         if f.lo_ms is not None:
-            mask &= col >= f.lo_ms
+            mask &= _safe_compare(col, f.lo_ms, ">=")
         if f.hi_ms is not None:
-            mask &= col <= f.hi_ms
+            mask &= _safe_compare(col, f.hi_ms, "<=")
         return mask
     if isinstance(f, PropertyCompare):
-        col = batch.column(f.prop)
-        ops = {
-            "=": lambda c: c == f.value,
-            "<>": lambda c: c != f.value,
-            "<": lambda c: c < f.value,
-            "<=": lambda c: c <= f.value,
-            ">": lambda c: c > f.value,
-            ">=": lambda c: c >= f.value,
-        }
-        return np.asarray(ops[f.op](col))
+        col = _prop_column(batch, f.prop)
+        if f.op == "=":
+            return np.asarray(col == f.value)
+        if f.op == "<>":
+            return np.asarray(col != f.value)
+        return _safe_compare(col, f.value, f.op)
     if isinstance(f, Between):
-        col = batch.column(f.prop)
-        return (col >= f.lo) & (col <= f.hi)
+        col = _prop_column(batch, f.prop)
+        return _safe_compare(col, f.lo, ">=") & _safe_compare(col, f.hi, "<=")
     if isinstance(f, In):
-        col = batch.column(f.prop)
+        col = _prop_column(batch, f.prop)
         # one hashed pass instead of a scan per value (high-cardinality
         # joins feed thousands of values); np.isin promotes dtypes the
         # same way `col == v` does, so semantics match the loop below
@@ -193,7 +237,7 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
         wanted = set(f.ids)
         return np.array([str(v) in wanted for v in batch.ids], dtype=bool)
     if isinstance(f, Like):
-        col = batch.column(f.prop)
+        col = _prop_column(batch, f.prop)
         rx = _like_regex(f.pattern, f.case_insensitive)
         return np.array([bool(rx.match(str(v))) for v in col], dtype=bool)
     raise NotImplementedError(f"cannot evaluate {type(f).__name__}")

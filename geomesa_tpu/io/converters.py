@@ -46,6 +46,14 @@ class Converter:
         for f in config.get("fields", []):
             self.fields.append((f["name"], parse_expression(f["transform"])
                                 if "transform" in f else None))
+        # named enrichment lookup tables for cacheLookup() transforms —
+        # scoped to this converter (pushed during convert()), so same-named
+        # caches in unrelated configs never collide
+        self._caches = {}
+        if config.get("caches"):
+            from .enrichment import cache_from_config
+            self._caches = {cname: cache_from_config(ccfg)
+                            for cname, ccfg in config["caches"].items()}
 
     #: converters whose raw source is a file path (shapefile sidecars,
     #: jdbc databases) rather than the file's bytes
@@ -77,6 +85,8 @@ class Converter:
         cols = self.raw_columns(source)
         n = len(next(iter(cols.values()))) if cols else 0
         data: dict = {}
+        from .enrichment import pop_active_caches, push_active_caches
+        push_active_caches(self._caches)
         try:
             for name, expr in self.fields:
                 if expr is None:
@@ -90,6 +100,8 @@ class Converter:
             ec.failure += n
             ec.errors.append(repr(e))
             return FeatureBatch(self.sft, {})
+        finally:
+            pop_active_caches()
         # geometry attrs: object arrays of Geometry objects → packed
         for attr in self.sft.attributes:
             v = data.get(attr.name)

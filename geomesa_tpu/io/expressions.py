@@ -154,7 +154,26 @@ _FUNCTIONS = {
     "concatenate": _fn_concat,
     "md5": _fn_md5,
     "uuid": lambda cols: _fn_uuid(cols),
+    "cachelookup": lambda cols, name_e, key_e, field_e: _fn_cache_lookup(
+        cols, name_e, key_e, field_e),
 }
+
+
+def _fn_cache_lookup(cols, name_e, key_e, field_e):
+    """cacheLookup('cache', $key, 'field') — enrichment join per row
+    (EnrichmentCacheFunctionFactory.scala analog)."""
+    from .enrichment import lookup_cache
+
+    name = name_e.evaluate(cols)
+    field = field_e.evaluate(cols)
+    # literal args evaluate to scalars; key is usually a column
+    name = name if isinstance(name, str) else str(np.asarray(name).flat[0])
+    field = field if isinstance(field, str) else str(np.asarray(field).flat[0])
+    cache = lookup_cache(name)
+    keys = key_e.evaluate(cols)
+    if np.ndim(keys) == 0:
+        return cache.get(keys, field)
+    return np.asarray([cache.get(k, field) for k in keys], dtype=object)
 
 _TOKEN = re.compile(r"""\s*(?:
       (?P<dollar>\$[A-Za-z0-9_./@-]+)

@@ -49,9 +49,12 @@ class IngestJob:
 
         sft = self.store.get_schema(self.type_name)
         result = IngestResult()
+        # one converter for the whole job: construction loads enrichment
+        # caches (CSV parses), and convert() itself is stateless, so the
+        # worker threads can share it safely
+        conv = converter_from_config(sft, self.converter_config)
 
         def parse(path: str):
-            conv = converter_from_config(sft, self.converter_config)
             ec = EvaluationContext()
             if conv.wants_path:
                 batch = conv.convert(path, ec)

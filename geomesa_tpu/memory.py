@@ -1,0 +1,234 @@
+"""In-memory indexed feature engine with incremental maintenance.
+
+The analog of the reference's geomesa-memory module — GeoCQEngine
+(memory/cqengine/GeoCQEngine.scala): a CQEngine-backed feature
+collection with per-attribute indexes plus geo predicates, used where
+features churn constantly (the Kafka live cache).  Unlike the
+TpuDataStore (bulk-sorted device indexes, rebuild-on-write), this engine
+maintains hash/sorted/spatial indexes incrementally per insert/remove —
+the streaming-update trade-off the reference makes the same way.
+
+Index selection: equality/IN → hash index; range → sorted index (rebuilt
+lazily per query after mutations, amortized); bbox → bucket grid; other
+filters fall back to a full scan with vectorized evaluation.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+from .features.batch import FeatureBatch
+from .features.feature_type import FeatureType
+from .filters import ast as fast
+from .filters.evaluate import evaluate_filter
+from .utils.spatial_index import BucketIndex
+
+__all__ = ["GeoCQEngine"]
+
+
+class _HashIndex:
+    """value → set(fid); equality/IN lookups (CQEngine HashIndex)."""
+
+    def __init__(self):
+        self.by_value: dict = {}
+
+    def insert(self, fid, value):
+        self.by_value.setdefault(value, set()).add(fid)
+
+    def remove(self, fid, value):
+        s = self.by_value.get(value)
+        if s is not None:
+            s.discard(fid)
+            if not s:
+                del self.by_value[value]
+
+    def equals(self, value) -> set:
+        return set(self.by_value.get(value, ()))
+
+    def isin(self, values) -> set:
+        out: set = set()
+        for v in values:
+            out |= self.by_value.get(v, set())
+        return out
+
+
+class _SortedIndex:
+    """Sorted (value, fid) pairs for range queries (NavigableIndex);
+    rebuilt lazily after mutations — O(n log n) on first range query,
+    O(log n + k) per query after."""
+
+    def __init__(self):
+        self._pairs: list = []
+        self._keys: list = []
+        self._stale = False
+
+    def insert(self, fid, value):
+        self._stale = True
+
+    def remove(self, fid, value):
+        self._stale = True
+
+    def _rebuild(self, live: dict):
+        self._pairs = sorted((v, f) for f, v in live.items() if v is not None)
+        self._keys = [p[0] for p in self._pairs]
+        self._stale = False
+
+    def range(self, live: dict, lo, hi, lo_inc=True, hi_inc=True) -> set:
+        if self._stale:
+            self._rebuild(live)
+        keys = self._keys
+        i = (bisect.bisect_left(keys, lo) if lo_inc
+             else bisect.bisect_right(keys, lo)) if lo is not None else 0
+        j = (bisect.bisect_right(keys, hi) if hi_inc
+             else bisect.bisect_left(keys, hi)) if hi is not None else len(keys)
+        return {f for _, f in self._pairs[i:j]}
+
+
+class GeoCQEngine:
+    """Incrementally-indexed in-memory feature collection."""
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+        self._features: dict[str, dict] = {}       # fid → attribute dict
+        self._xy: dict[str, tuple] = {}            # fid → (x, y)
+        self._spatial = BucketIndex()
+        self._hash: dict[str, _HashIndex] = {}
+        self._sorted: dict[str, _SortedIndex] = {}
+        for a in sft.attributes:
+            if a.is_geometry:
+                continue
+            self._hash[a.name] = _HashIndex()
+            if a.type in ("int", "long", "float", "double", "date"):
+                self._sorted[a.name] = _SortedIndex()
+
+    def __len__(self) -> int:
+        return len(self._features)
+
+    # -- mutation ----------------------------------------------------------
+    def insert(self, fid: str, attrs: dict, x: float, y: float):
+        """Insert or replace one feature (the live-cache upsert)."""
+        fid = str(fid)
+        if fid in self._features:
+            self.remove(fid)
+        self._features[fid] = attrs
+        self._xy[fid] = (float(x), float(y))
+        self._spatial.insert(fid, float(x), float(y))
+        for name, idx in self._hash.items():
+            idx.insert(fid, attrs.get(name))
+        for name, idx in self._sorted.items():
+            idx.insert(fid, attrs.get(name))
+
+    def insert_batch(self, batch: FeatureBatch):
+        x, y = batch.geom_xy()
+        names = [a.name for a in self.sft.attributes if not a.is_geometry]
+        cols = {n: batch.column(n) for n in names if n in batch.columns}
+        for i in range(len(batch)):
+            attrs = {n: c[i] for n, c in cols.items()}
+            self.insert(str(batch.ids[i]), attrs, x[i], y[i])
+
+    def remove(self, fid: str) -> bool:
+        fid = str(fid)
+        attrs = self._features.pop(fid, None)
+        if attrs is None:
+            return False
+        self._xy.pop(fid, None)
+        self._spatial.remove(fid)
+        for name, idx in self._hash.items():
+            idx.remove(fid, attrs.get(name))
+        for name, idx in self._sorted.items():
+            idx.remove(fid, attrs.get(name))
+        return True
+
+    def clear(self):
+        self.__init__(self.sft)
+
+    # -- query -------------------------------------------------------------
+    def query(self, filt) -> FeatureBatch:
+        """Evaluate a Filter/ECQL over the collection using the best
+        available index; returns a columnar batch of the hits."""
+        from .filters.ecql import parse_ecql
+        if isinstance(filt, str):
+            filt = parse_ecql(filt)
+        ids = self._candidates(filt)
+        if ids is None:
+            ids = set(self._features)
+        batch = self._to_batch(sorted(ids))
+        if len(batch) == 0:
+            return batch
+        mask = evaluate_filter(filt, batch)
+        return batch.take(np.flatnonzero(mask))
+
+    def _live_values(self, attr: str) -> dict:
+        return {fid: attrs.get(attr)
+                for fid, attrs in self._features.items()}
+
+    def _candidates(self, f) -> set | None:
+        """Index-driven candidate set; None = no usable index (full scan).
+        Always a superset of the true hits (exact filter re-check runs
+        vectorized afterwards)."""
+        if isinstance(f, fast.And):
+            best = None
+            for part in f.filters:
+                c = self._candidates(part)
+                if c is not None:
+                    best = c if best is None else (best & c)
+            return best
+        if isinstance(f, fast.Or):
+            out: set = set()
+            for part in f.filters:
+                c = self._candidates(part)
+                if c is None:
+                    return None
+                out |= c
+            return out
+        if isinstance(f, fast.BBox):
+            return set(self._spatial.query(f.xmin, f.ymin, f.xmax, f.ymax))
+        if isinstance(f, (fast.Intersects, fast.Within, fast.DWithin)):
+            env = f.geometry.envelope
+            pad = getattr(f, "distance", 0.0)
+            return set(self._spatial.query(env.xmin - pad, env.ymin - pad,
+                                           env.xmax + pad, env.ymax + pad))
+        if isinstance(f, fast.PropertyCompare) and f.prop in self._hash:
+            if f.op == "=":
+                return self._hash[f.prop].equals(f.value)
+            if f.op in ("<", "<=", ">", ">=") and f.prop in self._sorted:
+                live = self._live_values(f.prop)
+                if f.op == "<":
+                    return self._sorted[f.prop].range(live, None, f.value,
+                                                      hi_inc=False)
+                if f.op == "<=":
+                    return self._sorted[f.prop].range(live, None, f.value)
+                if f.op == ">":
+                    return self._sorted[f.prop].range(live, f.value, None,
+                                                      lo_inc=False)
+                return self._sorted[f.prop].range(live, f.value, None)
+            return None
+        if isinstance(f, fast.In) and f.prop in self._hash:
+            return self._hash[f.prop].isin(f.values)
+        if isinstance(f, fast.Between) and f.prop in self._sorted:
+            return self._sorted[f.prop].range(self._live_values(f.prop),
+                                              f.lo, f.hi)
+        if isinstance(f, fast.During) and f.prop in self._sorted:
+            return self._sorted[f.prop].range(self._live_values(f.prop),
+                                              f.lo_ms, f.hi_ms)
+        if isinstance(f, fast.IdFilter):
+            return {i for i in map(str, f.ids) if i in self._features}
+        return None
+
+    def _to_batch(self, fids: list) -> FeatureBatch:
+        if not fids:
+            return FeatureBatch.empty(self.sft)
+        data: dict = {}
+        for a in self.sft.attributes:
+            if a.is_geometry:
+                if a.name == self.sft.default_geom:
+                    xs = np.array([self._xy[f][0] for f in fids])
+                    ys = np.array([self._xy[f][1] for f in fids])
+                    data[a.name] = (xs, ys)
+                continue
+            data[a.name] = np.asarray(
+                [self._features[f].get(a.name) for f in fids], dtype=object)
+        return FeatureBatch.from_dict(self.sft, data,
+                                      ids=np.asarray(fids, dtype=object))

@@ -41,7 +41,10 @@ class GuardedQueryInterceptor:
 
 def load_interceptors(sft) -> list:
     """Instantiate the interceptor classes named in the SFT's user data
-    (comma-separated ``module:Class`` or ``module.Class`` paths)."""
+    (comma-separated ``module:Class`` or ``module.Class`` paths).  A
+    schema carrying ``geomesa.age.off`` user data auto-attaches the
+    age-off interceptor (the reference attaches its age-off iterator at
+    table-configuration time the same way)."""
     raw = sft.user_data.get(USER_DATA_KEY, "")
     out = []
     for name in (n.strip() for n in str(raw).split(",") if n.strip()):
@@ -50,6 +53,9 @@ def load_interceptors(sft) -> list:
         else:
             mod, _, cls = name.rpartition(".")
         out.append(getattr(importlib.import_module(mod), cls)())
+    from ..age_off import AGE_OFF_KEY, AgeOffInterceptor
+    if AGE_OFF_KEY in sft.user_data:
+        out.append(AgeOffInterceptor())
     return out
 
 

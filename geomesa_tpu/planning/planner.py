@@ -57,6 +57,12 @@ class QueryResult:
     scan_time_ms: float
 
 
+class QueryTimeoutError(TimeoutError):
+    """Query exceeded ``geomesa.query.timeout`` (the reference's
+    ThreadManagement reaper killing runaway scans,
+    index/utils/ThreadManagement.scala + GeoMesaFeatureReader.scala:31)."""
+
+
 class QueryPlanner:
     """Plans and runs queries against a store's in-memory index set."""
 
@@ -76,13 +82,25 @@ class QueryPlanner:
                              f"({len(batch)} features)")
         explain(lambda: f"Filter: {query.filter!r}")
 
+        from ..config import QueryProperties
+        timeout_s = QueryProperties.QUERY_TIMEOUT.to_int()
+        deadline = (time.perf_counter() + timeout_s) if timeout_s else None
+
+        def check_deadline(stage: str):
+            if deadline is not None and time.perf_counter() > deadline:
+                raise QueryTimeoutError(
+                    f"query on {self.sft.name!r} exceeded "
+                    f"{timeout_s}s during {stage}")
+
         t0 = time.perf_counter()
         decider = StrategyDecider(self.sft, store.stats_map(), len(batch))
         strategy = decider.decide(query.filter, explain)
         plan_ms = (time.perf_counter() - t0) * 1000
+        check_deadline("planning")
 
         t1 = time.perf_counter()
         candidates = self._scan(strategy, query, explain)
+        check_deadline("index scan")
         if candidates is None:  # full scan
             mask = evaluate_filter(query.filter, batch)
             positions = np.flatnonzero(mask)
@@ -94,6 +112,7 @@ class QueryPlanner:
             else:
                 positions = candidates
         scan_ms = (time.perf_counter() - t1) * 1000
+        check_deadline("filtering")
         explain(lambda: f"Scan: {len(positions)} hits "
                         f"(plan {plan_ms:.1f}ms, scan {scan_ms:.1f}ms)")
 
@@ -101,8 +120,16 @@ class QueryPlanner:
             positions = positions[allowed[positions]]
         positions = self._sort_limit(positions, batch, query)
         result_batch = batch.take(positions)
-        if query.properties is not None:
-            result_batch = _project(result_batch, query.properties)
+        properties = query.properties
+        if properties is None and "COLUMN_GROUP" in query.hints:
+            group = query.hints["COLUMN_GROUP"]
+            groups = self.sft.column_groups
+            if group not in groups:
+                raise ValueError(f"no column group {group!r} on "
+                                 f"{self.sft.name!r}")
+            properties = groups[group]
+        if properties is not None:
+            result_batch = _project(result_batch, properties)
         explain.pop()
         return QueryResult(result_batch, positions, strategy, plan_ms, scan_ms)
 

@@ -1,0 +1,258 @@
+"""Age-off (TTL), query timeout, memory engine, column groups, json-path
+attributes, and enrichment caches."""
+
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.age_off import age_off, parse_duration_ms
+from geomesa_tpu.datastore import TpuDataStore
+
+MS_2018 = 1514764800000
+DAY = 86_400_000
+NOW = int(time.time() * 1000)
+
+
+def test_parse_duration():
+    assert parse_duration_ms("7 days") == 7 * DAY
+    assert parse_duration_ms("12 hours") == 12 * 3_600_000
+    assert parse_duration_ms("30 minutes") == 1_800_000
+    assert parse_duration_ms("45 seconds") == 45_000
+    assert parse_duration_ms("500 ms") == 500
+    assert parse_duration_ms(1234) == 1234
+    with pytest.raises(ValueError):
+        parse_duration_ms("7 fortnights")
+
+
+class TestAgeOff:
+    def _store(self):
+        ds = TpuDataStore()
+        ds.create_schema("t", "v:Int,dtg:Date,*geom:Point")
+        ds.write("t", {
+            "v": np.arange(4),
+            "dtg": np.asarray([NOW - 10 * DAY, NOW - 5 * DAY,
+                               NOW - DAY, NOW]),
+            "geom": (np.zeros(4), np.zeros(4)),
+        })
+        return ds
+
+    def test_physical_age_off(self):
+        ds = self._store()
+        assert age_off(ds, "t", retention="7 days", dry_run=True) == 1
+        assert ds.get_count("t") == 4
+        assert age_off(ds, "t", retention="7 days") == 1
+        assert ds.get_count("t") == 3
+        assert age_off(ds, "t", retention="2 days") == 1
+        assert sorted(ds.query("t").column("v")) == [2, 3]
+
+    def test_scan_time_age_off_interceptor(self):
+        ds = TpuDataStore()
+        ds.create_schema(
+            "live", "v:Int,dtg:Date,*geom:Point;geomesa.age.off='3 days'")
+        ds.write("live", {
+            "v": np.arange(3),
+            "dtg": np.asarray([NOW - 10 * DAY, NOW - DAY, NOW]),
+            "geom": (np.zeros(3), np.zeros(3)),
+        })
+        # rows older than retention are hidden at query time but not deleted
+        assert sorted(ds.query("live").column("v")) == [1, 2]
+        assert ds._store("live").batch is not None
+        assert len(ds._store("live").batch) == 3
+
+
+def test_query_timeout():
+    from geomesa_tpu.config import clear_property, set_property
+    from geomesa_tpu.planning.planner import QueryTimeoutError
+
+    ds = TpuDataStore()
+    ds.create_schema("q", "v:Int,dtg:Date,*geom:Point")
+    ds.write("q", {"v": np.arange(10), "dtg": np.zeros(10, dtype=np.int64),
+                   "geom": (np.zeros(10), np.zeros(10))})
+    set_property("geomesa.query.timeout", -1)  # deadline already passed
+    try:
+        with pytest.raises(QueryTimeoutError):
+            ds.query("q", "v > 3")
+    finally:
+        clear_property("geomesa.query.timeout")
+    assert len(ds.query("q", "v > 3")) == 6
+
+
+class TestGeoCQEngine:
+    def _engine(self):
+        from geomesa_tpu.features.feature_type import parse_spec
+        from geomesa_tpu.memory import GeoCQEngine
+        sft = parse_spec("m", "name:String,age:Int,dtg:Date,*geom:Point")
+        eng = GeoCQEngine(sft)
+        for i in range(100):
+            eng.insert(f"f{i}", {"name": f"n{i % 5}", "age": i,
+                                 "dtg": MS_2018 + i * 1000},
+                       x=-75 + i * 0.01, y=40 + i * 0.01)
+        return eng
+
+    def test_equality_hash_index(self):
+        eng = self._engine()
+        got = eng.query("name = 'n3'")
+        assert len(got) == 20
+        assert set(got.column("name")) == {"n3"}
+
+    def test_range_sorted_index(self):
+        eng = self._engine()
+        assert len(eng.query("age >= 90")) == 10
+        assert len(eng.query("age BETWEEN 10 AND 19")) == 10
+        assert len(eng.query("age < 5 OR age >= 95")) == 10
+
+    def test_spatial_bucket_index(self):
+        eng = self._engine()
+        got = eng.query("BBOX(geom, -74.8, 40.2, -74.7, 40.3)")
+        xs, _ = got.geom_xy()
+        assert len(got) > 0 and (xs >= -74.8).all() and (xs <= -74.7).all()
+
+    def test_incremental_update_remove(self):
+        eng = self._engine()
+        eng.insert("f0", {"name": "changed", "age": 500, "dtg": 0}, 0.0, 0.0)
+        assert len(eng) == 100  # replaced, not added
+        assert len(eng.query("age = 500")) == 1
+        assert len(eng.query("name = 'n0'")) == 19
+        assert eng.remove("f0") and not eng.remove("f0")
+        assert len(eng) == 99
+        assert len(eng.query("age = 500")) == 0
+
+    def test_in_and_id_filters(self):
+        eng = self._engine()
+        assert len(eng.query("name IN ('n0', 'n1')")) == 40
+        assert len(eng.query("IN ('f1', 'f2', 'nope')")) == 2
+
+    def test_during(self):
+        eng = self._engine()
+        got = eng.query(
+            "dtg DURING 2018-01-01T00:00:10Z/2018-01-01T00:00:19Z")
+        assert len(got) == 10
+
+
+def test_column_groups():
+    ds = TpuDataStore()
+    ds.create_schema("cg", "a:String:column-groups=small,"
+                           "b:String:column-groups=small|big,"
+                           "c:String,dtg:Date,*geom:Point")
+    sft = ds.get_schema("cg")
+    assert sft.column_groups["small"] == ["geom", "dtg", "a", "b"]
+    assert sft.column_groups["big"] == ["geom", "dtg", "b"]
+    ds.write("cg", {"a": np.asarray(["x"], dtype=object),
+                    "b": np.asarray(["y"], dtype=object),
+                    "c": np.asarray(["z"], dtype=object),
+                    "dtg": np.asarray([0]),
+                    "geom": (np.zeros(1), np.zeros(1))})
+    from geomesa_tpu.planning.planner import Query
+    out = ds.query("cg", Query.of("INCLUDE", hints={"COLUMN_GROUP": "small"}))
+    assert "a" in out.columns and "b" in out.columns
+    assert "c" not in out.columns
+    with pytest.raises(ValueError):
+        ds.query("cg", Query.of("INCLUDE", hints={"COLUMN_GROUP": "nope"}))
+
+
+def test_json_path_attribute_queries():
+    ds = TpuDataStore()
+    ds.create_schema("j", "attrs:Json,dtg:Date,*geom:Point")
+    docs = ['{"user": {"age": 30, "name": "ann"}, "tags": ["a", "b"]}',
+            '{"user": {"age": 10, "name": "bob"}}',
+            '{"user": {"name": "cat"}}']
+    ds.write("j", {"attrs": np.asarray(docs, dtype=object),
+                   "dtg": np.zeros(3, dtype=np.int64),
+                   "geom": (np.zeros(3), np.zeros(3))})
+    assert len(ds.query("j", '"$.attrs.user.age" > 18')) == 1
+    assert len(ds.query("j", '"$.attrs.user.age" <= 30')) == 2
+    got = ds.query("j", "\"$.attrs.user.name\" = 'cat'")
+    assert len(got) == 1
+    assert len(ds.query("j", "\"$.attrs.tags[0]\" = 'a'")) == 1
+    # missing paths are never hits
+    assert len(ds.query("j", '"$.attrs.nope.deep" > 0')) == 0
+
+
+def test_enrichment_cache_lookup(tmp_path):
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.io.converters import converter_from_config
+    from geomesa_tpu.io.enrichment import clear_caches
+
+    csv_cache = tmp_path / "vessels.csv"
+    csv_cache.write_text("mmsi,flag,vtype\n123,US,cargo\n456,NO,tanker\n")
+    sft = parse_spec("e", "flag:String,vtype:String,*geom:Point")
+    conv = converter_from_config(sft, {
+        "type": "csv",
+        "caches": {
+            "vessels": {"type": "csv", "path": str(csv_cache),
+                        "key-column": "mmsi"},
+            "owners": {"type": "inline",
+                       "data": {"123": {"owner": "acme"}}},
+        },
+        "fields": [
+            {"name": "flag",
+             "transform": "cacheLookup('vessels', $0, 'flag')"},
+            {"name": "vtype",
+             "transform": "cacheLookup('vessels', $0, 'vtype')"},
+            {"name": "geom", "transform": "point($1,$2)"},
+        ],
+    })
+    batch = conv.convert("123,1.0,2.0\n456,3.0,4.0\n999,5.0,6.0\n")
+    assert list(batch.column("flag")) == ["US", "NO", None]
+    assert list(batch.column("vtype")) == ["cargo", "tanker", None]
+    clear_caches()
+
+
+def test_quoted_reserved_word_properties():
+    from geomesa_tpu.filters.ecql import parse_ecql
+    from geomesa_tpu.filters.ast import PropertyCompare
+    f = parse_ecql('"contains" = \'x\'')
+    assert isinstance(f, PropertyCompare) and f.prop == "contains"
+    assert parse_ecql('"IN" = 5').prop == "IN"
+
+
+def test_json_path_bracket_first_segment():
+    ds = TpuDataStore()
+    ds.create_schema("ja", "props:Json,dtg:Date,*geom:Point")
+    ds.write("ja", {"props": np.asarray(
+        ['[{"name": "first"}, {"name": "second"}]', '[]'], dtype=object),
+        "dtg": np.zeros(2, dtype=np.int64),
+        "geom": (np.zeros(2), np.zeros(2))})
+    assert len(ds.query("ja", "\"$.props[0].name\" = 'first'")) == 1
+    assert len(ds.query("ja", "\"$.props[1].name\" = 'second'")) == 1
+
+
+def test_memory_engine_sparse_attributes():
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.memory import GeoCQEngine
+    eng = GeoCQEngine(parse_spec("s", "name:String,age:Int,*geom:Point"))
+    eng.insert("1", {"name": "a"}, 0, 0)          # no age
+    eng.insert("2", {"name": "b", "age": 30}, 1, 1)
+    got = eng.query("INCLUDE")
+    assert len(got) == 2
+    got = eng.query("age > 10")
+    assert list(got.ids) == ["2"]
+
+
+def test_enrichment_caches_scoped_per_converter(tmp_path):
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.io.converters import converter_from_config
+    sft = parse_spec("e2", "v:String,*geom:Point")
+    mk = lambda val: converter_from_config(sft, {
+        "type": "csv",
+        "caches": {"shared": {"type": "inline",
+                              "data": {"k": {"f": val}}}},
+        "fields": [
+            {"name": "v", "transform": "cacheLookup('shared', $0, 'f')"},
+            {"name": "geom", "transform": "point($1,$2)"},
+        ]})
+    c1, c2 = mk("one"), mk("two")
+    # constructing c2 must not clobber c1's same-named cache
+    assert list(c1.convert("k,0,0\n").column("v")) == ["one"]
+    assert list(c2.convert("k,0,0\n").column("v")) == ["two"]
+
+
+def test_blob_id_path_traversal_rejected(tmp_path):
+    from geomesa_tpu.blob import GeoIndexedBlobStore
+    from geomesa_tpu.geometry.types import Point
+    bs = GeoIndexedBlobStore(blob_dir=str(tmp_path / "b"))
+    with pytest.raises(ValueError):
+        bs.put(b"x", geometry=Point(0, 0), blob_id="../escape")
+    assert bs.get("../../etc/passwd") is None
+    bs.delete_blob("../../etc/passwd")  # no-op, no exception
