@@ -16,6 +16,7 @@ from .binnedtime import (
     time_to_bin,
     to_binned_time,
 )
+from .legacy import LegacyZ2SFC, LegacyZ3SFC, legacy_z2_sfc, legacy_z3_sfc
 from .normalize import NormalizedDimension, normalized_lat, normalized_lon, normalized_time
 from .ranges import merge_ranges, zranges
 from .sfc import Z2SFC, Z3SFC, z2_sfc, z3_sfc

@@ -1,0 +1,124 @@
+"""Legacy (semi-normalized) curves, kept for on-disk back-compat.
+
+The reference retains deprecated curve variants whose dimension
+normalization uses ``ceil`` with a precision of ``2^p - 1`` values
+(SemiNormalizedDimension, curve/NormalizedDimension.scala:82-97) so that
+data written by old versions can still be read/deleted (LegacyZ2SFC.scala,
+LegacyZ3SFC.scala).  Same here: these produce the OLD key values — use
+them only to interpret indexes built by earlier key layouts.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from .binnedtime import TimePeriod, max_offset
+from .zorder import deinterleave2, deinterleave3, interleave2, interleave3
+
+__all__ = ["SemiNormalizedDimension", "LegacyZ2SFC", "LegacyZ3SFC",
+           "legacy_z2_sfc", "legacy_z3_sfc"]
+
+
+@dataclass(frozen=True)
+class SemiNormalizedDimension:
+    """``normalize(x) = ceil((x - min) / (max - min) * precision)`` with
+    max index = ``precision`` — the deprecated binning that does not
+    correctly bin the lower bound (NormalizedDimension.scala:84-87)."""
+
+    min: float
+    max: float
+    precision: int          # count of bins - 1 (e.g. 2^21 - 1)
+
+    @property
+    def max_index(self) -> int:
+        return self.precision
+
+    def normalize(self, x, xp=jnp):
+        x = xp.asarray(x, dtype=xp.float64)
+        i = xp.ceil((x - self.min) / (self.max - self.min)
+                    * self.precision).astype(xp.int64)
+        return xp.clip(i, 0, self.max_index).astype(xp.int32)
+
+    def denormalize(self, i, xp=np):
+        i = xp.asarray(i).astype(xp.float64)
+        return xp.where(
+            i == 0, self.min,
+            (i - 0.5) * (self.max - self.min) / self.precision + self.min)
+
+    def normalize_scalar(self, x: float) -> int:
+        i = math.ceil((x - self.min) / (self.max - self.min) * self.precision)
+        return max(0, min(self.max_index, int(i)))
+
+
+@dataclass(frozen=True)
+class LegacyZ2SFC:
+    """Z2 with semi-normalized 31-bit dims (LegacyZ2SFC.scala)."""
+
+    bits: int = 31
+
+    @property
+    def lon(self) -> SemiNormalizedDimension:
+        return SemiNormalizedDimension(-180.0, 180.0, (1 << self.bits) - 1)
+
+    @property
+    def lat(self) -> SemiNormalizedDimension:
+        return SemiNormalizedDimension(-90.0, 90.0, (1 << self.bits) - 1)
+
+    def index(self, x, y, xp=jnp):
+        return interleave2(self.lon.normalize(x, xp=xp),
+                           self.lat.normalize(y, xp=xp), xp=xp).astype(xp.int64)
+
+    def invert(self, z, xp=np):
+        ix, iy = deinterleave2(z, xp=xp)
+        return self.lon.denormalize(ix, xp=xp), self.lat.denormalize(iy, xp=xp)
+
+
+@dataclass(frozen=True)
+class LegacyZ3SFC:
+    """Z3 with semi-normalized dims: 2^21-1 lon/lat, 2^20-1 time
+    (LegacyZ3SFC.scala:16-21)."""
+
+    period: TimePeriod = TimePeriod.WEEK
+
+    @property
+    def lon(self) -> SemiNormalizedDimension:
+        return SemiNormalizedDimension(-180.0, 180.0, (1 << 21) - 1)
+
+    @property
+    def lat(self) -> SemiNormalizedDimension:
+        return SemiNormalizedDimension(-90.0, 90.0, (1 << 21) - 1)
+
+    @property
+    def time(self) -> SemiNormalizedDimension:
+        return SemiNormalizedDimension(
+            0.0, float(max_offset(self.period)), (1 << 20) - 1)
+
+    def index(self, x, y, t, xp=jnp):
+        return interleave3(self.lon.normalize(x, xp=xp),
+                           self.lat.normalize(y, xp=xp),
+                           self.time.normalize(t, xp=xp), xp=xp).astype(xp.int64)
+
+    def invert(self, z, xp=np):
+        ix, iy, it = deinterleave3(z, xp=xp)
+        return (self.lon.denormalize(ix, xp=xp),
+                self.lat.denormalize(iy, xp=xp),
+                self.time.denormalize(it, xp=xp))
+
+
+_Z2 = LegacyZ2SFC()
+_Z3_CACHE: dict[TimePeriod, LegacyZ3SFC] = {}
+
+
+def legacy_z2_sfc() -> LegacyZ2SFC:
+    return _Z2
+
+
+def legacy_z3_sfc(period: TimePeriod | str = TimePeriod.WEEK) -> LegacyZ3SFC:
+    period = TimePeriod(period) if not isinstance(period, TimePeriod) else period
+    if period not in _Z3_CACHE:
+        _Z3_CACHE[period] = LegacyZ3SFC(period)
+    return _Z3_CACHE[period]

@@ -123,8 +123,16 @@ def _prop_column(batch: FeatureBatch, prop: str) -> np.ndarray:
     if bracket:
         inner = f"[{idx}.{inner}" if inner else f"[{idx}"
     col = batch.column(attr)
-    docs = [(_json.loads(v) if isinstance(v, (str, bytes)) and v else v)
-            for v in col]
+
+    def parse(v):
+        if not (isinstance(v, (str, bytes)) and v):
+            return v
+        try:
+            return _json.loads(v)
+        except ValueError:
+            return None  # malformed json row: non-matching, not fatal
+
+    docs = [parse(v) for v in col]
     if not inner:
         return np.asarray(docs, dtype=object)
     return np.asarray([None if d is None else json_path_get(d, "$." + inner)
@@ -206,7 +214,11 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
         if f.op == "=":
             return np.asarray(col == f.value)
         if f.op == "<>":
-            return np.asarray(col != f.value)
+            mask = np.asarray(col != f.value)
+            if col.dtype == object:
+                # a missing (None) value matches nothing, <> included
+                mask &= np.array([v is not None for v in col])
+            return mask
         return _safe_compare(col, f.value, f.op)
     if isinstance(f, Between):
         col = _prop_column(batch, f.prop)
@@ -239,5 +251,6 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
     if isinstance(f, Like):
         col = _prop_column(batch, f.prop)
         rx = _like_regex(f.pattern, f.case_insensitive)
-        return np.array([bool(rx.match(str(v))) for v in col], dtype=bool)
+        return np.array([v is not None and bool(rx.match(str(v)))
+                         for v in col], dtype=bool)
     raise NotImplementedError(f"cannot evaluate {type(f).__name__}")

@@ -16,6 +16,7 @@ filters fall back to a full scan with vectorized evaluation.
 from __future__ import annotations
 
 import bisect
+import threading
 
 import numpy as np
 
@@ -91,6 +92,9 @@ class GeoCQEngine:
 
     def __init__(self, sft: FeatureType):
         self.sft = sft
+        # one lock serializes mutation vs. query — the engine is built for
+        # churny live-cache use where readers and the consumer thread race
+        self._lock = threading.RLock()
         self._features: dict[str, dict] = {}       # fid → attribute dict
         self._xy: dict[str, tuple] = {}            # fid → (x, y)
         self._spatial = BucketIndex()
@@ -109,9 +113,13 @@ class GeoCQEngine:
     # -- mutation ----------------------------------------------------------
     def insert(self, fid: str, attrs: dict, x: float, y: float):
         """Insert or replace one feature (the live-cache upsert)."""
+        with self._lock:
+            self._insert(fid, attrs, x, y)
+
+    def _insert(self, fid: str, attrs: dict, x: float, y: float):
         fid = str(fid)
         if fid in self._features:
-            self.remove(fid)
+            self._remove(fid)
         self._features[fid] = attrs
         self._xy[fid] = (float(x), float(y))
         self._spatial.insert(fid, float(x), float(y))
@@ -124,11 +132,16 @@ class GeoCQEngine:
         x, y = batch.geom_xy()
         names = [a.name for a in self.sft.attributes if not a.is_geometry]
         cols = {n: batch.column(n) for n in names if n in batch.columns}
-        for i in range(len(batch)):
-            attrs = {n: c[i] for n, c in cols.items()}
-            self.insert(str(batch.ids[i]), attrs, x[i], y[i])
+        with self._lock:
+            for i in range(len(batch)):
+                attrs = {n: c[i] for n, c in cols.items()}
+                self._insert(str(batch.ids[i]), attrs, x[i], y[i])
 
     def remove(self, fid: str) -> bool:
+        with self._lock:
+            return self._remove(fid)
+
+    def _remove(self, fid: str) -> bool:
         fid = str(fid)
         attrs = self._features.pop(fid, None)
         if attrs is None:
@@ -151,10 +164,11 @@ class GeoCQEngine:
         from .filters.ecql import parse_ecql
         if isinstance(filt, str):
             filt = parse_ecql(filt)
-        ids = self._candidates(filt)
-        if ids is None:
-            ids = set(self._features)
-        batch = self._to_batch(sorted(ids))
+        with self._lock:
+            ids = self._candidates(filt)
+            if ids is None:
+                ids = set(self._features)
+            batch = self._to_batch(sorted(ids))
         if len(batch) == 0:
             return batch
         mask = evaluate_filter(filt, batch)

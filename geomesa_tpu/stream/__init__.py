@@ -4,7 +4,8 @@ spatially-indexed cache with feature events)."""
 
 from .messages import GeoMessage
 from .broker import InProcessBroker
+from .polling import PollingStreamSource
 from .store import StreamDataStore, LiveFeatureCache
 
 __all__ = ["GeoMessage", "InProcessBroker", "StreamDataStore",
-           "LiveFeatureCache"]
+           "LiveFeatureCache", "PollingStreamSource"]

@@ -256,3 +256,74 @@ def test_blob_id_path_traversal_rejected(tmp_path):
         bs.put(b"x", geometry=Point(0, 0), blob_id="../escape")
     assert bs.get("../../etc/passwd") is None
     bs.delete_blob("../../etc/passwd")  # no-op, no exception
+
+
+def test_json_path_malformed_and_none_semantics():
+    ds = TpuDataStore()
+    ds.create_schema("jm", "attrs:Json,name:String,dtg:Date,*geom:Point")
+    ds.write("jm", {
+        "attrs": np.asarray(['{"a": 30}', '{bad', '{}'], dtype=object),
+        "name": np.asarray(["x", None, "Nellie"], dtype=object),
+        "dtg": np.zeros(3, dtype=np.int64),
+        "geom": (np.zeros(3), np.zeros(3))})
+    # malformed json row is a non-match, not a crash
+    assert len(ds.query("jm", '"$.attrs.a" = 30')) == 1
+    # None values do not match <>
+    assert len(ds.query("jm", '"$.attrs.a" <> 30')) == 0
+    # None does not match LIKE (str(None) = 'None' must not leak)
+    assert len(ds.query("jm", "name LIKE 'N%'")) == 1
+
+
+def test_memory_engine_concurrent_churn():
+    import threading
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.memory import GeoCQEngine
+    eng = GeoCQEngine(parse_spec("c", "v:Int,*geom:Point"))
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            eng.insert(f"f{i % 50}", {"v": i}, i % 10, i % 10)
+            if i % 3 == 0:
+                eng.remove(f"f{(i + 25) % 50}")
+            i += 1
+
+    def read():
+        while not stop.is_set():
+            try:
+                eng.query("v >= 0")
+                eng.query("BBOX(geom, 0, 0, 5, 5)")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=churn) for _ in range(2)] + [
+        threading.Thread(target=read) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_polling_truncation_recovery(tmp_path):
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.io.converters import converter_from_config
+    from geomesa_tpu.stream import PollingStreamSource
+    sft = parse_spec("tr", "v:Int,*geom:Point")
+    conv = converter_from_config(sft, {
+        "type": "csv",
+        "fields": [{"name": "v", "transform": "toInt($0)"},
+                   {"name": "geom", "transform": "point($1,$2)"}]})
+    got = []
+    src = PollingStreamSource(str(tmp_path / "*.log"), conv, got.append)
+    f = tmp_path / "r.log"
+    f.write_text("1,0,0\n2,0,0\n")
+    assert src.poll_once() == 2
+    f.write_text("9,0,0\n")  # truncation (logrotate copytruncate)
+    assert src.poll_once() == 1
+    assert [int(b.column("v")[0]) for b in got][-1] == 9

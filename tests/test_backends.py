@@ -336,3 +336,33 @@ def test_geohash_neighbors_antimeridian():
     from geomesa_tpu.utils import geohash_decode
     lons = geohash_decode(nbrs)[0]
     assert (lons < -179).any()          # wrapped across the antimeridian
+
+
+def test_polling_stream_source(tmp_path):
+    """Polling source tails growing files through a converter into a sink
+    (geomesa-stream analog)."""
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.io.converters import converter_from_config
+    from geomesa_tpu.stream import PollingStreamSource
+
+    sft = parse_spec("pol", "v:Int,*geom:Point")
+    conv = converter_from_config(sft, {
+        "type": "csv",
+        "fields": [
+            {"name": "v", "transform": "toInt($0)"},
+            {"name": "geom", "transform": "point($1,$2)"},
+        ]})
+    got = []
+    src = PollingStreamSource(str(tmp_path / "*.log"), conv, got.append)
+    f = tmp_path / "a.log"
+    f.write_text("1,0.0,0.0\n2,1.0,1.0\n")
+    assert src.poll_once() == 2
+    # partial line is held back until completed
+    with open(f, "a") as fh:
+        fh.write("3,2.0")
+    assert src.poll_once() == 0
+    with open(f, "a") as fh:
+        fh.write(",2.0\n")
+    assert src.poll_once() == 1
+    assert sum(len(b) for b in got) == 3
+    assert src.poll_once() == 0

@@ -1,0 +1,99 @@
+"""Raster store tests: pyramid levels, bbox query, device mosaic."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.raster import RasterStore, RasterTile
+
+
+def checker(v, shape=(16, 16)):
+    """Constant tile of value v."""
+    return np.full(shape, float(v), dtype=np.float32)
+
+
+@pytest.fixture
+def store():
+    rs = RasterStore()
+    # 2x2 grid of 16x16 tiles over [0,2]x[0,2]; value = tile index
+    k = 0
+    for ty in range(2):
+        for tx in range(2):
+            rs.put(checker(k), (tx, ty, tx + 1, ty + 1))
+            k += 1
+    # one coarse tile covering everything (32x smaller resolution)
+    rs.put(checker(99, (8, 8)), (0, 0, 2, 2))
+    return rs
+
+
+def test_levels_and_counts(store):
+    res = store.available_resolutions
+    assert len(res) == 2
+    assert res[0] == pytest.approx(1 / 16)
+    assert res[1] == pytest.approx(2 / 8)
+    assert store.count() == 5
+    assert store.count(res[0]) == 4
+
+
+def test_get_tiles_bbox(store):
+    tiles = store.get_tiles((0.2, 0.2, 0.8, 0.8))
+    assert len(tiles) == 1 and tiles[0].data[0, 0] == 0
+    tiles = store.get_tiles((0.5, 0.5, 1.5, 1.5))
+    assert len(tiles) == 4
+    # coarse level explicitly
+    tiles = store.get_tiles((0.2, 0.2, 0.8, 0.8), resolution=0.25)
+    assert len(tiles) == 1 and tiles[0].data[0, 0] == 99
+
+
+def test_resolution_selection(store):
+    fine, coarse = store.available_resolutions
+    # a request coarser than both picks the coarsest fine-enough level
+    assert store._pick_resolution(1.0) == coarse
+    assert store._pick_resolution(0.1) == fine
+    # finer than available -> finest existing
+    assert store._pick_resolution(0.001) == fine
+    assert store._pick_resolution(None) == fine
+
+
+def test_mosaic_values(store):
+    grid = store.mosaic((0, 0, 2, 2), 32, 32)
+    assert grid.shape == (32, 32)
+    # row 0 is north (y near 2): tiles 2 (left) and 3 (right)
+    assert grid[0, 0] == 2 and grid[0, -1] == 3
+    assert grid[-1, 0] == 0 and grid[-1, -1] == 1
+    # no nodata inside full coverage
+    assert not np.isnan(grid).any()
+
+
+def test_mosaic_nodata_and_partial():
+    rs = RasterStore()
+    rs.put(checker(7), (0, 0, 1, 1))
+    grid = rs.mosaic((0, 0, 2, 2), 16, 16)
+    south_west = grid[8:, :8]
+    assert (south_west == 7).all()
+    assert np.isnan(grid[:8, 8:]).all()  # north-east uncovered
+
+
+def test_mosaic_resamples_resolution(store):
+    # ask at the coarse level: everything is the coarse tile's value
+    grid = store.mosaic((0, 0, 2, 2), 8, 8, resolution=0.25)
+    assert (grid == 99).all()
+
+
+def test_empty_store():
+    rs = RasterStore()
+    assert rs.get_tiles((0, 0, 1, 1)) == []
+    grid = rs.mosaic((0, 0, 1, 1), 4, 4)
+    assert np.isnan(grid).all()
+
+
+def test_mismatched_tile_shape_rejected():
+    rs = RasterStore()
+    rs.put(checker(1), (0, 0, 1, 1))
+    with pytest.raises(ValueError):
+        # same resolution but different shape cannot stack
+        rs.put(checker(1, (16, 32)), (2, 0, 4, 1))
+
+
+def test_tile_resolution():
+    t = RasterTile(np.zeros((10, 20), dtype=np.float32), (0, 0, 2, 1))
+    assert t.resolution == pytest.approx(0.1)

@@ -91,3 +91,29 @@ def test_z3_ranges_contain_all_points(rng):
 def test_z3_whole_period():
     sfc = z3_sfc(TimePeriod.WEEK)
     assert sfc.whole_period == (0, max_offset(TimePeriod.WEEK))
+
+
+def test_legacy_semi_normalized_curves():
+    """Legacy (ceil-binned) curves differ from the current ones exactly at
+    bin boundaries — the back-compat property the reference keeps them for
+    (LegacyZ3SFC.scala, NormalizedDimension.scala:82-97)."""
+    import numpy as np
+    from geomesa_tpu.curve import z2_sfc, z3_sfc
+    from geomesa_tpu.curve.legacy import legacy_z2_sfc, legacy_z3_sfc
+
+    lz2, z2 = legacy_z2_sfc(), z2_sfc()
+    x = np.array([-180.0, -179.99997, 0.0, 179.99999])
+    y = np.array([-90.0, 0.0, 45.0, 89.99999])
+    lz = np.asarray(lz2.index(x, y, xp=np))
+    cz = np.asarray(z2.index(x, y, xp=np))
+    assert (lz != cz).any()          # different binning
+    # roundtrip stays within one legacy bin width
+    rx, ry = lz2.invert(lz, xp=np)
+    assert np.abs(rx - x).max() < 360.0 / ((1 << 31) - 1) * 1.5
+    # z3 legacy time precision is 2^20-1 (vs 2^21 bins current)
+    lz3 = legacy_z3_sfc("week")
+    assert lz3.time.max_index == (1 << 20) - 1
+    z = np.asarray(lz3.index(np.array([10.0]), np.array([20.0]),
+                             np.array([1000.0]), xp=np))
+    rx, ry, rt = lz3.invert(z, xp=np)
+    assert abs(float(rx[0]) - 10.0) < 1e-3 and abs(float(ry[0]) - 20.0) < 1e-3
