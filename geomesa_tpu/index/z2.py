@@ -19,7 +19,7 @@ import numpy as np
 from ..curve.sfc import Z2SFC, z2_sfc
 from ..curve.zorder import deinterleave2
 from ..config import DEFAULT_MAX_RANGES
-from ..ops.search import expand_ranges, gather_capacity
+from ..ops.search import expand_ranges, gather_capacity, run_packed_query
 
 __all__ = ["Z2PointIndex", "Z2QueryPlan", "plan_z2_query"]
 
@@ -54,15 +54,15 @@ def plan_z2_query(boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> Z2QueryPlan:
     return Z2QueryPlan(rzlo=zr[:, 0], rzhi=zr[:, 1], ixy=ixy, boxes=boxes)
 
 
-@jax.jit
-def _range_bounds(z, rzlo, rzhi):
+@partial(jax.jit, static_argnames=("capacity",))
+def _query_packed(z, pos, x, y, rzlo, rzhi, ixy, boxes, capacity: int):
+    """One-dispatch scan (seeks + gather + fused mask) returning the packed
+    ``[total, pos|-1, …]`` vector — one device round trip per query (see
+    z3._query_packed for the protocol rationale)."""
     starts = jnp.searchsorted(z, rzlo, side="left")
     ends = jnp.searchsorted(z, rzhi, side="right")
-    return starts, jnp.maximum(ends - starts, 0)
-
-
-@partial(jax.jit, static_argnames=("capacity",))
-def _scan_candidates(z, pos, x, y, starts, counts, ixy, boxes, capacity: int):
+    counts = jnp.maximum(ends - starts, 0)
+    total = jnp.sum(counts)
     idx, valid, _ = expand_ranges(starts, counts, capacity)
     zc = z[idx]
     posc = pos[idx]
@@ -83,11 +83,23 @@ def _scan_candidates(z, pos, x, y, starts, counts, ixy, boxes, capacity: int):
         & (xc[:, None] <= boxes[None, :, 2])
         & (yc[:, None] <= boxes[None, :, 3])
     ).any(axis=1)
-    return posc, valid & in_box_int & in_box_exact
+    mask = valid & in_box_int & in_box_exact
+    packed = jnp.where(mask, posc.astype(jnp.int64), jnp.int64(-1))
+    return jnp.concatenate([total[None].astype(jnp.int64), packed])
+
+
+@partial(jax.jit, static_argnames=("sfc",))
+def _encode_sort_z2(sfc, a, b):
+    zv = sfc.index(a, b)
+    return jax.lax.sort(
+        (zv, jnp.arange(zv.shape[0], dtype=jnp.int32)),
+        dimension=0, num_keys=1)
 
 
 class Z2PointIndex:
     """Device-resident Z2 index over point features."""
+
+    DEFAULT_CAPACITY = 1 << 15
 
     def __init__(self, z, pos, x, y):
         self.sfc: Z2SFC = z2_sfc()
@@ -95,6 +107,7 @@ class Z2PointIndex:
         self.pos = pos
         self.x = x
         self.y = y
+        self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
     def build(cls, x, y) -> "Z2PointIndex":
@@ -103,9 +116,8 @@ class Z2PointIndex:
         sfc = z2_sfc()
         xd = jnp.asarray(x)
         yd = jnp.asarray(y)
-        z = jax.jit(lambda a, b: sfc.index(a, b))(xd, yd)
-        order = jnp.argsort(z)
-        return cls(z=z[order], pos=order.astype(jnp.int32), x=xd, y=yd)
+        z_s, pos = _encode_sort_z2(sfc, xd, yd)
+        return cls(z=z_s, pos=pos, x=xd, y=yd)
 
     def __len__(self) -> int:
         return int(self.z.shape[0])
@@ -115,18 +127,13 @@ class Z2PointIndex:
         plan = plan_z2_query(boxes, max_ranges)
         if plan.num_ranges == 0 or len(self) == 0:
             return np.empty(0, dtype=np.int64)
-        starts, counts = _range_bounds(
-            self.z, jnp.asarray(plan.rzlo), jnp.asarray(plan.rzhi)
-        )
-        total = int(jnp.sum(counts))
-        if total == 0:
-            return np.empty(0, dtype=np.int64)
-        posc, mask = _scan_candidates(
-            self.z, self.pos, self.x, self.y,
-            starts, counts,
-            jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
-            capacity=gather_capacity(total),
-        )
-        posc = np.asarray(posc)
-        mask = np.asarray(mask)
-        return np.sort(posc[mask]).astype(np.int64)
+        def dispatch(capacity):
+            return _query_packed(
+                self.z, self.pos, self.x, self.y,
+                jnp.asarray(plan.rzlo), jnp.asarray(plan.rzhi),
+                jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
+                capacity=capacity,
+            )
+
+        hits, self._capacity = run_packed_query(dispatch, self._capacity)
+        return hits

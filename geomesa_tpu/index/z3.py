@@ -37,7 +37,9 @@ from ..curve.binnedtime import TimePeriod, max_date_ms, max_offset, to_binned_ti
 from ..curve.sfc import Z3SFC, z3_sfc
 from ..curve.zorder import deinterleave3
 from ..config import DEFAULT_MAX_RANGES, QueryProperties
-from ..ops.search import expand_ranges, gather_capacity, searchsorted2
+from ..ops.search import (
+    expand_ranges, gather_capacity, run_packed_query, searchsorted2,
+)
 
 
 def _use_pallas_scan() -> bool:
@@ -163,42 +165,50 @@ def plan_z3_query(
     )
 
 
-@jax.jit
-def _range_bounds(bins, z, rbin, rzlo, rzhi):
+@partial(jax.jit, static_argnames=("capacity", "use_pallas"))
+def _query_packed(
+    bins, z, pos, x, y, dtg,
+    rbin, rzlo, rzhi, rtlo, rthi,
+    ixy, boxes, t_lo_ms, t_hi_ms,
+    capacity: int, use_pallas: bool,
+):
+    """The WHOLE scan as one dispatch: binary-search seeks + fixed-capacity
+    gather + fused candidate mask, returning a single packed int64 vector
+    ``[total, pos_0|-1, pos_1|-1, …]``.
+
+    One program + one transfer per query: through a remote-device tunnel a
+    host sync costs ~100ms, so the old plan (range bounds → host count →
+    scan → host mask) paid three round trips where this pays one.  The
+    mask fuses the reference's two server-side stages — the z-decode
+    int-space bounds test (Z3Iterator/Z3Filter, filters/Z3Filter.scala:
+    19-55) and the exact double-precision re-check
+    (FilterTransformIterator) — and ``total`` lets the host detect
+    capacity overflow and retry bigger (rare; capacity is adaptive).
+    """
     starts = searchsorted2(bins, z, rbin, rzlo, side="left")
     ends = searchsorted2(bins, z, rbin, rzhi, side="right")
-    return starts, jnp.maximum(ends - starts, 0)
-
-
-@partial(jax.jit, static_argnames=("capacity",))
-def _scan_candidates(
-    bins, z, pos, x, y, dtg,
-    starts, counts, rtlo, rthi,
-    ixy, boxes, t_lo_ms, t_hi_ms,
-    capacity: int,
-):
-    """Fixed-capacity candidate gather + fused filter.
-
-    The mask fuses the reference's two server-side stages: the z-decode
-    int-space bounds test (Z3Iterator/Z3Filter) and the exact geometry/time
-    re-check (FilterTransformIterator) — one pass over gathered candidates.
-    """
+    counts = jnp.maximum(ends - starts, 0)
+    total = jnp.sum(counts)
     idx, valid, rid = expand_ranges(starts, counts, capacity)
     zc = z[idx]
     posc = pos[idx]
-    ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
-    ix = ix.astype(jnp.int32)
-    iy = iy.astype(jnp.int32)
-    it = it.astype(jnp.int32)
-    # int-space spatial check against any box (B, 4)
-    in_box_int = (
-        (ix[:, None] >= ixy[None, :, 0])
-        & (iy[:, None] >= ixy[None, :, 1])
-        & (ix[:, None] <= ixy[None, :, 2])
-        & (iy[:, None] <= ixy[None, :, 3])
-    ).any(axis=1)
-    in_time_int = (it >= rtlo[rid]) & (it <= rthi[rid])
-    # exact double-precision predicate on the original columns
+    if use_pallas:
+        from ..ops.pallas_kernels import z3_mask_pallas
+        mask_int = z3_mask_pallas(zc, ixy, rtlo[rid], rthi[rid])
+    else:
+        ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
+        ix = ix.astype(jnp.int32)
+        iy = iy.astype(jnp.int32)
+        it = it.astype(jnp.int32)
+        in_box_int = (
+            (ix[:, None] >= ixy[None, :, 0])
+            & (iy[:, None] >= ixy[None, :, 1])
+            & (ix[:, None] <= ixy[None, :, 2])
+            & (iy[:, None] <= ixy[None, :, 3])
+        ).any(axis=1)
+        mask_int = in_box_int & (it >= rtlo[rid]) & (it <= rthi[rid])
+    # exact double-precision predicate on the original columns (the
+    # FilterTransformIterator re-check)
     xc = x[posc]
     yc = y[posc]
     tc = dtg[posc]
@@ -209,32 +219,9 @@ def _scan_candidates(
         & (yc[:, None] <= boxes[None, :, 3])
     ).any(axis=1)
     in_time_exact = (tc >= t_lo_ms) & (tc <= t_hi_ms)
-    mask = valid & in_box_int & in_time_int & in_box_exact & in_time_exact
-    return posc, mask
-
-
-@partial(jax.jit, static_argnames=("capacity",))
-def _gather_candidates(z, pos, starts, counts, rtlo, rthi, capacity: int):
-    """Stage 1 of the pallas scan: fixed-capacity gather of candidate keys
-    plus per-candidate time bounds (by owning range)."""
-    idx, valid, rid = expand_ranges(starts, counts, capacity)
-    return z[idx], pos[idx], valid, rtlo[rid], rthi[rid]
-
-
-@partial(jax.jit, static_argnames=())
-def _exact_recheck(x, y, dtg, posc, boxes, t_lo_ms, t_hi_ms):
-    """Stage 3: exact double-precision predicate on the original columns
-    (the FilterTransformIterator re-check)."""
-    xc = x[posc]
-    yc = y[posc]
-    tc = dtg[posc]
-    in_box = (
-        (xc[:, None] >= boxes[None, :, 0])
-        & (yc[:, None] >= boxes[None, :, 1])
-        & (xc[:, None] <= boxes[None, :, 2])
-        & (yc[:, None] <= boxes[None, :, 3])
-    ).any(axis=1)
-    return in_box & (tc >= t_lo_ms) & (tc <= t_hi_ms)
+    mask = valid & mask_int & in_box_exact & in_time_exact
+    packed = jnp.where(mask, posc.astype(jnp.int64), jnp.int64(-1))
+    return jnp.concatenate([total[None].astype(jnp.int64), packed])
 
 
 #: tri-state: None = untried, True = pallas scan works on this backend,
@@ -242,22 +229,23 @@ def _exact_recheck(x, y, dtg, posc, boxes, t_lo_ms, t_hi_ms):
 _pallas_scan_ok: bool | None = None
 
 
-def _scan_candidates_pallas(bins, z, pos, x, y, dtg, starts, counts,
-                            rtlo, rthi, ixy, boxes, t_lo_ms, t_hi_ms,
-                            capacity: int):
-    """Pallas variant of :func:`_scan_candidates`: the z-decode +
-    int-bounds stage (Z3Filter.inBounds) runs as a fused VMEM kernel."""
-    from ..ops.pallas_kernels import z3_mask_pallas
-
-    zc, posc, valid, tlo_c, thi_c = _gather_candidates(
-        z, pos, starts, counts, rtlo, rthi, capacity)
-    mask_int = z3_mask_pallas(zc, ixy, tlo_c, thi_c)
-    mask_exact = _exact_recheck(x, y, dtg, posc, boxes, t_lo_ms, t_hi_ms)
-    return posc, valid & mask_int & mask_exact
+@partial(jax.jit, static_argnames=("sfc",))
+def _encode_sort_z3(sfc, xs, ys, os_, bs):
+    """Key encode + 2-key variadic sort (bin-major), permutation as
+    payload.  Module-level so repeated builds share one compile (Z3SFC is
+    a frozen dataclass, hence a hashable static arg)."""
+    zv = sfc.index(xs, ys, os_)
+    return jax.lax.sort(
+        (bs, zv, jnp.arange(zv.shape[0], dtype=jnp.int32)),
+        dimension=0, num_keys=2)
 
 
 class Z3PointIndex:
     """Device-resident Z3 index over point features with timestamps."""
+
+    #: initial fixed gather capacity; grows adaptively on overflow so the
+    #: common case is exactly ONE device dispatch + ONE transfer per query
+    DEFAULT_CAPACITY = 1 << 15
 
     def __init__(self, period, bins, z, pos, x, y, dtg):
         self.period = TimePeriod.parse(period)
@@ -268,6 +256,7 @@ class Z3PointIndex:
         self.x = x
         self.y = y
         self.dtg = dtg
+        self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
     def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK) -> "Z3PointIndex":
@@ -285,17 +274,8 @@ class Z3PointIndex:
         bind = jnp.asarray(host_bins.astype(np.int32))
         offd = jnp.asarray(host_offs.astype(np.float64))
 
-        z = jax.jit(lambda a, b, c: sfc.index(a, b, c))(xd, yd, offd)
-        order = jnp.lexsort((z, bind))
-        return cls(
-            period,
-            bins=bind[order],
-            z=z[order],
-            pos=order.astype(jnp.int32),
-            x=xd,
-            y=yd,
-            dtg=td,
-        )
+        bins_s, z_s, pos = _encode_sort_z3(sfc, xd, yd, offd, bind)
+        return cls(period, bins=bins_s, z=z_s, pos=pos, x=xd, y=yd, dtg=td)
 
     def __len__(self) -> int:
         return int(self.z.shape[0])
@@ -307,36 +287,27 @@ class Z3PointIndex:
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
         if plan.num_ranges == 0 or len(self) == 0:
             return np.empty(0, dtype=np.int64)
-        starts, counts = _range_bounds(
-            self.bins, self.z,
-            jnp.asarray(plan.rbin), jnp.asarray(plan.rzlo), jnp.asarray(plan.rzhi),
-        )
-        total = int(jnp.sum(counts))
-        if total == 0:
-            return np.empty(0, dtype=np.int64)
         args = (
             self.bins, self.z, self.pos, self.x, self.y, self.dtg,
-            starts, counts,
+            jnp.asarray(plan.rbin), jnp.asarray(plan.rzlo),
+            jnp.asarray(plan.rzhi),
             jnp.asarray(plan.rtlo), jnp.asarray(plan.rthi),
             jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
             plan.t_lo_ms, plan.t_hi_ms,
         )
-        capacity = gather_capacity(total)
-        global _pallas_scan_ok
-        posc = mask = None
-        if _pallas_scan_ok is not False and _use_pallas_scan():
-            try:
-                posc, mask = _scan_candidates_pallas(*args, capacity=capacity)
-                # materialize INSIDE the try: dispatch is async, so kernel
-                # failures only surface when results are pulled to host
-                posc = np.asarray(posc)
-                mask = np.asarray(mask)
-                _pallas_scan_ok = True
-            except Exception:  # Mosaic lowering/runtime failure → XLA path
-                _pallas_scan_ok = False
-                posc = mask = None
-        if posc is None:
-            posc, mask = _scan_candidates(*args, capacity=capacity)
-            posc = np.asarray(posc)
-            mask = np.asarray(mask)
-        return np.sort(posc[mask]).astype(np.int64)
+        def dispatch(capacity):
+            global _pallas_scan_ok
+            if _pallas_scan_ok is not False and _use_pallas_scan():
+                try:
+                    # materialize INSIDE the try: dispatch is async, so
+                    # kernel failures surface when results reach the host
+                    out = np.asarray(_query_packed(
+                        *args, capacity=capacity, use_pallas=True))
+                    _pallas_scan_ok = True
+                    return out
+                except Exception:  # Mosaic failure → XLA path
+                    _pallas_scan_ok = False
+            return _query_packed(*args, capacity=capacity, use_pallas=False)
+
+        hits, self._capacity = run_packed_query(dispatch, self._capacity)
+        return hits

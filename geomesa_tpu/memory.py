@@ -155,7 +155,16 @@ class GeoCQEngine:
         return True
 
     def clear(self):
-        self.__init__(self.sft)
+        with self._lock:
+            # reset in place — replacing the lock itself would let an
+            # in-flight reader race a post-clear writer
+            self._features.clear()
+            self._xy.clear()
+            self._spatial.clear()
+            for idx in self._hash.values():
+                idx.by_value.clear()
+            for idx in self._sorted.values():
+                idx._pairs, idx._keys, idx._stale = [], [], False
 
     # -- query -------------------------------------------------------------
     def query(self, filt) -> FeatureBatch:

@@ -55,12 +55,18 @@ _CHUNK = 512          # features per program along N
 _GTILE = 2048         # grid cells per program along G
 
 
+_ROWS = 8             # sublane-aligned rows per block (Mosaic requires 8)
+
+
 def _density_kernel(cells_ref, w_ref, out_ref, acc_ref):
     """One (grid-tile j, chunk i) step: acc += w_i @ onehot(cells_i, tile_j).
 
     The chunk axis i is the fastest grid dimension, so for each grid tile j
     the accumulator is initialized at i == 0, summed over all chunks, and
     flushed at the last chunk before the next tile reuses the scratch.
+    Each block carries _ROWS sublane rows of _CHUNK candidates; the rows
+    accumulate via _ROWS sequential MXU contractions (onehot stays within
+    VMEM budget that way).
     """
     j = pl.program_id(0)
     i = pl.program_id(1)
@@ -70,12 +76,14 @@ def _density_kernel(cells_ref, w_ref, out_ref, acc_ref):
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    cells = cells_ref[:]                       # (1, CHUNK) int32 flat cell ids
-    w = w_ref[:]                               # (1, CHUNK) f32 (0 where masked)
+    cells = cells_ref[:]                   # (_ROWS, CHUNK) int32 flat cell ids
+    w = w_ref[:]                           # (_ROWS, CHUNK) f32 (0 where masked)
     base = j * _GTILE
     tile_ids = base + jax.lax.broadcasted_iota(jnp.int32, (_CHUNK, _GTILE), 1)
-    onehot = (cells.reshape(_CHUNK, 1) == tile_ids).astype(jnp.float32)
-    acc_ref[:] += jnp.dot(w, onehot, preferred_element_type=jnp.float32)
+    for r in range(_ROWS):                 # static unroll
+        onehot = (cells[r].reshape(_CHUNK, 1) == tile_ids).astype(jnp.float32)
+        acc_ref[:] += jnp.dot(w[r].reshape(1, _CHUNK), onehot,
+                              preferred_element_type=jnp.float32)
 
     @pl.when(i == n_i - 1)
     def _():
@@ -102,30 +110,33 @@ def density_grid_pallas(x, y, weights, mask, env, width: int, height: int):
     w = jnp.where(mask, weights, 0.0).astype(jnp.float32)
 
     n = cells.shape[0]
-    n_pad = max(_CHUNK, ((n + _CHUNK - 1) // _CHUNK) * _CHUNK)
+    block = _ROWS * _CHUNK
+    n_pad = max(block, ((n + block - 1) // block) * block)
     cells = jnp.pad(cells, (0, n_pad - n), constant_values=width * height)
     w = jnp.pad(w, (0, n_pad - n))
 
     g = width * height
     g_pad = max(_GTILE, ((g + _GTILE - 1) // _GTILE) * _GTILE)
 
-    n_chunks = n_pad // _CHUNK
-    grid = (g_pad // _GTILE, n_chunks)
-    out = pl.pallas_call(
-        _density_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, _CHUNK), lambda j, i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _CHUNK), lambda j, i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, _GTILE), lambda j, i: (0, j),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, g_pad), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((1, _GTILE), jnp.float32)],
-        interpret=_interpret(),
-    )(cells.reshape(n_chunks, _CHUNK), w.reshape(n_chunks, _CHUNK))
+    n_rows = n_pad // _CHUNK
+    grid = (g_pad // _GTILE, n_rows // _ROWS)
+    # Mosaic rejects i64 program constants; trace the kernel in 32-bit mode
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _density_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((_ROWS, _CHUNK), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((_ROWS, _CHUNK), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((8, _GTILE), lambda j, i: (0, j),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((8, g_pad), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((8, _GTILE), jnp.float32)],
+            interpret=_interpret(),
+        )(cells.reshape(n_rows, _CHUNK), w.reshape(n_rows, _CHUNK))
     return out[0, :g].reshape(height, width)
 
 
@@ -136,26 +147,41 @@ def density_grid_pallas(x, y, weights, mask, env, width: int, height: int):
 _ZCHUNK = 1024
 
 
-def _z3_mask_kernel(boxes_ref, z_ref, tlo_ref, thi_ref, out_ref):
+def _combine3_32(v):
+    """Every-3rd-bit extract from a 32-bit lane (11 output bits)."""
+    v = v & jnp.uint32(0x49249249)
+    v = (v ^ (v >> jnp.uint32(2))) & jnp.uint32(0xC30C30C3)
+    v = (v ^ (v >> jnp.uint32(4))) & jnp.uint32(0x0F00F00F)
+    v = (v ^ (v >> jnp.uint32(8))) & jnp.uint32(0xFF0000FF)
+    v = (v ^ (v >> jnp.uint32(16))) & jnp.uint32(0x0000FFFF)
+    return v
+
+
+def _z3_mask_kernel(boxes_ref, zlo_ref, zhi_ref, tlo_ref, thi_ref, out_ref):
     """Per-chunk Z3Filter.inBounds: decode z, OR the R box tests, AND the
-    per-candidate time-offset bounds."""
-    z = z_ref[:].astype(jnp.uint64)                    # (1, ZCHUNK)
+    per-candidate time-offset bounds.
 
-    def combine3(v):
-        v = v & jnp.uint64(0x1249249249249249)
-        v = (v ^ (v >> jnp.uint64(2))) & jnp.uint64(0x10C30C30C30C30C3)
-        v = (v ^ (v >> jnp.uint64(4))) & jnp.uint64(0x100F00F00F00F00F)
-        v = (v ^ (v >> jnp.uint64(8))) & jnp.uint64(0x1F0000FF0000FF)
-        v = (v ^ (v >> jnp.uint64(16))) & jnp.uint64(0x1F00000000FFFF)
-        v = (v ^ (v >> jnp.uint64(32))) & jnp.uint64(0x1FFFFF)
-        return v
+    Mosaic has no 64-bit lanes, so the z column arrives as two uint32
+    halves; each 21-bit dimension recombines from an every-3rd-bit
+    extract of both halves (offsets differ because 32 % 3 == 2)."""
+    z_lo = zlo_ref[:]                                  # (_ROWS, ZCHUNK) u32
+    z_hi = zhi_ref[:]
 
-    xs = combine3(z).astype(jnp.int32)
-    ys = combine3(z >> jnp.uint64(1)).astype(jnp.int32)
-    ts = combine3(z >> jnp.uint64(2)).astype(jnp.int32)
+    def decode(shift):
+        # dim bits sit at z positions p = 3k + shift; the hi half's local
+        # offset is (shift + 1) % 3 and the lo half contributes
+        # ceil((32 - shift) / 3) low bits
+        nlo = (32 - shift + 2) // 3
+        lo = _combine3_32(z_lo >> jnp.uint32(shift))
+        hi = _combine3_32(z_hi >> jnp.uint32((shift + 1) % 3))
+        return (lo | (hi << jnp.uint32(nlo))).astype(jnp.int32)
+
+    xs = decode(0)
+    ys = decode(1)
+    ts = decode(2)
 
     r = boxes_ref.shape[0]
-    hit = jnp.zeros(z.shape, jnp.bool_)
+    hit = jnp.zeros(z_lo.shape, jnp.bool_)
     for k in range(r):                                 # R is static & small
         ok = (xs >= boxes_ref[k, 0]) & (ys >= boxes_ref[k, 1])
         ok &= (xs <= boxes_ref[k, 2]) & (ys <= boxes_ref[k, 3])
@@ -174,31 +200,35 @@ def z3_mask_pallas(z, ixy, tlo, thi):
     timeInBounds per row) as one fused VMEM pass.
     """
     n = z.shape[0]
-    n_pad = max(_ZCHUNK, ((n + _ZCHUNK - 1) // _ZCHUNK) * _ZCHUNK)
+    block = _ROWS * _ZCHUNK
+    n_pad = max(block, ((n + block - 1) // block) * block)
     zp = jnp.pad(z.astype(jnp.int64), (0, n_pad - n))
+    # Mosaic has no 64-bit lanes: ship z as two uint32 halves
+    z_u = zp.astype(jnp.uint64)
+    z_lo = (z_u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    z_hi = (z_u >> jnp.uint64(32)).astype(jnp.uint32)
     tlop = jnp.pad(jnp.asarray(tlo, jnp.int32), (0, n_pad - n),
                    constant_values=1)
     thip = jnp.pad(jnp.asarray(thi, jnp.int32), (0, n_pad - n))
-    grid_n = n_pad // _ZCHUNK
+    n_rows = n_pad // _ZCHUNK
     ixy = jnp.asarray(ixy, jnp.int32).reshape(-1, 4)
     r = ixy.shape[0]
 
-    out = pl.pallas_call(
-        _z3_mask_kernel,
-        grid=(grid_n,),
-        in_specs=[
-            pl.BlockSpec((r, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, _ZCHUNK), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((grid_n, _ZCHUNK), jnp.bool_),
-        interpret=_interpret(),
-    )(ixy, zp.reshape(grid_n, _ZCHUNK), tlop.reshape(grid_n, _ZCHUNK),
-      thip.reshape(grid_n, _ZCHUNK))
+    vspec = pl.BlockSpec((_ROWS, _ZCHUNK), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    # Mosaic rejects i64 program constants; trace the kernel in 32-bit mode
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _z3_mask_kernel,
+            grid=(n_rows // _ROWS,),
+            in_specs=[
+                pl.BlockSpec((r, 4), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                vspec, vspec, vspec, vspec,
+            ],
+            out_specs=vspec,
+            out_shape=jax.ShapeDtypeStruct((n_rows, _ZCHUNK), jnp.bool_),
+            interpret=_interpret(),
+        )(ixy, z_lo.reshape(n_rows, _ZCHUNK), z_hi.reshape(n_rows, _ZCHUNK),
+          tlop.reshape(n_rows, _ZCHUNK), thip.reshape(n_rows, _ZCHUNK))
     return out.reshape(-1)[:n]

@@ -18,6 +18,25 @@ import jax.numpy as jnp
 __all__ = ["searchsorted2", "expand_ranges", "gather_capacity"]
 
 
+def run_packed_query(dispatch, capacity: int):
+    """Run a packed one-dispatch scan with adaptive capacity.
+
+    ``dispatch(capacity) -> np.ndarray`` must return the wire vector
+    ``[total, pos_0|-1, pos_1|-1, …]`` (int64).  If ``total`` exceeds the
+    capacity the gather truncated — regrow to the next power of two and
+    retry (rare; capacity is sticky with the caller).  Returns
+    ``(sorted_positions, capacity)``.
+    """
+    import numpy as np
+    while True:
+        out = np.asarray(dispatch(capacity))
+        total = int(out[0])
+        if total <= capacity:
+            packed = out[1:]
+            return np.sort(packed[packed >= 0]), capacity
+        capacity = gather_capacity(total)
+
+
 def gather_capacity(total: int, minimum: int = 1024) -> int:
     """Static gather capacity: next power of two ≥ total.  Bounds the number
     of distinct compiled shapes for the candidate-scan kernels to log2(N)."""

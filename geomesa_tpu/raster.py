@@ -89,7 +89,9 @@ class RasterStore:
 
     def count(self, resolution: float | None = None) -> int:
         if resolution is not None:
-            lvl = self._levels.get(resolution)
+            # levels are keyed on rounded resolution (put() rounds the
+            # same way), so a tile's own .resolution always matches
+            lvl = self._levels.get(round(resolution, 12))
             return 0 if lvl is None else len(lvl.tiles)
         return sum(len(v.tiles) for v in self._levels.values())
 

@@ -54,7 +54,6 @@ class PollingStreamSource:
             last_nl = chunk.rfind(b"\n")
             if last_nl < 0:
                 continue
-            self._offsets[path] = seen + last_nl + 1
             batch = self.converter.convert(chunk[:last_nl + 1])
             if len(batch):
                 if callable(self.sink):
@@ -62,6 +61,10 @@ class PollingStreamSource:
                 else:
                     self.sink.write(self.type_name, batch)
                 delivered += len(batch)
+            # advance only after successful convert+deliver: a transient
+            # sink failure re-reads the chunk next poll instead of
+            # silently dropping it
+            self._offsets[path] = seen + last_nl + 1
         return delivered
 
     # -- background loop ---------------------------------------------------

@@ -366,3 +366,32 @@ def test_polling_stream_source(tmp_path):
     assert src.poll_once() == 1
     assert sum(len(b) for b in got) == 3
     assert src.poll_once() == 0
+
+
+def test_polling_retries_after_sink_failure(tmp_path):
+    """A failing sink must not advance the offset (no silent data loss)."""
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.io.converters import converter_from_config
+    from geomesa_tpu.stream import PollingStreamSource
+
+    sft = parse_spec("rf", "v:Int,*geom:Point")
+    conv = converter_from_config(sft, {
+        "type": "csv",
+        "fields": [{"name": "v", "transform": "toInt($0)"},
+                   {"name": "geom", "transform": "point($1,$2)"}]})
+    calls = {"n": 0}
+    got = []
+
+    def flaky(batch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("sink down")
+        got.append(batch)
+
+    src = PollingStreamSource(str(tmp_path / "*.log"), conv, flaky)
+    (tmp_path / "a.log").write_text("1,0,0\n2,0,0\n")
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        src.poll_once()
+    assert src.poll_once() == 2  # retried, nothing lost
+    assert sum(len(b) for b in got) == 2

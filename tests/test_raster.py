@@ -97,3 +97,11 @@ def test_mismatched_tile_shape_rejected():
 def test_tile_resolution():
     t = RasterTile(np.zeros((10, 20), dtype=np.float32), (0, 0, 2, 1))
     assert t.resolution == pytest.approx(0.1)
+
+
+def test_count_accepts_tile_resolution():
+    """count() must accept a tile's own .resolution (rounding-keyed)."""
+    rs = RasterStore()
+    t = RasterTile(np.zeros((16, 16), dtype=np.float32), (0, 0, 1.0 / 3, 1))
+    rs.put(t.data, t.bbox)
+    assert rs.count(t.resolution) == 1
