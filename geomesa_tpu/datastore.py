@@ -346,6 +346,50 @@ class TpuDataStore:
                for s in range(0, len(batch), batch_size)]
         return pa.Table.from_batches(rbs)
 
+    def query_windows(self, name: str, windows) -> list[np.ndarray]:
+        """Batched bbox+time window queries: one device dispatch for ALL
+        windows (``[(boxes, t_lo_ms, t_hi_ms), …]``), returning a position
+        array per window — the BatchScanner-over-many-range-sets pattern
+        the analytics processes (tube-select, kNN rings) are built on.
+        Falls back to per-window planner queries for non-point schemas."""
+        store = self._store(name)
+        if store.batch is None or len(store.batch) == 0:
+            return [np.empty(0, dtype=np.int64) for _ in windows]
+        sft = store.sft
+        if sft.name not in self._interceptors:
+            from .planning.interceptor import load_interceptors
+            self._interceptors[sft.name] = load_interceptors(sft)
+        # guards/rewrites must see every scan: with interceptors configured
+        # take the (slower) per-window planner path, which applies them
+        use_fast = (sft.is_points and sft.dtg_field
+                    and not self._interceptors[sft.name])
+        if not use_fast:
+            from .filters.ast import And, BBox, During, Or
+            out = []
+            for boxes, lo, hi in windows:
+                parts = [BBox(sft.geom_field, *b) for b in boxes]
+                f = parts[0] if len(parts) == 1 else Or(tuple(parts))
+                if sft.dtg_field and not (lo is None and hi is None):
+                    f = And((f, During(sft.dtg_field, lo, hi)))
+                out.append(self.query_result(name, Query.of(f)).positions)
+            return out
+        t0 = time.time()
+        hits = store.z3_index().query_many(windows)
+        allowed = (store.vis_mask(self._auth_provider.get_authorizations())
+                   if self._auth_provider is not None else None)
+        if allowed is not None:
+            hits = [h[allowed[h]] for h in hits]
+        from .metrics import registry as _metrics
+        _metrics.counter(f"query.{name}.windows").inc(len(windows))
+        if self._audit_writer is not None:
+            from .audit import QueryEvent
+            self._audit_writer.write_event(QueryEvent(
+                store="tpu", type_name=name, user=self._user,
+                filter=f"batched windows[{len(windows)}]",
+                scan_time_ms=(time.time() - t0) * 1e3,
+                hits=int(sum(len(h) for h in hits))))
+        return hits
+
     def explain(self, name: str, query="INCLUDE") -> str:
         from .planning.explain import ExplainString
         ex = ExplainString()

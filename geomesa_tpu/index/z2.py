@@ -19,7 +19,10 @@ import numpy as np
 from ..curve.sfc import Z2SFC, z2_sfc
 from ..curve.zorder import deinterleave2
 from ..config import DEFAULT_MAX_RANGES
-from ..ops.search import expand_ranges, gather_capacity, run_packed_query
+from ..ops.search import (
+    expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
+    run_packed_query,
+)
 
 __all__ = ["Z2PointIndex", "Z2QueryPlan", "plan_z2_query"]
 
@@ -127,11 +130,16 @@ class Z2PointIndex:
         plan = plan_z2_query(boxes, max_ranges)
         if plan.num_ranges == 0 or len(self) == 0:
             return np.empty(0, dtype=np.int64)
+        r = pad_ranges({"rzlo": plan.rzlo, "rzhi": plan.rzhi},
+                       pad_pow2(plan.num_ranges))
+        ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
+                             pad_pow2(len(plan.boxes), minimum=1))
+
         def dispatch(capacity):
             return _query_packed(
                 self.z, self.pos, self.x, self.y,
-                jnp.asarray(plan.rzlo), jnp.asarray(plan.rzhi),
-                jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
+                jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
+                jnp.asarray(ixy), jnp.asarray(bxs),
                 capacity=capacity,
             )
 

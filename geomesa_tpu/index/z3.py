@@ -38,7 +38,8 @@ from ..curve.sfc import Z3SFC, z3_sfc
 from ..curve.zorder import deinterleave3
 from ..config import DEFAULT_MAX_RANGES, QueryProperties
 from ..ops.search import (
-    expand_ranges, gather_capacity, run_packed_query, searchsorted2,
+    expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
+    run_packed_query, searchsorted2,
 )
 
 
@@ -224,6 +225,60 @@ def _query_packed(
     return jnp.concatenate([total[None].astype(jnp.int64), packed])
 
 
+@partial(jax.jit, static_argnames=("capacity",))
+def _query_many_packed(
+    bins, z, pos, x, y, dtg,
+    rbin, rzlo, rzhi, rtlo, rthi, rqid,
+    ixy, boxes, bqid, qtlo, qthi,
+    capacity: int,
+):
+    """Batched multi-window scan: Q independent bbox+time queries in ONE
+    dispatch (the reference's BatchScanner over many range sets,
+    accumulated per query).  Each covering range and each box carries its
+    owning query id; a candidate only matches boxes/time bounds of its own
+    query.  Returns ``[total, (qid << 40 | pos)|-1, …]`` — one transfer
+    decodes into per-query hit lists.  This amortizes the ~100ms remote
+    dispatch round trip across e.g. a tube-select's per-segment windows or
+    a kNN's expanding rings.
+    """
+    starts = searchsorted2(bins, z, rbin, rzlo, side="left")
+    ends = searchsorted2(bins, z, rbin, rzhi, side="right")
+    counts = jnp.maximum(ends - starts, 0)
+    total = jnp.sum(counts)
+    idx, valid, rid = expand_ranges(starts, counts, capacity)
+    zc = z[idx]
+    posc = pos[idx]
+    cqid = rqid[rid]
+    ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
+    ix = ix.astype(jnp.int32)
+    iy = iy.astype(jnp.int32)
+    it = it.astype(jnp.int32)
+    same_q = cqid[:, None] == bqid[None, :]
+    in_box_int = (
+        same_q
+        & (ix[:, None] >= ixy[None, :, 0])
+        & (iy[:, None] >= ixy[None, :, 1])
+        & (ix[:, None] <= ixy[None, :, 2])
+        & (iy[:, None] <= ixy[None, :, 3])
+    ).any(axis=1)
+    in_time_int = (it >= rtlo[rid]) & (it <= rthi[rid])
+    xc = x[posc]
+    yc = y[posc]
+    tc = dtg[posc]
+    in_box_exact = (
+        same_q
+        & (xc[:, None] >= boxes[None, :, 0])
+        & (yc[:, None] >= boxes[None, :, 1])
+        & (xc[:, None] <= boxes[None, :, 2])
+        & (yc[:, None] <= boxes[None, :, 3])
+    ).any(axis=1)
+    in_time_exact = (tc >= qtlo[cqid]) & (tc <= qthi[cqid])
+    mask = valid & in_box_int & in_time_int & in_box_exact & in_time_exact
+    coded = (cqid.astype(jnp.int64) << jnp.int64(40)) | posc.astype(jnp.int64)
+    packed = jnp.where(mask, coded, jnp.int64(-1))
+    return jnp.concatenate([total[None].astype(jnp.int64), packed])
+
+
 #: tri-state: None = untried, True = pallas scan works on this backend,
 #: False = failed once (e.g. Mosaic lowering) — stay on the XLA path
 _pallas_scan_ok: bool | None = None
@@ -257,6 +312,10 @@ class Z3PointIndex:
         self.y = y
         self.dtg = dtg
         self._capacity = self.DEFAULT_CAPACITY
+        #: data time extent; queries clamp to it so an unbounded interval
+        #: plans over the data's bins, not every bin since the epoch
+        self.t_min_ms: int | None = None
+        self.t_max_ms: int | None = None
 
     @classmethod
     def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK) -> "Z3PointIndex":
@@ -267,6 +326,8 @@ class Z3PointIndex:
         y = np.asarray(y, dtype=np.float64)
         dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
         host_bins, host_offs = to_binned_time(dtg_ms, period)
+        t_min = int(dtg_ms.min()) if len(dtg_ms) else 0
+        t_max = int(dtg_ms.max()) if len(dtg_ms) else 0
 
         xd = jnp.asarray(x)
         yd = jnp.asarray(y)
@@ -275,24 +336,45 @@ class Z3PointIndex:
         offd = jnp.asarray(host_offs.astype(np.float64))
 
         bins_s, z_s, pos = _encode_sort_z3(sfc, xd, yd, offd, bind)
-        return cls(period, bins=bins_s, z=z_s, pos=pos, x=xd, y=yd, dtg=td)
+        idx = cls(period, bins=bins_s, z=z_s, pos=pos, x=xd, y=yd, dtg=td)
+        idx.t_min_ms, idx.t_max_ms = t_min, t_max
+        return idx
 
     def __len__(self) -> int:
         return int(self.z.shape[0])
+
+    def _clamp_time(self, t_lo_ms, t_hi_ms) -> tuple[int, int]:
+        """Clamp to the data's time extent; ``None`` bounds are open (no
+        time constraint) and resolve to the extent itself."""
+        t_lo_ms = self.t_min_ms if t_lo_ms is None else int(t_lo_ms)
+        t_hi_ms = self.t_max_ms if t_hi_ms is None else int(t_hi_ms)
+        if self.t_min_ms is not None:
+            t_lo_ms = max(t_lo_ms, self.t_min_ms)
+        if self.t_max_ms is not None:
+            t_hi_ms = min(t_hi_ms, self.t_max_ms)
+        return t_lo_ms, t_hi_ms
 
     def query(self, boxes, t_lo_ms: int, t_hi_ms: int,
               max_ranges: int = DEFAULT_MAX_RANGES) -> np.ndarray:
         """Return original-order positions of features matching
         bbox(es) ∧ time interval, exactly (oracle-equal hit sets)."""
+        t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
         if plan.num_ranges == 0 or len(self) == 0:
             return np.empty(0, dtype=np.int64)
+        # bucket the plan shapes so differently-shaped queries share
+        # compiles (one compile per power-of-two range/box count)
+        r = pad_ranges({"rbin": plan.rbin, "rzlo": plan.rzlo,
+                        "rzhi": plan.rzhi, "rtlo": plan.rtlo,
+                        "rthi": plan.rthi}, pad_pow2(plan.num_ranges))
+        ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
+                             pad_pow2(len(plan.boxes), minimum=1))
         args = (
             self.bins, self.z, self.pos, self.x, self.y, self.dtg,
-            jnp.asarray(plan.rbin), jnp.asarray(plan.rzlo),
-            jnp.asarray(plan.rzhi),
-            jnp.asarray(plan.rtlo), jnp.asarray(plan.rthi),
-            jnp.asarray(plan.ixy), jnp.asarray(plan.boxes),
+            jnp.asarray(r["rbin"]), jnp.asarray(r["rzlo"]),
+            jnp.asarray(r["rzhi"]),
+            jnp.asarray(r["rtlo"]), jnp.asarray(r["rthi"]),
+            jnp.asarray(ixy), jnp.asarray(bxs),
             plan.t_lo_ms, plan.t_hi_ms,
         )
         def dispatch(capacity):
@@ -311,3 +393,65 @@ class Z3PointIndex:
 
         hits, self._capacity = run_packed_query(dispatch, self._capacity)
         return hits
+
+    def query_many(self, windows,
+                   max_ranges: int = DEFAULT_MAX_RANGES) -> list[np.ndarray]:
+        """Batched queries: ``windows`` is a list of
+        ``(boxes, t_lo_ms, t_hi_ms)``; returns one sorted position array
+        per window — all windows scanned in ONE device dispatch (see
+        _query_many_packed)."""
+        n_q = len(windows)
+        if n_q == 0 or len(self) == 0:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        per_range = max(1, max_ranges // n_q)
+        rbin, rzlo, rzhi, rtlo, rthi, rqid = [], [], [], [], [], []
+        ixy, boxes, bqid = [], [], []
+        qtlo = np.empty(n_q, dtype=np.int64)
+        qthi = np.empty(n_q, dtype=np.int64)
+        for q, (bxs, lo, hi) in enumerate(windows):
+            lo, hi = self._clamp_time(lo, hi)
+            plan = plan_z3_query(bxs, lo, hi, self.period, per_range)
+            qtlo[q] = plan.t_lo_ms
+            qthi[q] = plan.t_hi_ms
+            if plan.num_ranges == 0:
+                continue
+            rbin.append(plan.rbin)
+            rzlo.append(plan.rzlo)
+            rzhi.append(plan.rzhi)
+            rtlo.append(plan.rtlo)
+            rthi.append(plan.rthi)
+            rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+            ixy.append(plan.ixy)
+            boxes.append(plan.boxes)
+            bqid.append(np.full(len(plan.boxes), q, dtype=np.int32))
+        if not rbin:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        ra = {"rbin": np.concatenate(rbin), "rzlo": np.concatenate(rzlo),
+              "rzhi": np.concatenate(rzhi), "rtlo": np.concatenate(rtlo),
+              "rthi": np.concatenate(rthi), "rqid": np.concatenate(rqid)}
+        ra = pad_ranges(ra, pad_pow2(len(ra["rbin"])))
+        ixy_c, boxes_c, bqid_c = pad_boxes(
+            np.concatenate(ixy), np.concatenate(boxes),
+            pad_pow2(sum(len(b) for b in boxes), minimum=1),
+            np.concatenate(bqid))
+        args = (
+            self.bins, self.z, self.pos, self.x, self.y, self.dtg,
+            jnp.asarray(ra["rbin"]), jnp.asarray(ra["rzlo"]),
+            jnp.asarray(ra["rzhi"]), jnp.asarray(ra["rtlo"]),
+            jnp.asarray(ra["rthi"]), jnp.asarray(ra["rqid"]),
+            jnp.asarray(ixy_c), jnp.asarray(boxes_c), jnp.asarray(bqid_c),
+            jnp.asarray(qtlo), jnp.asarray(qthi),
+        )
+
+        def dispatch(capacity):
+            return _query_many_packed(*args, capacity=capacity)
+
+        coded, self._capacity = run_packed_query(dispatch, self._capacity)
+        qids = coded >> 40
+        positions = coded & ((np.int64(1) << 40) - 1)
+        out = []
+        for q in range(n_q):
+            hits = positions[qids == q]
+            # a feature can land in several of a query's covering ranges
+            out.append(np.unique(hits))
+        return out

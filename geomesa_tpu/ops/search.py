@@ -37,6 +37,45 @@ def run_packed_query(dispatch, capacity: int):
         capacity = gather_capacity(total)
 
 
+def pad_pow2(n: int, minimum: int = 8) -> int:
+    """Next power of two ≥ n — plan arrays pad to bucketed shapes so the
+    jitted scan compiles once per bucket, not once per query shape."""
+    return gather_capacity(n, minimum)
+
+
+def pad_ranges(arrays: dict, n_pad: int) -> dict:
+    """Pad per-range plan arrays to ``n_pad`` with never-matching ranges
+    (zlo > zhi ⇒ searchsorted start == end ⇒ count 0)."""
+    import numpy as np
+    n = len(next(iter(arrays.values())))
+    if n == n_pad:
+        return arrays
+    fill = {"rbin": -1, "rzlo": 1, "rzhi": 0, "rtlo": 1, "rthi": 0,
+            "rqid": 0}
+    out = {}
+    for k, v in arrays.items():
+        pad = np.full(n_pad - n, fill.get(k, 0), dtype=v.dtype)
+        out[k] = np.concatenate([v, pad])
+    return out
+
+
+def pad_boxes(ixy, boxes, n_pad: int, bqid=None):
+    """Pad box arrays with inverted (never-matching) boxes."""
+    import numpy as np
+    n = len(ixy)
+    if n == n_pad:
+        return (ixy, boxes) if bqid is None else (ixy, boxes, bqid)
+    ixy_p = np.concatenate(
+        [ixy, np.tile(np.array([[1, 1, 0, 0]], ixy.dtype), (n_pad - n, 1))])
+    boxes_p = np.concatenate(
+        [boxes, np.tile(np.array([[1.0, 1.0, 0.0, 0.0]], boxes.dtype),
+                        (n_pad - n, 1))])
+    if bqid is None:
+        return ixy_p, boxes_p
+    bqid_p = np.concatenate([bqid, np.full(n_pad - n, -1, bqid.dtype)])
+    return ixy_p, boxes_p, bqid_p
+
+
 def gather_capacity(total: int, minimum: int = 1024) -> int:
     """Static gather capacity: next power of two ≥ total.  Bounds the number
     of distinct compiled shapes for the candidate-scan kernels to log2(N)."""

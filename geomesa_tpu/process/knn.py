@@ -47,31 +47,46 @@ def knn_process(store, schema: str, x: float, y: float, k: int,
     ``store`` is a TpuDataStore; spatial candidates come from the z2/z3
     index via bbox window queries; exact haversine distances rank them.
     """
-    from ..planning.planner import Query
-    from ..filters.ast import And, BBox, During
-
     sft = store.get_schema(schema)
     geom = sft.geom_field
     radius = float(initial_radius_m)
+    batch = store._store(schema).batch
+    if batch is None or len(batch) == 0:
+        return np.empty(0, dtype=np.int64), np.empty(0)
+    # None bounds mean "no time constraint" — query_windows plans these
+    # over the data's extent instead of a sentinel interval
+    lo = int(t_lo_ms) if t_lo_ms is not None and sft.dtg_field else None
+    hi = int(t_hi_ms) if t_hi_ms is not None and sft.dtg_field else None
+    all_xy = batch.geom_xy(geom)
 
+    def rank(positions):
+        bx, by = all_xy[0][positions], all_xy[1][positions]
+        d = haversine_m(x, y, bx, by)
+        order = np.argsort(d, kind="stable")
+        return d, order
+
+    # batched expanding rings: each dispatch scans THREE radii at once
+    # (r, 2r, 4r) so the remote round trip amortizes across rounds — the
+    # GeoHash-spiral expansion (process/knn/KNNQuery.scala:34-101)
+    # re-expressed as indexed window batches
     while True:
-        box = _deg_window(x, y, radius)
-        f = BBox(geom, *box)
-        if t_lo_ms is not None and t_hi_ms is not None and sft.dtg_field:
-            f = And((f, During(sft.dtg_field, t_lo_ms, t_hi_ms)))
-        result = store.query_result(schema, Query.of(f))
-        if len(result.positions):
-            bx, by = result.batch.geom_xy(geom)
-            d = haversine_m(x, y, bx, by)
-            order = np.argsort(d, kind="stable")
+        radii = [radius, radius * 2, radius * 4]
+        windows = [([_deg_window(x, y, r)], lo, hi) for r in radii]
+        ring_hits = store.query_windows(schema, windows)
+        for r, positions in zip(radii, ring_hits):
+            if not len(positions):
+                continue
+            d, order = rank(positions)
             # secure condition: the k-th distance fits inside the scanned
             # window (no closer feature can hide outside it)
-            if len(order) >= k and d[order[k - 1]] <= radius:
+            if len(order) >= k and d[order[k - 1]] <= r:
                 sel = order[:k]
-                return result.positions[sel], d[sel]
-        if radius >= max_radius_m:
-            if len(result.positions) == 0:
+                return positions[sel], d[sel]
+        if radii[-1] >= max_radius_m:
+            positions = ring_hits[-1]
+            if len(positions) == 0:
                 return np.empty(0, dtype=np.int64), np.empty(0)
+            d, order = rank(positions)
             sel = order[:k]
-            return result.positions[sel], d[sel]
-        radius *= 2.0
+            return positions[sel], d[sel]
+        radius *= 8.0

@@ -39,9 +39,6 @@ def tube_select(store, schema: str, track_xy, track_t_ms,
 
     ``track_xy``: (T, 2) ordered track vertices; ``track_t_ms``: (T,) times.
     """
-    from ..planning.planner import Query
-    from ..filters.ast import And, BBox, During
-
     sft = store.get_schema(schema)
     geom = sft.geom_field
     dtg = sft.dtg_field
@@ -55,20 +52,20 @@ def tube_select(store, schema: str, track_xy, track_t_ms,
     dlon = float(np.max(dlat / cos))
     pad = max(dlat, dlon)
 
-    # one indexed window query per segment (bbox × time slab)
-    parts = []
+    # one indexed window per segment (bbox × time slab) — all segments
+    # scanned in a single batched dispatch (datastore.query_windows)
+    windows = []
     for i in range(len(track) - 1):
         seg = track[i:i + 2]
         box = (seg[:, 0].min() - pad, seg[:, 1].min() - pad,
                seg[:, 0].max() + pad, seg[:, 1].max() + pad)
-        f = BBox(geom, *box)
         if dtg:
             lo = int(min(times[i], times[i + 1])) - int(time_buffer_ms)
             hi = int(max(times[i], times[i + 1])) + int(time_buffer_ms)
-            f = And((f, During(dtg, lo, hi)))
-        r = store.query_result(schema, Query.of(f))
-        if len(r.positions):
-            parts.append(r.positions)
+        else:
+            lo, hi = 0, (1 << 62)
+        windows.append(([box], lo, hi))
+    parts = [p for p in store.query_windows(schema, windows) if len(p)]
     if not parts:
         return np.empty(0, dtype=np.int64)
     cand = np.unique(np.concatenate(parts))

@@ -125,3 +125,29 @@ def test_time_window_boundaries():
     # Thursday), so a partial window
     assert w[bins[0]][1] == week
     assert w[bins[-1]][0] == 0
+
+
+def test_query_many_matches_query(index, dataset):
+    """Batched multi-window scan returns the same hit sets as individual
+    queries (and brute force)."""
+    x, y, t = dataset
+    idx = index
+    MS = MS_2018
+    windows = [
+        ([(-74.5, 40.5, -73.5, 41.5)], MS + 2 * 86_400_000, MS + 7 * 86_400_000),
+        ([(-74.2, 40.8, -73.9, 41.1)], MS, MS + 3 * 86_400_000),
+        ([(-80.0, 35.0, -79.0, 36.0)], MS, MS + 14 * 86_400_000),  # empty
+    ]
+    batched = idx.query_many(windows)
+    for (boxes, lo, hi), got in zip(windows, batched):
+        single = idx.query(boxes, lo, hi)
+        assert np.array_equal(got, single)
+
+
+def test_query_open_time_bounds(index, dataset):
+    """None time bounds clamp to the data's extent (not the epoch)."""
+    x, y, t = dataset
+    got = index.query([(-74.5, 40.5, -73.5, 41.5)], None, None)
+    brute = np.flatnonzero((x >= -74.5) & (x <= -73.5)
+                           & (y >= 40.5) & (y <= 41.5))
+    assert np.array_equal(got, brute)
