@@ -166,6 +166,48 @@ def plan_z3_query(
     )
 
 
+def candidate_mask(zc, rtlo_c, rthi_c, ixy, boxes, xc, yc, tc,
+                   t_lo_ms, t_hi_ms, cqid=None, bqid=None, qtlo=None,
+                   qthi=None):
+    """Shared fused candidate filter: z-decode int-space bounds test
+    (Z3Filter.inBounds, filters/Z3Filter.scala:19-55) AND the exact
+    double-precision re-check (FilterTransformIterator) — used by the
+    single-query, batched, and sharded scan programs so the mask
+    semantics cannot diverge.
+
+    ``rtlo_c``/``rthi_c`` are per-CANDIDATE normalized time bounds
+    (already gathered by owning range).  With ``cqid``/``bqid`` given,
+    boxes only apply to candidates of the same query; exact time bounds
+    then come from ``qtlo``/``qthi`` per query instead of the scalars.
+    """
+    ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
+    ix = ix.astype(jnp.int32)
+    iy = iy.astype(jnp.int32)
+    it = it.astype(jnp.int32)
+    box_pairs = (
+        (ix[:, None] >= ixy[None, :, 0])
+        & (iy[:, None] >= ixy[None, :, 1])
+        & (ix[:, None] <= ixy[None, :, 2])
+        & (iy[:, None] <= ixy[None, :, 3])
+    )
+    exact_pairs = (
+        (xc[:, None] >= boxes[None, :, 0])
+        & (yc[:, None] >= boxes[None, :, 1])
+        & (xc[:, None] <= boxes[None, :, 2])
+        & (yc[:, None] <= boxes[None, :, 3])
+    )
+    if cqid is not None:
+        same_q = cqid[:, None] == bqid[None, :]
+        box_pairs &= same_q
+        exact_pairs &= same_q
+        in_time_exact = (tc >= qtlo[cqid]) & (tc <= qthi[cqid])
+    else:
+        in_time_exact = (tc >= t_lo_ms) & (tc <= t_hi_ms)
+    in_time_int = (it >= rtlo_c) & (it <= rthi_c)
+    return (box_pairs.any(axis=1) & in_time_int
+            & exact_pairs.any(axis=1) & in_time_exact)
+
+
 @partial(jax.jit, static_argnames=("capacity", "use_pallas"))
 def _query_packed(
     bins, z, pos, x, y, dtg,
@@ -193,34 +235,24 @@ def _query_packed(
     idx, valid, rid = expand_ranges(starts, counts, capacity)
     zc = z[idx]
     posc = pos[idx]
-    if use_pallas:
-        from ..ops.pallas_kernels import z3_mask_pallas
-        mask_int = z3_mask_pallas(zc, ixy, rtlo[rid], rthi[rid])
-    else:
-        ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
-        ix = ix.astype(jnp.int32)
-        iy = iy.astype(jnp.int32)
-        it = it.astype(jnp.int32)
-        in_box_int = (
-            (ix[:, None] >= ixy[None, :, 0])
-            & (iy[:, None] >= ixy[None, :, 1])
-            & (ix[:, None] <= ixy[None, :, 2])
-            & (iy[:, None] <= ixy[None, :, 3])
-        ).any(axis=1)
-        mask_int = in_box_int & (it >= rtlo[rid]) & (it <= rthi[rid])
-    # exact double-precision predicate on the original columns (the
-    # FilterTransformIterator re-check)
     xc = x[posc]
     yc = y[posc]
     tc = dtg[posc]
-    in_box_exact = (
-        (xc[:, None] >= boxes[None, :, 0])
-        & (yc[:, None] >= boxes[None, :, 1])
-        & (xc[:, None] <= boxes[None, :, 2])
-        & (yc[:, None] <= boxes[None, :, 3])
-    ).any(axis=1)
-    in_time_exact = (tc >= t_lo_ms) & (tc <= t_hi_ms)
-    mask = valid & mask_int & in_box_exact & in_time_exact
+    if use_pallas:
+        from ..ops.pallas_kernels import z3_mask_pallas
+        mask_int = z3_mask_pallas(zc, ixy, rtlo[rid], rthi[rid])
+        in_box_exact = (
+            (xc[:, None] >= boxes[None, :, 0])
+            & (yc[:, None] >= boxes[None, :, 1])
+            & (xc[:, None] <= boxes[None, :, 2])
+            & (yc[:, None] <= boxes[None, :, 3])
+        ).any(axis=1)
+        mask = (mask_int & in_box_exact
+                & (tc >= t_lo_ms) & (tc <= t_hi_ms))
+    else:
+        mask = candidate_mask(zc, rtlo[rid], rthi[rid], ixy, boxes,
+                              xc, yc, tc, t_lo_ms, t_hi_ms)
+    mask = valid & mask
     packed = jnp.where(mask, posc.astype(jnp.int64), jnp.int64(-1))
     return jnp.concatenate([total[None].astype(jnp.int64), packed])
 
@@ -249,31 +281,10 @@ def _query_many_packed(
     zc = z[idx]
     posc = pos[idx]
     cqid = rqid[rid]
-    ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
-    ix = ix.astype(jnp.int32)
-    iy = iy.astype(jnp.int32)
-    it = it.astype(jnp.int32)
-    same_q = cqid[:, None] == bqid[None, :]
-    in_box_int = (
-        same_q
-        & (ix[:, None] >= ixy[None, :, 0])
-        & (iy[:, None] >= ixy[None, :, 1])
-        & (ix[:, None] <= ixy[None, :, 2])
-        & (iy[:, None] <= ixy[None, :, 3])
-    ).any(axis=1)
-    in_time_int = (it >= rtlo[rid]) & (it <= rthi[rid])
-    xc = x[posc]
-    yc = y[posc]
-    tc = dtg[posc]
-    in_box_exact = (
-        same_q
-        & (xc[:, None] >= boxes[None, :, 0])
-        & (yc[:, None] >= boxes[None, :, 1])
-        & (xc[:, None] <= boxes[None, :, 2])
-        & (yc[:, None] <= boxes[None, :, 3])
-    ).any(axis=1)
-    in_time_exact = (tc >= qtlo[cqid]) & (tc <= qthi[cqid])
-    mask = valid & in_box_int & in_time_int & in_box_exact & in_time_exact
+    mask = valid & candidate_mask(
+        zc, rtlo[rid], rthi[rid], ixy, boxes,
+        x[posc], y[posc], dtg[posc], 0, 0,
+        cqid=cqid, bqid=bqid, qtlo=qtlo, qthi=qthi)
     coded = (cqid.astype(jnp.int64) << jnp.int64(40)) | posc.astype(jnp.int64)
     packed = jnp.where(mask, coded, jnp.int64(-1))
     return jnp.concatenate([total[None].astype(jnp.int64), packed])

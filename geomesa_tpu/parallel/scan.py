@@ -28,11 +28,16 @@ try:  # jax>=0.8 top-level API; the experimental path is deprecated
 except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
+from functools import lru_cache
+
 from ..curve.binnedtime import TimePeriod, to_binned_time
 from ..curve.sfc import z3_sfc
-from ..index.z3 import Z3QueryPlan, plan_z3_query
+from ..index.z3 import Z3QueryPlan, candidate_mask, plan_z3_query
 from ..ops.density import density_grid, density_grid_auto
-from ..ops.search import searchsorted2
+from ..ops.search import (
+    expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
+    searchsorted2,
+)
 from .mesh import device_mesh, shard_batch
 
 __all__ = ["ShardedZ3Index", "sharded_range_count", "sharded_density"]
@@ -41,13 +46,15 @@ __all__ = ["ShardedZ3Index", "sharded_range_count", "sharded_density"]
 class ShardedZ3Index:
     """Z3 point index sharded over the feature axis of a device mesh."""
 
-    def __init__(self, mesh: Mesh, period: TimePeriod, bins, z, x, y, dtg, valid):
+    def __init__(self, mesh: Mesh, period: TimePeriod, bins, z, pos,
+                 x, y, dtg, valid):
         self.mesh = mesh
         self.period = period
         self.sfc = z3_sfc(period)
-        # per-shard locally-sorted key columns
+        # per-shard locally-sorted key columns (+ local permutation)
         self.bins = bins
         self.z = z
+        self.pos = pos
         # sharded feature columns (original shard order)
         self.x = x
         self.y = y
@@ -71,18 +78,20 @@ class ShardedZ3Index:
         @partial(
             shard_map, mesh=mesh,
             in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard")),
-            out_specs=(P("shard"), P("shard")),
+            out_specs=(P("shard"), P("shard"), P("shard")),
         )
         def encode_sort(xs, ys, bs, os_, vs):
             z = sfc.index(xs, ys, os_)
             # invalid (padding) rows get bin -1 so no query range matches
             bs = jnp.where(vs, bs, -1)
-            # variadic 2-key sort: ~7x faster than lexsort+gather on TPU
-            bs_s, z_s = jax.lax.sort((bs, z), dimension=0, num_keys=2)
-            return bs_s, z_s
+            # variadic 2-key sort with the local permutation as payload
+            bs_s, z_s, pos = jax.lax.sort(
+                (bs, z, jnp.arange(z.shape[0], dtype=jnp.int32)),
+                dimension=0, num_keys=2)
+            return bs_s, z_s, pos
 
-        bins_s, z_s = jax.jit(encode_sort)(xd, yd, bind, offd, valid)
-        return cls(mesh, period, bins_s, z_s, xd, yd, td, valid)
+        bins_s, z_s, pos = jax.jit(encode_sort)(xd, yd, bind, offd, valid)
+        return cls(mesh, period, bins_s, z_s, pos, xd, yd, td, valid)
 
     def total(self) -> int:
         return int(np.asarray(jnp.sum(self.valid)))
@@ -99,6 +108,45 @@ class ShardedZ3Index:
             jnp.asarray(plan.rbin), jnp.asarray(plan.rzlo),
             jnp.asarray(plan.rzhi))
 
+    def query(self, boxes, t_lo_ms: int, t_hi_ms: int,
+              max_ranges: int = 2000, capacity: int = 1 << 15) -> np.ndarray:
+        """Exact global hit positions across all shards.
+
+        Each shard scans its local sorted segment (seeks + fixed-capacity
+        gather + fused mask — the same candidate_mask as the single-chip
+        packed query) and emits ``shard_offset + local_pos`` ids; results
+        stack along the shard axis so the host reads one
+        (n_shards × capacity) packed array plus per-shard totals for
+        overflow retry — the scatter/gather + client-merge pattern of the
+        reference's BatchScanPlan.  Programs are cached per
+        (mesh, capacity, bucketed plan shape): plan arrays pad to
+        power-of-two buckets and travel as traced arguments, so repeat
+        queries reuse the compile.
+        """
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        if plan.num_ranges == 0:
+            return np.empty(0, dtype=np.int64)
+        per_shard = int(self.z.shape[0]) // self.mesh.devices.size
+        r = pad_ranges({"rbin": plan.rbin, "rzlo": plan.rzlo,
+                        "rzhi": plan.rzhi, "rtlo": plan.rtlo,
+                        "rthi": plan.rthi}, pad_pow2(plan.num_ranges))
+        ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
+                             pad_pow2(len(plan.boxes), minimum=1))
+        while True:
+            scan = _sharded_scan_program(self.mesh, capacity, per_shard)
+            packed, totals = scan(
+                self.bins, self.z, self.pos, self.x, self.y, self.dtg,
+                self.valid,
+                jnp.asarray(r["rbin"]), jnp.asarray(r["rzlo"]),
+                jnp.asarray(r["rzhi"]), jnp.asarray(r["rtlo"]),
+                jnp.asarray(r["rthi"]), jnp.asarray(ixy), jnp.asarray(bxs),
+                jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
+            totals = np.asarray(totals)
+            if int(totals.max(initial=0)) <= capacity:
+                packed = np.asarray(packed)
+                return np.sort(packed[packed >= 0])
+            capacity = gather_capacity(int(totals.max()))
+
     def density(self, boxes, t_lo_ms: int, t_hi_ms: int, env,
                 width: int = 256, height: int = 256,
                 weights=None) -> np.ndarray:
@@ -110,6 +158,36 @@ class ShardedZ3Index:
             self.mesh, self.x, self.y, self.dtg, self.valid, w,
             jnp.asarray(boxes), int(t_lo_ms), int(t_hi_ms),
             tuple(float(v) for v in env), width, height)
+
+
+@lru_cache(maxsize=64)
+def _sharded_scan_program(mesh: Mesh, capacity: int, per_shard: int):
+    """Jitted collective scan, cached per (mesh, capacity, shard size) —
+    plan arrays are traced arguments so new queries reuse the compile."""
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 7 + (P(None),) * 7 + (P(), P()),
+        out_specs=(P("shard"), P("shard")),
+    )
+    def scan(lb, lz, lp, xs, ys, ts, vs,
+             rb, rlo, rhi, rtl, rth, ixy, bxs, t_lo, t_hi):
+        starts = searchsorted2(lb, lz, rb, rlo, side="left")
+        ends = searchsorted2(lb, lz, rb, rhi, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        total = jnp.sum(counts)
+        idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
+        zc = lz[idx]
+        posc = lp[idx]
+        mask = valid_slot & vs[posc] & candidate_mask(
+            zc, rtl[rid], rth[rid], ixy, bxs,
+            xs[posc], ys[posc], ts[posc], t_lo, t_hi)
+        shard = jax.lax.axis_index("shard").astype(jnp.int64)
+        gpos = shard * per_shard + posc.astype(jnp.int64)
+        packed = jnp.where(mask, gpos, jnp.int64(-1))
+        return packed, total[None].astype(jnp.int64)
+
+    return jax.jit(scan)
 
 
 def sharded_range_count(mesh, bins, z, rbin, rzlo, rzhi) -> int:

@@ -89,3 +89,20 @@ def test_single_device_density_kernel(data):
         jnp.asarray(x), jnp.asarray(y), jnp.ones(len(x)),
         jnp.asarray(mask), env, 128, 128))
     assert grid.sum() == pytest.approx(len(x))
+
+
+def test_sharded_query_exact(sharded, data):
+    """Full distributed query: per-shard packed scans, exact global hits."""
+    x, y, t = data
+    idx = sharded
+    MS = MS_2018
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS + 86_400_000, MS + 6 * 86_400_000
+    hits = idx.query([box], tlo, thi)
+    brute = np.flatnonzero(
+        (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        & (t >= tlo) & (t <= thi))
+    assert np.array_equal(np.sort(hits), np.sort(brute))
+    # tiny capacity forces the overflow-retry path
+    hits2 = idx.query([box], tlo, thi, capacity=8)
+    assert np.array_equal(np.sort(hits2), np.sort(brute))
