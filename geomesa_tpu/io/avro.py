@@ -27,7 +27,8 @@ from ..features.feature_type import FeatureType
 from ..geometry.wkb import wkb_decode, wkb_encode
 from ..geometry.types import Point
 
-__all__ = ["to_avro", "from_avro", "avro_schema"]
+__all__ = ["to_avro", "from_avro", "avro_schema",
+           "encode_record", "decode_record"]
 
 _MAGIC = b"Obj\x01"
 
@@ -89,6 +90,74 @@ def _r_bytes(buf, pos: int):
 
 
 # -- writer -----------------------------------------------------------------
+
+def encode_record(sft: FeatureType, fid: str, attrs: dict) -> bytes:
+    """One feature as Avro binary (the record body of :func:`to_avro`'s
+    schema) — the per-message payload of the schema-registry streaming
+    codec."""
+    body = bytearray()
+    _w_str(str(fid), body)
+    for a in sft.attributes:
+        v = attrs.get(a.name)
+        if a.is_geometry:
+            if v is None:
+                _w_long(1, body)
+            else:
+                if isinstance(v, (tuple, list)) and len(v) == 2:
+                    v = Point(float(v[0]), float(v[1]))
+                _w_long(0, body)
+                _w_bytes(wkb_encode(v), body)
+            continue
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            _w_long(1, body)
+            continue
+        _w_long(0, body)
+        t = _AVRO_TYPES.get(a.type, "string")
+        if t in ("long", "int"):
+            _w_long(int(v), body)
+        elif t == "double":
+            body += struct.pack("<d", float(v))
+        elif t == "float":
+            body += struct.pack("<f", float(v))
+        elif t == "boolean":
+            body.append(1 if v else 0)
+        else:
+            _w_str(str(v), body)
+    return bytes(body)
+
+
+def decode_record(sft: FeatureType, buf, pos: int = 0):
+    """Inverse of :func:`encode_record`: returns ``(fid, attrs, pos)``."""
+    buf = memoryview(buf)
+    fid_b, pos = _r_bytes(buf, pos)
+    attrs: dict = {}
+    for a in sft.attributes:
+        branch, pos = _r_long(buf, pos)
+        if branch == 1:
+            attrs[a.name] = None
+            continue
+        if a.is_geometry:
+            b, pos = _r_bytes(buf, pos)
+            attrs[a.name] = wkb_decode(b)
+            continue
+        t = _AVRO_TYPES.get(a.type, "string")
+        if t in ("long", "int"):
+            v, pos = _r_long(buf, pos)
+        elif t == "double":
+            (v,) = struct.unpack_from("<d", buf, pos)
+            pos += 8
+        elif t == "float":
+            (v,) = struct.unpack_from("<f", buf, pos)
+            pos += 4
+        elif t == "boolean":
+            v = bool(buf[pos])
+            pos += 1
+        else:
+            b, pos = _r_bytes(buf, pos)
+            v = b.decode()
+        attrs[a.name] = v
+    return fid_b.decode(), attrs, pos
+
 
 def to_avro(batch: FeatureBatch, path_or_buf) -> None:
     sft = batch.sft
@@ -199,35 +268,10 @@ def from_avro(path_or_buf, sft: FeatureType) -> FeatureBatch:
         n, pos = _r_long(buf, pos)
         _, pos = _r_long(buf, pos)  # byte length
         for _ in range(n):
-            fid, pos = _r_bytes(buf, pos)
-            ids.append(fid.decode())
+            fid, attrs, pos = decode_record(sft, buf, pos)
+            ids.append(fid)
             for a in sft.attributes:
-                branch, pos = _r_long(buf, pos)
-                if branch == 1:
-                    cols[a.name].append(None)
-                    continue
-                if a.is_geometry:
-                    b, pos = _r_bytes(buf, pos)
-                    cols[a.name].append(wkb_decode(b))
-                    continue
-                t = _AVRO_TYPES.get(a.type, "string")
-                if t in ("long", "int"):
-                    v, pos = _r_long(buf, pos)
-                    cols[a.name].append(v)
-                elif t == "double":
-                    (v,) = struct.unpack_from("<d", buf, pos)
-                    pos += 8
-                    cols[a.name].append(v)
-                elif t == "float":
-                    (v,) = struct.unpack_from("<f", buf, pos)
-                    pos += 4
-                    cols[a.name].append(v)
-                elif t == "boolean":
-                    cols[a.name].append(bool(buf[pos]))
-                    pos += 1
-                else:
-                    s, pos = _r_bytes(buf, pos)
-                    cols[a.name].append(s.decode())
+                cols[a.name].append(attrs[a.name])
         if bytes(buf[pos:pos + 16]) != sync:
             raise ValueError("sync marker mismatch")
         pos += 16

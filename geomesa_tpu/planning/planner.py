@@ -92,14 +92,16 @@ class QueryPlanner:
                     f"query on {self.sft.name!r} exceeded "
                     f"{timeout_s}s during {stage}")
 
-        t0 = time.perf_counter()
-        decider = StrategyDecider(self.sft, store.stats_map(), len(batch))
-        strategy = decider.decide(query.filter, explain)
-        plan_ms = (time.perf_counter() - t0) * 1000
+        from ..utils.profiling import profile
+        with profile("query.plan") as plan_span:
+            decider = StrategyDecider(self.sft, store.stats_map(), len(batch))
+            strategy = decider.decide(query.filter, explain)
+        plan_ms = plan_span.ms
         check_deadline("planning")
 
         t1 = time.perf_counter()
-        candidates = self._scan(strategy, query, explain)
+        with profile("query.scan"):
+            candidates = self._scan(strategy, query, explain)
         check_deadline("index scan")
         if candidates is None:  # full scan
             mask = evaluate_filter(query.filter, batch)

@@ -5,7 +5,9 @@ spatially-indexed cache with feature events)."""
 from .messages import GeoMessage
 from .broker import InProcessBroker
 from .polling import PollingStreamSource
+from .registry import AvroMessageCodec, SchemaRegistry
 from .store import StreamDataStore, LiveFeatureCache
 
 __all__ = ["GeoMessage", "InProcessBroker", "StreamDataStore",
-           "LiveFeatureCache", "PollingStreamSource"]
+           "LiveFeatureCache", "PollingStreamSource", "SchemaRegistry",
+           "AvroMessageCodec"]

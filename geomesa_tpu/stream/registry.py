@@ -1,0 +1,92 @@
+"""Schema-registry Avro message codec for the streaming layer.
+
+The analog of the reference's Confluent integration
+(geomesa-kafka/.../confluent/*: a Kafka store variant whose record
+values are Confluent-framed Avro — magic byte 0x00 + 4-byte big-endian
+schema id + Avro binary — resolved against a schema registry).  Here the
+registry is in-process (subject → schema id → FeatureType), the framing
+is identical, and the payload uses the framework's own Avro record codec
+(io/avro.encode_record), so messages interop with standard Avro tooling.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from ..features.feature_type import FeatureType, parse_spec
+from ..io.avro import avro_schema, decode_record, encode_record
+
+__all__ = ["SchemaRegistry", "AvroMessageCodec"]
+
+_MAGIC = 0x00
+
+
+class SchemaRegistry:
+    """subject → versioned schemas with global ids (Confluent REST model,
+    in-process)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_id: dict[int, FeatureType] = {}
+        self._subjects: dict[str, list[int]] = {}
+        self._next_id = 1
+
+    def register(self, subject: str, sft_or_spec) -> int:
+        """Register a schema version under a subject; returns its id
+        (idempotent for an identical latest version)."""
+        sft = (sft_or_spec if isinstance(sft_or_spec, FeatureType)
+               else parse_spec(subject, sft_or_spec))
+        with self._lock:
+            versions = self._subjects.setdefault(subject, [])
+            if versions:
+                latest = self._by_id[versions[-1]]
+                if latest.spec_string() == sft.spec_string():
+                    return versions[-1]
+            sid = self._next_id
+            self._next_id += 1
+            self._by_id[sid] = sft
+            versions.append(sid)
+            return sid
+
+    def get(self, schema_id: int) -> FeatureType:
+        with self._lock:
+            if schema_id not in self._by_id:
+                raise KeyError(f"no schema with id {schema_id}")
+            return self._by_id[schema_id]
+
+    def latest(self, subject: str) -> tuple[int, FeatureType]:
+        with self._lock:
+            versions = self._subjects.get(subject)
+            if not versions:
+                raise KeyError(f"no such subject {subject!r}")
+            return versions[-1], self._by_id[versions[-1]]
+
+    def avro_schema(self, schema_id: int) -> dict:
+        """The Avro record schema JSON for a registered id."""
+        return avro_schema(self.get(schema_id))
+
+
+class AvroMessageCodec:
+    """Confluent-framed Avro feature messages.
+
+    ``encode(subject, fid, attrs)`` → ``b"\\x00" + id(4B BE) + avro``;
+    ``decode(data)`` resolves the embedded schema id and returns
+    ``(sft, fid, attrs)`` — so consumers need no out-of-band schema.
+    """
+
+    def __init__(self, registry: SchemaRegistry):
+        self.registry = registry
+
+    def encode(self, subject: str, fid: str, attrs: dict) -> bytes:
+        sid, sft = self.registry.latest(subject)
+        return (bytes([_MAGIC]) + struct.pack(">I", sid)
+                + encode_record(sft, fid, attrs))
+
+    def decode(self, data: bytes):
+        if not data or data[0] != _MAGIC:
+            raise ValueError("not a schema-registry framed message")
+        (sid,) = struct.unpack_from(">I", data, 1)
+        sft = self.registry.get(sid)
+        fid, attrs, _ = decode_record(sft, data, pos=5)
+        return sft, fid, attrs

@@ -395,3 +395,54 @@ def test_polling_retries_after_sink_failure(tmp_path):
         src.poll_once()
     assert src.poll_once() == 2  # retried, nothing lost
     assert sum(len(b) for b in got) == 2
+
+
+def test_schema_registry_avro_messages():
+    """Confluent-variant streaming: Avro-framed change messages resolved
+    through the schema registry (magic byte + schema id + avro binary)."""
+    import struct
+    from geomesa_tpu.stream import (
+        AvroMessageCodec, SchemaRegistry, StreamDataStore,
+    )
+
+    reg = SchemaRegistry()
+    store = StreamDataStore(registry=reg)
+    store.create_schema("ships", "mmsi:String,speed:Double,dtg:Date,"
+                                 "*geom:Point")
+    store.write("ships", "s1", {"mmsi": "123", "speed": 12.5,
+                                "dtg": 1514764800000, "geom": (5.0, 55.0)})
+    # wire format really is Confluent-framed avro
+    codec = AvroMessageCodec(reg)
+    raw = codec.encode("ships", "s2", {"mmsi": "456", "speed": 2.0,
+                                       "dtg": 0, "geom": (1.0, 2.0)})
+    assert raw[0] == 0x00
+    (sid,) = struct.unpack_from(">I", raw, 1)
+    assert reg.get(sid).name == "ships"
+    sft, fid, attrs = codec.decode(raw)
+    assert fid == "s2" and attrs["mmsi"] == "456"
+    assert abs(attrs["speed"] - 2.0) < 1e-12
+
+    store.consume("ships")
+    got = store.query("ships", "speed > 10")
+    assert len(got) == 1 and got.column("mmsi")[0] == "123"
+    # registry idempotency + versioning
+    assert reg.register("ships", store.get_schema("ships")) == sid
+    v2 = reg.register("ships", "mmsi:String,speed:Double,heading:Int,"
+                               "dtg:Date,*geom:Point")
+    assert v2 != sid and reg.latest("ships")[0] == v2
+
+
+def test_stream_poison_message_skipped():
+    """An undecodable message must not wedge the consumer group."""
+    from geomesa_tpu.stream import SchemaRegistry, StreamDataStore
+
+    reg = SchemaRegistry()
+    s = StreamDataStore(registry=reg)
+    s.create_schema("p", "v:Int,*geom:Point")
+    s.write("p", "a", {"v": 1, "geom": (0.0, 0.0)})
+    # poison: confluent-framed with an unknown schema id
+    s.broker.send("p", "bad", b"\x00\xff\xff\xff\xff...garbage")
+    s.write("p", "b", {"v": 2, "geom": [1.0, 1.0]})  # list coords work too
+    assert s.consume("p") == 2      # both good records applied
+    assert s.consume("p") == 0      # offsets advanced past the poison
+    assert len(s.query("p")) == 2

@@ -165,3 +165,15 @@ def test_avro_polygon_and_secondary_geometry_roundtrip():
     rt2 = from_avro(buf, sft2)
     x2, y2 = rt2.geom_xy("geom2")
     assert (x2[0], y2[0]) == (3.0, 4.0)
+
+
+def test_profile_context():
+    from geomesa_tpu.utils.profiling import Timings, profile
+    t = Timings()
+    with profile("phase.a", sink=t):
+        sum(range(1000))
+    with profile("phase.a", sink=t):
+        pass
+    assert len(t.times["phase.a"]) == 2
+    assert t.total_ms("phase.a") >= 0
+    assert "phase.a" in repr(t)
