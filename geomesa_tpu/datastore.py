@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -39,7 +40,17 @@ from .stats.stat import (
     CountStat, EnumerationStat, Histogram, MinMax, Stat, TopK, stat_from_json,
 )
 
-__all__ = ["TpuDataStore"]
+__all__ = ["TpuDataStore", "CatalogVersionError"]
+
+#: on-disk catalog format version; bumped on incompatible layout changes
+CATALOG_VERSION = 1
+
+
+class CatalogVersionError(RuntimeError):
+    """Catalog written by a NEWER framework version (the client/server
+    version-mismatch handshake, GeoMesaDataStore.scala:433-500: refuse to
+    run rather than corrupt data written by a newer layout)."""
+
 
 
 class _SchemaStore:
@@ -168,7 +179,43 @@ class TpuDataStore:
         self._interceptors: dict[str, list] = {}
         if catalog_dir:
             os.makedirs(catalog_dir, exist_ok=True)
+            self._check_catalog_version()
             self._load_catalog()
+
+    # -- catalog version handshake + mutation locking ---------------------
+    def _version_path(self) -> str:
+        return os.path.join(self._catalog_dir, "catalog.version")
+
+    def _check_catalog_version(self) -> None:
+        path = self._version_path()
+        if os.path.exists(path):
+            with open(path) as f:
+                found = int(f.read().strip() or 0)
+            if found > CATALOG_VERSION:
+                raise CatalogVersionError(
+                    f"catalog {self._catalog_dir!r} has version {found}, "
+                    f"newer than this framework's {CATALOG_VERSION}; "
+                    "upgrade before opening it")
+        else:
+            with open(path, "w") as f:
+                f.write(str(CATALOG_VERSION))
+
+    @contextmanager
+    def _catalog_lock(self):
+        """File lock serializing schema mutations across processes sharing
+        a catalog directory (the ZookeeperLocking/DistributedLocking role,
+        index/utils/DistributedLocking.scala)."""
+        if not self._catalog_dir:
+            yield
+            return
+        import fcntl
+        path = os.path.join(self._catalog_dir, ".lock")
+        with open(path, "w") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
 
     # -- schema lifecycle (MetadataBackedDataStore.createSchema etc.) ----
     def create_schema(self, sft_or_name, spec: str | None = None) -> FeatureType:
@@ -178,8 +225,16 @@ class TpuDataStore:
             sft = parse_spec(sft_or_name, spec)
         if sft.name in self._schemas:
             raise ValueError(f"schema {sft.name!r} already exists")
-        self._schemas[sft.name] = _SchemaStore(sft)
-        self._persist_schema(sft)
+        with self._catalog_lock():
+            # re-check ON DISK under the lock: another process sharing the
+            # catalog may have created it since we loaded (check-then-act)
+            if self._catalog_dir and os.path.exists(os.path.join(
+                    self._catalog_dir, f"{sft.name}.schema.json")):
+                raise ValueError(
+                    f"schema {sft.name!r} already exists in the catalog "
+                    "(created by another process)")
+            self._schemas[sft.name] = _SchemaStore(sft)
+            self._persist_schema(sft)
         return sft
 
     def get_schema(self, name: str) -> FeatureType:
@@ -191,22 +246,24 @@ class TpuDataStore:
         store = self._store(name)
         if [a.name for a in sft.attributes] != [a.name for a in store.sft.attributes]:
             raise ValueError("updateSchema cannot add/remove attributes")
-        store.sft = sft
-        self._interceptors.pop(name, None)
-        if sft.name != name:
-            self._schemas[sft.name] = self._schemas.pop(name)
-            self._interceptors.pop(sft.name, None)
-        self._persist_schema(sft)
+        with self._catalog_lock():
+            store.sft = sft
+            self._interceptors.pop(name, None)
+            if sft.name != name:
+                self._schemas[sft.name] = self._schemas.pop(name)
+                self._interceptors.pop(sft.name, None)
+            self._persist_schema(sft)
 
     def remove_schema(self, name: str) -> None:
-        self._schemas.pop(name, None)
-        self._interceptors.pop(name, None)
-        if self._catalog_dir:
-            for suffix in (".schema.json", ".parquet", ".stats.json",
-                           ".vis.json"):
-                path = os.path.join(self._catalog_dir, f"{name}{suffix}")
-                if os.path.exists(path):
-                    os.remove(path)
+        with self._catalog_lock():
+            self._schemas.pop(name, None)
+            self._interceptors.pop(name, None)
+            if self._catalog_dir:
+                for suffix in (".schema.json", ".parquet", ".stats.json",
+                               ".vis.json"):
+                    path = os.path.join(self._catalog_dir, f"{name}{suffix}")
+                    if os.path.exists(path):
+                        os.remove(path)
 
     @property
     def type_names(self) -> list[str]:

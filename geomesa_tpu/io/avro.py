@@ -185,37 +185,19 @@ def to_avro(batch: FeatureBatch, path_or_buf) -> None:
             if f"{a.name}_x" in batch.columns:
                 geom_xy[a.name] = batch.geom_xy(a.name)
     for i in range(n):
-        _w_str(str(batch.ids[i]), body)
+        attrs: dict = {}
         for a in sft.attributes:
             if a.is_geometry:
                 if a.name == sft.default_geom and geoms is not None:
-                    _w_long(0, body)  # union branch 0 (value)
-                    _w_bytes(wkb_encode(geoms.geometry(i)), body)
+                    attrs[a.name] = geoms.geometry(i)
                 elif a.name in geom_xy:
                     x, y = geom_xy[a.name]
-                    _w_long(0, body)
-                    _w_bytes(wkb_encode(Point(float(x[i]), float(y[i]))),
-                             body)
-                else:
-                    _w_long(1, body)  # no geometry data: null branch
+                    attrs[a.name] = Point(float(x[i]), float(y[i]))
                 continue
             col = batch.columns.get(a.name)
-            v = None if col is None else col[i]
-            if v is None or (isinstance(v, float) and np.isnan(v)):
-                _w_long(1, body)  # union branch 1 (null)
-                continue
-            _w_long(0, body)
-            t = _AVRO_TYPES.get(a.type, "string")
-            if t in ("long", "int"):
-                _w_long(int(v), body)
-            elif t == "double":
-                body += struct.pack("<d", float(v))
-            elif t == "float":
-                body += struct.pack("<f", float(v))
-            elif t == "boolean":
-                body.append(1 if v else 0)
-            else:
-                _w_str(str(v), body)
+            if col is not None:
+                attrs[a.name] = col[i]
+        body += encode_record(sft, str(batch.ids[i]), attrs)
 
     block = bytearray()
     _w_long(n, block)

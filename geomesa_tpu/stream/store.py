@@ -186,13 +186,26 @@ class StreamDataStore:
         records = self.broker.poll(self.group, name, max_records)
         positions: dict = {}
         applied = 0
-        for (part, off), raw in records:
-            try:
-                if self._codec is not None and raw[:1] == b"\x00":
-                    _, fid, attrs = self._codec.decode(raw)
-                    msg = GeoMessage.change(fid, attrs)
-                else:
-                    msg = GeoMessage.from_bytes(raw)
+        try:
+            for (part, off), raw in records:
+                try:
+                    if self._codec is not None and raw[:1] == b"\x00":
+                        _, fid, attrs = self._codec.decode(raw)
+                        msg = GeoMessage.change(fid, attrs)
+                    else:
+                        msg = GeoMessage.from_bytes(raw)
+                except Exception:  # noqa: BLE001 — poison message: skip,
+                    # log, and STILL advance the offset; replaying bytes
+                    # that can never decode would wedge the group forever
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "dropping undecodable message at %s/%s[%d]@%d",
+                        name, self.group, part, off)
+                    positions[part] = off + 1
+                    continue
+                # apply/listener failures are NOT poison: propagate without
+                # committing this offset so the message is redelivered
+                # (at-least-once)
                 if msg.kind == "change":
                     cache.put(msg.feature_id, msg.attributes)
                 elif msg.kind == "delete":
@@ -202,16 +215,10 @@ class StreamDataStore:
                 for fn in self._listeners.get(name, ()):
                     fn(msg)
                 applied += 1
-            except Exception:  # noqa: BLE001 — poison message: skip, log,
-                # and STILL advance the offset; replaying a message that
-                # can never decode would wedge the consumer group forever
-                import logging
-                logging.getLogger(__name__).exception(
-                    "dropping undecodable message at %s/%s[%d]@%d",
-                    name, self.group, part, off)
-            positions[part] = off + 1
-        if positions:
-            self.broker.commit(self.group, name, positions)
+                positions[part] = off + 1
+        finally:
+            if positions:
+                self.broker.commit(self.group, name, positions)
         return applied
 
     # -- query side (LocalQueryRunner semantics) --------------------------

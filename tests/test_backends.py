@@ -446,3 +446,26 @@ def test_stream_poison_message_skipped():
     assert s.consume("p") == 2      # both good records applied
     assert s.consume("p") == 0      # offsets advanced past the poison
     assert len(s.query("p")) == 2
+
+
+def test_stream_listener_error_redelivers():
+    """Apply/listener failures are NOT poison: the offset stays uncommitted
+    and the message is redelivered (at-least-once)."""
+    from geomesa_tpu.stream import StreamDataStore
+
+    s = StreamDataStore()
+    s.create_schema("l", "v:Int,*geom:Point")
+    calls = {"n": 0}
+
+    def flaky(msg):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("listener down")
+
+    s.add_listener("l", flaky)
+    s.write("l", "a", {"v": 1, "geom": (0.0, 0.0)})
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        s.consume("l")
+    assert s.consume("l") == 1     # redelivered and applied
+    assert calls["n"] == 2

@@ -186,3 +186,46 @@ def test_schema_lifecycle():
         ds.create_schema("a", "x:Int,*geom:Point")
     ds.remove_schema("a")
     assert ds.type_names == []
+
+
+def test_catalog_version_handshake(tmp_path):
+    """A catalog from a NEWER framework version refuses to open (the
+    distributed version-mismatch check, GeoMesaDataStore.scala:433-500)."""
+    from geomesa_tpu.datastore import CatalogVersionError
+
+    d = str(tmp_path / "cat")
+    ds = TpuDataStore(d)
+    ds.create_schema("t", "v:Int,*geom:Point")
+    # same version reopens fine
+    assert TpuDataStore(d).type_names == ["t"]
+    with open(f"{d}/catalog.version", "w") as f:
+        f.write("999")
+    with pytest.raises(CatalogVersionError):
+        TpuDataStore(d)
+
+
+def test_catalog_schema_lock(tmp_path):
+    """Schema mutations take the catalog file lock (multi-process safety);
+    nested use must not deadlock."""
+    d = str(tmp_path / "cat")
+    ds = TpuDataStore(d)
+    ds.create_schema("a", "v:Int,*geom:Point")
+    ds.remove_schema("a")
+    ds.create_schema("a", "v:Int,*geom:Point")
+    assert ds.type_names == ["a"]
+
+
+def test_back_compat_catalog_fixture():
+    """Frozen v1 catalog (tests/data/catalog_v1, written 2026-07) must
+    keep loading and answering queries in future versions — the
+    reference's BackCompatibilityTest pattern (replaying old serialized
+    data against current code)."""
+    import os
+    d = os.path.join(os.path.dirname(__file__), "data", "catalog_v1")
+    ds = TpuDataStore(d)
+    assert ds.type_names == ["legacy"]
+    assert ds.get_count("legacy") == 500
+    got = ds.query("legacy", "BBOX(geom, -10, 40, 0, 50) AND name = 'n1'")
+    x, _ = got.geom_xy()
+    assert len(got) > 0 and (x <= 0).all()
+    assert set(got.column("name")) == {"n1"}
