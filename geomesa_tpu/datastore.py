@@ -177,10 +177,12 @@ class TpuDataStore:
         self._audit_writer = audit_writer
         self._user = user
         self._interceptors: dict[str, list] = {}
+        self._lock_depth = 0
         if catalog_dir:
             os.makedirs(catalog_dir, exist_ok=True)
-            self._check_catalog_version()
-            self._load_catalog()
+            with self._catalog_lock():
+                self._check_catalog_version()
+                self._load_catalog()
 
     # -- catalog version handshake + mutation locking ---------------------
     def _version_path(self) -> str:
@@ -202,20 +204,32 @@ class TpuDataStore:
 
     @contextmanager
     def _catalog_lock(self):
-        """File lock serializing schema mutations across processes sharing
-        a catalog directory (the ZookeeperLocking/DistributedLocking role,
-        index/utils/DistributedLocking.scala)."""
+        """File lock serializing catalog reads/mutations across processes
+        sharing a catalog directory (the ZookeeperLocking/
+        DistributedLocking role, index/utils/DistributedLocking.scala).
+        Reentrant within this store instance (flock on a second fd of the
+        same file would deadlock against ourselves)."""
         if not self._catalog_dir:
             yield
             return
-        import fcntl
-        path = os.path.join(self._catalog_dir, ".lock")
-        with open(path, "w") as f:
-            fcntl.flock(f, fcntl.LOCK_EX)
+        if self._lock_depth > 0:
+            self._lock_depth += 1
             try:
                 yield
             finally:
-                fcntl.flock(f, fcntl.LOCK_UN)
+                self._lock_depth -= 1
+            return
+        import fcntl
+        path = os.path.join(self._catalog_dir, ".lock")
+        f = open(path, "w")
+        fcntl.flock(f, fcntl.LOCK_EX)
+        self._lock_depth = 1
+        try:
+            yield
+        finally:
+            self._lock_depth = 0
+            fcntl.flock(f, fcntl.LOCK_UN)
+            f.close()
 
     # -- schema lifecycle (MetadataBackedDataStore.createSchema etc.) ----
     def create_schema(self, sft_or_name, spec: str | None = None) -> FeatureType:
@@ -252,6 +266,16 @@ class TpuDataStore:
             if sft.name != name:
                 self._schemas[sft.name] = self._schemas.pop(name)
                 self._interceptors.pop(sft.name, None)
+                # move the persisted artifacts: stale old-name files would
+                # resurrect a phantom schema on the next catalog load
+                if self._catalog_dir:
+                    for suffix in (".schema.json", ".parquet",
+                                   ".stats.json", ".vis.json"):
+                        old = os.path.join(self._catalog_dir,
+                                           f"{name}{suffix}")
+                        if os.path.exists(old):
+                            os.replace(old, os.path.join(
+                                self._catalog_dir, f"{sft.name}{suffix}"))
             self._persist_schema(sft)
 
     def remove_schema(self, name: str) -> None:
@@ -583,8 +607,11 @@ class TpuDataStore:
     def _load_catalog(self) -> None:
         for fn in os.listdir(self._catalog_dir):
             if fn.endswith(".schema.json"):
-                with open(os.path.join(self._catalog_dir, fn)) as f:
-                    meta = json.load(f)
+                try:
+                    with open(os.path.join(self._catalog_dir, fn)) as f:
+                        meta = json.load(f)
+                except FileNotFoundError:
+                    continue  # removed by a concurrent process mid-listing
                 sft = parse_spec(meta["name"], meta["spec"])
                 self._schemas[sft.name] = _SchemaStore(sft)
                 self._load_data(sft.name)

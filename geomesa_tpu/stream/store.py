@@ -125,6 +125,12 @@ class StreamDataStore:
         self._schemas: dict[str, FeatureType] = {}
         self._caches: dict[str, LiveFeatureCache] = {}
         self._listeners: dict[str, list] = {}
+        #: per-offset apply-failure counts; after MAX_APPLY_ATTEMPTS the
+        #: record is dead-lettered (skipped) so one bad-but-decodable
+        #: message cannot block its partition forever
+        self._apply_failures: dict = {}
+
+    MAX_APPLY_ATTEMPTS = 3
 
     # -- schema -----------------------------------------------------------
     def create_schema(self, name: str, spec: str) -> FeatureType:
@@ -205,16 +211,32 @@ class StreamDataStore:
                     continue
                 # apply/listener failures are NOT poison: propagate without
                 # committing this offset so the message is redelivered
-                # (at-least-once)
-                if msg.kind == "change":
-                    cache.put(msg.feature_id, msg.attributes)
-                elif msg.kind == "delete":
-                    cache.remove(msg.feature_id)
+                # (at-least-once) — but only MAX_APPLY_ATTEMPTS times, after
+                # which the record is dead-lettered so a deterministically
+                # failing message cannot block its partition forever
+                key = (name, part, off)
+                try:
+                    if msg.kind == "change":
+                        cache.put(msg.feature_id, msg.attributes)
+                    elif msg.kind == "delete":
+                        cache.remove(msg.feature_id)
+                    else:
+                        cache.clear()
+                    for fn in self._listeners.get(name, ()):
+                        fn(msg)
+                except Exception:
+                    n_fail = self._apply_failures.get(key, 0) + 1
+                    self._apply_failures[key] = n_fail
+                    if n_fail < self.MAX_APPLY_ATTEMPTS:
+                        raise
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "dead-lettering message after %d failed apply "
+                        "attempts at %s/%s[%d]@%d", n_fail, name,
+                        self.group, part, off)
                 else:
-                    cache.clear()
-                for fn in self._listeners.get(name, ()):
-                    fn(msg)
-                applied += 1
+                    self._apply_failures.pop(key, None)
+                    applied += 1
                 positions[part] = off + 1
         finally:
             if positions:

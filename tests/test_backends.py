@@ -469,3 +469,21 @@ def test_stream_listener_error_redelivers():
         s.consume("l")
     assert s.consume("l") == 1     # redelivered and applied
     assert calls["n"] == 2
+
+
+def test_stream_apply_failure_dead_letters_after_retries():
+    """A decodable message that deterministically fails to apply is
+    retried MAX_APPLY_ATTEMPTS times, then dead-lettered."""
+    from geomesa_tpu.stream import StreamDataStore
+
+    s = StreamDataStore()
+    s.create_schema("dl", "v:Int,*geom:Point")
+    s.add_listener("dl", lambda msg: (_ for _ in ()).throw(
+        RuntimeError("always fails")))
+    s.write("dl", "a", {"v": 1, "geom": (0.0, 0.0)})
+    import pytest as _pytest
+    for _ in range(s.MAX_APPLY_ATTEMPTS - 1):
+        with _pytest.raises(RuntimeError):
+            s.consume("dl")
+    assert s.consume("dl") == 0       # dead-lettered, offset advanced
+    assert s.consume("dl") == 0       # gone for good

@@ -229,3 +229,19 @@ def test_back_compat_catalog_fixture():
     x, _ = got.geom_xy()
     assert len(got) > 0 and (x <= 0).all()
     assert set(got.column("name")) == {"n1"}
+
+
+def test_update_schema_rename_moves_catalog_files(tmp_path):
+    """Renaming a schema must move its persisted artifacts: a reload
+    must see only the new name, with the data intact."""
+    d = str(tmp_path / "cat")
+    ds = TpuDataStore(d)
+    ds.create_schema("old", "v:Int,dtg:Date,*geom:Point")
+    ds.write("old", {"v": np.arange(5), "dtg": np.zeros(5, np.int64),
+                     "geom": (np.zeros(5), np.zeros(5))})
+    ds.flush("old")
+    from geomesa_tpu.features.feature_type import parse_spec
+    ds.update_schema("old", parse_spec("new", "v:Int,dtg:Date,*geom:Point"))
+    ds2 = TpuDataStore(d)
+    assert ds2.type_names == ["new"]
+    assert ds2.get_count("new") == 5

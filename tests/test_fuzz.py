@@ -1,0 +1,133 @@
+"""Seeded differential fuzz: random data/queries vs independent oracles.
+
+The heavyweight sweep (more trials, all periods, larger N) runs ad hoc;
+these seeded versions pin the same properties in CI time:
+
+* Z3/Z2 hit sets == brute force, across all time periods and boundary
+  coordinates/timestamps.
+* XZ2/XZ3 candidates are SUPERSETS of every bbox-intersecting geometry
+  (lossy-by-design, never lossy the wrong way).
+* point_in_polygon agrees with matplotlib's Path implementation away
+  from polygon boundaries.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.curve import TimePeriod
+from geomesa_tpu.geometry.types import LineString, Point, Polygon
+from geomesa_tpu.index import Z2PointIndex, Z3PointIndex
+from geomesa_tpu.index.xz2 import XZ2Index
+from geomesa_tpu.index.xz3 import XZ3Index
+
+MS = 1514764800000
+DAY = 86_400_000
+
+
+@pytest.mark.parametrize("period", [TimePeriod.DAY, TimePeriod.WEEK,
+                                    TimePeriod.MONTH, TimePeriod.YEAR])
+def test_fuzz_z3_all_periods(period):
+    rng = np.random.default_rng(hash(period.value) % 2**32)
+    n = 5000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    span = 200 * DAY
+    t = rng.integers(MS, MS + span, n)
+    x[0], y[0] = -180.0, -90.0
+    x[1], y[1] = 180.0, 90.0
+    t[2], t[3] = MS, MS + span - 1
+    idx = Z3PointIndex.build(x, y, t, period=period)
+    for _ in range(4):
+        x0, y0 = rng.uniform(-180, 175), rng.uniform(-90, 85)
+        box = (x0, y0, min(180, x0 + rng.uniform(0.1, 60)),
+               min(90, y0 + rng.uniform(0.1, 60)))
+        tlo = int(rng.integers(MS - DAY, MS + span))
+        thi = tlo + int(rng.integers(1, span))
+        got = idx.query([box], tlo, thi)
+        want = np.flatnonzero(
+            (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+            & (t >= tlo) & (t <= thi))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_fuzz_z2_multibox():
+    rng = np.random.default_rng(11)
+    n = 8000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    idx = Z2PointIndex.build(x, y)
+    for _ in range(8):
+        boxes = []
+        for _ in range(int(rng.integers(1, 5))):
+            x0, y0 = rng.uniform(-180, 180), rng.uniform(-90, 90)
+            boxes.append((x0, y0, min(180, x0 + rng.uniform(0, 40)),
+                          min(90, y0 + rng.uniform(0, 40))))
+        want = np.zeros(n, bool)
+        for b in boxes:
+            want |= (x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+        np.testing.assert_array_equal(idx.query(boxes), np.flatnonzero(want))
+
+
+def _rand_geom(rng):
+    kind = rng.integers(0, 3)
+    cx, cy = rng.uniform(-170, 170), rng.uniform(-80, 80)
+    if kind == 0:
+        return Point(cx, cy)
+    if kind == 1:
+        return LineString(np.column_stack(
+            [cx + rng.uniform(-2, 2, 4), cy + rng.uniform(-2, 2, 4)]))
+    w, h = rng.uniform(0.01, 3), rng.uniform(0.01, 3)
+    return Polygon([(cx - w, cy - h), (cx + w, cy - h),
+                    (cx + w, cy + h), (cx - w, cy + h)])
+
+
+def test_fuzz_xz_candidate_supersets():
+    rng = np.random.default_rng(5)
+    n = 800
+    geoms = [_rand_geom(rng) for _ in range(n)]
+    t = rng.integers(MS, MS + 30 * DAY, n)
+    xz2 = XZ2Index.build(geoms, g=12)
+    xz3 = XZ3Index.build(geoms, t, period="week", g=10)
+    for _ in range(5):
+        qx, qy = rng.uniform(-170, 170), rng.uniform(-80, 80)
+        qw, qh = rng.uniform(0.5, 30), rng.uniform(0.5, 30)
+        q = Polygon([(qx - qw, qy - qh), (qx + qw, qy - qh),
+                     (qx + qw, qy + qh), (qx - qw, qy + qh)])
+        qe = q.envelope
+        inter = np.array([
+            g.envelope.xmin <= qe.xmax and g.envelope.xmax >= qe.xmin
+            and g.envelope.ymin <= qe.ymax and g.envelope.ymax >= qe.ymin
+            for g in geoms])
+        cand2 = set(int(i) for i in xz2.query(q, exact=False))
+        assert set(np.flatnonzero(inter)) <= cand2
+        tlo = int(rng.integers(MS, MS + 30 * DAY))
+        thi = tlo + int(rng.integers(1, 10 * DAY))
+        cand3 = set(int(i) for i in xz3.query(q, tlo, thi, exact=False))
+        want3 = set(np.flatnonzero(inter & (t >= tlo) & (t <= thi)))
+        assert want3 <= cand3
+
+
+def test_fuzz_point_in_polygon_vs_matplotlib():
+    mpath = pytest.importorskip("matplotlib.path")
+    from geomesa_tpu.geometry.predicates import (
+        point_in_polygon, points_on_rings,
+    )
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        k = int(rng.integers(3, 9))
+        ang = np.sort(rng.uniform(0, 2 * np.pi, k))
+        r = rng.uniform(0.5, 5, k)
+        cx, cy = rng.uniform(-50, 50, 2)
+        ring = np.column_stack([cx + r * np.cos(ang), cy + r * np.sin(ang)])
+        poly = Polygon(ring)
+        px = rng.uniform(cx - 6, cx + 6, 1000)
+        py = rng.uniform(cy - 6, cy + 6, 1000)
+        got = point_in_polygon(px, py, poly)
+        want = mpath.Path(np.vstack([ring, ring[:1]])).contains_points(
+            np.column_stack([px, py]))
+        diff = got != want
+        if diff.any():
+            # disagreements must sit on the boundary (FP edge cases)
+            near = points_on_rings(px[diff], py[diff], [poly.shell],
+                                   eps=1e-9)
+            assert int(diff.sum()) - int(near.sum()) <= 3

@@ -1,0 +1,60 @@
+"""Planner correctness sweep: every strategy's hit set must equal the
+full-filter oracle across tricky filter shapes (the reference's
+*IdxStrategyTest correctness-vs-baseline pattern, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.filters import parse_ecql
+from geomesa_tpu.filters.evaluate import evaluate_filter
+
+MS = 1514764800000
+DAY = 86_400_000
+
+QUERIES = [
+    "BBOX(geom,-10,-10,10,10)",
+    "NOT BBOX(geom,-10,-10,10,10)",
+    "BBOX(geom,-10,-10,10,10) AND v > 0",
+    "BBOX(geom,-10,-10,10,10) OR BBOX(geom,100,0,120,20)",
+    "(BBOX(geom,-10,-10,10,10) OR name = 'n1') AND score < 0.5",
+    "name = 'n1' AND dtg DURING 2018-01-03T00:00:00Z/2018-01-10T00:00:00Z",
+    "name IN ('n1','n2') AND NOT cat = 'c0'",
+    "v BETWEEN -10 AND 10 AND BBOX(geom,0,0,90,85)",
+    "dtg AFTER 2018-01-15T00:00:00Z",
+    "dtg BEFORE 2018-01-02T00:00:00Z OR dtg AFTER 2018-01-20T00:00:00Z",
+    "INTERSECTS(geom, POLYGON((0 0, 40 0, 40 40, 0 40, 0 0)))",
+    "NOT (name = 'n1' OR name = 'n2')",
+    "score >= 0.99 OR v = 0",
+    "BBOX(geom,-180,-85,180,85) AND name LIKE 'n%'",
+    "(name = 'n3' AND BBOX(geom,-50,-50,50,50)) "
+    "OR (cat = 'c2' AND dtg BEFORE 2018-01-02T00:00:00Z)",
+    "DWITHIN(geom, POINT(5 5), 3)",
+]
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(123)
+    n = 20_000
+    ds = TpuDataStore()
+    ds.create_schema(
+        "t", "name:String:index=true,cat:String,v:Int,score:Double,"
+             "dtg:Date,*geom:Point")
+    ds.write("t", {
+        "name": np.asarray([f"n{i % 7}" for i in range(n)], dtype=object),
+        "cat": np.asarray([f"c{i % 3}" for i in range(n)], dtype=object),
+        "v": rng.integers(-50, 50, n),
+        "score": rng.uniform(0, 1, n),
+        "dtg": rng.integers(MS, MS + 21 * DAY, n),
+        "geom": (rng.uniform(-180, 180, n), rng.uniform(-85, 85, n)),
+    })
+    return ds
+
+
+@pytest.mark.parametrize("ecql", QUERIES)
+def test_strategy_hits_equal_oracle(store, ecql):
+    got = store.query_result("t", ecql).positions
+    oracle = np.flatnonzero(
+        evaluate_filter(parse_ecql(ecql), store._store("t").batch))
+    np.testing.assert_array_equal(np.sort(got), oracle)
