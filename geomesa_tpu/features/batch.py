@@ -83,6 +83,13 @@ class FeatureBatch:
                     # concat regardless of construction style
                     if isinstance(vals, tuple):
                         x, y = vals
+                    elif (isinstance(vals, list) and vals
+                          and isinstance(vals[0], (tuple, list))
+                          and len(vals[0]) == 2
+                          and not isinstance(vals[0][0], (tuple, list))):
+                        # list of (x, y) coordinate pairs
+                        arr = np.asarray(vals, dtype=np.float64)
+                        x, y = arr[:, 0], arr[:, 1]
                     else:
                         pts = (vals if isinstance(vals, PackedGeometry)
                                else pack_geometries(vals))

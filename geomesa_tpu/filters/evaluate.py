@@ -19,9 +19,11 @@ import numpy as np
 from ..features.batch import FeatureBatch
 from ..geometry.predicates import (
     bbox_intersects,
+    geometry_distance,
     geometry_intersects,
     point_in_polygon,
     points_on_rings,
+    points_to_geometry_dist,
 )
 from ..geometry.types import (
     LineString,
@@ -39,6 +41,16 @@ from .ast import (
 __all__ = ["evaluate_filter"]
 
 
+def _use_xy_fast_path(batch: FeatureBatch, prop: str) -> bool:
+    """True when the property's x/y columns are the right source: either
+    it is a secondary point attribute, or the default geometry with no
+    packed (non-point) storage.  The packed column only ever holds the
+    DEFAULT geometry, so other props must never fall through to it."""
+    if f"{prop}_x" not in batch.columns:
+        return False
+    return prop != batch.sft.default_geom or batch.geoms is None
+
+
 def _like_regex(pattern: str, case_insensitive: bool) -> re.Pattern:
     # SQL LIKE: % = any run, _ = single char
     esc = re.escape(pattern).replace("%", ".*").replace("_", ".")
@@ -49,9 +61,8 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
     """Spatial mask for a query geometry over the batch's geometry column
     (point fast path or packed geometries), honoring the operator."""
     n = len(batch)
-    xkey = f"{prop}_x"
-    if xkey in batch.columns and batch.geoms is None:
-        x, y = batch.columns[xkey], batch.columns[f"{prop}_y"]
+    if _use_xy_fast_path(batch, prop):
+        x, y = batch.columns[f"{prop}_x"], batch.columns[f"{prop}_y"]
         if op == "contains":
             # a point can only contain (and only intersects-equal) a point
             if isinstance(geom, Point):
@@ -194,13 +205,35 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
     if isinstance(f, Contains):
         return _geom_mask_polygonal(batch, f.prop, f.geometry, "contains")
     if isinstance(f, DWithin):
-        xkey = f"{f.prop}_x"
-        if xkey in batch.columns:
-            x, y = batch.columns[xkey], batch.columns[f"{f.prop}_y"]
+        env = f.geometry.envelope
+        window = (env.xmin - f.distance, env.ymin - f.distance,
+                  env.xmax + f.distance, env.ymax + f.distance)
+        if _use_xy_fast_path(batch, f.prop):
+            x = batch.columns[f"{f.prop}_x"]
+            y = batch.columns[f"{f.prop}_y"]
             if isinstance(f.geometry, Point):
                 d2 = (x - f.geometry.x) ** 2 + (y - f.geometry.y) ** 2
                 return d2 <= f.distance ** 2
-        raise NotImplementedError("DWITHIN currently supports point-to-point")
+            # bbox prefilter bounds the (points × segments) distance work
+            near = ((x >= window[0]) & (x <= window[2])
+                    & (y >= window[1]) & (y <= window[3]))
+            out = np.zeros(n, dtype=bool)
+            if near.any():
+                idx = np.flatnonzero(near)
+                out[idx] = (points_to_geometry_dist(x[idx], y[idx],
+                                                    f.geometry)
+                            <= f.distance)
+            return out
+        packed = batch.geoms
+        if packed is None:
+            raise KeyError(f"no geometry column for {f.prop!r}")
+        # bbox prefilter expanded by the distance, then exact per candidate
+        cand = bbox_intersects(packed.bbox, window)
+        out = np.zeros(n, dtype=bool)
+        for i in np.flatnonzero(cand):
+            out[i] = (geometry_distance(packed.geometry(int(i)), f.geometry)
+                      <= f.distance)
+        return out
     if isinstance(f, During):
         col = _prop_column(batch, f.prop)
         mask = np.ones(n, dtype=bool)

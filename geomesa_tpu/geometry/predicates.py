@@ -181,6 +181,74 @@ def _points_of(geom: Geometry) -> np.ndarray:
     raise ValueError(geom)
 
 
+def all_vertices(geom: Geometry) -> np.ndarray:
+    """Every vertex of a geometry, INCLUDING polygon hole rings (unlike
+    ``_points_of``, whose shell-only view suffices for intersection
+    seeding but not for distance)."""
+    if isinstance(geom, (Polygon, MultiPolygon)):
+        return np.vstack(_rings_of(geom))
+    return _points_of(geom)
+
+
+def points_to_geometry_dist(px, py, geom: Geometry) -> np.ndarray:
+    """Vectorized planar distance (coordinate units) from points to a
+    geometry: 0 inside polygons / on lines, else distance to the nearest
+    vertex/segment.  Segment work is chunked to bound the (N × S)
+    broadcast (same discipline as the edge-chunked predicates)."""
+    px = np.asarray(px, dtype=np.float64)
+    py = np.asarray(py, dtype=np.float64)
+    out = np.full(px.shape, np.inf)
+    if isinstance(geom, (Point, MultiPoint)):
+        pts = _points_of(geom)
+        for qx, qy in pts:
+            out = np.minimum(out, np.hypot(px - qx, py - qy))
+        return out
+    a, b = _segments(geom)
+    for s0 in range(0, len(a), _EDGE_CHUNK):
+        aa = a[s0:s0 + _EDGE_CHUNK]
+        bb = b[s0:s0 + _EDGE_CHUNK]
+        ax, ay = aa[:, 0], aa[:, 1]
+        bx, by = bb[:, 0], bb[:, 1]
+        dx, dy = bx - ax, by - ay
+        ln2 = dx * dx + dy * dy
+        ln2 = np.where(ln2 == 0, 1.0, ln2)
+        t = ((px[:, None] - ax[None, :]) * dx[None, :]
+             + (py[:, None] - ay[None, :]) * dy[None, :]) / ln2[None, :]
+        t = np.clip(t, 0.0, 1.0)
+        cx = ax[None, :] + t * dx[None, :]
+        cy = ay[None, :] + t * dy[None, :]
+        d = np.hypot(px[:, None] - cx, py[:, None] - cy)
+        out = np.minimum(out, d.min(axis=1))
+    if isinstance(geom, (Polygon, MultiPolygon)):
+        inside = point_in_polygon(px, py, geom)
+        out = np.where(inside, 0.0, out)
+    return out
+
+
+def geometry_to_point_dist(geom: Geometry, qx: float, qy: float) -> float:
+    """Planar distance from a geometry to a point (0 when the point is
+    inside/on the geometry)."""
+    if isinstance(geom, Point):
+        return float(np.hypot(geom.x - qx, geom.y - qy))
+    return float(points_to_geometry_dist(
+        np.array([qx]), np.array([qy]), geom)[0])
+
+
+def geometry_distance(a: Geometry, b: Geometry) -> float:
+    """Planar min distance between two geometries (0 when intersecting).
+
+    For non-crossing segment sets the minimum is attained at a vertex of
+    one operand, so min(vertices(a)→b, vertices(b)→a) is exact once
+    crossings are handled by the intersects check."""
+    if geometry_intersects(a, b):
+        return 0.0
+    va = all_vertices(a)
+    vb = all_vertices(b)
+    d1 = points_to_geometry_dist(va[:, 0], va[:, 1], b).min()
+    d2 = points_to_geometry_dist(vb[:, 0], vb[:, 1], a).min()
+    return float(min(d1, d2))
+
+
 def geometry_intersects(a: Geometry, b: Geometry) -> bool:
     """JTS-style ``intersects`` dispatch over the supported type lattice."""
     if not a.envelope.intersects(b.envelope):

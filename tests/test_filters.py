@@ -166,3 +166,63 @@ def test_evaluate_polygon_batch():
     batch = FeatureBatch.from_dict(sft, {"geom": polys})
     f = parse_ecql("INTERSECTS(geom, POLYGON ((1.5 1.5, 5 1.5, 5 5, 1.5 5, 1.5 1.5)))")
     np.testing.assert_array_equal(evaluate_filter(f, batch), [True, False, True])
+
+
+def test_dwithin_non_point_query_geometries():
+    """DWITHIN with linestring/polygon query geometries over point
+    features, and point queries over packed-geometry features."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry.types import LineString, Polygon
+
+    ds = TpuDataStore()
+    ds.create_schema("pnt", "v:Int,*geom:Point")
+    ds.write("pnt", {"v": np.arange(4),
+                     "geom": (np.array([0.0, 1.0, 5.0, 2.5]),
+                              np.array([0.0, 1.0, 5.0, 0.0]))})
+    got = ds.query("pnt", "DWITHIN(geom, LINESTRING(0 0, 2 2), 0.8)")
+    assert sorted(got.column("v")) == [0, 1]
+    got = ds.query("pnt",
+                   "DWITHIN(geom, POLYGON((2 -1, 3 -1, 3 1, 2 1, 2 -1)), 0.6)")
+    assert sorted(got.column("v")) == [3]
+
+    ds.create_schema("gm", "v:Int,*geom:Geometry")
+    ds.write("gm", {"v": np.arange(2), "geom": [
+        Polygon([(0, 0), (1, 0), (1, 1), (0, 1)]),
+        LineString([(10, 10), (12, 12)])]})
+    assert list(ds.query("gm", "DWITHIN(geom, POINT(1.5 0.5), 0.6)")
+                .column("v")) == [0]
+    assert list(ds.query("gm", "DWITHIN(geom, POINT(11 10.9), 0.2)")
+                .column("v")) == [1]
+    # inside the polygon -> distance 0
+    assert list(ds.query("gm", "DWITHIN(geom, POINT(0.5 0.5), 0.01)")
+                .column("v")) == [0]
+
+
+def test_dwithin_mid_segment_and_secondary_point_prop():
+    """Mid-segment closest approach counts (not just vertices), and
+    spatial predicates on a secondary Point property must not fall
+    through to the default packed geometry column."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry.types import LineString, Polygon
+
+    ds = TpuDataStore()
+    ds.create_schema("seg", "v:Int,*geom:Geometry")
+    ds.write("seg", {"v": np.arange(1),
+                     "geom": [LineString([(-100, 1.4), (100, 1.4)])]})
+    got = ds.query(
+        "seg", "DWITHIN(geom, POLYGON((-1 0, 1 0, 1 1, -1 1, -1 0)), 0.5)")
+    assert list(got.column("v")) == [0]  # true distance 0.4, mid-segment
+
+    ds.create_schema("two", "v:Int,p:Point,*geom:Geometry")
+    ds.write("two", {"v": np.arange(2),
+                     "p": [(0.0, 0.0), (50.0, 50.0)],
+                     "geom": [Polygon([(49, 49), (51, 49), (51, 51),
+                                       (49, 51)]),
+                              Polygon([(-1, -1), (1, -1), (1, 1),
+                                       (-1, 1)])]})
+    got = ds.query("two", "DWITHIN(p, POINT(0 0), 0.1)")
+    assert list(got.column("v")) == [0]  # row whose p is at the origin
+    got = ds.query("two", "BBOX(p, 40, 40, 60, 60)")
+    assert list(got.column("v")) == [1]
