@@ -21,6 +21,7 @@ from ..geometry.predicates import (
     bbox_intersects,
     geometry_distance,
     geometry_intersects,
+    geometry_within,
     point_in_polygon,
     points_on_rings,
     points_to_geometry_dist,
@@ -92,9 +93,11 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
             idx = np.flatnonzero(near)
             out[idx] = points_on_rings(x[idx], y[idx], rings)
         return out
-    # packed geometries: bbox prefilter + exact object test
+    # packed geometries: bbox prefilter + exact object test.  The packed
+    # column only ever stores the DEFAULT geometry — refuse rather than
+    # silently answer for a different property
     packed = batch.geoms
-    if packed is None:
+    if packed is None or prop != batch.sft.default_geom:
         raise KeyError(f"no geometry column for {prop!r}")
     env = geom.envelope
     cand = bbox_intersects(packed.bbox, env.as_tuple())
@@ -104,10 +107,9 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
         if op == "intersects":
             out[i] = geometry_intersects(gi, geom)
         elif op == "within":
-            # approximated as: gi intersects geom and gi's envelope inside
-            out[i] = geom.envelope.contains(gi.envelope) and geometry_intersects(gi, geom)
+            out[i] = geometry_within(gi, geom)
         elif op == "contains":
-            out[i] = gi.envelope.contains(geom.envelope) and geometry_intersects(gi, geom)
+            out[i] = geometry_within(geom, gi)
         else:
             raise NotImplementedError(op)
     return out
@@ -189,9 +191,9 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
     if isinstance(f, Not):
         return ~evaluate_filter(f.filter, batch)
     if isinstance(f, BBox):
-        xkey = f"{f.prop}_x"
-        if xkey in batch.columns and batch.geoms is None:
-            x, y = batch.columns[xkey], batch.columns[f"{f.prop}_y"]
+        if _use_xy_fast_path(batch, f.prop):
+            x = batch.columns[f"{f.prop}_x"]
+            y = batch.columns[f"{f.prop}_y"]
             return (x >= f.xmin) & (x <= f.xmax) & (y >= f.ymin) & (y <= f.ymax)
         # non-point geometries: exact intersects against the box polygon
         # (the reference's default strict-bbox behavior; loose mode would
@@ -225,7 +227,7 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
                             <= f.distance)
             return out
         packed = batch.geoms
-        if packed is None:
+        if packed is None or f.prop != batch.sft.default_geom:
             raise KeyError(f"no geometry column for {f.prop!r}")
         # bbox prefilter expanded by the distance, then exact per candidate
         cand = bbox_intersects(packed.bbox, window)

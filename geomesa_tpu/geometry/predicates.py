@@ -108,13 +108,10 @@ def points_in_packed_polygon(px, py, packed, i: int) -> np.ndarray:
     return _crossing_parity(px, py, rings) | points_on_rings(px, py, rings)
 
 
-def segments_intersect(p1, p2, q1, q2) -> np.ndarray:
-    """Vectorized proper-or-touching segment intersection.
-
-    ``p1, p2``: (A, 2) segment endpoints; ``q1, q2``: (B, 2).  Returns
-    (A, B) boolean matrix.  Uses orientation sign tests with collinear
-    overlap handled by bbox checks.
-    """
+def _segment_orientations(p1, p2, q1, q2):
+    """Broadcast (A,2)×(B,2) endpoints to the four orientation terms the
+    crossing tests share; returns (p1, p2, q1, q2, d1, d2, d3, d4) with
+    operands reshaped to (A, 1, 2)/(1, B, 2)."""
     p1 = np.asarray(p1, np.float64)[:, None, :]
     p2 = np.asarray(p2, np.float64)[:, None, :]
     q1 = np.asarray(q1, np.float64)[None, :, :]
@@ -128,10 +125,23 @@ def segments_intersect(p1, p2, q1, q2) -> np.ndarray:
     d2 = cross(q1, q2, p2)
     d3 = cross(p1, p2, q1)
     d4 = cross(p1, p2, q2)
-    proper = (
-        (((d1 > 0) & (d2 < 0)) | ((d1 < 0) & (d2 > 0)))
-        & (((d3 > 0) & (d4 < 0)) | ((d3 < 0) & (d4 > 0)))
-    )
+    return p1, p2, q1, q2, d1, d2, d3, d4
+
+
+def _proper_mask(d1, d2, d3, d4) -> np.ndarray:
+    return ((((d1 > 0) & (d2 < 0)) | ((d1 < 0) & (d2 > 0)))
+            & (((d3 > 0) & (d4 < 0)) | ((d3 < 0) & (d4 > 0))))
+
+
+def segments_intersect(p1, p2, q1, q2) -> np.ndarray:
+    """Vectorized proper-or-touching segment intersection.
+
+    ``p1, p2``: (A, 2) segment endpoints; ``q1, q2``: (B, 2).  Returns
+    (A, B) boolean matrix.  Uses orientation sign tests with collinear
+    overlap handled by bbox checks.
+    """
+    p1, p2, q1, q2, d1, d2, d3, d4 = _segment_orientations(p1, p2, q1, q2)
+    proper = _proper_mask(d1, d2, d3, d4)
 
     def on_bbox(a1, a2, b):
         return (
@@ -232,6 +242,57 @@ def geometry_to_point_dist(geom: Geometry, qx: float, qy: float) -> float:
         return float(np.hypot(geom.x - qx, geom.y - qy))
     return float(points_to_geometry_dist(
         np.array([qx]), np.array([qy]), geom)[0])
+
+
+def segments_cross_properly(p1, p2, q1, q2) -> np.ndarray:
+    """Strict interior crossings only (touching/collinear excluded) —
+    the test that distinguishes "within with boundary contact" from a
+    genuine boundary violation."""
+    _, _, _, _, d1, d2, d3, d4 = _segment_orientations(p1, p2, q1, q2)
+    return _proper_mask(d1, d2, d3, d4)
+
+
+def geometry_within(a: Geometry, b: Geometry) -> bool:
+    """``a`` within ``b`` (boundary contact allowed): every vertex of
+    ``a`` (hole rings included) lies in the closure of ``b`` and no
+    segment of ``a`` properly crosses ``b``'s boundary.  Exact for the
+    supported lattice up to degenerate collinear-overlap edge cases."""
+    if not b.envelope.contains(a.envelope):
+        return False
+    if isinstance(b, (Polygon, MultiPolygon)):
+        va = all_vertices(a)
+        if not point_in_polygon(va[:, 0], va[:, 1], b).all():
+            return False
+        a1, a2 = _segments(a)
+        b1, b2 = _segments(b)
+        if len(a1) and len(b1) and bool(
+                segments_cross_properly(a1, a2, b1, b2).any()):
+            return False
+        if isinstance(a, (Polygon, MultiPolygon)):
+            # a hole of b lying strictly inside a's interior escapes both
+            # tests above; any b-ring vertex strictly inside a betrays it
+            vb = all_vertices(b)
+            inside = point_in_polygon(vb[:, 0], vb[:, 1], a)
+            if inside.any():
+                idx = np.flatnonzero(inside)
+                a_rings = _rings_of(a)
+                on_edge = points_on_rings(vb[idx, 0], vb[idx, 1], a_rings)
+                if bool((~on_edge).any()):
+                    return False
+        return True
+    if isinstance(b, (LineString, MultiLineString)):
+        # only puntal/lineal a can be within a line; vertices must sit on it
+        if isinstance(a, (Polygon, MultiPolygon)):
+            return False
+        va = all_vertices(a)
+        rings = ([b.coords] if isinstance(b, LineString)
+                 else [l.coords for l in b.lines])
+        return bool(points_on_rings(va[:, 0], va[:, 1], rings).all())
+    # b is (multi)point: a must be a coincident (multi)point
+    if isinstance(a, (Point, MultiPoint)):
+        bp = {tuple(p) for p in _points_of(b)}
+        return all(tuple(p) in bp for p in _points_of(a))
+    return False
 
 
 def geometry_distance(a: Geometry, b: Geometry) -> float:

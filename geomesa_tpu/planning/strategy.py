@@ -147,24 +147,26 @@ class StrategyDecider:
             return [FilterStrategy("none", 0.0)]
 
         spatial = bool(geoms and geoms.values)
-        # z3/xz3 need a *bounded* interval (the reference's
-        # SpatioTemporalFilterStrategy requirement)
-        bounded = tuple(
-            iv for iv in (intervals.values if intervals else ())
-            if iv[0] is not None and iv[1] is not None
-        )
-        temporal = bool(bounded)
+        # fully-bounded intervals serve either z index; the z3 POINT index
+        # also serves half-open intervals because it clamps them to the
+        # data's time extent (the reference requires bounded intervals,
+        # SpatioTemporalFilterStrategy — clamping removes that need here)
+        all_ivs = tuple(intervals.values) if intervals else ()
+        bounded = tuple(iv for iv in all_ivs
+                        if iv[0] is not None and iv[1] is not None)
+        usable = all_ivs if sft.is_points else bounded
+        temporal = bool(usable)
 
         sp_frac = self._spatial_fraction(geoms.values if geoms else ())
-        tm_frac = self._temporal_fraction(bounded)
+        tm_frac = self._temporal_fraction(usable)
 
-        if temporal and (spatial or True) and dtg:
+        if temporal and dtg:
             idx = "z3" if sft.is_points else "xz3"
             cost = self.total * sp_frac * tm_frac
             out.append(FilterStrategy(
                 idx, max(1.0, cost),
                 geometries=tuple(geoms.values) if geoms else (),
-                intervals=bounded))
+                intervals=usable))
         if spatial:
             idx = "z2" if sft.is_points else "xz2"
             cost = self.total * sp_frac

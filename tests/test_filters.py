@@ -226,3 +226,64 @@ def test_dwithin_mid_segment_and_secondary_point_prop():
     assert list(got.column("v")) == [0]  # row whose p is at the origin
     got = ds.query("two", "BBOX(p, 40, 40, 60, 60)")
     assert list(got.column("v")) == [1]
+
+
+def test_within_contains_exact_for_packed_geometries():
+    """WITHIN/CONTAINS are exact (not envelope approximations): an
+    L-shaped query whose envelope contains a feature must not claim
+    containment when the feature pokes into the notch."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry.types import Polygon
+
+    # L-shape covering everything except the notch [5,10]x[5,10]
+    l_shape = ("POLYGON((0 0, 10 0, 10 5, 5 5, 5 10, 0 10, 0 0))")
+    ds = TpuDataStore()
+    ds.create_schema("w", "v:Int,*geom:Geometry")
+    ds.write("w", {"v": np.arange(3), "geom": [
+        Polygon([(1, 1), (2, 1), (2, 2), (1, 2)]),     # inside the L
+        Polygon([(6, 6), (8, 6), (8, 8), (6, 8)]),     # inside the NOTCH
+        Polygon([(4, 4), (7, 4), (7, 4.8), (4, 4.8)]),  # in lower arm
+    ]})
+    got = ds.query("w", f"WITHIN(geom, {l_shape})")
+    assert sorted(got.column("v")) == [0, 2]  # notch square is NOT within
+    # CONTAINS: which features contain a small square in the lower arm
+    got = ds.query("w", "CONTAINS(geom, POLYGON((6.5 6.5, 7 6.5, 7 7, 6.5 7, 6.5 6.5)))")
+    assert sorted(got.column("v")) == [1]
+
+
+def test_within_rejects_hole_overlap():
+    """a covering a hole of b is NOT within b (hole strictly inside a)."""
+    import numpy as np
+    from geomesa_tpu.geometry.predicates import geometry_within
+    from geomesa_tpu.geometry.types import Polygon
+
+    b = Polygon([(0, 0), (10, 0), (10, 10), (0, 10)],
+                holes=[[(4, 4), (6, 4), (6, 6), (4, 6)]])
+    a_over_hole = Polygon([(3, 3), (7, 3), (7, 7), (3, 7)])
+    a_clear = Polygon([(1, 1), (3, 1), (3, 3), (1, 3)])
+    assert not geometry_within(a_over_hole, b)
+    assert geometry_within(a_clear, b)
+
+
+def test_secondary_nonpoint_geometry_prop_raises():
+    """Spatial predicates on a secondary NON-point geometry property must
+    refuse (the packed column stores only the default geometry)."""
+    import numpy as np
+    import pytest as _pytest
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.filters.ast import DWithin, Intersects
+    from geomesa_tpu.filters.evaluate import evaluate_filter
+    from geomesa_tpu.geometry.types import Point, Polygon
+
+    ds = TpuDataStore()
+    ds.create_schema("sec", "v:Int,other:Geometry,*geom:Geometry")
+    ds.write("sec", {"v": np.arange(1),
+                     "other": [Polygon([(0, 0), (1, 0), (1, 1), (0, 1)])],
+                     "geom": [Polygon([(5, 5), (6, 5), (6, 6), (5, 6)])]})
+    batch = ds._store("sec").batch
+    with _pytest.raises(KeyError):
+        evaluate_filter(DWithin("other", Point(0.5, 0.5), 1.0), batch)
+    with _pytest.raises(KeyError):
+        evaluate_filter(
+            Intersects("other", Polygon([(0, 0), (1, 0), (1, 1)])), batch)
