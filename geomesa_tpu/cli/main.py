@@ -185,6 +185,18 @@ def cmd_stats_histogram(args):
         print(f"bin {i}\t{c}")
 
 
+def cmd_stats_analyze(args):
+    """Recompute stats from the stored data and persist them (the
+    reference's stats-analyze command / StatsRunner)."""
+    ds = _store(args)
+    store = ds._store(args.feature_name)
+    store.recompute_stats()
+    ds.persist_stats(args.feature_name)
+    print(f"analyzed {args.feature_name}: "
+          f"{0 if store.batch is None else len(store.batch)} features, "
+          f"{len(store._stats)} stats persisted")
+
+
 def cmd_age_off(args):
     """Expire old rows (tools age-off command analog)."""
     from ..age_off import age_off
@@ -246,6 +258,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("--track", help="track-id attribute for bin export")
+
+    sp = add("stats-analyze", cmd_stats_analyze,
+             help="recompute and persist stats")
+    catalog(sp)
 
     sp = add("age-off", cmd_age_off, help="expire rows older than a "
                                           "retention period")

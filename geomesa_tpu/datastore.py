@@ -60,6 +60,9 @@ class _SchemaStore:
         self.sft = sft
         self.batch: FeatureBatch | None = None
         self.visibilities: np.ndarray | None = None  # per-feature vis strings
+        #: attr name → per-feature vis strings (attribute-level visibility,
+        #: the reference's KryoVisibilityRowEncoder / vis-level=attribute)
+        self.attr_visibilities: dict[str, np.ndarray] = {}
         self._vis_masks: dict = {}
         self._dirty = True
         self._indexes: dict = {}
@@ -80,8 +83,10 @@ class _SchemaStore:
                 self._stats[f"{a.name}_topk"] = TopK(a.name)
                 self._stats[f"{a.name}_enumeration"] = EnumerationStat(a.name)
 
-    def write(self, batch: FeatureBatch, visibility: str = ""):
+    def write(self, batch: FeatureBatch, visibility: str = "",
+              attribute_visibilities: dict | None = None):
         vis = np.full(len(batch), visibility, dtype=object)
+        prior = 0 if self.batch is None else len(self.batch)
         if self.batch is None:
             self.batch = batch
             self.visibilities = vis
@@ -90,6 +95,16 @@ class _SchemaStore:
                 self.visibilities = np.full(len(self.batch), "", dtype=object)
             self.batch = self.batch.concat(batch)
             self.visibilities = np.concatenate([self.visibilities, vis])
+        # per-attribute labels: pad other attrs/rows with "" (visible)
+        touched = set(self.attr_visibilities) | set(
+            attribute_visibilities or ())
+        for attr in touched:
+            col = self.attr_visibilities.get(
+                attr, np.full(prior, "", dtype=object))
+            label = (attribute_visibilities or {}).get(attr, "")
+            col = np.concatenate(
+                [col, np.full(len(batch), label, dtype=object)])
+            self.attr_visibilities[attr] = col
         for s in self._stats.values():
             s.observe(batch)
         self._vis_masks: dict = {}
@@ -112,6 +127,15 @@ class _SchemaStore:
 
     def stats_map(self) -> dict:
         return self._stats
+
+    def recompute_stats(self) -> None:
+        """Rebuild every sketch from the current rows (sketches are not
+        invertible, so deletes/reloads re-observe)."""
+        self._stats = {}
+        self._init_stats()
+        if self.batch is not None and len(self.batch):
+            for s in self._stats.values():
+                s.observe(self.batch)
 
     def _rebuild_if_dirty(self):
         if self._dirty:
@@ -299,17 +323,30 @@ class TpuDataStore:
         return self._schemas[name]
 
     # -- ingest -----------------------------------------------------------
-    def write(self, name: str, data, ids=None, visibility: str = "") -> int:
+    def write(self, name: str, data, ids=None, visibility: str = "",
+              attribute_visibilities: dict | None = None) -> int:
         """Append features: a FeatureBatch or a dict of columns.
 
         ``visibility`` is an optional visibility expression (e.g.
         ``"admin&ops"``) applied to every feature in this write; queries
         made with an auth provider only see features whose expression
-        their auths satisfy.
+        their auths satisfy.  ``attribute_visibilities`` maps attribute
+        names to expressions guarding just that attribute (the
+        reference's attribute-level visibility / KryoVisibilityRowEncoder):
+        unauthorized callers see the row but the guarded values are
+        nulled.
         """
+        from .security import parse_visibility
         if visibility:
-            from .security import parse_visibility
             parse_visibility(visibility)  # validate eagerly
+        store0 = self._store(name)
+        for attr, expr in (attribute_visibilities or {}).items():
+            spec = store0.sft.attribute(attr)   # KeyError on typos
+            if spec.is_geometry:
+                raise ValueError(
+                    f"cannot set attribute visibility on geometry {attr!r}")
+            if expr:
+                parse_visibility(expr)
         store = self._store(name)
         batch = (data if isinstance(data, FeatureBatch)
                  else FeatureBatch.from_dict(store.sft, data, ids=ids))
@@ -322,7 +359,8 @@ class TpuDataStore:
                 batch.sft, dict(batch.columns), geoms=batch.geoms,
                 ids=np.array([str(base + i) for i in range(len(batch))],
                              dtype=object))
-        store.write(batch, visibility=visibility)
+        store.write(batch, visibility=visibility,
+                    attribute_visibilities=attribute_visibilities)
         from .metrics import registry as _metrics
         _metrics.counter(f"write.{name}.features").inc(len(batch))
         return len(batch)
@@ -342,13 +380,11 @@ class TpuDataStore:
         store.batch = store.batch.take(np.flatnonzero(keep))
         if store.visibilities is not None:
             store.visibilities = store.visibilities[keep]
+        for attr in list(store.attr_visibilities):
+            store.attr_visibilities[attr] = store.attr_visibilities[attr][keep]
         store._vis_masks = {}
         store._dirty = True
-        store._stats = {}
-        store._init_stats()
-        if len(store.batch):
-            for s in store._stats.values():
-                s.observe(store.batch)
+        store.recompute_stats()
         return removed
 
     # -- query ------------------------------------------------------------
@@ -371,8 +407,30 @@ class TpuDataStore:
         allowed = (store.vis_mask(self._auth_provider.get_authorizations())
                    if self._auth_provider is not None else None)
         result = QueryPlanner(store.sft, store).run(q, explain, allowed=allowed)
+        self._mask_attributes(store, result)
         self._audit(name, q, result)
         return result
+
+    def _mask_attributes(self, store: _SchemaStore, result: QueryResult):
+        """Null out attribute values this caller's auths don't satisfy
+        (attribute-level visibility)."""
+        if self._auth_provider is None or not store.attr_visibilities:
+            return
+        from .security import visibility_mask
+        auths = self._auth_provider.get_authorizations()
+        batch = result.batch
+        for attr, labels in store.attr_visibilities.items():
+            if attr not in batch.columns:
+                continue
+            mask = visibility_mask(labels[result.positions], auths)
+            if mask.all():
+                continue
+            col = batch.columns[attr]
+            if col.dtype != object:
+                col = col.astype(object)
+            col = col.copy()
+            col[~mask] = None
+            batch.columns[attr] = col
 
     def _intercept(self, sft: FeatureType, q: Query) -> Query:
         from .planning.interceptor import apply_interceptors, load_interceptors
@@ -572,14 +630,24 @@ class TpuDataStore:
             return
         from .io.export import to_parquet
         to_parquet(store.batch, os.path.join(self._catalog_dir, f"{name}.parquet"))
-        if store.visibilities is not None:
+        if store.visibilities is not None or store.attr_visibilities:
             # dictionary-encoded: visibilities are low-cardinality
-            uniq, codes = np.unique(store.visibilities.astype(str),
-                                    return_inverse=True)
+            payload: dict = {}
+            if store.visibilities is not None:
+                uniq, codes = np.unique(store.visibilities.astype(str),
+                                        return_inverse=True)
+                payload["labels"] = uniq.tolist()
+                payload["codes"] = codes.tolist()
+            if store.attr_visibilities:
+                attrs = {}
+                for attr, col in store.attr_visibilities.items():
+                    u, c = np.unique(col.astype(str), return_inverse=True)
+                    attrs[attr] = {"labels": u.tolist(),
+                                   "codes": c.tolist()}
+                payload["attributes"] = attrs
             with open(os.path.join(self._catalog_dir,
                                    f"{name}.vis.json"), "w") as f:
-                json.dump({"labels": uniq.tolist(),
-                           "codes": codes.tolist()}, f)
+                json.dump(payload, f)
         self.persist_stats(name)
 
     def _load_data(self, name: str) -> None:
@@ -593,8 +661,16 @@ class TpuDataStore:
             if os.path.exists(vis_path):
                 with open(vis_path) as f:
                     enc = json.load(f)
-                labels = np.asarray(enc["labels"], dtype=object)
-                store.visibilities = labels[np.asarray(enc["codes"], int)]
+                if "labels" in enc:
+                    labels = np.asarray(enc["labels"], dtype=object)
+                    store.visibilities = labels[np.asarray(enc["codes"], int)]
+                else:
+                    store.visibilities = np.full(len(store.batch), "",
+                                                 dtype=object)
+                for attr, e in enc.get("attributes", {}).items():
+                    lbl = np.asarray(e["labels"], dtype=object)
+                    store.attr_visibilities[attr] = lbl[
+                        np.asarray(e["codes"], int)]
             else:
                 store.visibilities = np.full(len(store.batch), "",
                                              dtype=object)

@@ -268,6 +268,14 @@ def geometry_within(a: Geometry, b: Geometry) -> bool:
         if len(a1) and len(b1) and bool(
                 segments_cross_properly(a1, a2, b1, b2).any()):
             return False
+        if len(a1):
+            # a segment can leave b between two boundary vertices with
+            # only touching (no proper) crossings — e.g. a chord across a
+            # notch; its midpoint betrays it
+            mx = (a1[:, 0] + a2[:, 0]) / 2
+            my = (a1[:, 1] + a2[:, 1]) / 2
+            if not point_in_polygon(mx, my, b).all():
+                return False
         if isinstance(a, (Polygon, MultiPolygon)):
             # a hole of b lying strictly inside a's interior escapes both
             # tests above; any b-ring vertex strictly inside a betrays it
@@ -281,13 +289,23 @@ def geometry_within(a: Geometry, b: Geometry) -> bool:
                     return False
         return True
     if isinstance(b, (LineString, MultiLineString)):
-        # only puntal/lineal a can be within a line; vertices must sit on it
+        # only puntal/lineal a can be within a line; vertices AND segment
+        # midpoints must sit on it (vertices alone miss a diagonal whose
+        # endpoints touch the line but whose body leaves it)
         if isinstance(a, (Polygon, MultiPolygon)):
             return False
         va = all_vertices(a)
         rings = ([b.coords] if isinstance(b, LineString)
                  else [l.coords for l in b.lines])
-        return bool(points_on_rings(va[:, 0], va[:, 1], rings).all())
+        if not bool(points_on_rings(va[:, 0], va[:, 1], rings).all()):
+            return False
+        a1, a2 = _segments(a)
+        if len(a1):
+            mx = (a1[:, 0] + a2[:, 0]) / 2
+            my = (a1[:, 1] + a2[:, 1]) / 2
+            if not bool(points_on_rings(mx, my, rings).all()):
+                return False
+        return True
     # b is (multi)point: a must be a coincident (multi)point
     if isinstance(a, (Point, MultiPoint)):
         bp = {tuple(p) for p in _points_of(b)}

@@ -44,7 +44,7 @@ __all__ = ["WebApp", "serve"]
 class WebApp:
     """WSGI application exposing a TpuDataStore over HTTP."""
 
-    def __init__(self, store, audit_writer=None, geojson=None):
+    def __init__(self, store, audit_writer=None, geojson=None, blob=None):
         self.store = store
         # prefer an explicitly-passed audit writer, else the store's
         self.audit = audit_writer or getattr(store, "_audit_writer", None)
@@ -54,6 +54,8 @@ class WebApp:
             from ..geojson.servlet import GeoJsonApp
             self.geojson_app = (geojson if isinstance(geojson, GeoJsonApp)
                                 else GeoJsonApp(geojson))
+        #: optional GeoIndexedBlobStore (BlobstoreServlet analog)
+        self.blob = blob
         self._router = Router([
             (r"^/api/version$", self._version),
             (r"^/api/schemas$", self._schemas),
@@ -62,6 +64,8 @@ class WebApp:
             (r"^/api/stats/([^/]+)/([a-z]+)$", self._stats),
             (r"^/api/audit/([^/]+)$", self._audit_events),
             (r"^/api/metrics$", self._metrics_dump),
+            (r"^/api/blob$", self._blob_index),
+            (r"^/api/blob/([^/]+)$", self._blob_item),
         ])
 
     # -- WSGI entry point --------------------------------------------------
@@ -232,6 +236,45 @@ class WebApp:
 
     def _metrics_dump(self, method, params, environ):
         return 200, _metrics.snapshot()
+
+    # -- blob store (geomesa-blobstore-web BlobstoreServlet analog) -------
+    def _require_blob(self):
+        if self.blob is None:
+            raise HttpError(404, "no blob store configured")
+        return self.blob
+
+    def _blob_index(self, method, params, environ):
+        bs = self._require_blob()
+        if method == "GET":
+            return 200, {"ids": bs.query_ids(params.get("cql", "INCLUDE"))}
+        if method == "POST":
+            n = int(environ.get("CONTENT_LENGTH") or 0)
+            data = environ["wsgi.input"].read(n) if n else b""
+            if not data:
+                raise HttpError(400, "empty blob body")
+            from ..blob import wkt_handler
+            kw = {}
+            if "wkt" in params:
+                kw.update(handler=wkt_handler, params={"wkt": params["wkt"]})
+            else:
+                raise HttpError(400, "need ?wkt= for the blob geometry")
+            bid = bs.put(data, dtg=int_param(params, "dtg", 0) or 0,
+                         filename=params.get("filename", ""), **kw)
+            return 201, {"id": bid}
+        raise HttpError(405, method)
+
+    def _blob_item(self, method, params, environ, bid):
+        bs = self._require_blob()
+        if method == "GET":
+            hit = bs.get(bid)
+            if hit is None:
+                raise HttpError(404, f"no such blob: {bid!r}")
+            data, filename = hit
+            return 200, data, "application/octet-stream"
+        if method == "DELETE":
+            bs.delete_blob(bid)
+            return 204, None
+        raise HttpError(405, method)
 
 
 def _jsonable(v):

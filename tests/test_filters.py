@@ -287,3 +287,16 @@ def test_secondary_nonpoint_geometry_prop_raises():
     with _pytest.raises(KeyError):
         evaluate_filter(
             Intersects("other", Polygon([(0, 0), (1, 0), (1, 1)])), batch)
+
+
+def test_within_lineal_midpoint_violations():
+    from geomesa_tpu.geometry.predicates import geometry_within
+    from geomesa_tpu.geometry.types import LineString, Polygon
+
+    l_path = LineString([(0, 0), (1, 0), (1, 1)])
+    assert not geometry_within(LineString([(0, 0), (1, 1)]), l_path)
+    assert geometry_within(LineString([(0, 0), (1, 0)]), l_path)
+    # chord across the notch of an L polygon: endpoints touch, body leaves
+    l_poly = Polygon([(0, 0), (10, 0), (10, 5), (5, 5), (5, 10), (0, 10)])
+    assert not geometry_within(LineString([(10, 5), (5, 10)]), l_poly)
+    assert geometry_within(LineString([(1, 1), (4, 4)]), l_poly)

@@ -258,3 +258,37 @@ def test_timer_concurrent_blocks():
     [x.join() for x in threads]
     assert not errs
     assert t.count == 200 and t.min >= 0.0
+
+
+def test_attr_visibility_survives_delete_and_flush(tmp_path):
+    """Attribute guards stay aligned after deletes and persist across a
+    catalog reload."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.security import StaticAuthorizationsProvider
+
+    d = str(tmp_path / "cat")
+    ds = TpuDataStore(d, auth_provider=StaticAuthorizationsProvider(["u"]))
+    ds.create_schema("s", "name:String,ssn:String,dtg:Date,*geom:Point")
+    ds.write("s", {"name": np.asarray(["open"], dtype=object),
+                   "ssn": np.asarray(["PUBLIC"], dtype=object),
+                   "dtg": np.zeros(1, np.int64),
+                   "geom": (np.zeros(1), np.zeros(1))}, ids=["a"])
+    ds.write("s", {"name": np.asarray(["guard"], dtype=object),
+                   "ssn": np.asarray(["SECRET"], dtype=object),
+                   "dtg": np.zeros(1, np.int64),
+                   "geom": (np.zeros(1), np.zeros(1))}, ids=["b"],
+             attribute_visibilities={"ssn": "admin"})
+    ds.delete("s", ["a"])
+    got = ds.query("s")
+    assert list(got.column("ssn")) == [None]  # still guarded post-delete
+    ds.flush("s")
+    ds2 = TpuDataStore(d, auth_provider=StaticAuthorizationsProvider(["u"]))
+    assert list(ds2.query("s").column("ssn")) == [None]  # survives reload
+    import pytest as _pytest
+    with _pytest.raises(KeyError):
+        ds.write("s", {"name": np.asarray(["x"], dtype=object),
+                       "ssn": np.asarray(["y"], dtype=object),
+                       "dtg": np.zeros(1, np.int64),
+                       "geom": (np.zeros(1), np.zeros(1))},
+                 attribute_visibilities={"typo": "admin"})

@@ -179,3 +179,59 @@ def test_histogram_respects_visibility():
                         "/api/stats/v/histogram?attribute=age&bins=4")
     assert status == 200
     assert sum(body["counts"]) == 2 and body["hi"] <= 20.0
+
+
+def test_blob_rest_roundtrip(tmp_path):
+    from geomesa_tpu.blob import GeoIndexedBlobStore
+
+    bs = GeoIndexedBlobStore(blob_dir=str(tmp_path / "blobs"))
+    app2 = WebApp(TpuDataStore(), blob=bs)
+
+    def call_raw(method, path, body=b""):
+        captured = {}
+
+        def sr(status, headers):
+            captured["status"] = int(status.split()[0])
+            captured["ct"] = dict(headers).get("Content-Type")
+
+        qs = ""
+        if "?" in path:
+            path, qs = path.split("?", 1)
+        out = b"".join(app2({
+            "REQUEST_METHOD": method, "PATH_INFO": path, "QUERY_STRING": qs,
+            "CONTENT_LENGTH": str(len(body)), "wsgi.input": io.BytesIO(body),
+        }, sr))
+        return captured["status"], out, captured.get("ct")
+
+    s, body, _ = call_raw("POST", "/api/blob?wkt=POINT%20(10%2020)"
+                                  "&filename=f.bin&dtg=0", b"\x01payload")
+    assert s == 201
+    bid = json.loads(body)["id"]
+    s, data, ct = call_raw("GET", f"/api/blob/{bid}")
+    assert s == 200 and data == b"\x01payload"
+    assert ct == "application/octet-stream"
+    s, body, _ = call_raw("GET", "/api/blob?cql=BBOX(geom,5,15,15,25)")
+    assert json.loads(body)["ids"] == [bid]
+    s, _, _ = call_raw("DELETE", f"/api/blob/{bid}")
+    assert s == 204
+    s, _, _ = call_raw("GET", f"/api/blob/{bid}")
+    assert s == 404
+
+
+def test_attribute_level_visibility():
+    from geomesa_tpu.security import StaticAuthorizationsProvider
+
+    ds = TpuDataStore(auth_provider=StaticAuthorizationsProvider(["user"]))
+    ds.create_schema("av", "name:String,ssn:String,dtg:Date,*geom:Point")
+    ds.write("av", {"name": np.asarray(["a", "b"], dtype=object),
+                    "ssn": np.asarray(["111", "222"], dtype=object),
+                    "dtg": np.zeros(2, np.int64),
+                    "geom": (np.zeros(2), np.zeros(2))},
+             attribute_visibilities={"ssn": "admin"})
+    got = ds.query("av")
+    assert list(got.column("name")) == ["a", "b"]   # row visible
+    assert list(got.column("ssn")) == [None, None]  # guarded attr nulled
+    # privileged caller sees values
+    ds._auth_provider = StaticAuthorizationsProvider(["admin"])
+    got = ds.query("av")
+    assert list(got.column("ssn")) == ["111", "222"]
