@@ -189,12 +189,8 @@ def cmd_stats_analyze(args):
     """Recompute stats from the stored data and persist them (the
     reference's stats-analyze command / StatsRunner)."""
     ds = _store(args)
-    store = ds._store(args.feature_name)
-    store.recompute_stats()
-    ds.persist_stats(args.feature_name)
-    print(f"analyzed {args.feature_name}: "
-          f"{0 if store.batch is None else len(store.batch)} features, "
-          f"{len(store._stats)} stats persisted")
+    n = ds.stats_analyze(args.feature_name)
+    print(f"analyzed {args.feature_name}: {n} features, stats persisted")
 
 
 def cmd_age_off(args):

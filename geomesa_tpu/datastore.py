@@ -110,6 +110,35 @@ class _SchemaStore:
         self._vis_masks: dict = {}
         self._dirty = True
 
+    def masked_batch(self, auths):
+        """Batch with attribute-guarded values nulled for these auths —
+        used for FILTERING as well as results, so a restricted caller
+        cannot probe guarded values via CQL predicates.  Cached per auth
+        set; unguarded columns share the original arrays."""
+        if not self.attr_visibilities or self.batch is None:
+            return self.batch
+        key = ("attrs", frozenset(auths))
+        cache = self._vis_masks
+        if key not in cache:
+            from .security import visibility_mask
+            cols = dict(self.batch.columns)
+            changed = False
+            for attr, labels in self.attr_visibilities.items():
+                if attr not in cols:
+                    continue
+                mask = visibility_mask(labels, frozenset(auths))
+                if mask.all():
+                    continue
+                col = cols[attr]
+                col = col.astype(object) if col.dtype != object else col.copy()
+                col[~mask] = None
+                cols[attr] = col
+                changed = True
+            cache[key] = (FeatureBatch(
+                self.batch.sft, cols, self.batch.ids, self.batch.geoms)
+                if changed else self.batch)
+        return cache[key]
+
     def vis_mask(self, auths) -> np.ndarray | None:
         """Cached per-auth-set visibility mask over all features; None when
         every label is empty (everything visible)."""
@@ -188,6 +217,18 @@ class _SchemaStore:
             self._indexes[key] = AttributeIndex.build(
                 attr, self.batch.column(attr))
         return self._indexes[key]
+
+
+class _MaskedStoreView:
+    """Delegates to a _SchemaStore but substitutes the attribute-masked
+    batch (attribute-level visibility for restricted callers)."""
+
+    def __init__(self, store: _SchemaStore, batch: FeatureBatch):
+        self._store = store
+        self.batch = batch
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
 
 
 class TpuDataStore:
@@ -339,15 +380,15 @@ class TpuDataStore:
         from .security import parse_visibility
         if visibility:
             parse_visibility(visibility)  # validate eagerly
-        store0 = self._store(name)
+        store = self._store(name)
         for attr, expr in (attribute_visibilities or {}).items():
-            spec = store0.sft.attribute(attr)   # KeyError on typos
-            if spec.is_geometry:
+            spec = store.sft.attribute(attr)   # KeyError on typos
+            if spec.is_geometry or attr == store.sft.dtg_field:
                 raise ValueError(
-                    f"cannot set attribute visibility on geometry {attr!r}")
+                    "cannot set attribute visibility on geometry or the "
+                    f"dtg field ({attr!r}): indexes scan them unmasked")
             if expr:
                 parse_visibility(expr)
-        store = self._store(name)
         batch = (data if isinstance(data, FeatureBatch)
                  else FeatureBatch.from_dict(store.sft, data, ids=ids))
         if not batch.ids_explicit:
@@ -404,33 +445,20 @@ class TpuDataStore:
                                  FilterStrategy("none", 0), 0.0, 0.0)
             self._audit(name, q, result)
             return result
-        allowed = (store.vis_mask(self._auth_provider.get_authorizations())
-                   if self._auth_provider is not None else None)
-        result = QueryPlanner(store.sft, store).run(q, explain, allowed=allowed)
-        self._mask_attributes(store, result)
+        allowed = None
+        eval_store = store
+        if self._auth_provider is not None:
+            auths = self._auth_provider.get_authorizations()
+            allowed = store.vis_mask(auths)
+            masked = store.masked_batch(auths)
+            if masked is not store.batch:
+                # guarded values must be invisible to FILTERS too, not
+                # just results — evaluate over the masked view
+                eval_store = _MaskedStoreView(store, masked)
+        result = QueryPlanner(store.sft, eval_store).run(
+            q, explain, allowed=allowed)
         self._audit(name, q, result)
         return result
-
-    def _mask_attributes(self, store: _SchemaStore, result: QueryResult):
-        """Null out attribute values this caller's auths don't satisfy
-        (attribute-level visibility)."""
-        if self._auth_provider is None or not store.attr_visibilities:
-            return
-        from .security import visibility_mask
-        auths = self._auth_provider.get_authorizations()
-        batch = result.batch
-        for attr, labels in store.attr_visibilities.items():
-            if attr not in batch.columns:
-                continue
-            mask = visibility_mask(labels[result.positions], auths)
-            if mask.all():
-                continue
-            col = batch.columns[attr]
-            if col.dtype != object:
-                col = col.astype(object)
-            col = col.copy()
-            col[~mask] = None
-            batch.columns[attr] = col
 
     def _intercept(self, sft: FeatureType, q: Query) -> Query:
         from .planning.interceptor import apply_interceptors, load_interceptors
@@ -567,8 +595,19 @@ class TpuDataStore:
         return Envelope(float(bb[:, 0].min()), float(bb[:, 1].min()),
                         float(bb[:, 2].max()), float(bb[:, 3].max()))
 
+    def _attr_guarded(self, store: _SchemaStore, attr: str) -> bool:
+        """True when this caller cannot see every value of the attribute."""
+        if self._auth_provider is None or attr not in store.attr_visibilities:
+            return False
+        from .security import visibility_mask
+        return not visibility_mask(
+            store.attr_visibilities[attr],
+            self._auth_provider.get_authorizations()).all()
+
     def get_attribute_bounds(self, name: str, attr: str):
         store = self._store(name)
+        if self._attr_guarded(store, attr):
+            return None
         mask = self._restricted_mask(store)
         if mask is not None:
             col = store.batch.column(attr)[mask]
@@ -583,6 +622,9 @@ class TpuDataStore:
         sketches (observed over all rows) are recomputed over the visible
         subset so hidden values cannot leak through TopK/enumeration."""
         store = self._store(name)
+        attr = getattr(store._stats.get(key), "attr", None)
+        if attr and self._attr_guarded(store, attr):
+            return None
         mask = self._restricted_mask(store)
         s = store._stats.get(key)
         if mask is None or s is None:
@@ -600,6 +642,15 @@ class TpuDataStore:
         with open(path, "w") as f:
             json.dump({"name": sft.name, "spec": sft.spec_string(),
                        "updated": time.time()}, f)
+
+    def stats_analyze(self, name: str) -> int:
+        """Recompute a schema's sketches from its stored rows and persist
+        them (the reference's stats-analyze / StatsRunner); returns the
+        observed feature count."""
+        store = self._store(name)
+        store.recompute_stats()
+        self.persist_stats(name)
+        return 0 if store.batch is None else len(store.batch)
 
     def persist_stats(self, name: str) -> None:
         if not self._catalog_dir:

@@ -106,10 +106,14 @@ class WebApp:
         store = self.store._store(name)
         if store.batch is None or len(store.batch) == 0:
             return None
+        batch = store.batch
+        auth = self.store._auth_provider
+        if auth is not None:
+            batch = store.masked_batch(auth.get_authorizations())
         mask = self.store._restricted_mask(store)
         if mask is None:
-            return store.batch
-        return store.batch.take(np.flatnonzero(mask))
+            return batch
+        return batch.take(np.flatnonzero(mask))
 
     # -- handlers ----------------------------------------------------------
     def _version(self, method, params, environ):

@@ -292,3 +292,41 @@ def test_attr_visibility_survives_delete_and_flush(tmp_path):
                        "dtg": np.zeros(1, np.int64),
                        "geom": (np.zeros(1), np.zeros(1))},
                  attribute_visibilities={"typo": "admin"})
+
+
+def test_attr_visibility_not_probeable_via_filters():
+    """Guarded values must be invisible to FILTERS and sketches, not just
+    nulled in results (no CQL probing / stats side channels)."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.security import StaticAuthorizationsProvider
+
+    ds = TpuDataStore(auth_provider=StaticAuthorizationsProvider(["u"]))
+    ds.create_schema("pv", "name:String,ssn:String:index=true,"
+                           "age:Int,dtg:Date,*geom:Point")
+    ds.write("pv", {"name": np.asarray(["a"], dtype=object),
+                    "ssn": np.asarray(["111"], dtype=object),
+                    "age": np.asarray([42]),
+                    "dtg": np.zeros(1, np.int64),
+                    "geom": (np.zeros(1), np.zeros(1))},
+             attribute_visibilities={"ssn": "admin", "age": "admin"})
+    # filter probing returns nothing
+    assert len(ds.query("pv", "ssn = '111'")) == 0
+    assert len(ds.query("pv", "age = 42")) == 0
+    assert len(ds.query("pv", "age > 0")) == 0
+    # the row itself is still visible
+    got = ds.query("pv")
+    assert list(got.column("name")) == ["a"]
+    assert list(got.column("ssn")) == [None]
+    # stats do not leak guarded attributes
+    assert ds.get_attribute_bounds("pv", "age") is None
+    assert ds.stat("pv", "ssn_topk") is None
+    # guarding the dtg field is rejected (indexes scan it unmasked)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ds.write("pv", {"name": np.asarray(["b"], dtype=object),
+                        "ssn": np.asarray(["2"], dtype=object),
+                        "age": np.asarray([1]),
+                        "dtg": np.zeros(1, np.int64),
+                        "geom": (np.zeros(1), np.zeros(1))},
+                 attribute_visibilities={"dtg": "admin"})
