@@ -120,6 +120,12 @@ class _SchemaStore:
         key = ("attrs", frozenset(auths))
         cache = self._vis_masks
         if key not in cache:
+            # bound the per-auth-set masked copies (many distinct tenants
+            # on a read-mostly store would otherwise grow without limit)
+            masked_keys = [k for k in cache
+                           if isinstance(k, tuple) and k[0] == "attrs"]
+            if len(masked_keys) >= 16:
+                cache.pop(masked_keys[0], None)
             from .security import visibility_mask
             cols = dict(self.batch.columns)
             changed = False
@@ -541,7 +547,27 @@ class TpuDataStore:
                 out.append(self.query_result(name, Query.of(f)).positions)
             return out
         t0 = time.time()
-        hits = store.z3_index().query_many(windows)
+        # untimed windows (both bounds None) scan the Z2 index: with the
+        # time axis unconstrained, z3 covering ranges degrade to near
+        # full-bin scans, while z2 ranges stay tight
+        untimed = [i for i, (_, lo, hi) in enumerate(windows)
+                   if lo is None and hi is None]
+        if len(untimed) == len(windows):
+            hits = store.z2_index().query_many([w[0] for w in windows])
+        elif not untimed:
+            hits = store.z3_index().query_many(windows)
+        else:
+            uset = set(untimed)
+            timed_idx = [i for i in range(len(windows)) if i not in uset]
+            z2_hits = store.z2_index().query_many(
+                [windows[i][0] for i in untimed])
+            z3_hits = store.z3_index().query_many(
+                [windows[i] for i in timed_idx])
+            hits = [None] * len(windows)
+            for j, i in enumerate(untimed):
+                hits[i] = z2_hits[j]
+            for j, i in enumerate(timed_idx):
+                hits[i] = z3_hits[j]
         allowed = (store.vis_mask(self._auth_provider.get_authorizations())
                    if self._auth_provider is not None else None)
         if allowed is not None:

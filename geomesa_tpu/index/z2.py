@@ -58,6 +58,45 @@ def plan_z2_query(boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> Z2QueryPlan:
 
 
 @partial(jax.jit, static_argnames=("capacity",))
+def _query_many_packed(z, pos, x, y, rzlo, rzhi, rqid, ixy, boxes, bqid,
+                       capacity: int):
+    """Batched multi-box-set scan: Q independent queries in one dispatch
+    (see z3._query_many_packed for the packed qid<<40|pos protocol)."""
+    starts = jnp.searchsorted(z, rzlo, side="left")
+    ends = jnp.searchsorted(z, rzhi, side="right")
+    counts = jnp.maximum(ends - starts, 0)
+    total = jnp.sum(counts)
+    idx, valid, rid = expand_ranges(starts, counts, capacity)
+    zc = z[idx]
+    posc = pos[idx]
+    cqid = rqid[rid]
+    ix, iy = deinterleave2(zc.astype(jnp.uint64))
+    ix = ix.astype(jnp.int64)
+    iy = iy.astype(jnp.int64)
+    same_q = cqid[:, None] == bqid[None, :]
+    in_box_int = (
+        same_q
+        & (ix[:, None] >= ixy[None, :, 0])
+        & (iy[:, None] >= ixy[None, :, 1])
+        & (ix[:, None] <= ixy[None, :, 2])
+        & (iy[:, None] <= ixy[None, :, 3])
+    ).any(axis=1)
+    xc = x[posc]
+    yc = y[posc]
+    in_box_exact = (
+        same_q
+        & (xc[:, None] >= boxes[None, :, 0])
+        & (yc[:, None] >= boxes[None, :, 1])
+        & (xc[:, None] <= boxes[None, :, 2])
+        & (yc[:, None] <= boxes[None, :, 3])
+    ).any(axis=1)
+    mask = valid & in_box_int & in_box_exact
+    coded = (cqid.astype(jnp.int64) << jnp.int64(40)) | posc.astype(jnp.int64)
+    packed = jnp.where(mask, coded, jnp.int64(-1))
+    return jnp.concatenate([total[None].astype(jnp.int64), packed])
+
+
+@partial(jax.jit, static_argnames=("capacity",))
 def _query_packed(z, pos, x, y, rzlo, rzhi, ixy, boxes, capacity: int):
     """One-dispatch scan (seeks + gather + fused mask) returning the packed
     ``[total, pos|-1, …]`` vector — one device round trip per query (see
@@ -145,3 +184,47 @@ class Z2PointIndex:
 
         hits, self._capacity = run_packed_query(dispatch, self._capacity)
         return hits
+
+    def query_many(self, boxes_list,
+                   max_ranges: int = DEFAULT_MAX_RANGES) -> list[np.ndarray]:
+        """Batched spatial-only queries: one device dispatch for ALL the
+        box sets; returns a sorted position array per entry."""
+        n_q = len(boxes_list)
+        if n_q == 0 or len(self) == 0:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        per = max(1, max_ranges // n_q)
+        rzlo, rzhi, rqid, ixy, bxs, bqid = [], [], [], [], [], []
+        for q, boxes in enumerate(boxes_list):
+            plan = plan_z2_query(boxes, per)
+            if plan.num_ranges == 0:
+                continue
+            rzlo.append(plan.rzlo)
+            rzhi.append(plan.rzhi)
+            rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+            ixy.append(plan.ixy)
+            bxs.append(plan.boxes)
+            bqid.append(np.full(len(plan.boxes), q, dtype=np.int32))
+        if not rzlo:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        r = pad_ranges({"rzlo": np.concatenate(rzlo),
+                        "rzhi": np.concatenate(rzhi),
+                        "rqid": np.concatenate(rqid)},
+                       pad_pow2(sum(len(a) for a in rzlo)))
+        ixy_c, boxes_c, bqid_c = pad_boxes(
+            np.concatenate(ixy), np.concatenate(bxs),
+            pad_pow2(sum(len(b) for b in bxs), minimum=1),
+            np.concatenate(bqid))
+
+        def dispatch(capacity):
+            return _query_many_packed(
+                self.z, self.pos, self.x, self.y,
+                jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
+                jnp.asarray(r["rqid"]), jnp.asarray(ixy_c),
+                jnp.asarray(boxes_c), jnp.asarray(bqid_c),
+                capacity=capacity,
+            )
+
+        coded, self._capacity = run_packed_query(dispatch, self._capacity)
+        qids = coded >> 40
+        positions = coded & ((np.int64(1) << 40) - 1)
+        return [np.unique(positions[qids == q]) for q in range(n_q)]

@@ -187,7 +187,16 @@ class QueryPlanner:
                     query: Query) -> np.ndarray:
         if query.sort_by:
             keys = batch.column(query.sort_by)[positions]
-            order = np.argsort(keys, kind="stable")
+            if keys.dtype == object:
+                # object columns may mix None (masked/sparse values) with
+                # comparables: sort Nones last, stably
+                order = np.asarray(sorted(
+                    range(len(keys)),
+                    key=lambda i: (keys[i] is None, keys[i]
+                                   if keys[i] is not None else 0)),
+                    dtype=np.int64)
+            else:
+                order = np.argsort(keys, kind="stable")
             if query.sort_desc:
                 order = order[::-1]
             positions = positions[order]

@@ -13,26 +13,34 @@ __all__ = ["proximity_process"]
 
 def proximity_process(store, schema: str, geometries, distance_m: float):
     """Positions of features within ``distance_m`` meters of any of the
-    input geometries (points / vertices of lines and polygons)."""
-    from ..planning.planner import Query
-    from ..filters.ast import BBox
-
+    input geometries (points / vertices of lines and polygons).  All the
+    per-geometry candidate windows scan in ONE batched dispatch
+    (store.query_windows), then exact meter distances rank candidates."""
     sft = store.get_schema(schema)
     geom = sft.geom_field
-    parts = []
+    batch = store._store(schema).batch
+    if batch is None or len(batch) == 0:
+        return np.empty(0, dtype=np.int64)
+    geometries = list(geometries)
+    windows = []
     for g in geometries:
         env = g.envelope
         dlat = np.degrees(distance_m / EARTH_RADIUS_M)
         cos = max(0.01, np.cos(np.radians((env.ymin + env.ymax) / 2)))
         dlon = dlat / cos
-        box = (env.xmin - dlon, env.ymin - dlat, env.xmax + dlon, env.ymax + dlat)
-        r = store.query_result(schema, Query.of(BBox(geom, *box)))
-        if not len(r.positions):
+        box = (env.xmin - dlon, env.ymin - dlat,
+               env.xmax + dlon, env.ymax + dlat)
+        windows.append(([box], None, None))
+    per_geom = store.query_windows(schema, windows)
+    all_xy = batch.geom_xy(geom)
+    parts = []
+    for g, positions in zip(geometries, per_geom):
+        if not len(positions):
             continue
-        bx, by = r.batch.geom_xy(geom)
+        bx, by = all_xy[0][positions], all_xy[1][positions]
         if isinstance(g, Point):
             d = haversine_m(g.x, g.y, bx, by)
-            parts.append(r.positions[d <= distance_m])
+            parts.append(positions[d <= distance_m])
         else:
             from ..geometry.predicates import _points_of, _segments, point_in_polygon
             from ..geometry.types import MultiPolygon, Polygon
@@ -47,7 +55,7 @@ def proximity_process(store, schema: str, geometries, distance_m: float):
                 d = np.min(
                     np.stack([haversine_m(vx, vy, bx, by) for vx, vy in verts]),
                     axis=0)
-                parts.append(r.positions[d <= distance_m])
+                parts.append(positions[d <= distance_m])
                 continue
             dist_deg, t = _point_segment_dist_deg(
                 bx, by, segs[0][:, 0], segs[0][:, 1], segs[1][:, 0], segs[1][:, 1])
@@ -59,7 +67,7 @@ def proximity_process(store, schema: str, geometries, distance_m: float):
             keep = haversine_m(bx, by, cx, cy) <= distance_m
             if isinstance(g, (Polygon, MultiPolygon)):
                 keep |= point_in_polygon(bx, by, g)
-            parts.append(r.positions[keep])
+            parts.append(positions[keep])
     if not parts:
         return np.empty(0, dtype=np.int64)
     return np.unique(np.concatenate(parts))

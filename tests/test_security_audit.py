@@ -330,3 +330,31 @@ def test_attr_visibility_not_probeable_via_filters():
                         "dtg": np.zeros(1, np.int64),
                         "geom": (np.zeros(1), np.zeros(1))},
                  attribute_visibilities={"dtg": "admin"})
+
+
+def test_sort_by_guarded_column_does_not_crash():
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.planning.planner import Query
+    from geomesa_tpu.security import StaticAuthorizationsProvider
+
+    ds = TpuDataStore(auth_provider=StaticAuthorizationsProvider(["u"]))
+    ds.create_schema("so", "age:Int,dtg:Date,*geom:Point")
+    ds.write("so", {"age": np.asarray([3, 1, 2]),
+                    "dtg": np.zeros(3, np.int64),
+                    "geom": (np.zeros(3), np.zeros(3))},
+             attribute_visibilities={"age": "admin"})
+    got = ds.query("so", Query.of("INCLUDE", sort_by="age"))
+    assert len(got) == 3 and list(got.column("age")) == [None] * 3
+
+
+def test_proximity_empty_schema():
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry.types import Point
+    from geomesa_tpu.process.proximity import proximity_process
+
+    ds = TpuDataStore()
+    ds.create_schema("e", "v:Int,dtg:Date,*geom:Point")
+    got = proximity_process(ds, "e", [Point(0, 0)], 1000)
+    assert len(got) == 0

@@ -76,3 +76,54 @@ def test_budget_exactness(index, dataset):
     box = (0.0, 40.0, 25.0, 55.0)
     np.testing.assert_array_equal(index.query([box], max_ranges=8),
                                   oracle(x, y, box))
+
+
+def test_z2_query_many_matches_singles():
+    import numpy as np
+    from geomesa_tpu.index import Z2PointIndex
+    rng = np.random.default_rng(13)
+    n = 20_000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    idx = Z2PointIndex.build(x, y)
+    queries = []
+    for _ in range(9):
+        x0, y0 = rng.uniform(-170, 150), rng.uniform(-80, 60)
+        queries.append([(x0, y0, x0 + rng.uniform(1, 20),
+                         y0 + rng.uniform(1, 20))])
+    batched = idx.query_many(queries)
+    for boxes, got in zip(queries, batched):
+        np.testing.assert_array_equal(got, idx.query(boxes))
+        b = boxes[0]
+        brute = np.flatnonzero((x >= b[0]) & (x <= b[2])
+                               & (y >= b[1]) & (y <= b[3]))
+        np.testing.assert_array_equal(got, brute)
+
+
+def test_query_windows_untimed_routes_to_z2():
+    """Untimed windows scan the z2 index (tight ranges) — and mixed
+    timed/untimed batches merge back in order."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    MS = 1514764800000
+    rng = np.random.default_rng(2)
+    n = 5000
+    ds = TpuDataStore()
+    ds.create_schema("w", "v:Int,dtg:Date,*geom:Point")
+    x = rng.uniform(-10, 10, n)
+    y = rng.uniform(40, 50, n)
+    t = rng.integers(MS, MS + 7 * 86_400_000, n)
+    ds.write("w", {"v": np.arange(n), "dtg": t, "geom": (x, y)})
+    windows = [
+        ([(-5, 42, 0, 47)], None, None),                       # untimed
+        ([(-5, 42, 0, 47)], MS, MS + 2 * 86_400_000),          # timed
+        ([(2, 44, 4, 46)], None, None),                        # untimed
+    ]
+    hits = ds.query_windows("w", windows)
+    b0 = np.flatnonzero((x >= -5) & (x <= 0) & (y >= 42) & (y <= 47))
+    np.testing.assert_array_equal(hits[0], b0)
+    b1 = np.flatnonzero((x >= -5) & (x <= 0) & (y >= 42) & (y <= 47)
+                        & (t >= MS) & (t <= MS + 2 * 86_400_000))
+    np.testing.assert_array_equal(hits[1], b1)
+    b2 = np.flatnonzero((x >= 2) & (x <= 4) & (y >= 44) & (y <= 46))
+    np.testing.assert_array_equal(hits[2], b2)
