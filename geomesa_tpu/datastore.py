@@ -155,6 +155,9 @@ class _SchemaStore:
         if cache is None:
             cache = self._vis_masks = {}
         if key not in cache:
+            row_keys = [k for k in cache if isinstance(k, frozenset)]
+            if len(row_keys) >= 64:  # bound per-auth-set masks (tenants)
+                cache.pop(row_keys[0], None)
             from .security import visibility_mask
             mask = visibility_mask(self.visibilities, key)
             cache[key] = None if mask.all() else mask
@@ -175,7 +178,18 @@ class _SchemaStore:
     def _rebuild_if_dirty(self):
         if self._dirty:
             self._indexes.clear()
+            self._dev_xy = None
             self._dirty = False
+
+    def device_xy(self):
+        """The point columns uploaded once and shared by the z2 AND z3
+        builders (two separate uploads would double HBM + transfer)."""
+        if getattr(self, "_dev_xy", None) is None:
+            import jax.numpy as jnp
+            x, y = self.batch.geom_xy()
+            self._dev_xy = (jnp.asarray(np.asarray(x, np.float64)),
+                            jnp.asarray(np.asarray(y, np.float64)))
+        return self._dev_xy
 
     # -- lazily-built indexes --------------------------------------------
     def z3_index(self) -> Z3PointIndex:
@@ -183,15 +197,17 @@ class _SchemaStore:
         if "z3" not in self._indexes:
             x, y = self.batch.geom_xy()
             dtg = self.batch.column(self.sft.dtg_field)
+            xd, yd = self.device_xy()
             self._indexes["z3"] = Z3PointIndex.build(
-                x, y, dtg, period=self.sft.z3_interval)
+                x, y, dtg, period=self.sft.z3_interval, xd=xd, yd=yd)
         return self._indexes["z3"]
 
     def z2_index(self) -> Z2PointIndex:
         self._rebuild_if_dirty()
         if "z2" not in self._indexes:
             x, y = self.batch.geom_xy()
-            self._indexes["z2"] = Z2PointIndex.build(x, y)
+            xd, yd = self.device_xy()
+            self._indexes["z2"] = Z2PointIndex.build(x, y, xd=xd, yd=yd)
         return self._indexes["z2"]
 
     def xz3_index(self) -> XZ3Index:
@@ -400,12 +416,23 @@ class TpuDataStore:
         if not batch.ids_explicit:
             # feature ids must be unique across writes: re-base auto ids on
             # a shallow copy so the caller's batch (and any prior-write
-            # alias held by the store) is never mutated
-            base = 0 if store.batch is None else len(store.batch)
+            # alias held by the store) is never mutated.  With
+            # ``geomesa.fid.strategy=z3`` user data, auto ids are
+            # z-prefixed UUIDs (Z3FeatureIdGenerator locality).
+            if (store.sft.user_data.get("geomesa.fid.strategy") == "z3"
+                    and store.sft.is_points and store.sft.dtg_field):
+                from .utils.feature_id import z3_feature_ids
+                x, y = batch.geom_xy()
+                new_ids = z3_feature_ids(
+                    x, y, batch.column(store.sft.dtg_field),
+                    period=store.sft.z3_interval)
+            else:
+                base = 0 if store.batch is None else len(store.batch)
+                new_ids = np.array(
+                    [str(base + i) for i in range(len(batch))], dtype=object)
             batch = FeatureBatch(
                 batch.sft, dict(batch.columns), geoms=batch.geoms,
-                ids=np.array([str(base + i) for i in range(len(batch))],
-                             dtype=object))
+                ids=new_ids)
         store.write(batch, visibility=visibility,
                     attribute_visibilities=attribute_visibilities)
         from .metrics import registry as _metrics

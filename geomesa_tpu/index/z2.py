@@ -152,12 +152,12 @@ class Z2PointIndex:
         self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
-    def build(cls, x, y) -> "Z2PointIndex":
+    def build(cls, x, y, xd=None, yd=None) -> "Z2PointIndex":
         x = np.asarray(x, dtype=np.float64)
         y = np.asarray(y, dtype=np.float64)
         sfc = z2_sfc()
-        xd = jnp.asarray(x)
-        yd = jnp.asarray(y)
+        xd = jnp.asarray(x) if xd is None else xd
+        yd = jnp.asarray(y) if yd is None else yd
         z_s, pos = _encode_sort_z2(sfc, xd, yd)
         return cls(z=z_s, pos=pos, x=xd, y=yd)
 

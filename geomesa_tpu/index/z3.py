@@ -329,8 +329,11 @@ class Z3PointIndex:
         self.t_max_ms: int | None = None
 
     @classmethod
-    def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK) -> "Z3PointIndex":
-        """Encode keys (device) and sort (device lexsort, bin-major)."""
+    def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK,
+              xd=None, yd=None) -> "Z3PointIndex":
+        """Encode keys (device) and sort (device lexsort, bin-major).
+        ``xd``/``yd`` optionally supply already-device-resident coordinate
+        arrays (shared with other indexes) to skip re-upload."""
         period = TimePeriod.parse(period)
         sfc = z3_sfc(period)
         x = np.asarray(x, dtype=np.float64)
@@ -340,8 +343,8 @@ class Z3PointIndex:
         t_min = int(dtg_ms.min()) if len(dtg_ms) else 0
         t_max = int(dtg_ms.max()) if len(dtg_ms) else 0
 
-        xd = jnp.asarray(x)
-        yd = jnp.asarray(y)
+        xd = jnp.asarray(x) if xd is None else xd
+        yd = jnp.asarray(y) if yd is None else yd
         td = jnp.asarray(dtg_ms)
         bind = jnp.asarray(host_bins.astype(np.int32))
         offd = jnp.asarray(host_offs.astype(np.float64))

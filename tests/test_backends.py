@@ -487,3 +487,50 @@ def test_stream_apply_failure_dead_letters_after_retries():
             s.consume("dl")
     assert s.consume("dl") == 0       # dead-lettered, offset advanced
     assert s.consume("dl") == 0       # gone for good
+
+
+def test_z3_feature_ids_locality():
+    """Z3-prefixed UUIDs: nearby features in space+time sort near each
+    other (Z3FeatureIdGenerator analog); uuids stay v4-shaped unique."""
+    import numpy as np
+    from geomesa_tpu.utils.feature_id import random_feature_id, z3_feature_ids
+
+    MS = 1514764800000
+    rng = np.random.default_rng(0)
+    # two tight clusters far apart, same week
+    n = 200
+    x = np.concatenate([rng.uniform(-75, -74.9, n), rng.uniform(100, 100.1, n)])
+    y = np.concatenate([rng.uniform(40, 40.1, n), rng.uniform(-30, -29.9, n)])
+    t = np.full(2 * n, MS + 1000)
+    ids = z3_feature_ids(x, y, t)
+    assert len(set(ids)) == 2 * n
+    for u in ids[:5]:
+        assert len(u) == 36 and u[14] == "4"  # uuid4 version nibble
+    order = np.argsort(ids)
+    # sorting by id must keep each cluster contiguous
+    cluster = (order >= n).astype(int)
+    assert (np.diff(cluster) != 0).sum() == 1
+    assert len(random_feature_id()) == 36
+
+
+def test_z3_feature_ids_exact_key_order():
+    """Id string sort order equals (bin, z-prefix) key order exactly —
+    the fixed UUID version nibble must not perturb ordering."""
+    import numpy as np
+    from geomesa_tpu.curve import TimePeriod, to_binned_time, z3_sfc
+    from geomesa_tpu.utils.feature_id import z3_feature_ids
+
+    MS = 1514764800000
+    rng = np.random.default_rng(1)
+    n = 2000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(MS, MS + 14 * 86_400_000, n)
+    ids = z3_feature_ids(x, y, t)
+    sfc = z3_sfc(TimePeriod.WEEK)
+    bins, offs = to_binned_time(t, TimePeriod.WEEK)
+    z = np.asarray(sfc.index(x, y, offs.astype(np.float64), xp=np),
+                   dtype=np.uint64)
+    zkey = (bins.astype(np.uint64) << np.uint64(44)) | (z >> np.uint64(19))
+    np.testing.assert_array_equal(zkey[np.argsort(ids, kind="stable")],
+                                  np.sort(zkey))

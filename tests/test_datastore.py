@@ -245,3 +245,20 @@ def test_update_schema_rename_moves_catalog_files(tmp_path):
     ds2 = TpuDataStore(d)
     assert ds2.type_names == ["new"]
     assert ds2.get_count("new") == 5
+
+
+def test_z3_fid_strategy_auto_ids():
+    """geomesa.fid.strategy=z3 generates z-prefixed UUID auto ids."""
+    ds = TpuDataStore()
+    ds.create_schema("zf", "v:Int,dtg:Date,*geom:Point;"
+                           "geomesa.fid.strategy=z3")
+    rng = np.random.default_rng(0)
+    n = 50
+    ds.write("zf", {"v": np.arange(n),
+                    "dtg": rng.integers(1514764800000,
+                                        1515364800000, n),
+                    "geom": (rng.uniform(-10, 10, n),
+                             rng.uniform(40, 50, n))})
+    batch = ds.query("zf")
+    assert len(set(batch.ids)) == n
+    assert all(len(i) == 36 and i[14] == "4" for i in batch.ids)
