@@ -172,7 +172,7 @@ class FeatureBatch:
         cols = {k: v[positions] for k, v in self.columns.items()}
         geoms = None
         if self.geoms is not None:
-            geoms = pack_geometries([self.geoms.geometry(int(i)) for i in positions])
+            geoms = self.geoms.take(positions)
         return FeatureBatch(self.sft, cols, self.ids[positions], geoms)
 
     def concat(self, other: "FeatureBatch") -> "FeatureBatch":
@@ -186,8 +186,6 @@ class FeatureBatch:
                 "cannot concat: one batch has packed geometries, the other none")
         geoms = None
         if self.geoms is not None and other.geoms is not None:
-            all_geoms = [self.geoms.geometry(i) for i in range(len(self.geoms))]
-            all_geoms += [other.geoms.geometry(i) for i in range(len(other.geoms))]
-            geoms = pack_geometries(all_geoms)
+            geoms = self.geoms.concat(other.geoms)
         return FeatureBatch(
             self.sft, cols, np.concatenate([self.ids, other.ids]), geoms)

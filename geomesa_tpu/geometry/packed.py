@@ -43,6 +43,23 @@ GEOM_KIND = {
 _KIND_NAMES = {v: k for k, v in GEOM_KIND.items()}
 
 
+def _expand_ranges_np(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Concatenate ``arange(starts[k], starts[k]+counts[k])`` for all k
+    (vectorized; the classic cumsum-of-deltas trick)."""
+    starts = np.asarray(starts, dtype=np.int64)
+    counts = np.asarray(counts, dtype=np.int64)
+    nz = counts > 0
+    starts, counts = starts[nz], counts[nz]
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    out = np.ones(total, dtype=np.int64)
+    ends = np.cumsum(counts)
+    out[0] = starts[0]
+    out[ends[:-1]] = starts[1:] - (starts[:-1] + counts[:-1] - 1)
+    return np.cumsum(out)
+
+
 @dataclass
 class PackedGeometry:
     """A column of N geometries in flat SoA buffers."""
@@ -81,6 +98,49 @@ class PackedGeometry:
         if kind == "Polygon":
             return Polygon(parts[0][0], tuple(parts[0][1:]))
         return MultiPolygon(tuple(Polygon(p[0], tuple(p[1:])) for p in parts))
+
+    def take(self, positions) -> "PackedGeometry":
+        """Row gather as pure offset arithmetic (CSR row selection) — no
+        per-row geometry object rebuilds; the hot path for materializing
+        non-point query results."""
+        positions = np.asarray(positions)
+        if positions.dtype == bool:
+            positions = np.flatnonzero(positions)
+        positions = positions.astype(np.int64)
+        kinds = self.kinds[positions]
+        bbox = self.bbox[positions]
+        gp = self.geom_part_offsets
+        part_counts = gp[positions + 1] - gp[positions]
+        new_gp = np.concatenate([[0], np.cumsum(part_counts)])
+        part_idx = _expand_ranges_np(gp[positions], part_counts)
+        pr = self.part_ring_offsets
+        ring_counts = pr[part_idx + 1] - pr[part_idx]
+        new_pr = np.concatenate([[0], np.cumsum(ring_counts)])
+        ring_idx = _expand_ranges_np(pr[part_idx], ring_counts)
+        ro = self.ring_offsets
+        coord_counts = ro[ring_idx + 1] - ro[ring_idx]
+        new_ro = np.concatenate([[0], np.cumsum(coord_counts)])
+        coord_idx = _expand_ranges_np(ro[ring_idx], coord_counts)
+        return PackedGeometry(
+            kinds=kinds, coords=self.coords[coord_idx],
+            ring_offsets=new_ro, part_ring_offsets=new_pr,
+            geom_part_offsets=new_gp, bbox=bbox)
+
+    def concat(self, other: "PackedGeometry") -> "PackedGeometry":
+        """Buffer concatenation with offset shifts (no object rebuilds)."""
+        return PackedGeometry(
+            kinds=np.concatenate([self.kinds, other.kinds]),
+            coords=np.concatenate([self.coords, other.coords]),
+            ring_offsets=np.concatenate(
+                [self.ring_offsets,
+                 other.ring_offsets[1:] + self.ring_offsets[-1]]),
+            part_ring_offsets=np.concatenate(
+                [self.part_ring_offsets,
+                 other.part_ring_offsets[1:] + self.part_ring_offsets[-1]]),
+            geom_part_offsets=np.concatenate(
+                [self.geom_part_offsets,
+                 other.geom_part_offsets[1:] + self.geom_part_offsets[-1]]),
+            bbox=np.concatenate([self.bbox, other.bbox]))
 
     def rings_of(self, i: int) -> list[np.ndarray]:
         """All rings of geometry i as coordinate arrays."""

@@ -161,3 +161,61 @@ def test_packed_point_in_polygon():
                                   [True, True])
     np.testing.assert_array_equal(points_in_packed_polygon(px, py, packed, 1),
                                   [False, True])
+
+
+def test_packed_take_concat_vectorized_equivalence():
+    """Offset-arithmetic take/concat match the per-object rebuild path."""
+    import numpy as np
+    from geomesa_tpu.geometry.packed import pack_geometries
+    from geomesa_tpu.geometry.types import (
+        LineString, MultiPolygon, Point, Polygon,
+    )
+    rng = np.random.default_rng(0)
+
+    def rand_geom():
+        k = rng.integers(0, 4)
+        cx, cy = rng.uniform(-170, 170), rng.uniform(-80, 80)
+        if k == 0:
+            return Point(cx, cy)
+        if k == 1:
+            return LineString(np.column_stack(
+                [cx + rng.uniform(-1, 1, 5), cy + rng.uniform(-1, 1, 5)]))
+        if k == 2:
+            return Polygon([(cx, cy), (cx + 1, cy), (cx + 1, cy + 1),
+                            (cx, cy + 1)],
+                           holes=[[(cx + .2, cy + .2), (cx + .4, cy + .2),
+                                   (cx + .4, cy + .4)]])
+        return MultiPolygon((Polygon([(cx, cy), (cx + 1, cy),
+                                      (cx + 1, cy + 1)]),
+                             Polygon([(cx + 2, cy), (cx + 3, cy),
+                                      (cx + 3, cy + 1)])))
+
+    geoms = [rand_geom() for _ in range(500)]
+    packed = pack_geometries(geoms)
+    pos = rng.choice(500, 120, replace=False)
+    sub = packed.take(pos)
+    ref = pack_geometries([packed.geometry(int(i)) for i in pos])
+    np.testing.assert_array_equal(sub.kinds, ref.kinds)
+    np.testing.assert_allclose(sub.coords, ref.coords)
+    np.testing.assert_array_equal(sub.ring_offsets, ref.ring_offsets)
+    np.testing.assert_array_equal(sub.part_ring_offsets,
+                                  ref.part_ring_offsets)
+    np.testing.assert_array_equal(sub.geom_part_offsets,
+                                  ref.geom_part_offsets)
+    cat = packed.concat(sub)
+    assert len(cat) == 620
+    assert type(cat.geometry(len(packed))) is type(sub.geometry(0))
+    np.testing.assert_allclose(cat.bbox[500:], sub.bbox)
+
+
+def test_packed_take_accepts_boolean_mask():
+    import numpy as np
+    from geomesa_tpu.geometry.packed import pack_geometries
+    from geomesa_tpu.geometry.types import Point, Polygon
+
+    packed = pack_geometries([Point(0, 0),
+                              Polygon([(0, 0), (1, 0), (1, 1)]),
+                              Point(2, 2)])
+    sub = packed.take(np.array([True, False, True]))
+    assert len(sub) == 2
+    assert list(sub.kinds) == [0, 0]  # the two points
