@@ -206,6 +206,20 @@ def cmd_age_off(args):
         print(f"aged off {n} features from {args.feature_name}")
 
 
+def cmd_fs_partitions(args):
+    """List or compact a filesystem store's partitions (the reference's
+    manage-partitions command over FSDS partition schemes)."""
+    from ..fs import FileSystemDataStore
+    fs = FileSystemDataStore(args.root)
+    if args.compact:
+        fs.compact(args.feature_name)
+        print(f"compacted {args.feature_name}")
+    info = fs.partition_info(args.feature_name)
+    for name in sorted(info):
+        print(f"{name}\t{info[name]['files']} file(s)"
+              f"\t{info[name]['features']} features")
+
+
 def cmd_version(args):
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -254,6 +268,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("--track", help="track-id attribute for bin export")
+
+    sp = add("fs-partitions", cmd_fs_partitions,
+             help="list/compact filesystem-store partitions")
+    sp.add_argument("-r", "--root", required=True,
+                    help="filesystem store root directory")
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("--compact", action="store_true")
 
     sp = add("stats-analyze", cmd_stats_analyze,
              help="recompute and persist stats")

@@ -86,6 +86,14 @@ class _TypeStorage:
     def partitions(self) -> list:
         return sorted(self._load_meta()["partitions"])
 
+    def partition_info(self) -> dict:
+        """partition name → {"files": count, "features": count} — the
+        public view of the partition metadata (CLI/manage-partitions)."""
+        meta = self._load_meta()
+        return {name: {"files": len(files),
+                       "features": sum(f["count"] for f in files)}
+                for name, files in meta["partitions"].items()}
+
     def count(self) -> int:
         return sum(f["count"] for files in self._load_meta()["partitions"].values()
                    for f in files)
@@ -223,6 +231,10 @@ class FileSystemDataStore:
 
     def query(self, name: str, query="INCLUDE") -> FeatureBatch:
         return self._storage(name).query(query)
+
+    def partition_info(self, name: str) -> dict:
+        """Per-partition file/feature counts (manage-partitions view)."""
+        return self._storage(name).partition_info()
 
     def partitions(self, name: str) -> list:
         return self._storage(name).partitions()

@@ -115,3 +115,27 @@ def test_catalog_persists_across_processes(catalog, capsys):
     capsys.readouterr()
     main(["get-type-names", "-c", cat])
     assert "people" not in capsys.readouterr().out
+
+
+def test_cli_fs_partitions(tmp_path, capsys):
+    import numpy as np
+    from geomesa_tpu.cli.main import main
+    from geomesa_tpu.fs import FileSystemDataStore
+
+    root = str(tmp_path / "fsroot")
+    fs = FileSystemDataStore(root)
+    fs.create_schema("evt", "dtg:Date,*geom:Point")
+    rng = np.random.default_rng(0)
+    n = 100
+    fs.write("evt", {
+        "dtg": rng.integers(1514764800000, 1514764800000 + 2 * 86_400_000, n),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))})
+    fs.write("evt", {
+        "dtg": rng.integers(1514764800000, 1514764800000 + 2 * 86_400_000, n),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))})
+    main(["fs-partitions", "-r", root, "-f", "evt"])
+    out = capsys.readouterr().out
+    assert "2 file(s)" in out
+    main(["fs-partitions", "-r", root, "-f", "evt", "--compact"])
+    out = capsys.readouterr().out
+    assert "compacted evt" in out and "1 file(s)" in out
