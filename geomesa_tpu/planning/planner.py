@@ -142,6 +142,18 @@ class QueryPlanner:
         name = strategy.index
         if name == "none":
             return np.empty(0, dtype=np.int64)
+        if name == "or-split":
+            explain(lambda: f"OR-split across {len(strategy.branches)} "
+                            "indexed branches")
+            parts = []
+            for _, st in strategy.branches:
+                cand = self._scan(st, query, explain)
+                if cand is not None and len(cand):
+                    parts.append(cand)
+            # candidates are per-branch supersets; run()'s single full-OR
+            # re-check makes the final hit set exact
+            return (_union(parts) if parts
+                    else np.empty(0, dtype=np.int64))
         if name == "full":
             explain("Executing full-table scan")
             return None

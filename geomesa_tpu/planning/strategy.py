@@ -32,12 +32,15 @@ class FilterStrategy:
     """A candidate execution strategy: which index serves the query and at
     what estimated cost (feature count to scan)."""
 
-    index: str                  # 'z3' | 'z2' | 'xz3' | 'xz2' | 'id' | 'attr:<name>' | 'full'
+    #: 'z3' | 'z2' | 'xz3' | 'xz2' | 'id' | 'attr:<name>' | 'or-split'
+    #: | 'full' | 'none'
+    index: str
     cost: float
     geometries: tuple = ()      # extracted query geometries
     intervals: tuple = ()       # extracted (lo_ms, hi_ms)
     ids: tuple = ()             # extracted feature ids
     attr_values: tuple = ()     # attribute predicate descriptors
+    branches: tuple = ()        # ('or-split') per-branch FilterStrategy
 
     def __repr__(self):
         return f"FilterStrategy({self.index}, cost={self.cost:.0f})"
@@ -186,17 +189,34 @@ class StrategyDecider:
 
     def decide(self, f: Filter, explain: Explainer | None = None) -> FilterStrategy:
         explain = explain or ExplainNull()
-        if isinstance(f, _Exclude):
-            return FilterStrategy("none", 0.0)
-        options = self.strategies(f)
+        chosen = self._decide(f)
         explain.push("Strategy selection:")
-        for o in options:
+        for o in self.strategies(f) if not isinstance(f, _Exclude) else ():
             explain(lambda o=o: f"option {o.index}: estimated cost {o.cost:.0f}")
-        chosen = min(options, key=lambda o: o.cost)
         if chosen.index == "full" and QueryProperties.BLOCK_FULL_TABLE_SCANS.to_bool():
             raise RuntimeError(
                 "full-table scan required but blocked "
                 "(geomesa.scan.block.full.table=true)")
         explain(lambda: f"chosen: {chosen.index} (cost {chosen.cost:.0f})")
         explain.pop()
+        return chosen
+
+    def _decide(self, f: Filter) -> FilterStrategy:
+        if isinstance(f, _Exclude):
+            return FilterStrategy("none", 0.0)
+        options = self.strategies(f)
+        chosen = min(options, key=lambda o: o.cost)
+        if chosen.index == "full":
+            # OR-split (FilterSplitter's disjunction handling,
+            # planning/FilterSplitter.scala:294-307): when every branch of
+            # a top-level OR is individually indexable and the summed
+            # branch costs beat one full scan, serve the query per branch
+            from ..filters.ast import Or
+            if isinstance(f, Or):
+                branch = [ (p, self._decide(p)) for p in f.filters ]
+                if all(st.index not in ("full",) for _, st in branch):
+                    total = sum(st.cost for _, st in branch)
+                    if total < chosen.cost:
+                        return FilterStrategy("or-split", total,
+                                              branches=tuple(branch))
         return chosen

@@ -58,3 +58,31 @@ def test_strategy_hits_equal_oracle(store, ecql):
     oracle = np.flatnonzero(
         evaluate_filter(parse_ecql(ecql), store._store("t").batch))
     np.testing.assert_array_equal(np.sort(got), oracle)
+
+
+def test_or_split_uses_indexes(store):
+    """A top-level OR whose branches each have an index scans per branch
+    and unions (FilterSplitter's disjunction handling) — exactly."""
+    q = ("(name = 'n3' AND BBOX(geom,-50,-50,50,50)) "
+         "OR name = 'n1' OR BBOX(geom,100,0,120,20)")
+    ex = store.explain("t", q)
+    assert "OR-split" in ex
+    got = store.query_result("t", q).positions
+    oracle = np.flatnonzero(
+        evaluate_filter(parse_ecql(q), store._store("t").batch))
+    np.testing.assert_array_equal(np.sort(got), oracle)
+
+
+def test_or_split_respects_block_full_scans(store):
+    """With full scans blocked, indexable ORs still run (via or-split);
+    unindexable filters still raise."""
+    from geomesa_tpu.config import clear_property, set_property
+
+    set_property("geomesa.scan.block.full.table", True)
+    try:
+        r = store.query_result("t", "name = 'n1' OR BBOX(geom, 0, 0, 5, 5)")
+        assert r.strategy.index == "or-split"
+        with pytest.raises(RuntimeError):
+            store.query("t", "score < 2")  # unindexed attribute
+    finally:
+        clear_property("geomesa.scan.block.full.table")
