@@ -168,10 +168,25 @@ class _SchemaStore:
 
     def recompute_stats(self) -> None:
         """Rebuild every sketch from the current rows (sketches are not
-        invertible, so deletes/reloads re-observe)."""
+        invertible, so deletes/reloads re-observe).  With data present,
+        numeric attributes additionally get range histograms (the
+        StatsRunner/stats-analyze products the cost estimator consumes,
+        stats/StatsBasedEstimator spirit) — bounds come from the data, so
+        these only exist after an analyze/recompute pass."""
         self._stats = {}
         self._init_stats()
         if self.batch is not None and len(self.batch):
+            from .stats.stat import Histogram
+            for a in self.sft.attributes:
+                if (a.indexed
+                        and a.type in ("int", "long", "float", "double")
+                        and a.name in self.batch.columns):
+                    col = self.batch.column(a.name)
+                    if len(col) and col.dtype != object:
+                        lo, hi = float(col.min()), float(col.max())
+                        if hi > lo:
+                            self._stats[f"{a.name}_histogram"] = Histogram(
+                                a.name, 32, lo, hi)
             for s in self._stats.values():
                 s.observe(self.batch)
 

@@ -148,7 +148,12 @@ class QueryPlanner:
             parts = []
             for _, st in strategy.branches:
                 cand = self._scan(st, query, explain)
-                if cand is not None and len(cand):
+                if cand is None:
+                    # a full-scan branch inside a split would silently
+                    # lose its rows from the union — degrade the whole
+                    # split to one full scan instead
+                    return None
+                if len(cand):
                     parts.append(cand)
             # candidates are per-branch supersets; run()'s single full-OR
             # re-check makes the final hit set exact

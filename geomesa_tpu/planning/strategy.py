@@ -189,9 +189,9 @@ class StrategyDecider:
 
     def decide(self, f: Filter, explain: Explainer | None = None) -> FilterStrategy:
         explain = explain or ExplainNull()
-        chosen = self._decide(f)
+        chosen, options = self._decide(f)
         explain.push("Strategy selection:")
-        for o in self.strategies(f) if not isinstance(f, _Exclude) else ():
+        for o in options:
             explain(lambda o=o: f"option {o.index}: estimated cost {o.cost:.0f}")
         if chosen.index == "full" and QueryProperties.BLOCK_FULL_TABLE_SCANS.to_bool():
             raise RuntimeError(
@@ -201,9 +201,9 @@ class StrategyDecider:
         explain.pop()
         return chosen
 
-    def _decide(self, f: Filter) -> FilterStrategy:
+    def _decide(self, f: Filter) -> tuple[FilterStrategy, list]:
         if isinstance(f, _Exclude):
-            return FilterStrategy("none", 0.0)
+            return FilterStrategy("none", 0.0), []
         options = self.strategies(f)
         chosen = min(options, key=lambda o: o.cost)
         if chosen.index == "full":
@@ -213,10 +213,11 @@ class StrategyDecider:
             # branch costs beat one full scan, serve the query per branch
             from ..filters.ast import Or
             if isinstance(f, Or):
-                branch = [ (p, self._decide(p)) for p in f.filters ]
-                if all(st.index not in ("full",) for _, st in branch):
+                branch = [(p, self._decide(p)[0]) for p in f.filters]
+                if all(st.index != "full" for _, st in branch):
                     total = sum(st.cost for _, st in branch)
                     if total < chosen.cost:
-                        return FilterStrategy("or-split", total,
-                                              branches=tuple(branch))
-        return chosen
+                        split = FilterStrategy("or-split", total,
+                                               branches=tuple(branch))
+                        return split, options + [split]
+        return chosen, options

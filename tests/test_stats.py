@@ -160,3 +160,23 @@ def test_z3_histogram(batch):
     s1.observe(a)
     s2.observe(b)
     assert (s1 + s2).counts == s.counts
+
+
+def test_stats_analyze_builds_range_histograms():
+    """stats-analyze adds numeric-attribute histograms that sharpen
+    range-cost estimates (StatsBasedEstimator role)."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+
+    rng = np.random.default_rng(0)
+    n = 5000
+    ds = TpuDataStore()
+    ds.create_schema("h", "v:Int:index=true,dtg:Date,*geom:Point")
+    ds.write("h", {"v": rng.integers(0, 1000, n),
+                   "dtg": np.zeros(n, np.int64),
+                   "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))})
+    before = ds.query_result("h", "v BETWEEN 10 AND 20").strategy.cost
+    ds.stats_analyze("h")
+    after = ds.query_result("h", "v BETWEEN 10 AND 20").strategy.cost
+    assert after < before / 2           # histogram sharpened the estimate
+    assert ds.stat("h", "v_histogram") is not None
