@@ -131,3 +131,97 @@ def test_fuzz_point_in_polygon_vs_matplotlib():
             near = points_on_rings(px[diff], py[diff], [poly.shell],
                                    eps=1e-9)
             assert int(diff.sum()) - int(near.sum()) <= 3
+
+
+def test_fuzz_random_filters_vs_row_oracle():
+    """Random filter trees: planner+evaluator hit sets equal an
+    INDEPENDENT row-wise interpreter (not evaluate_filter), so a shared
+    bug in the vectorized path cannot self-certify."""
+    import operator
+    import re as _re
+
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.filters import ast as A
+
+    rng = np.random.default_rng(99)
+    n = 1500
+    ds = TpuDataStore()
+    ds.create_schema("t", "name:String:index=true,v:Int,f:Double,"
+                          "dtg:Date,*geom:Point")
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-85, 85, n)
+    name = np.asarray([f"n{i % 6}" for i in range(n)], dtype=object)
+    v = rng.integers(-100, 100, n)
+    fv = rng.uniform(0, 1, n)
+    t = rng.integers(MS, MS + 21 * DAY, n)
+    ds.write("t", {"name": name, "v": v, "f": fv, "dtg": t, "geom": (x, y)})
+
+    def oracle(f, i):
+        if isinstance(f, A._Include):
+            return True
+        if isinstance(f, A.And):
+            return all(oracle(p, i) for p in f.filters)
+        if isinstance(f, A.Or):
+            return any(oracle(p, i) for p in f.filters)
+        if isinstance(f, A.Not):
+            return not oracle(f.filter, i)
+        if isinstance(f, A.BBox):
+            return (f.xmin <= x[i] <= f.xmax) and (f.ymin <= y[i] <= f.ymax)
+        if isinstance(f, A.During):
+            if f.lo_ms is not None and t[i] < f.lo_ms:
+                return False
+            return not (f.hi_ms is not None and t[i] > f.hi_ms)
+        if isinstance(f, A.PropertyCompare):
+            ops = {"=": operator.eq, "<>": operator.ne, "<": operator.lt,
+                   "<=": operator.le, ">": operator.gt, ">=": operator.ge}
+            col = {"v": v, "f": fv}[f.prop]
+            return bool(ops[f.op](col[i], f.value))
+        if isinstance(f, A.Between):
+            col = {"v": v, "f": fv}[f.prop]
+            return f.lo <= col[i] <= f.hi
+        if isinstance(f, A.In):
+            return name[i] in f.values
+        if isinstance(f, A.Like):
+            esc = _re.escape(f.pattern).replace("%", ".*").replace("_", ".")
+            return bool(_re.match("^" + esc + "$", str(name[i])))
+        raise NotImplementedError(type(f))
+
+    def rand_filter(depth=0):
+        k = rng.integers(0, 9 if depth < 2 else 7)
+        if k == 0:
+            x0, x1 = sorted(rng.uniform(-180, 180, 2))
+            y0, y1 = sorted(rng.uniform(-85, 85, 2))
+            return A.BBox("geom", float(x0), float(y0), float(x1), float(y1))
+        if k == 1:
+            lo = int(rng.integers(MS, MS + 20 * DAY))
+            hi = lo + int(rng.integers(1, 5 * DAY))
+            which = rng.integers(0, 3)
+            return A.During("dtg", None if which == 1 else lo,
+                            None if which == 2 else hi)
+        if k == 2:
+            return A.PropertyCompare(
+                "v", str(rng.choice(["=", "<>", "<", "<=", ">", ">="])),
+                int(rng.integers(-100, 100)))
+        if k == 3:
+            return A.Between("f", float(rng.uniform(0, 0.5)),
+                             float(rng.uniform(0.5, 1)))
+        if k == 4:
+            return A.In("name", tuple(rng.choice(
+                ["n0", "n1", "n2", "n3", "zz"], rng.integers(1, 4),
+                replace=False).tolist()))
+        if k == 5:
+            return A.Like("name",
+                          str(rng.choice(["n%", "%1", "n_", "x%"])), False)
+        if k == 6:
+            return A.Not(rand_filter(depth + 1))
+        if k == 7:
+            return A.And(tuple(rand_filter(depth + 1)
+                               for _ in range(int(rng.integers(2, 4)))))
+        return A.Or(tuple(rand_filter(depth + 1)
+                          for _ in range(int(rng.integers(2, 4)))))
+
+    for _ in range(60):
+        f = rand_filter()
+        got = set(int(i) for i in ds.query_result("t", f).positions)
+        want = set(i for i in range(n) if oracle(f, i))
+        assert got == want, (repr(f)[:120], len(got), len(want))
