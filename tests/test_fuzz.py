@@ -182,8 +182,17 @@ def test_fuzz_random_filters_vs_row_oracle():
         if isinstance(f, A.In):
             return name[i] in f.values
         if isinstance(f, A.Like):
-            esc = _re.escape(f.pattern).replace("%", ".*").replace("_", ".")
-            return bool(_re.match("^" + esc + "$", str(name[i])))
+            # independent character-walk LIKE matcher (NOT the
+            # implementation's regex construction)
+            def like(s, p):
+                if not p:
+                    return not s
+                if p[0] == "%":
+                    return any(like(s[k:], p[1:]) for k in range(len(s) + 1))
+                if p[0] == "_":
+                    return bool(s) and like(s[1:], p[1:])
+                return bool(s) and s[0] == p[0] and like(s[1:], p[1:])
+            return like(str(name[i]), f.pattern)
         raise NotImplementedError(type(f))
 
     def rand_filter(depth=0):
@@ -206,9 +215,10 @@ def test_fuzz_random_filters_vs_row_oracle():
             return A.Between("f", float(rng.uniform(0, 0.5)),
                              float(rng.uniform(0.5, 1)))
         if k == 4:
+            # sizes straddle the >4 threshold of the np.isin fast path
             return A.In("name", tuple(rng.choice(
-                ["n0", "n1", "n2", "n3", "zz"], rng.integers(1, 4),
-                replace=False).tolist()))
+                ["n0", "n1", "n2", "n3", "n4", "n5", "zz", "yy"],
+                rng.integers(1, 8), replace=False).tolist()))
         if k == 5:
             return A.Like("name",
                           str(rng.choice(["n%", "%1", "n_", "x%"])), False)
