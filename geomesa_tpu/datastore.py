@@ -21,7 +21,6 @@ import json
 import os
 import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -34,7 +33,7 @@ from .index.xz2 import XZ2Index
 from .index.xz3 import XZ3Index
 from .index.z2 import Z2PointIndex
 from .index.z3 import Z3PointIndex
-from .planning.explain import Explainer, ExplainNull
+from .planning.explain import Explainer
 from .planning.planner import Query, QueryPlanner, QueryResult
 from .stats.stat import (
     CountStat, EnumerationStat, Histogram, MinMax, Stat, TopK, stat_from_json,

@@ -11,7 +11,6 @@ applied to index candidates.
 
 from __future__ import annotations
 
-import fnmatch
 import re
 
 import numpy as np

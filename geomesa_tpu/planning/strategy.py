@@ -17,8 +17,8 @@ import numpy as np
 from ..config import QueryProperties
 from ..features.feature_type import FeatureType
 from ..filters.ast import (
-    And, Between, During, Filter, IdFilter, In, Like, Not, Or,
-    PropertyCompare, _Exclude, _Include,
+    And, Between, Filter, IdFilter, In, Like, Or,
+    PropertyCompare, _Exclude,
 )
 from ..filters.extract import extract_geometries, extract_intervals
 from ..stats.stat import EnumerationStat, Frequency, Histogram, MinMax, TopK
