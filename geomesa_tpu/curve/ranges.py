@@ -85,12 +85,21 @@ def zranges(
       ``[lo, hi]`` z ranges whose union covers (and with an unexhausted
       budget, exactly equals) the query cells.
     """
-    mins = np.atleast_2d(np.asarray(mins, dtype=np.int64)).astype(np.uint64)
-    maxs = np.atleast_2d(np.asarray(maxs, dtype=np.int64)).astype(np.uint64)
+    mins = np.atleast_2d(np.asarray(mins, dtype=np.int64))
+    maxs = np.atleast_2d(np.asarray(maxs, dtype=np.int64))
     if mins.shape != maxs.shape or mins.shape[1] != dims:
         raise ValueError(f"expected (B, {dims}) box bounds, got {mins.shape}/{maxs.shape}")
     budget = DEFAULT_MAX_RANGES if max_ranges is None else int(max_ranges)
     depth_cap = bits if max_levels is None else min(bits, int(max_levels))
+
+    from .. import native
+
+    res = native.zranges_native(mins, maxs, dims, bits, budget, depth_cap)
+    if res is not None:
+        return res
+
+    mins = mins.astype(np.uint64)
+    maxs = maxs.astype(np.uint64)
     fanout = 1 << dims
 
     # boxes as (B, d) for broadcasting against the (n, d) frontier

@@ -140,6 +140,14 @@ class XZ2SFC:
             windows[:, 0], windows[:, 1], windows[:, 2], windows[:, 3], np
         )
 
+        from .. import native
+
+        res = native.xz_ranges_native(
+            np.stack([wxmin, wymin], axis=1), np.stack([wxmax, wymax], axis=1),
+            dims=2, g=g, budget=budget)
+        if res is not None:
+            return res
+
         # frontier: integer cell coords (kx, ky) at the current level and the
         # running sequence code prefix of each cell
         kx = np.array([0], dtype=np.int64)

@@ -16,7 +16,7 @@ data-dependent descent into ``g`` fixed VPU steps.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import lru_cache
 
 import jax.numpy as jnp
@@ -121,6 +121,15 @@ class XZ3SFC:
         wxmin, wymin, wzmin, wxmax, wymax, wzmax = self._normalize(
             (windows[:, 0], windows[:, 1], windows[:, 2],
              windows[:, 3], windows[:, 4], windows[:, 5]), np)
+
+        from .. import native
+
+        res = native.xz_ranges_native(
+            np.stack([wxmin, wymin, wzmin], axis=1),
+            np.stack([wxmax, wymax, wzmax], axis=1),
+            dims=3, g=g, budget=budget)
+        if res is not None:
+            return res
 
         kx = np.array([0], dtype=np.int64)
         ky = np.array([0], dtype=np.int64)

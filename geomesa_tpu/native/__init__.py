@@ -1,0 +1,156 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+The device compute path is JAX/XLA/Pallas; this package holds the native
+*host* pieces — currently the planner's range-decomposition hot loops
+(the role the reference outsources to the external ``sfcurve`` JVM
+library, geomesa-z3/pom.xml:16-17).  The shared library is compiled from
+:mod:`geomesa_native.cpp` on first use with the system ``g++`` and cached
+by source hash; everything degrades gracefully to the numpy
+implementations when a toolchain is unavailable or
+``GEOMESA_TPU_NATIVE=0`` is set.
+
+The native and numpy paths are semantically identical by construction
+(same sweep, same emit order, same budget arithmetic) and are
+differential-tested against each other in ``tests/test_native.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+__all__ = ["available", "zranges_native", "xz_ranges_native"]
+
+_SRC = os.path.join(os.path.dirname(__file__), "geomesa_native.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _cache_dir() -> str:
+    override = os.environ.get("GEOMESA_TPU_NATIVE_CACHE")
+    if override:
+        return override
+    return os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "geomesa_tpu",
+    )
+
+
+def _build() -> ctypes.CDLL | None:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    cache = _cache_dir()
+    lib_path = os.path.join(cache, f"libgeomesa_native-{tag}.so")
+    if not os.path.exists(lib_path):
+        os.makedirs(cache, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache)
+        os.close(fd)
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, lib_path)  # atomic under concurrent builders
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    lib.gm_zranges.restype = ctypes.c_int64
+    lib.gm_zranges.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.gm_xz_ranges.restype = ctypes.c_int64
+    lib.gm_xz_ranges.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if not _TRIED:
+            if os.environ.get("GEOMESA_TPU_NATIVE", "1") != "0":
+                _LIB = _build()
+            _TRIED = True
+    return _LIB
+
+
+def available() -> bool:
+    """True when the native library compiled and loaded."""
+    return _load() is not None
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def zranges_native(mins: np.ndarray, maxs: np.ndarray, dims: int, bits: int,
+                   budget: int, depth_cap: int) -> np.ndarray | None:
+    """Native Z2/Z3 range decomposition; None when the library is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    mins = np.ascontiguousarray(mins, dtype=np.int64)
+    maxs = np.ascontiguousarray(maxs, dtype=np.int64)
+    n_boxes = mins.shape[0]
+    cap = max(int(budget) + 16, 16)
+    out = np.empty(2 * cap, dtype=np.int64)
+    n = lib.gm_zranges(_i64ptr(mins), _i64ptr(maxs), n_boxes, dims, bits,
+                       budget, depth_cap, _i64ptr(out), cap)
+    if n < 0:  # capacity retry (defensive; budget bounds the emit count)
+        cap = -n
+        out = np.empty(2 * cap, dtype=np.int64)
+        n = lib.gm_zranges(_i64ptr(mins), _i64ptr(maxs), n_boxes, dims, bits,
+                           budget, depth_cap, _i64ptr(out), cap)
+        if n < 0:
+            return None
+    return out[: 2 * n].reshape(-1, 2).copy()
+
+
+def xz_ranges_native(wmins: np.ndarray, wmaxs: np.ndarray, dims: int, g: int,
+                     budget: int) -> np.ndarray | None:
+    """Native XZ2/XZ3 range decomposition over pre-normalized windows."""
+    lib = _load()
+    if lib is None:
+        return None
+    wmins = np.ascontiguousarray(wmins, dtype=np.float64)
+    wmaxs = np.ascontiguousarray(wmaxs, dtype=np.float64)
+    n_windows = wmins.shape[0]
+    cap = max(int(budget) + 16, 16)
+    out = np.empty(2 * cap, dtype=np.int64)
+    n = lib.gm_xz_ranges(_f64ptr(wmins), _f64ptr(wmaxs), n_windows, dims, g,
+                         budget, _i64ptr(out), cap)
+    if n < 0:
+        cap = -n
+        out = np.empty(2 * cap, dtype=np.int64)
+        n = lib.gm_xz_ranges(_f64ptr(wmins), _f64ptr(wmaxs), n_windows, dims,
+                             g, budget, _i64ptr(out), cap)
+        if n < 0:
+            return None
+    return out[: 2 * n].reshape(-1, 2).copy()

@@ -1,0 +1,120 @@
+"""Differential tests: native (C++) range decomposition vs numpy fallback.
+
+The native library (geomesa_tpu/native/geomesa_native.cpp) implements the
+same level-synchronous sweeps as curve/ranges.py and curve/{xz2,xz3}.py —
+same emit order, same budget arithmetic — so outputs must be identical
+array-for-array, including under budget truncation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import native
+from geomesa_tpu.curve import ranges as ranges_mod
+from geomesa_tpu.curve.xz2 import xz2_sfc
+from geomesa_tpu.curve.xz3 import xz3_sfc
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _py_zranges(mins, maxs, dims, bits, max_ranges=None, max_levels=None):
+    """Run the numpy path with the native dispatch disabled."""
+    orig = native.zranges_native
+    native.zranges_native = lambda *a, **k: None
+    try:
+        return ranges_mod.zranges(mins, maxs, dims=dims, bits=bits,
+                                  max_ranges=max_ranges, max_levels=max_levels)
+    finally:
+        native.zranges_native = orig
+
+
+def _py_xz_ranges(sfc, queries, max_ranges=None):
+    orig = native.xz_ranges_native
+    native.xz_ranges_native = lambda *a, **k: None
+    try:
+        return sfc.ranges(queries, max_ranges=max_ranges)
+    finally:
+        native.xz_ranges_native = orig
+
+
+def test_native_loads():
+    assert native.available()
+
+
+@pytest.mark.parametrize("dims,bits", [(2, 31), (2, 8), (3, 21), (3, 5)])
+def test_zranges_differential(dims, bits):
+    rng = np.random.default_rng(1234 + dims * 100 + bits)
+    hi = (1 << bits) - 1
+    for trial in range(25):
+        n_boxes = int(rng.integers(1, 5))
+        a = rng.integers(0, hi + 1, size=(n_boxes, dims))
+        b = rng.integers(0, hi + 1, size=(n_boxes, dims))
+        mins, maxs = np.minimum(a, b), np.maximum(a, b)
+        budget = int(rng.choice([4, 32, 2000]))
+        levels = None if trial % 3 else int(rng.integers(1, bits + 1))
+        got = ranges_mod.zranges(mins, maxs, dims=dims, bits=bits,
+                                 max_ranges=budget, max_levels=levels)
+        want = _py_zranges(mins, maxs, dims=dims, bits=bits,
+                           max_ranges=budget, max_levels=levels)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_zranges_full_domain_and_point():
+    # whole domain → single range
+    got = ranges_mod.zranges([[0, 0]], [[(1 << 8) - 1, (1 << 8) - 1]],
+                             dims=2, bits=8)
+    np.testing.assert_array_equal(got, [[0, (1 << 16) - 1]])
+    # single cell
+    got = ranges_mod.zranges([[3, 5]], [[3, 5]], dims=2, bits=8,
+                             max_ranges=10_000)
+    want = _py_zranges([[3, 5]], [[3, 5]], dims=2, bits=8, max_ranges=10_000)
+    np.testing.assert_array_equal(got, want)
+    assert got.shape == (1, 2)
+
+
+@pytest.mark.parametrize("g", [6, 12])
+def test_xz2_ranges_differential(g):
+    sfc = xz2_sfc(g)
+    rng = np.random.default_rng(99 + g)
+    for _ in range(25):
+        n = int(rng.integers(1, 4))
+        x = np.sort(rng.uniform(-180, 180, size=(n, 2)), axis=1)
+        y = np.sort(rng.uniform(-90, 90, size=(n, 2)), axis=1)
+        queries = np.stack([x[:, 0], y[:, 0], x[:, 1], y[:, 1]], axis=1)
+        budget = int(rng.choice([8, 100, 2000]))
+        got = sfc.ranges(queries, max_ranges=budget)
+        want = _py_xz_ranges(sfc, queries, max_ranges=budget)
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("g", [6, 12])
+def test_xz3_ranges_differential(g):
+    sfc = xz3_sfc("week", g)
+    rng = np.random.default_rng(7 + g)
+    zmax = sfc.z_hi
+    for _ in range(20):
+        n = int(rng.integers(1, 4))
+        x = np.sort(rng.uniform(-180, 180, size=(n, 2)), axis=1)
+        y = np.sort(rng.uniform(-90, 90, size=(n, 2)), axis=1)
+        z = np.sort(rng.uniform(0, zmax, size=(n, 2)), axis=1)
+        queries = np.stack(
+            [x[:, 0], y[:, 0], z[:, 0], x[:, 1], y[:, 1], z[:, 1]], axis=1)
+        budget = int(rng.choice([8, 100, 2000]))
+        got = sfc.ranges(queries, max_ranges=budget)
+        want = _py_xz_ranges(sfc, queries, max_ranges=budget)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_env_kill_switch(monkeypatch):
+    # GEOMESA_TPU_NATIVE=0 must be honored by a fresh loader state
+    monkeypatch.setenv("GEOMESA_TPU_NATIVE", "0")
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", False)
+    assert not native.available()
+    # and zranges still works via numpy
+    out = ranges_mod.zranges([[0, 0]], [[7, 7]], dims=2, bits=4)
+    assert out.shape[0] >= 1
