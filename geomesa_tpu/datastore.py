@@ -250,8 +250,12 @@ class _SchemaStore:
         self._rebuild_if_dirty()
         key = f"attr:{attr}"
         if key not in self._indexes:
+            # date-tiered when the schema has a dtg field (the reference's
+            # secondary DateIndexKeySpace tier)
+            secondary = (self.batch.column(self.sft.dtg_field)
+                         if self.sft.dtg_field else None)
             self._indexes[key] = AttributeIndex.build(
-                attr, self.batch.column(attr))
+                attr, self.batch.column(attr), secondary=secondary)
         return self._indexes[key]
 
 

@@ -18,6 +18,7 @@ the role the reference's CQL geometry evaluation plays in
 FilterTransformIterator.
 """
 
+from .crs import register_crs, reproject_batch, transform
 from .packed import PackedGeometry, pack_geometries
 from .predicates import (
     bbox_intersects,

@@ -1,0 +1,115 @@
+"""Coordinate reference system transforms for query-result reprojection.
+
+Analog of the reference's reprojection step in QueryPlanner.runQuery
+(planning/QueryPlanner.scala:74-81, driven by the GeoTools ``Query`` CRS
+settings) — applied after scan + filter, to the result only.
+
+TPU-first: transforms are closed-form vectorized math over the columnar
+geometry layout (``<geom>_x``/``<geom>_y`` point columns, packed coord
+arrays for non-points), written generically over the array namespace so
+they run under numpy on host or jax.numpy on device.  Supported natively:
+EPSG:4326 (lon/lat degrees, the storage CRS) and EPSG:3857 (spherical web
+mercator).  Additional CRSs plug in via :func:`register_crs` with forward
+and inverse functions to/from 4326.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["transform", "register_crs", "reproject_batch", "EPSG_4326",
+           "EPSG_3857"]
+
+EPSG_4326 = "EPSG:4326"
+EPSG_3857 = "EPSG:3857"
+
+_R = 6378137.0                      # WGS84 spherical radius (meters)
+_MAX_LAT = 85.05112877980659        # web-mercator latitude cutoff
+
+
+def _merc_fwd(x, y, xp):
+    lat = xp.clip(xp.asarray(y, dtype=xp.float64), -_MAX_LAT, _MAX_LAT)
+    lon = xp.asarray(x, dtype=xp.float64)
+    mx = _R * xp.radians(lon)
+    my = _R * xp.log(xp.tan(np.pi / 4.0 + xp.radians(lat) / 2.0))
+    return mx, my
+
+
+def _merc_inv(x, y, xp):
+    lon = xp.degrees(xp.asarray(x, dtype=xp.float64) / _R)
+    lat = xp.degrees(
+        2.0 * xp.arctan(xp.exp(xp.asarray(y, dtype=xp.float64) / _R))
+        - np.pi / 2.0)
+    return lon, lat
+
+
+#: crs → (to_4326, from_4326); each fn is (x, y, xp) → (x', y')
+_REGISTRY: dict[str, tuple] = {
+    EPSG_4326: (lambda x, y, xp: (x, y), lambda x, y, xp: (x, y)),
+    EPSG_3857: (_merc_inv, _merc_fwd),
+}
+
+
+def register_crs(code: str, to_4326, from_4326) -> None:
+    """Register a custom CRS by its transforms to/from EPSG:4326.
+
+    Each transform is ``(x, y, xp) -> (x', y')`` over array inputs, where
+    ``xp`` is the array namespace (numpy or jax.numpy)."""
+    _REGISTRY[_norm(code)] = (to_4326, from_4326)
+
+
+def _norm(code: str) -> str:
+    code = code.strip().upper()
+    if code.isdigit():
+        code = f"EPSG:{code}"
+    if code == "CRS:84":  # axis-order-free alias for 4326
+        code = EPSG_4326
+    return code
+
+
+def transform(x, y, src: str, dst: str, xp=np):
+    """Vectorized coordinate transform ``src`` → ``dst`` (via 4326)."""
+    src, dst = _norm(src), _norm(dst)
+    for code in (src, dst):
+        if code not in _REGISTRY:
+            raise ValueError(f"unknown CRS {code!r}; register_crs() to add")
+    if src == dst:
+        return x, y
+    to4326 = _REGISTRY[src][0]
+    from4326 = _REGISTRY[dst][1]
+    lon, lat = to4326(x, y, xp)
+    return from4326(lon, lat, xp)
+
+
+def reproject_batch(batch, dst: str, src: str = EPSG_4326):
+    """Return a copy of a FeatureBatch with all geometry columns
+    reprojected ``src`` → ``dst``; no-op when they match."""
+    if _norm(dst) == _norm(src):
+        return batch
+    from ..features.batch import FeatureBatch
+
+    cols = dict(batch.columns)
+    for attr in batch.sft.attributes:
+        if not attr.is_geometry:
+            continue
+        xk, yk = f"{attr.name}_x", f"{attr.name}_y"
+        if xk in cols and yk in cols:
+            cols[xk], cols[yk] = transform(cols[xk], cols[yk], src, dst)
+        bk = f"{attr.name}_bbox"
+        if bk in cols:
+            bbox = np.asarray(cols[bk], dtype=np.float64)
+            x0, y0 = transform(bbox[:, 0], bbox[:, 1], src, dst)
+            x1, y1 = transform(bbox[:, 2], bbox[:, 3], src, dst)
+            cols[bk] = np.stack([x0, y0, x1, y1], axis=1)
+    geoms = batch.geoms
+    if geoms is not None:
+        gx, gy = transform(geoms.coords[:, 0], geoms.coords[:, 1], src, dst)
+        # per-geometry bboxes: transforming corners is exact for the
+        # axis-monotone transforms supported here
+        bx0, by0 = transform(geoms.bbox[:, 0], geoms.bbox[:, 1], src, dst)
+        bx1, by1 = transform(geoms.bbox[:, 2], geoms.bbox[:, 3], src, dst)
+        from dataclasses import replace
+        geoms = replace(geoms, coords=np.stack([gx, gy], axis=1),
+                        bbox=np.stack([bx0, by0, bx1, by1], axis=1))
+    return FeatureBatch(batch.sft, cols, batch.ids, geoms,
+                        ids_explicit=True)

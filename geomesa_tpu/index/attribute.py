@@ -5,9 +5,20 @@ attribute/ — lexicoded values via ``AttributeIndexKey.typeRegistry``
 (AttributeIndexKey.scala:38), ``encodeForQuery`` :52).  Lexicographic byte
 encoding is unnecessary here: the "table" is a host-side sorted column in
 its natural dtype (numpy sort order == lexicoder order for numerics and
-strings), plus the permutation.  A secondary Z3/date tier (the reference's
-tiered keys) is planned as a follow-up; date refinement currently happens
-in the residual filter.
+strings), plus the permutation.
+
+**Secondary tier.**  The reference appends a secondary key — the date, or
+the full Z3 key — after each lexicoded attribute value
+(``AttributeIndexKeySpace`` sharing + ``DateIndexKeySpace``; tiered-range
+assembly in ``GeoMesaFeatureIndex.getQueryStrategy``,
+api/GeoMesaFeatureIndex.scala:248-338), so that ``attr = X AND dtg
+DURING …`` seeks a sub-range instead of post-filtering.  Here the tier is
+a second int64 sort key (epoch-millis dtg): rows are ordered by
+``(value, secondary)`` via one lexsort, and equality/IN lookups refine
+each value run with two extra ``searchsorted`` calls.  As in the
+reference, tiers apply only when the primary is a point value (equality /
+IN) — range and prefix scans span many value runs and fall back to the
+planner's residual filter.
 """
 
 from __future__ import annotations
@@ -18,20 +29,29 @@ __all__ = ["AttributeIndex"]
 
 
 class AttributeIndex:
-    """Sorted-column index over one attribute."""
+    """Sorted-column index over one attribute, optionally date-tiered."""
 
-    def __init__(self, attr: str, values: np.ndarray, pos: np.ndarray):
+    def __init__(self, attr: str, values: np.ndarray, pos: np.ndarray,
+                 secondary: np.ndarray | None = None):
         self.attr = attr
-        self.values = values      # sorted
+        self.values = values      # sorted (by value, then secondary)
         self.pos = pos
+        self.secondary = secondary  # int64, sorted within each value run
 
     @classmethod
-    def build(cls, attr: str, column: np.ndarray) -> "AttributeIndex":
+    def build(cls, attr: str, column: np.ndarray,
+              secondary: np.ndarray | None = None) -> "AttributeIndex":
         col = np.asarray(column)
         if col.dtype == object:
             col = col.astype(str)
-        order = np.argsort(col, kind="stable")
-        return cls(attr, col[order], order.astype(np.int64))
+        if secondary is None:
+            order = np.argsort(col, kind="stable")
+            sec = None
+        else:
+            sec_col = np.asarray(secondary, dtype=np.int64)
+            order = np.lexsort((sec_col, col))
+            sec = sec_col[order]
+        return cls(attr, col[order], order.astype(np.int64), sec)
 
     def __len__(self) -> int:
         return len(self.values)
@@ -41,17 +61,29 @@ class AttributeIndex:
             return str(v)
         return v
 
-    def query_equals(self, value) -> np.ndarray:
+    def _refine(self, lo: int, hi: int, sec_window) -> slice:
+        """Narrow a value run [lo, hi) by the secondary window."""
+        if sec_window is None or self.secondary is None or lo >= hi:
+            return slice(lo, hi)
+        s_lo, s_hi = sec_window
+        run = self.secondary[lo:hi]
+        i0 = lo if s_lo is None else lo + int(np.searchsorted(run, s_lo, side="left"))
+        i1 = hi if s_hi is None else lo + int(np.searchsorted(run, s_hi, side="right"))
+        return slice(i0, i1)
+
+    def query_equals(self, value, sec_window=None) -> np.ndarray:
+        """Positions where attr == value, optionally tier-refined by an
+        inclusive ``(lo, hi)`` secondary (dtg-ms) window."""
         value = self._cast(value)
         lo = np.searchsorted(self.values, value, side="left")
         hi = np.searchsorted(self.values, value, side="right")
-        return np.sort(self.pos[lo:hi])
+        return np.sort(self.pos[self._refine(lo, hi, sec_window)])
 
-    def query_in(self, values) -> np.ndarray:
+    def query_in(self, values, sec_window=None) -> np.ndarray:
         if not len(values):
             return np.empty(0, dtype=np.int64)
         return np.sort(np.unique(np.concatenate(
-            [self.query_equals(v) for v in values])))
+            [self.query_equals(v, sec_window) for v in values])))
 
     def query_range(self, lo=None, hi=None, lo_inclusive=True,
                     hi_inclusive=True) -> np.ndarray:

@@ -110,6 +110,23 @@ def _f64ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
 
 
+def _call_with_capacity(call, budget: int) -> np.ndarray | None:
+    """Run a native range fn with a modest initial buffer, growing once to
+    the exact required capacity on a negative return.  The budget bounds
+    the emit count, but huge 'unlimited' budgets must not preallocate
+    proportionally."""
+    cap = min(int(budget), 4096) + 16
+    out = np.empty(2 * cap, dtype=np.int64)
+    n = call(out, cap)
+    if n < 0:
+        cap = -n
+        out = np.empty(2 * cap, dtype=np.int64)
+        n = call(out, cap)
+        if n < 0:
+            return None
+    return out[: 2 * n].reshape(-1, 2).copy()
+
+
 def zranges_native(mins: np.ndarray, maxs: np.ndarray, dims: int, bits: int,
                    budget: int, depth_cap: int) -> np.ndarray | None:
     """Native Z2/Z3 range decomposition; None when the library is absent."""
@@ -118,19 +135,11 @@ def zranges_native(mins: np.ndarray, maxs: np.ndarray, dims: int, bits: int,
         return None
     mins = np.ascontiguousarray(mins, dtype=np.int64)
     maxs = np.ascontiguousarray(maxs, dtype=np.int64)
-    n_boxes = mins.shape[0]
-    cap = max(int(budget) + 16, 16)
-    out = np.empty(2 * cap, dtype=np.int64)
-    n = lib.gm_zranges(_i64ptr(mins), _i64ptr(maxs), n_boxes, dims, bits,
-                       budget, depth_cap, _i64ptr(out), cap)
-    if n < 0:  # capacity retry (defensive; budget bounds the emit count)
-        cap = -n
-        out = np.empty(2 * cap, dtype=np.int64)
-        n = lib.gm_zranges(_i64ptr(mins), _i64ptr(maxs), n_boxes, dims, bits,
-                           budget, depth_cap, _i64ptr(out), cap)
-        if n < 0:
-            return None
-    return out[: 2 * n].reshape(-1, 2).copy()
+    return _call_with_capacity(
+        lambda out, cap: lib.gm_zranges(
+            _i64ptr(mins), _i64ptr(maxs), mins.shape[0], dims, bits,
+            budget, depth_cap, _i64ptr(out), cap),
+        budget)
 
 
 def xz_ranges_native(wmins: np.ndarray, wmaxs: np.ndarray, dims: int, g: int,
@@ -141,16 +150,8 @@ def xz_ranges_native(wmins: np.ndarray, wmaxs: np.ndarray, dims: int, g: int,
         return None
     wmins = np.ascontiguousarray(wmins, dtype=np.float64)
     wmaxs = np.ascontiguousarray(wmaxs, dtype=np.float64)
-    n_windows = wmins.shape[0]
-    cap = max(int(budget) + 16, 16)
-    out = np.empty(2 * cap, dtype=np.int64)
-    n = lib.gm_xz_ranges(_f64ptr(wmins), _f64ptr(wmaxs), n_windows, dims, g,
-                         budget, _i64ptr(out), cap)
-    if n < 0:
-        cap = -n
-        out = np.empty(2 * cap, dtype=np.int64)
-        n = lib.gm_xz_ranges(_f64ptr(wmins), _f64ptr(wmaxs), n_windows, dims,
-                             g, budget, _i64ptr(out), cap)
-        if n < 0:
-            return None
-    return out[: 2 * n].reshape(-1, 2).copy()
+    return _call_with_capacity(
+        lambda out, cap: lib.gm_xz_ranges(
+            _f64ptr(wmins), _f64ptr(wmaxs), wmins.shape[0], dims, g,
+            budget, _i64ptr(out), cap),
+        budget)

@@ -39,6 +39,7 @@ class Query:
     sort_by: str | None = None           # attribute name
     sort_desc: bool = False
     max_features: int | None = None
+    crs: str | None = None               # output CRS; None = storage (4326)
     hints: dict = field(default_factory=dict)
 
     @classmethod
@@ -132,6 +133,11 @@ class QueryPlanner:
             properties = groups[group]
         if properties is not None:
             result_batch = _project(result_batch, properties)
+        if query.crs:
+            # result-side reprojection (QueryPlanner.scala:74-81)
+            from ..geometry.crs import reproject_batch
+            result_batch = reproject_batch(result_batch, query.crs)
+            explain(lambda: f"Reprojected to {query.crs}")
         explain.pop()
         return QueryResult(result_batch, positions, strategy, plan_ms, scan_ms)
 
@@ -169,10 +175,18 @@ class QueryPlanner:
             attr = name[5:]
             idx = store.attribute_index(attr)
             (a, kind, payload) = strategy.attr_values[0]
+            # covering secondary (dtg) window for the date tier; exactness
+            # comes from run()'s residual filter as always
+            sec_window = None
+            if strategy.intervals and idx.secondary is not None:
+                los = [iv[0] for iv in strategy.intervals]
+                his = [iv[1] for iv in strategy.intervals]
+                sec_window = (None if any(v is None for v in los) else min(los),
+                              None if any(v is None for v in his) else max(his))
             if kind == "equals":
-                return idx.query_equals(payload)
+                return idx.query_equals(payload, sec_window)
             if kind == "in":
-                return idx.query_in(payload)
+                return idx.query_in(payload, sec_window)
             if kind == "range":
                 lo, hi, lo_inc, hi_inc = payload
                 return idx.query_range(lo, hi, lo_inc, hi_inc)

@@ -180,9 +180,17 @@ class StrategyDecider:
 
         indexed = {a.name for a in sft.attributes if a.indexed}
         for attr, kind, payload in _collect_attr_predicates(f, indexed):
+            cost = self._attr_cost(attr, kind, payload)
+            # the date tier narrows equality/IN runs by the temporal
+            # fraction (tiered-range assembly,
+            # api/GeoMesaFeatureIndex.scala:248-338)
+            tiered = all_ivs if dtg and kind in ("equals", "in") else ()
+            if tiered:
+                cost *= self._temporal_fraction(all_ivs)
             out.append(FilterStrategy(
-                f"attr:{attr}", max(1.0, self._attr_cost(attr, kind, payload)),
-                attr_values=((attr, kind, payload),)))
+                f"attr:{attr}", max(1.0, cost),
+                attr_values=((attr, kind, payload),),
+                intervals=tiered))
 
         out.append(FilterStrategy("full", float(self.total)))
         return out

@@ -1,0 +1,119 @@
+"""Tiered attribute index: secondary date keys narrow equality/IN scans
+(the reference's AttributeIndexKeySpace + DateIndexKeySpace tier,
+api/GeoMesaFeatureIndex.scala:248-338)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.filters import evaluate_filter, parse_ecql
+from geomesa_tpu.index.attribute import AttributeIndex
+from geomesa_tpu.planning.planner import Query
+from geomesa_tpu.planning.strategy import StrategyDecider
+
+MS_2018 = 1514764800000
+DAY = 86_400_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(42)
+    n = 20_000
+    return {
+        "name": rng.choice(["a", "b", "c", "d", "e"], n),
+        "dtg": rng.integers(MS_2018, MS_2018 + 30 * DAY, n),
+    }
+
+
+def test_build_orders_secondary_within_runs(data):
+    idx = AttributeIndex.build("name", data["name"], secondary=data["dtg"])
+    # within each value run, secondary must be sorted
+    vals = idx.values
+    for v in np.unique(vals):
+        lo = np.searchsorted(vals, v, "left")
+        hi = np.searchsorted(vals, v, "right")
+        run = idx.secondary[lo:hi]
+        assert np.all(run[:-1] <= run[1:])
+
+
+@pytest.mark.parametrize("window", [
+    (MS_2018 + 5 * DAY, MS_2018 + 9 * DAY),
+    (None, MS_2018 + 2 * DAY),
+    (MS_2018 + 25 * DAY, None),
+    (MS_2018 + 40 * DAY, MS_2018 + 50 * DAY),  # empty
+])
+def test_equals_with_window_exact(data, window):
+    idx = AttributeIndex.build("name", data["name"], secondary=data["dtg"])
+    lo, hi = window
+    got = idx.query_equals("c", (lo, hi))
+    mask = data["name"] == "c"
+    if lo is not None:
+        mask &= data["dtg"] >= lo
+    if hi is not None:
+        mask &= data["dtg"] <= hi
+    np.testing.assert_array_equal(got, np.flatnonzero(mask))
+
+
+def test_in_with_window_exact(data):
+    idx = AttributeIndex.build("name", data["name"], secondary=data["dtg"])
+    lo, hi = MS_2018 + 3 * DAY, MS_2018 + 6 * DAY
+    got = idx.query_in(["a", "e"], (lo, hi))
+    mask = np.isin(data["name"], ["a", "e"]) & (data["dtg"] >= lo) & (data["dtg"] <= hi)
+    np.testing.assert_array_equal(got, np.flatnonzero(mask))
+
+
+def test_untier_matches_legacy(data):
+    flat = AttributeIndex.build("name", data["name"])
+    tiered = AttributeIndex.build("name", data["name"], secondary=data["dtg"])
+    np.testing.assert_array_equal(flat.query_equals("b"),
+                                  tiered.query_equals("b"))
+    np.testing.assert_array_equal(flat.query_range("b", "d"),
+                                  tiered.query_range("b", "d"))
+
+
+@pytest.fixture(scope="module")
+def store(data):
+    ds = TpuDataStore()
+    n = len(data["dtg"])
+    rng = np.random.default_rng(7)
+    ds.create_schema(
+        "tiered", "name:String:index=true,dtg:Date,*geom:Point")
+    ds.write("tiered", {
+        "name": data["name"],
+        "dtg": data["dtg"],
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(-10, 10, n)),
+    })
+    return ds
+
+
+def test_planner_end_to_end_attr_plus_time(store):
+    ecql = ("name = 'c' AND dtg DURING "
+            "2018-01-03T00:00:00Z/2018-01-05T00:00:00Z")
+    res = store.query_result("tiered", ecql)
+    st = store._store("tiered")
+    want = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+    np.testing.assert_array_equal(np.sort(res.positions), want)
+    assert res.strategy.index == "attr:name"
+    # the tier must carry the intervals into the strategy
+    assert res.strategy.intervals
+
+
+def test_tier_discounts_strategy_cost(store):
+    st = store._store("tiered")
+    decider = StrategyDecider(st.sft, st.stats_map(), len(st.batch))
+    plain = decider.decide(parse_ecql("name = 'c'"))
+    tiered = decider.decide(parse_ecql(
+        "name = 'c' AND dtg DURING 2018-01-03T00:00:00Z/2018-01-05T00:00:00Z"))
+    assert tiered.index == "attr:name"
+    assert tiered.cost < plain.cost
+
+
+def test_tier_narrows_candidates(store):
+    """The scan itself (pre-residual-filter) must return fewer candidates
+    with the window than without — the point of the tier."""
+    st = store._store("tiered")
+    idx = st.attribute_index("name")
+    full = idx.query_equals("c")
+    lo, hi = MS_2018 + 2 * DAY, MS_2018 + 4 * DAY
+    narrowed = idx.query_equals("c", (lo, hi))
+    assert 0 < len(narrowed) < len(full)

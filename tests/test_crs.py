@@ -1,0 +1,76 @@
+"""CRS transforms + query-result reprojection (QueryPlanner.scala:74-81
+analog)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.geometry import crs
+from geomesa_tpu.planning.planner import Query
+
+
+def test_known_mercator_values():
+    # equator/prime meridian → origin; lon 180 → world half-width
+    x, y = crs.transform(np.array([0.0, 180.0]), np.array([0.0, 0.0]),
+                         "EPSG:4326", "EPSG:3857")
+    np.testing.assert_allclose(x, [0.0, 20037508.342789244], rtol=1e-12)
+    np.testing.assert_allclose(y, [0.0, 0.0], atol=1e-9)
+
+
+def test_round_trip():
+    rng = np.random.default_rng(3)
+    lon = rng.uniform(-180, 180, 1000)
+    lat = rng.uniform(-85, 85, 1000)
+    mx, my = crs.transform(lon, lat, "4326", "3857")
+    lon2, lat2 = crs.transform(mx, my, "EPSG:3857", "CRS:84")
+    np.testing.assert_allclose(lon2, lon, atol=1e-9)
+    np.testing.assert_allclose(lat2, lat, atol=1e-9)
+
+
+def test_lat_clipped_at_cutoff():
+    _, my = crs.transform(np.array([0.0]), np.array([90.0]), "4326", "3857")
+    assert np.isfinite(my).all()
+
+
+def test_unknown_crs_raises():
+    with pytest.raises(ValueError, match="unknown CRS"):
+        crs.transform(np.zeros(1), np.zeros(1), "4326", "EPSG:9999")
+
+
+def test_register_custom_crs():
+    # trivial offset CRS
+    crs.register_crs("TEST:1",
+                     lambda x, y, xp: (x - 10.0, y),
+                     lambda x, y, xp: (x + 10.0, y))
+    x, y = crs.transform(np.array([5.0]), np.array([2.0]), "4326", "TEST:1")
+    np.testing.assert_allclose(x, [15.0])
+
+
+def test_query_reprojects_points_and_polygons():
+    ds = TpuDataStore()
+    ds.create_schema("pts", "name:String,*geom:Point")
+    ds.write("pts", {"name": ["a", "b"], "geom": ([0.0, 90.0], [0.0, 45.0])})
+    res = ds.query_result("pts", Query.of("INCLUDE", crs="EPSG:3857"))
+    x, y = res.batch.geom_xy()
+    np.testing.assert_allclose(x[1], 90.0 * 20037508.342789244 / 180.0)
+    assert abs(y[1]) > 5_000_000  # mercator meters, not degrees
+
+    from geomesa_tpu.geometry import geometry_from_wkt
+    ds.create_schema("polys", "name:String,*geom:Polygon")
+    ds.write("polys", {
+        "name": ["p"],
+        "geom": [geometry_from_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")],
+    })
+    res = ds.query_result("polys", Query.of("INCLUDE", crs="3857"))
+    g = res.batch.geoms
+    assert g.coords[:, 0].max() > 1_000_000  # meters
+    assert g.bbox[0, 2] > 1_000_000
+
+
+def test_reproject_noop_same_crs():
+    ds = TpuDataStore()
+    ds.create_schema("x", "name:String,*geom:Point")
+    ds.write("x", {"name": ["a"], "geom": ([1.0], [2.0])})
+    res = ds.query_result("x", Query.of("INCLUDE", crs="EPSG:4326"))
+    x, y = res.batch.geom_xy()
+    np.testing.assert_allclose([x[0], y[0]], [1.0, 2.0])
