@@ -112,4 +112,4 @@ def reproject_batch(batch, dst: str, src: str = EPSG_4326):
         geoms = replace(geoms, coords=np.stack([gx, gy], axis=1),
                         bbox=np.stack([bx0, by0, bx1, by1], axis=1))
     return FeatureBatch(batch.sft, cols, batch.ids, geoms,
-                        ids_explicit=True)
+                        ids_explicit=batch.ids_explicit)

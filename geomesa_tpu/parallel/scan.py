@@ -40,7 +40,8 @@ from ..ops.search import (
 )
 from .mesh import device_mesh, shard_batch
 
-__all__ = ["ShardedZ3Index", "sharded_range_count", "sharded_density"]
+__all__ = ["ShardedZ3Index", "sharded_range_count", "sharded_density",
+           "ring_range_counts"]
 
 
 class ShardedZ3Index:
@@ -107,6 +108,28 @@ class ShardedZ3Index:
             self.mesh, self.bins, self.z,
             jnp.asarray(plan.rbin), jnp.asarray(plan.rzlo),
             jnp.asarray(plan.rzhi))
+
+    def range_counts_ring(self, boxes, t_lo_ms: int, t_hi_ms: int,
+                          max_ranges: int = 2000) -> np.ndarray:
+        """Global per-range candidate counts via the ring-parallel scan
+        (ranges sharded + rotated, data stationary) — see
+        :func:`ring_range_counts`."""
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        if plan.num_ranges == 0:
+            return np.empty(0, dtype=np.int64)
+        n = self.mesh.devices.size
+        pad = (-plan.num_ranges) % n
+        # padding ranges are empty (lo > hi) so they count nothing
+        rbin = np.concatenate([plan.rbin, np.full(pad, -2, plan.rbin.dtype)])
+        rzlo = np.concatenate([plan.rzlo, np.ones(pad, plan.rzlo.dtype)])
+        rzhi = np.concatenate([plan.rzhi, np.zeros(pad, plan.rzhi.dtype)])
+        spec = NamedSharding(self.mesh, P("shard"))
+        counts = ring_range_counts(
+            self.mesh, self.bins, self.z,
+            jax.device_put(jnp.asarray(rbin), spec),
+            jax.device_put(jnp.asarray(rzlo), spec),
+            jax.device_put(jnp.asarray(rzhi), spec))
+        return counts[: plan.num_ranges]
 
     def query(self, boxes, t_lo_ms: int, t_hi_ms: int,
               max_ranges: int = 2000, capacity: int = 1 << 15) -> np.ndarray:
@@ -203,6 +226,60 @@ def sharded_range_count(mesh, bins, z, rbin, rzlo, rzhi) -> int:
         return jax.lax.psum(local[None], "shard")
 
     return int(np.asarray(jax.jit(count)(bins, z, rbin, rzlo, rzhi))[0])
+
+
+def ring_range_counts(mesh, bins, z, rbin, rzlo, rzhi) -> np.ndarray:
+    """Per-range candidate counts with BOTH data and ranges sharded —
+    the ring-parallel scan (SURVEY.md §5 'long-context' mapping).
+
+    The replicated-plan path (:func:`sharded_range_count`) broadcasts
+    every query range to every device; for huge multi-window plans
+    (tube-select over thousands of track segments, kNN ring batches,
+    planner cost probes over dense bin sets) that replication can exceed
+    a device's HBM.  Here each device keeps its sorted data shard
+    *stationary* and holds 1/N of the ranges; each of N steps seeks the
+    resident range block against the local segment, adds into an
+    accumulator that travels WITH the block, and rotates block +
+    accumulator to the neighbor via ``ppermute`` over ICI — the ring
+    attention communication pattern (blockwise KV rotation) applied to
+    range scanning.  After N hops every block is home with global
+    per-range counts.
+
+    Args are device arrays: ``bins``/``z`` sharded over features,
+    ``rbin``/``rzlo``/``rzhi`` sharded over ranges (pad to a multiple of
+    the mesh size with empty ranges, e.g. lo>hi).  Returns the global
+    per-range counts as a host array aligned with the input range order.
+    """
+    n = mesh.devices.size
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard")),
+        out_specs=P("shard"),
+    )
+    def ring(local_bins, local_z, rb, rlo, rhi):
+        # derive the zero accumulator from a sharded operand so it carries
+        # the device-varying type shard_map's scan requires of a carried
+        # value that gets ppermuted
+        acc = (rb * 0).astype(jnp.int64)
+
+        def step(carry, _):
+            rb, rlo, rhi, acc = carry
+            starts = searchsorted2(local_bins, local_z, rb, rlo, side="left")
+            ends = searchsorted2(local_bins, local_z, rb, rhi, side="right")
+            acc = acc + jnp.maximum(ends - starts, 0).astype(jnp.int64)
+            rb = jax.lax.ppermute(rb, "shard", perm)
+            rlo = jax.lax.ppermute(rlo, "shard", perm)
+            rhi = jax.lax.ppermute(rhi, "shard", perm)
+            acc = jax.lax.ppermute(acc, "shard", perm)
+            return (rb, rlo, rhi, acc), None
+
+        (rb, rlo, rhi, acc), _ = jax.lax.scan(
+            step, (rb, rlo, rhi, acc), None, length=n)
+        return acc
+
+    return np.asarray(jax.jit(ring)(bins, z, rbin, rzlo, rzhi))
 
 
 def sharded_density(mesh, x, y, dtg, valid, weights, boxes,

@@ -40,10 +40,9 @@ class MergedDataStoreView:
         for store, scope in zip(self.stores, self.filters):
             sq = q
             if scope is not None:
-                sq = Query(filter=And((q.filter, scope)),
-                           properties=q.properties, sort_by=q.sort_by,
-                           sort_desc=q.sort_desc,
-                           max_features=q.max_features, hints=dict(q.hints))
+                from dataclasses import replace
+                sq = replace(q, filter=And((q.filter, scope)),
+                             hints=dict(q.hints))
             out = store.query(name, sq)
             if len(out):
                 parts.append(out)

@@ -74,3 +74,31 @@ def test_reproject_noop_same_crs():
     res = ds.query_result("x", Query.of("INCLUDE", crs="EPSG:4326"))
     x, y = res.batch.geom_xy()
     np.testing.assert_allclose([x[0], y[0]], [1.0, 2.0])
+
+
+def test_merged_view_propagates_crs():
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.filters import parse_ecql
+    from geomesa_tpu.views import MergedDataStoreView
+
+    ds = TpuDataStore()
+    ds.create_schema("pts2", "name:String,*geom:Point")
+    ds.write("pts2", {"name": ["a"], "geom": ([90.0], [45.0])})
+    view = MergedDataStoreView([ds], filters=[parse_ecql("INCLUDE")])
+    out = view.query("pts2", Query.of("INCLUDE", crs="EPSG:3857"))
+    x, _ = out.geom_xy()
+    assert abs(x[0]) > 1e6  # mercator meters, not degrees
+
+
+def test_reproject_preserves_ids_explicit_flag():
+    from geomesa_tpu.features import FeatureBatch
+    from geomesa_tpu.features.feature_type import parse_spec
+    import numpy as np
+
+    sft = parse_spec("p", "name:String,*geom:Point")
+    batch = FeatureBatch.from_dict(
+        sft, {"name": np.array(["a"], object),
+              "geom": (np.array([1.0]), np.array([2.0]))})
+    assert not batch.ids_explicit
+    out = crs.reproject_batch(batch, "EPSG:3857")
+    assert out.ids_explicit == batch.ids_explicit

@@ -106,3 +106,39 @@ def test_sharded_query_exact(sharded, data):
     # tiny capacity forces the overflow-retry path
     hits2 = idx.query([box], tlo, thi, capacity=8)
     assert np.array_equal(np.sort(hits2), np.sort(brute))
+
+
+def test_ring_range_counts_match_replicated(sharded):
+    """Ring-rotated sharded-range counts must equal the replicated-plan
+    psum count in aggregate, and per-range sums must be consistent."""
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018 + 2 * 86_400_000, MS_2018 + 9 * 86_400_000
+    per_range = sharded.range_counts_ring([box], tlo, thi)
+    total = sharded.range_count([box], tlo, thi)
+    assert per_range.sum() == total
+    assert (per_range >= 0).all()
+    # range count not divisible by mesh size exercises the padding path
+    assert len(per_range) >= 1
+
+
+def test_ring_range_counts_oracle(sharded, data):
+    """Per-range counts vs a host brute-force count over the same plan."""
+    from geomesa_tpu.index.z3 import plan_z3_query
+    from geomesa_tpu.curve import TimePeriod, to_binned_time
+    from geomesa_tpu.curve.sfc import z3_sfc
+
+    x, y, t = data
+    box = (-74.3, 40.2, -73.6, 41.7)
+    tlo, thi = MS_2018 + 86_400_000, MS_2018 + 12 * 86_400_000
+    plan = plan_z3_query([box], tlo, thi, TimePeriod.WEEK, 512)
+    per_range = sharded.range_counts_ring([box], tlo, thi, max_ranges=512)
+    assert len(per_range) == plan.num_ranges
+
+    sfc = z3_sfc(TimePeriod.WEEK)
+    bins, offs = to_binned_time(np.asarray(t, np.int64), TimePeriod.WEEK)
+    z = np.asarray(sfc.index(x, y, offs.astype(np.float64), xp=np))
+    want = np.zeros(plan.num_ranges, dtype=np.int64)
+    for i in range(plan.num_ranges):
+        want[i] = np.count_nonzero(
+            (bins == plan.rbin[i]) & (z >= plan.rzlo[i]) & (z <= plan.rzhi[i]))
+    np.testing.assert_array_equal(per_range, want)
