@@ -38,10 +38,14 @@ __all__ = ["FileSystemDataStore"]
 
 
 class _TypeStorage:
-    def __init__(self, root: str, sft: FeatureType, scheme: PartitionScheme):
+    def __init__(self, root: str, sft: FeatureType, scheme: PartitionScheme,
+                 encoding: str = "parquet"):
+        if encoding not in ("parquet", "orc"):
+            raise ValueError(f"unsupported encoding {encoding!r}")
         self.root = root
         self.sft = sft
         self.scheme = scheme
+        self.encoding = encoding
         self._lock = threading.Lock()
         self._meta_path = os.path.join(root, "metadata.json")
 
@@ -51,7 +55,20 @@ class _TypeStorage:
             with open(self._meta_path) as f:
                 return json.load(f)
         return {"spec": self.sft.spec_string(),
-                "scheme": self.scheme.to_config(), "partitions": {}}
+                "scheme": self.scheme.to_config(),
+                "encoding": self.encoding, "partitions": {}}
+
+    # -- file codec (parquet or ORC, the FSDS storage formats) ------------
+    def _write_file(self, batch: FeatureBatch, path: str) -> None:
+        from ..io.export import to_orc, to_parquet
+
+        (to_orc if self.encoding == "orc" else to_parquet)(batch, path)
+
+    def _read_file(self, path: str) -> FeatureBatch:
+        from ..io.export import from_orc, from_parquet
+
+        return (from_orc if self.encoding == "orc" else from_parquet)(
+            path, self.sft)
 
     def _save_meta(self, meta: dict) -> None:
         tmp = self._meta_path + ".tmp"
@@ -61,8 +78,6 @@ class _TypeStorage:
 
     # -- io ---------------------------------------------------------------
     def write(self, batch: FeatureBatch) -> None:
-        from ..io.export import to_parquet
-
         if len(batch) == 0:
             return
         names = self.scheme.partitions_for_batch(self.sft, batch)
@@ -77,8 +92,8 @@ class _TypeStorage:
                 sub = batch.take(order[s:e])
                 pdir = os.path.join(self.root, part)
                 os.makedirs(pdir, exist_ok=True)
-                fname = f"{uuid.uuid4().hex[:12]}.parquet"
-                to_parquet(sub, os.path.join(pdir, fname))
+                fname = f"{uuid.uuid4().hex[:12]}.{self.encoding}"
+                self._write_file(sub, os.path.join(pdir, fname))
                 meta["partitions"].setdefault(part, []).append(
                     {"file": fname, "count": len(sub)})
             self._save_meta(meta)
@@ -115,12 +130,10 @@ class _TypeStorage:
     def read_partition(self, name: str) -> FeatureBatch | None:
         """All of one partition's files as a single batch (no filtering) —
         the per-split read used by the RDD provider."""
-        from ..io.export import from_parquet
-
         meta = self._load_meta()
         entries = meta["partitions"].get(name, [])
-        parts = [from_parquet(os.path.join(self.root, name, e["file"]),
-                              self.sft) for e in entries]
+        parts = [self._read_file(os.path.join(self.root, name, e["file"]))
+                 for e in entries]
         if not parts:
             return None
         out = parts[0]
@@ -129,15 +142,13 @@ class _TypeStorage:
         return out
 
     def query(self, query) -> FeatureBatch:
-        from ..io.export import from_parquet
-
         q = query if isinstance(query, Query) else Query.of(query)
         meta = self._load_meta()
         parts = []
         for part in self._select_partitions(q.filter):
             for entry in meta["partitions"][part]:
                 path = os.path.join(self.root, part, entry["file"])
-                batch = from_parquet(path, self.sft)
+                batch = self._read_file(path)
                 mask = evaluate_filter(q.filter, batch)
                 if mask.any():
                     parts.append(batch.take(np.flatnonzero(mask)))
@@ -152,21 +163,19 @@ class _TypeStorage:
 
     def compact(self, partition: str) -> int:
         """Merge a partition's files into one; returns resulting file count."""
-        from ..io.export import from_parquet, to_parquet
-
         with self._lock:
             meta = self._load_meta()
             files = meta["partitions"].get(partition, [])
             if len(files) <= 1:
                 return len(files)
             pdir = os.path.join(self.root, partition)
-            batches = [from_parquet(os.path.join(pdir, f["file"]), self.sft)
+            batches = [self._read_file(os.path.join(pdir, f["file"]))
                        for f in files]
             merged = batches[0]
             for b in batches[1:]:
                 merged = merged.concat(b)
-            fname = f"{uuid.uuid4().hex[:12]}.parquet"
-            to_parquet(merged, os.path.join(pdir, fname))
+            fname = f"{uuid.uuid4().hex[:12]}.{self.encoding}"
+            self._write_file(merged, os.path.join(pdir, fname))
             for f in files:
                 os.remove(os.path.join(pdir, f["file"]))
             meta["partitions"][partition] = [
@@ -176,7 +185,8 @@ class _TypeStorage:
 
 
 class FileSystemDataStore:
-    """Multi-type partitioned parquet store rooted at a directory."""
+    """Multi-type partitioned parquet/ORC store rooted at a directory
+    (FSDS analog; geomesa-fs parquet + orc storage formats)."""
 
     def __init__(self, root: str):
         self.root = root
@@ -193,10 +203,12 @@ class FileSystemDataStore:
                 sft = parse_spec(name, m["spec"])
                 self._types[name] = _TypeStorage(
                     os.path.join(self.root, name), sft,
-                    scheme_from_config(m["scheme"]))
+                    scheme_from_config(m["scheme"]),
+                    encoding=m.get("encoding", "parquet"))
 
     def create_schema(self, name: str, spec: str,
-                      scheme: PartitionScheme | dict | None = None) -> FeatureType:
+                      scheme: PartitionScheme | dict | None = None,
+                      encoding: str = "parquet") -> FeatureType:
         if name in self._types:
             raise ValueError(f"schema {name!r} already exists")
         sft = parse_spec(name, spec)
@@ -204,7 +216,8 @@ class FileSystemDataStore:
             scheme = scheme_from_config({"scheme": "datetime"})
         elif isinstance(scheme, dict):
             scheme = scheme_from_config(scheme)
-        ts = _TypeStorage(os.path.join(self.root, name), sft, scheme)
+        ts = _TypeStorage(os.path.join(self.root, name), sft, scheme,
+                          encoding=encoding)
         os.makedirs(ts.root, exist_ok=True)
         ts._save_meta(ts._load_meta())
         self._types[name] = ts

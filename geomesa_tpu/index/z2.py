@@ -126,8 +126,9 @@ def _query_packed(z, pos, x, y, rzlo, rzhi, ixy, boxes, capacity: int):
         & (yc[:, None] <= boxes[None, :, 3])
     ).any(axis=1)
     mask = valid & in_box_int & in_box_exact
-    packed = jnp.where(mask, posc.astype(jnp.int64), jnp.int64(-1))
-    return jnp.concatenate([total[None].astype(jnp.int64), packed])
+    # int32 wire format — see z3._query_packed
+    packed = jnp.where(mask, posc.astype(jnp.int32), jnp.int32(-1))
+    return jnp.concatenate([total[None].astype(jnp.int32), packed])
 
 
 @partial(jax.jit, static_argnames=("sfc",))

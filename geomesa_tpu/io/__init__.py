@@ -11,10 +11,12 @@ columns, and exports ride pyarrow (Arrow/Parquet) instead of row codecs.
 from .bin_encoder import decode_bin, encode_bin
 from .converters import Converter, EvaluationContext, converter_from_config
 from .export import (
+    from_orc,
     from_parquet,
     to_arrow,
     to_csv,
     to_geojson,
+    to_orc,
     to_parquet,
 )
 
@@ -22,4 +24,5 @@ __all__ = [
     "Converter", "EvaluationContext", "converter_from_config",
     "encode_bin", "decode_bin",
     "to_arrow", "to_csv", "to_geojson", "to_parquet", "from_parquet",
+    "to_orc", "from_orc",
 ]

@@ -16,7 +16,8 @@ from ..features.batch import FeatureBatch
 from ..features.feature_type import FeatureType, parse_spec
 from ..geometry.wkt import geometry_from_wkt, geometry_to_wkt
 
-__all__ = ["to_arrow", "to_parquet", "from_parquet", "to_csv", "to_geojson"]
+__all__ = ["to_arrow", "to_parquet", "from_parquet", "to_orc", "from_orc",
+           "to_csv", "to_geojson"]
 
 
 def _geom_wkt_column(batch: FeatureBatch) -> np.ndarray | None:
@@ -88,6 +89,33 @@ def from_parquet(path: str, sft: FeatureType | None = None) -> FeatureBatch:
         if spec is None:
             raise ValueError("parquet file lacks geomesa_tpu schema metadata; pass sft")
         sft = parse_spec(name.decode(), spec.decode())
+    return _table_to_batch(table, sft)
+
+
+def to_orc(batch: FeatureBatch, path: str) -> None:
+    """ORC export (the FSDS ORC storage format,
+    geomesa-fs/.../orc/).  ORC does not carry arrow schema metadata, so
+    reading back requires the schema (the FSDS metadata supplies it)."""
+    import pyarrow as pa
+    import pyarrow.orc as orc
+
+    table = to_arrow(batch)
+    # ORC timestamps don't round-trip epoch-millis; store dates as int64
+    # (the reader casts date columns to int64 anyway)
+    for i, f in enumerate(table.schema):
+        if pa.types.is_timestamp(f.type):
+            table = table.set_column(
+                i, f.name, table.column(i).cast("int64"))
+    orc.write_table(table, path)
+
+
+def from_orc(path: str, sft: FeatureType) -> FeatureBatch:
+    import pyarrow.orc as orc
+
+    return _table_to_batch(orc.ORCFile(path).read(), sft)
+
+
+def _table_to_batch(table, sft: FeatureType) -> FeatureBatch:
     data: dict = {}
     cols = {c: table.column(c) for c in table.column_names}
     extra_bbox: dict = {}

@@ -22,10 +22,11 @@ def run_packed_query(dispatch, capacity: int):
     """Run a packed one-dispatch scan with adaptive capacity.
 
     ``dispatch(capacity) -> np.ndarray`` must return the wire vector
-    ``[total, pos_0|-1, pos_1|-1, …]`` (int64).  If ``total`` exceeds the
-    capacity the gather truncated — regrow to the next power of two and
-    retry (rare; capacity is sticky with the caller).  Returns
-    ``(sorted_positions, capacity)``.
+    ``[total, pos_0|-1, pos_1|-1, …]`` (any integer dtype; int32 keeps
+    the transfer small).  If ``total`` exceeds the capacity the gather
+    truncated — regrow to the next power of two and retry (rare;
+    capacity is sticky with the caller).  Returns
+    ``(sorted_positions int64, capacity)``.
     """
     import numpy as np
     while True:
@@ -33,7 +34,7 @@ def run_packed_query(dispatch, capacity: int):
         total = int(out[0])
         if total <= capacity:
             packed = out[1:]
-            return np.sort(packed[packed >= 0]), capacity
+            return np.sort(packed[packed >= 0]).astype(np.int64), capacity
         capacity = gather_capacity(total)
 
 
