@@ -20,8 +20,8 @@ from ..curve.sfc import Z2SFC, z2_sfc
 from ..curve.zorder import deinterleave2
 from ..config import DEFAULT_MAX_RANGES
 from ..ops.search import (
-    expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
-    run_packed_query,
+    expand_ranges, gather_capacity, pack_wire, pad_boxes, pad_pow2,
+    pad_ranges, run_packed_query,
 )
 
 __all__ = ["Z2PointIndex", "Z2QueryPlan", "plan_z2_query"]
@@ -57,11 +57,12 @@ def plan_z2_query(boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> Z2QueryPlan:
     return Z2QueryPlan(rzlo=zr[:, 0], rzhi=zr[:, 1], ixy=ixy, boxes=boxes)
 
 
-@partial(jax.jit, static_argnames=("capacity",))
+@partial(jax.jit, static_argnames=("capacity", "pos_bits"))
 def _query_many_packed(z, pos, x, y, rzlo, rzhi, rqid, ixy, boxes, bqid,
-                       capacity: int):
+                       capacity: int, pos_bits: int = 40):
     """Batched multi-box-set scan: Q independent queries in one dispatch
-    (see z3._query_many_packed for the packed qid<<40|pos protocol)."""
+    (see z3._query_many_packed for the packed qid<<pos_bits|pos protocol
+    and the int32/int64 wire choice)."""
     starts = jnp.searchsorted(z, rzlo, side="left")
     ends = jnp.searchsorted(z, rzhi, side="right")
     counts = jnp.maximum(ends - starts, 0)
@@ -91,9 +92,9 @@ def _query_many_packed(z, pos, x, y, rzlo, rzhi, rqid, ixy, boxes, bqid,
         & (yc[:, None] <= boxes[None, :, 3])
     ).any(axis=1)
     mask = valid & in_box_int & in_box_exact
-    coded = (cqid.astype(jnp.int64) << jnp.int64(40)) | posc.astype(jnp.int64)
-    packed = jnp.where(mask, coded, jnp.int64(-1))
-    return jnp.concatenate([total[None].astype(jnp.int64), packed])
+    dt = jnp.int32 if pos_bits < 31 else jnp.int64
+    coded = ((cqid.astype(dt) << dt(pos_bits)) | posc.astype(dt))
+    return pack_wire(total, coded, mask, dt)
 
 
 @partial(jax.jit, static_argnames=("capacity",))
@@ -127,8 +128,7 @@ def _query_packed(z, pos, x, y, rzlo, rzhi, ixy, boxes, capacity: int):
     ).any(axis=1)
     mask = valid & in_box_int & in_box_exact
     # int32 wire format — see z3._query_packed
-    packed = jnp.where(mask, posc.astype(jnp.int32), jnp.int32(-1))
-    return jnp.concatenate([total[None].astype(jnp.int32), packed])
+    return pack_wire(total, posc, mask, jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("sfc",))
@@ -193,7 +193,8 @@ class Z2PointIndex:
         n_q = len(boxes_list)
         if n_q == 0 or len(self) == 0:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
-        per = max(1, max_ranges // n_q)
+        # per-window scan-ranges budget (see z3.query_many)
+        per = max_ranges
         rzlo, rzhi, rqid, ixy, bxs, bqid = [], [], [], [], [], []
         for q, boxes in enumerate(boxes_list):
             plan = plan_z2_query(boxes, per)
@@ -216,16 +217,20 @@ class Z2PointIndex:
             pad_pow2(sum(len(b) for b in bxs), minimum=1),
             np.concatenate(bqid))
 
+        from .z3 import coded_pos_bits
+
+        pos_bits = coded_pos_bits(len(self), n_q)
+
         def dispatch(capacity):
             return _query_many_packed(
                 self.z, self.pos, self.x, self.y,
                 jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
                 jnp.asarray(r["rqid"]), jnp.asarray(ixy_c),
                 jnp.asarray(boxes_c), jnp.asarray(bqid_c),
-                capacity=capacity,
+                capacity=capacity, pos_bits=pos_bits,
             )
 
         coded, self._capacity = run_packed_query(dispatch, self._capacity)
-        qids = coded >> 40
-        positions = coded & ((np.int64(1) << 40) - 1)
+        qids = coded >> pos_bits
+        positions = coded & ((np.int64(1) << pos_bits) - 1)
         return [np.unique(positions[qids == q]) for q in range(n_q)]

@@ -38,8 +38,8 @@ from ..curve.sfc import Z3SFC, z3_sfc
 from ..curve.zorder import deinterleave3
 from ..config import DEFAULT_MAX_RANGES, QueryProperties
 from ..ops.search import (
-    expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
-    run_packed_query, searchsorted2,
+    expand_ranges, gather_capacity, pack_wire, pad_boxes, pad_pow2,
+    pad_ranges, run_packed_query, searchsorted2,
 )
 
 
@@ -256,25 +256,26 @@ def _query_packed(
     # int32 wire format: positions are int32 throughout (build sorts an
     # int32 iota), and the device→host link pays ~125ms/MB — halving the
     # packed bytes halves the dominant cost of a large-capacity query
-    packed = jnp.where(mask, posc.astype(jnp.int32), jnp.int32(-1))
-    return jnp.concatenate([total[None].astype(jnp.int32), packed])
+    return pack_wire(total, posc, mask, jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("capacity",))
+@partial(jax.jit, static_argnames=("capacity", "pos_bits"))
 def _query_many_packed(
     bins, z, pos, x, y, dtg,
     rbin, rzlo, rzhi, rtlo, rthi, rqid,
     ixy, boxes, bqid, qtlo, qthi,
-    capacity: int,
+    capacity: int, pos_bits: int = 40,
 ):
     """Batched multi-window scan: Q independent bbox+time queries in ONE
     dispatch (the reference's BatchScanner over many range sets,
     accumulated per query).  Each covering range and each box carries its
     owning query id; a candidate only matches boxes/time bounds of its own
-    query.  Returns ``[total, (qid << 40 | pos)|-1, …]`` — one transfer
-    decodes into per-query hit lists.  This amortizes the ~100ms remote
-    dispatch round trip across e.g. a tube-select's per-segment windows or
-    a kNN's expanding rings.
+    query.  Returns ``[total, (qid << pos_bits | pos)|-1, …]`` — one
+    transfer decodes into per-query hit lists; when qid and pos together
+    fit 31 bits the wire vector is int32 (halving the dominant
+    device→host transfer, ~125ms/MB), else int64.  This amortizes the
+    ~100ms remote dispatch round trip across e.g. a tube-select's
+    per-segment windows or a kNN's expanding rings.
     """
     starts = searchsorted2(bins, z, rbin, rzlo, side="left")
     ends = searchsorted2(bins, z, rbin, rzhi, side="right")
@@ -288,9 +289,18 @@ def _query_many_packed(
         zc, rtlo[rid], rthi[rid], ixy, boxes,
         x[posc], y[posc], dtg[posc], 0, 0,
         cqid=cqid, bqid=bqid, qtlo=qtlo, qthi=qthi)
-    coded = (cqid.astype(jnp.int64) << jnp.int64(40)) | posc.astype(jnp.int64)
-    packed = jnp.where(mask, coded, jnp.int64(-1))
-    return jnp.concatenate([total[None].astype(jnp.int64), packed])
+    dt = jnp.int32 if pos_bits < 31 else jnp.int64
+    coded = ((cqid.astype(dt) << dt(pos_bits)) | posc.astype(dt))
+    return pack_wire(total, coded, mask, dt)
+
+
+def coded_pos_bits(n_rows: int, n_queries: int) -> int:
+    """Wire coding for multi-window scans: bits reserved for the position
+    field.  Prefers an int32-fitting layout (qid_bits + pos_bits <= 31);
+    falls back to the 40-bit int64 layout for huge shards."""
+    pos_bits = max(1, int(np.ceil(np.log2(max(2, n_rows)))))
+    qid_bits = max(1, int(np.ceil(np.log2(max(2, n_queries)))))
+    return pos_bits if pos_bits + qid_bits <= 31 else 40
 
 
 #: tri-state: None = untried, True = pallas scan works on this backend,
@@ -420,7 +430,11 @@ class Z3PointIndex:
         n_q = len(windows)
         if n_q == 0 or len(self) == 0:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
-        per_range = max(1, max_ranges // n_q)
+        # the scan-ranges target applies PER window, as in the reference
+        # (each window is an independent scan with its own budget): finer
+        # covering ranges cost a bigger searchsorted batch (cheap) but
+        # shrink the candidate gather + transfer (the dominant cost)
+        per_range = max_ranges
         rbin, rzlo, rzhi, rtlo, rthi, rqid = [], [], [], [], [], []
         ixy, boxes, bqid = [], [], []
         qtlo = np.empty(n_q, dtype=np.int64)
@@ -460,12 +474,15 @@ class Z3PointIndex:
             jnp.asarray(qtlo), jnp.asarray(qthi),
         )
 
+        pos_bits = coded_pos_bits(len(self), n_q)
+
         def dispatch(capacity):
-            return _query_many_packed(*args, capacity=capacity)
+            return _query_many_packed(*args, capacity=capacity,
+                                      pos_bits=pos_bits)
 
         coded, self._capacity = run_packed_query(dispatch, self._capacity)
-        qids = coded >> 40
-        positions = coded & ((np.int64(1) << 40) - 1)
+        qids = coded >> pos_bits
+        positions = coded & ((np.int64(1) << pos_bits) - 1)
         out = []
         for q in range(n_q):
             hits = positions[qids == q]

@@ -15,25 +15,46 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["searchsorted2", "expand_ranges", "gather_capacity"]
+__all__ = ["searchsorted2", "expand_ranges", "gather_capacity",
+           "pack_wire", "run_packed_query"]
+
+#: bits per word of the split candidate total in the wire header
+_TOTAL_SPLIT = 30
+
+
+def pack_wire(total, values, mask, dt):
+    """Encode one scan's result as the packed wire vector
+    ``[total_hi, total_lo, v_0|-1, v_1|-1, …]`` in dtype ``dt``.
+
+    The device→host link costs ~125ms/MB, so values travel as int32
+    whenever they fit (positions, or qid<<pos_bits|pos codes that fit 31
+    bits).  The candidate ``total`` — which can legitimately exceed 2^31
+    when overlapping covering ranges double-count a large gather — is
+    split into two 30-bit words so the int32 wire can never wrap it into
+    a false "fits" signal (overflow detection depends on it).
+    """
+    head = jnp.stack([(total >> _TOTAL_SPLIT).astype(dt),
+                      (total & ((1 << _TOTAL_SPLIT) - 1)).astype(dt)])
+    packed = jnp.where(mask, values.astype(dt), dt(-1))
+    return jnp.concatenate([head, packed])
 
 
 def run_packed_query(dispatch, capacity: int):
     """Run a packed one-dispatch scan with adaptive capacity.
 
-    ``dispatch(capacity) -> np.ndarray`` must return the wire vector
-    ``[total, pos_0|-1, pos_1|-1, …]`` (any integer dtype; int32 keeps
-    the transfer small).  If ``total`` exceeds the capacity the gather
+    ``dispatch(capacity) -> np.ndarray`` must return a
+    :func:`pack_wire` vector (any integer dtype; int32 keeps the
+    transfer small).  If ``total`` exceeds the capacity the gather
     truncated — regrow to the next power of two and retry (rare;
     capacity is sticky with the caller).  Returns
-    ``(sorted_positions int64, capacity)``.
+    ``(sorted_values int64, capacity)``.
     """
     import numpy as np
     while True:
         out = np.asarray(dispatch(capacity))
-        total = int(out[0])
+        total = (int(out[0]) << _TOTAL_SPLIT) | int(out[1])
         if total <= capacity:
-            packed = out[1:]
+            packed = out[2:]
             return np.sort(packed[packed >= 0]).astype(np.int64), capacity
         capacity = gather_capacity(total)
 

@@ -60,3 +60,58 @@ def test_expand_ranges_exact_capacity():
     idx, valid, _ = expand_ranges(starts, counts, capacity=4)
     assert np.asarray(valid).all()
     np.testing.assert_array_equal(np.asarray(idx), [0, 1, 5, 6])
+
+
+def test_coded_pos_bits_boundaries():
+    from geomesa_tpu.index.z3 import coded_pos_bits
+
+    # 20 pos bits + 11 qid bits = 31 → int32-eligible layout
+    assert coded_pos_bits(1 << 20, 1 << 11) == 20
+    # one more pos bit overflows 31 → int64 fallback layout
+    assert coded_pos_bits(1 << 21, 1 << 11) == 40
+    assert coded_pos_bits(2, 2) == 1
+    assert coded_pos_bits((1 << 40), 2) == 40
+
+
+def test_query_many_int64_wire_path(monkeypatch):
+    """Force the 40-bit int64 coding and check exactness (the layout used
+    for shards too big for the int32 wire)."""
+    import numpy as np
+
+    from geomesa_tpu.index import z3 as z3mod
+
+    monkeypatch.setattr(z3mod, "coded_pos_bits", lambda n, q: 40)
+    rng = np.random.default_rng(8)
+    n = 20_000
+    ms = 1514764800000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(ms, ms + 14 * 86_400_000, n)
+    idx = z3mod.Z3PointIndex.build(x, y, t, period="week")
+    windows = [
+        ([(-74.5, 40.5, -73.5, 41.5)], ms, ms + 7 * 86_400_000),
+        ([(-74.2, 40.1, -73.8, 40.9)], ms + 86_400_000, ms + 3 * 86_400_000),
+    ]
+    out = idx.query_many(windows)
+    for (boxes, lo, hi), hits in zip(windows, out):
+        b = boxes[0]
+        want = np.flatnonzero(
+            (x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+            & (t >= lo) & (t <= hi))
+        np.testing.assert_array_equal(hits, want)
+
+
+def test_pack_wire_total_survives_int32(monkeypatch):
+    """A candidate total ≥ 2^31 must survive the int32 wire (split-word
+    header) so capacity overflow is detected, not silently wrapped."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from geomesa_tpu.ops.search import _TOTAL_SPLIT, pack_wire
+
+    big = (1 << 31) + 12345
+    wire = np.asarray(pack_wire(
+        jnp.int64(big), jnp.arange(4, dtype=jnp.int32),
+        jnp.ones(4, dtype=bool), jnp.int32))
+    decoded = (int(wire[0]) << _TOTAL_SPLIT) | int(wire[1])
+    assert decoded == big
