@@ -100,18 +100,19 @@ def main():
     batched_dt = (time.perf_counter() - t0) / 5
     batched_hits = int(sum(len(b) for b in batched))
 
-    # density: Pallas MXU one-hot histogram over the scan window
-    from geomesa_tpu.ops.pallas_kernels import density_grid_pallas
+    # density histogram (auto: sorted-segment at this N; Pallas MXU
+    # one-hot for small batches)
+    from geomesa_tpu.ops.density import density_grid_auto
     import jax.numpy as jnp
     dmask = jnp.ones(N, dtype=bool)
     dw = jnp.ones(N, dtype=jnp.float32)
-    grid = density_grid_pallas(xd, yd, dw, dmask,
-                               (-180.0, -90.0, 180.0, 90.0), 256, 128)
+    grid = density_grid_auto(xd, yd, dw, dmask,
+                             (-180.0, -90.0, 180.0, 90.0), 256, 128)
     _ = np.asarray(grid)  # warm
     t0 = time.perf_counter()
     for _ in range(5):
-        grid = density_grid_pallas(xd, yd, dw, dmask,
-                                   (-180.0, -90.0, 180.0, 90.0), 256, 128)
+        grid = density_grid_auto(xd, yd, dw, dmask,
+                                 (-180.0, -90.0, 180.0, 90.0), 256, 128)
         _ = np.asarray(grid[:1, :1])
     density_dt = (time.perf_counter() - t0) / 5
 

@@ -20,8 +20,8 @@ from ..curve.sfc import Z2SFC, z2_sfc
 from ..curve.zorder import deinterleave2
 from ..config import DEFAULT_MAX_RANGES
 from ..ops.search import (
-    expand_ranges, gather_capacity, pack_wire, pad_boxes, pad_pow2,
-    pad_ranges, run_packed_query,
+    coded_pos_bits, expand_ranges, gather_capacity, pack_wire, pad_boxes,
+    pad_pow2, pad_ranges, run_packed_query, wire_dtype,
 )
 
 __all__ = ["Z2PointIndex", "Z2QueryPlan", "plan_z2_query"]
@@ -92,7 +92,7 @@ def _query_many_packed(z, pos, x, y, rzlo, rzhi, rqid, ixy, boxes, bqid,
         & (yc[:, None] <= boxes[None, :, 3])
     ).any(axis=1)
     mask = valid & in_box_int & in_box_exact
-    dt = jnp.int32 if pos_bits < 31 else jnp.int64
+    dt = wire_dtype(pos_bits)
     coded = ((cqid.astype(dt) << dt(pos_bits)) | posc.astype(dt))
     return pack_wire(total, coded, mask, dt)
 
@@ -193,11 +193,10 @@ class Z2PointIndex:
         n_q = len(boxes_list)
         if n_q == 0 or len(self) == 0:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
-        # per-window scan-ranges budget (see z3.query_many)
-        per = max_ranges
         rzlo, rzhi, rqid, ixy, bxs, bqid = [], [], [], [], [], []
         for q, boxes in enumerate(boxes_list):
-            plan = plan_z2_query(boxes, per)
+            # per-window scan-ranges budget (see z3.query_many)
+            plan = plan_z2_query(boxes, max_ranges)
             if plan.num_ranges == 0:
                 continue
             rzlo.append(plan.rzlo)
@@ -216,8 +215,6 @@ class Z2PointIndex:
             np.concatenate(ixy), np.concatenate(bxs),
             pad_pow2(sum(len(b) for b in bxs), minimum=1),
             np.concatenate(bqid))
-
-        from .z3 import coded_pos_bits
 
         pos_bits = coded_pos_bits(len(self), n_q)
 

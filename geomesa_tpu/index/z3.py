@@ -38,8 +38,8 @@ from ..curve.sfc import Z3SFC, z3_sfc
 from ..curve.zorder import deinterleave3
 from ..config import DEFAULT_MAX_RANGES, QueryProperties
 from ..ops.search import (
-    expand_ranges, gather_capacity, pack_wire, pad_boxes, pad_pow2,
-    pad_ranges, run_packed_query, searchsorted2,
+    coded_pos_bits, expand_ranges, gather_capacity, pack_wire, pad_boxes,
+    pad_pow2, pad_ranges, run_packed_query, searchsorted2, wire_dtype,
 )
 
 
@@ -289,18 +289,9 @@ def _query_many_packed(
         zc, rtlo[rid], rthi[rid], ixy, boxes,
         x[posc], y[posc], dtg[posc], 0, 0,
         cqid=cqid, bqid=bqid, qtlo=qtlo, qthi=qthi)
-    dt = jnp.int32 if pos_bits < 31 else jnp.int64
+    dt = wire_dtype(pos_bits)
     coded = ((cqid.astype(dt) << dt(pos_bits)) | posc.astype(dt))
     return pack_wire(total, coded, mask, dt)
-
-
-def coded_pos_bits(n_rows: int, n_queries: int) -> int:
-    """Wire coding for multi-window scans: bits reserved for the position
-    field.  Prefers an int32-fitting layout (qid_bits + pos_bits <= 31);
-    falls back to the 40-bit int64 layout for huge shards."""
-    pos_bits = max(1, int(np.ceil(np.log2(max(2, n_rows)))))
-    qid_bits = max(1, int(np.ceil(np.log2(max(2, n_queries)))))
-    return pos_bits if pos_bits + qid_bits <= 31 else 40
 
 
 #: tri-state: None = untried, True = pallas scan works on this backend,
@@ -430,18 +421,18 @@ class Z3PointIndex:
         n_q = len(windows)
         if n_q == 0 or len(self) == 0:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
-        # the scan-ranges target applies PER window, as in the reference
-        # (each window is an independent scan with its own budget): finer
-        # covering ranges cost a bigger searchsorted batch (cheap) but
-        # shrink the candidate gather + transfer (the dominant cost)
-        per_range = max_ranges
         rbin, rzlo, rzhi, rtlo, rthi, rqid = [], [], [], [], [], []
         ixy, boxes, bqid = [], [], []
         qtlo = np.empty(n_q, dtype=np.int64)
         qthi = np.empty(n_q, dtype=np.int64)
         for q, (bxs, lo, hi) in enumerate(windows):
             lo, hi = self._clamp_time(lo, hi)
-            plan = plan_z3_query(bxs, lo, hi, self.period, per_range)
+            # the scan-ranges target applies PER window, as in the
+            # reference (each window is an independent scan): finer
+            # covering ranges cost a bigger searchsorted batch (cheap)
+            # but shrink the candidate gather + transfer (the dominant
+            # cost)
+            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges)
             qtlo[q] = plan.t_lo_ms
             qthi[q] = plan.t_hi_ms
             if plan.num_ranges == 0:

@@ -16,10 +16,27 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["searchsorted2", "expand_ranges", "gather_capacity",
-           "pack_wire", "run_packed_query"]
+           "coded_pos_bits", "wire_dtype", "pack_wire", "run_packed_query"]
 
 #: bits per word of the split candidate total in the wire header
 _TOTAL_SPLIT = 30
+
+
+def coded_pos_bits(n_rows: int, n_queries: int) -> int:
+    """Wire coding for multi-window scans: bits reserved for the position
+    field of the ``qid << pos_bits | pos`` code.  Prefers an
+    int32-fitting layout (qid_bits + pos_bits <= 31); falls back to the
+    40-bit int64 layout for huge shards.  :func:`wire_dtype` maps the
+    result to the wire dtype — keep the two in sync via this module."""
+    import numpy as np
+    pos_bits = max(1, int(np.ceil(np.log2(max(2, n_rows)))))
+    qid_bits = max(1, int(np.ceil(np.log2(max(2, n_queries)))))
+    return pos_bits if pos_bits + qid_bits <= 31 else 40
+
+
+def wire_dtype(pos_bits: int):
+    """Wire dtype for a coded layout chosen by :func:`coded_pos_bits`."""
+    return jnp.int32 if pos_bits < 31 else jnp.int64
 
 
 def pack_wire(total, values, mask, dt):

@@ -63,7 +63,7 @@ def test_expand_ranges_exact_capacity():
 
 
 def test_coded_pos_bits_boundaries():
-    from geomesa_tpu.index.z3 import coded_pos_bits
+    from geomesa_tpu.ops.search import coded_pos_bits
 
     # 20 pos bits + 11 qid bits = 31 → int32-eligible layout
     assert coded_pos_bits(1 << 20, 1 << 11) == 20
