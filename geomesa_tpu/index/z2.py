@@ -20,8 +20,8 @@ from ..curve.sfc import Z2SFC, z2_sfc
 from ..curve.zorder import deinterleave2
 from ..config import DEFAULT_MAX_RANGES
 from ..ops.search import (
-    coded_pos_bits, expand_ranges, gather_capacity, pack_wire, pad_boxes,
-    pad_pow2, pad_ranges, run_packed_query, wire_dtype,
+    coded_pos_bits, expand_ranges, gather_capacity, pack_coded,
+    pack_wire, pad_boxes, pad_pow2, pad_ranges, run_packed_query,
 )
 
 __all__ = ["Z2PointIndex", "Z2QueryPlan", "plan_z2_query"]
@@ -92,9 +92,7 @@ def _query_many_packed(z, pos, x, y, rzlo, rzhi, rqid, ixy, boxes, bqid,
         & (yc[:, None] <= boxes[None, :, 3])
     ).any(axis=1)
     mask = valid & in_box_int & in_box_exact
-    dt = wire_dtype(pos_bits)
-    coded = ((cqid.astype(dt) << dt(pos_bits)) | posc.astype(dt))
-    return pack_wire(total, coded, mask, dt)
+    return pack_coded(total, cqid, posc, mask, pos_bits)
 
 
 @partial(jax.jit, static_argnames=("capacity",))

@@ -38,8 +38,9 @@ from ..curve.sfc import Z3SFC, z3_sfc
 from ..curve.zorder import deinterleave3
 from ..config import DEFAULT_MAX_RANGES, QueryProperties
 from ..ops.search import (
-    coded_pos_bits, expand_ranges, gather_capacity, pack_wire, pad_boxes,
-    pad_pow2, pad_ranges, run_packed_query, searchsorted2, wire_dtype,
+    coded_pos_bits, expand_ranges, gather_capacity, pack_coded,
+    pack_wire, pad_boxes, pad_pow2, pad_ranges, run_packed_query,
+    searchsorted2,
 )
 
 
@@ -289,9 +290,7 @@ def _query_many_packed(
         zc, rtlo[rid], rthi[rid], ixy, boxes,
         x[posc], y[posc], dtg[posc], 0, 0,
         cqid=cqid, bqid=bqid, qtlo=qtlo, qthi=qthi)
-    dt = wire_dtype(pos_bits)
-    coded = ((cqid.astype(dt) << dt(pos_bits)) | posc.astype(dt))
-    return pack_wire(total, coded, mask, dt)
+    return pack_coded(total, cqid, posc, mask, pos_bits)
 
 
 #: tri-state: None = untried, True = pallas scan works on this backend,

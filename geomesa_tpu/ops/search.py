@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["searchsorted2", "expand_ranges", "gather_capacity",
-           "coded_pos_bits", "wire_dtype", "pack_wire", "run_packed_query"]
+           "coded_pos_bits", "wire_dtype", "pack_wire", "pack_coded",
+           "run_packed_query"]
 
 #: bits per word of the split candidate total in the wire header
 _TOTAL_SPLIT = 30
@@ -37,6 +38,16 @@ def coded_pos_bits(n_rows: int, n_queries: int) -> int:
 def wire_dtype(pos_bits: int):
     """Wire dtype for a coded layout chosen by :func:`coded_pos_bits`."""
     return jnp.int32 if pos_bits < 31 else jnp.int64
+
+
+def pack_coded(total, qid, pos, mask, pos_bits: int):
+    """Encode a multi-window scan result: ``qid << pos_bits | pos`` in
+    the dtype :func:`wire_dtype` picks, wrapped by :func:`pack_wire` —
+    the single definition of the coded layout shared by every batched
+    scan kernel (decode: ``coded >> pos_bits`` / mask)."""
+    dt = wire_dtype(pos_bits)
+    coded = (qid.astype(dt) << dt(pos_bits)) | pos.astype(dt)
+    return pack_wire(total, coded, mask, dt)
 
 
 def pack_wire(total, values, mask, dt):

@@ -137,14 +137,15 @@ class ShardedZ3Index:
 
         Each shard scans its local sorted segment (seeks + fixed-capacity
         gather + fused mask — the same candidate_mask as the single-chip
-        packed query) and emits ``shard_offset + local_pos`` ids; results
+        packed query) and emits shard-LOCAL int32 positions; results
         stack along the shard axis so the host reads one
         (n_shards × capacity) packed array plus per-shard totals for
-        overflow retry — the scatter/gather + client-merge pattern of the
-        reference's BatchScanPlan.  Programs are cached per
-        (mesh, capacity, bucketed plan shape): plan arrays pad to
-        power-of-two buckets and travel as traced arguments, so repeat
-        queries reuse the compile.
+        overflow retry, then re-bases hits to global row ids (it knows
+        the row→shard mapping) — the scatter/gather + client-merge
+        pattern of the reference's BatchScanPlan, with the int32 wire
+        halving the cross-host transfer.  Programs are cached per
+        (mesh, capacity): plan arrays pad to power-of-two buckets and
+        travel as traced arguments, so repeat queries reuse the compile.
         """
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
         if plan.num_ranges == 0:
@@ -156,7 +157,7 @@ class ShardedZ3Index:
         ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
                              pad_pow2(len(plan.boxes), minimum=1))
         while True:
-            scan = _sharded_scan_program(self.mesh, capacity, per_shard)
+            scan = _sharded_scan_program(self.mesh, capacity)
             packed, totals = scan(
                 self.bins, self.z, self.pos, self.x, self.y, self.dtg,
                 self.valid,
@@ -166,8 +167,15 @@ class ShardedZ3Index:
                 jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
             totals = np.asarray(totals)
             if int(totals.max(initial=0)) <= capacity:
-                packed = np.asarray(packed)
-                return np.sort(packed[packed >= 0])
+                # int32 wire: shard-LOCAL positions; the host re-bases by
+                # shard (it knows the row→shard mapping), halving the
+                # cross-host transfer (see z3._query_packed)
+                local = np.asarray(packed).reshape(
+                    self.mesh.devices.size, capacity)
+                hit = local >= 0
+                shard_of = np.nonzero(hit)[0].astype(np.int64)
+                gpos = shard_of * per_shard + local[hit].astype(np.int64)
+                return np.sort(gpos)
             capacity = gather_capacity(int(totals.max()))
 
     def density(self, boxes, t_lo_ms: int, t_hi_ms: int, env,
@@ -184,9 +192,10 @@ class ShardedZ3Index:
 
 
 @lru_cache(maxsize=64)
-def _sharded_scan_program(mesh: Mesh, capacity: int, per_shard: int):
-    """Jitted collective scan, cached per (mesh, capacity, shard size) —
-    plan arrays are traced arguments so new queries reuse the compile."""
+def _sharded_scan_program(mesh: Mesh, capacity: int):
+    """Jitted collective scan, cached per (mesh, capacity) — plan arrays
+    are traced arguments so new queries reuse the compile.  Emits
+    shard-local int32 positions; the caller re-bases them globally."""
 
     @partial(
         shard_map, mesh=mesh,
@@ -205,9 +214,7 @@ def _sharded_scan_program(mesh: Mesh, capacity: int, per_shard: int):
         mask = valid_slot & vs[posc] & candidate_mask(
             zc, rtl[rid], rth[rid], ixy, bxs,
             xs[posc], ys[posc], ts[posc], t_lo, t_hi)
-        shard = jax.lax.axis_index("shard").astype(jnp.int64)
-        gpos = shard * per_shard + posc.astype(jnp.int64)
-        packed = jnp.where(mask, gpos, jnp.int64(-1))
+        packed = jnp.where(mask, posc.astype(jnp.int32), jnp.int32(-1))
         return packed, total[None].astype(jnp.int64)
 
     return jax.jit(scan)

@@ -235,3 +235,59 @@ def test_fuzz_random_filters_vs_row_oracle():
         got = set(int(i) for i in ds.query_result("t", f).positions)
         want = set(i for i in range(n) if oracle(f, i))
         assert got == want, (repr(f)[:120], len(got), len(want))
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_query_many_vs_single(seed):
+    """Batched multi-window scans must equal per-window single queries
+    (and the brute-force oracle) for random window batches — guards the
+    per-window budget + qid|pos wire coding."""
+    rng = np.random.default_rng(100 + seed)
+    n = 8000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    span = 30 * DAY
+    t = rng.integers(MS, MS + span, n)
+    idx = Z3PointIndex.build(x, y, t, period=TimePeriod.WEEK)
+    n_q = int(rng.integers(1, 40))
+    windows = []
+    for _ in range(n_q):
+        boxes = []
+        for _ in range(int(rng.integers(1, 3))):
+            x0, y0 = rng.uniform(-180, 170), rng.uniform(-90, 80)
+            boxes.append((x0, y0, x0 + rng.uniform(0.5, 80),
+                          y0 + rng.uniform(0.5, 80)))
+        tlo = int(rng.integers(MS - DAY, MS + span))
+        windows.append((boxes, tlo, tlo + int(rng.integers(DAY, span))))
+    batched = idx.query_many(windows)
+    assert len(batched) == n_q
+    for (boxes, tlo, thi), hits in zip(windows, batched):
+        single = idx.query(boxes, tlo, thi)
+        np.testing.assert_array_equal(hits, single)
+        in_any = np.zeros(n, dtype=bool)
+        for b in boxes:
+            in_any |= ((x >= b[0]) & (x <= b[2])
+                       & (y >= b[1]) & (y <= b[3]))
+        want = np.flatnonzero(in_any & (t >= tlo) & (t <= thi))
+        np.testing.assert_array_equal(hits, want)
+
+
+def test_fuzz_z2_query_many_vs_single():
+    rng = np.random.default_rng(5150)
+    n = 8000
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    idx = Z2PointIndex.build(x, y)
+    n_q = int(rng.integers(2, 30))
+    batches = []
+    for _ in range(n_q):
+        x0, y0 = rng.uniform(-180, 170), rng.uniform(-90, 80)
+        batches.append([(x0, y0, x0 + rng.uniform(0.5, 60),
+                         y0 + rng.uniform(0.5, 60))])
+    out = idx.query_many(batches)
+    for boxes, hits in zip(batches, out):
+        b = boxes[0]
+        want = np.flatnonzero((x >= b[0]) & (x <= b[2])
+                              & (y >= b[1]) & (y <= b[3]))
+        np.testing.assert_array_equal(hits, want)
+        np.testing.assert_array_equal(hits, idx.query(boxes))
