@@ -17,8 +17,8 @@ from ..geometry.types import Envelope, Geometry
 
 __all__ = [
     "Filter", "Include", "Exclude", "And", "Or", "Not", "BBox", "Intersects",
-    "Contains", "Within", "DWithin", "During", "PropertyCompare", "Between",
-    "In", "IdFilter", "Like", "Attribute",
+    "Contains", "Within", "DWithin", "GeomEquals", "During",
+    "PropertyCompare", "Between", "In", "IdFilter", "Like", "Attribute",
 ]
 
 
@@ -114,10 +114,34 @@ class Within(Filter):
 
 @dataclass(frozen=True)
 class DWithin(Filter):
-    """Feature geometry within ``distance`` (degrees) of the query geometry."""
+    """Feature geometry within ``distance`` of the query geometry.
+
+    ``distance`` is in degrees unless ``meters`` is set (the ECQL units
+    suffix, converted via the reference's meters multiplier,
+    GeometryProcessing.metersMultiplier/distanceDegrees)."""
     prop: str
     geometry: Geometry
     distance: float
+    meters: bool = False
+
+    @property
+    def degrees(self) -> float:
+        """Covering degree-space equivalent of the distance (the larger
+        lon-degree equivalent at the geometry's latitude, mirroring the
+        reference's buffer-by-east-degrees rewrite)."""
+        if not self.meters:
+            return self.distance
+        import math
+        env = self.geometry.envelope
+        lat = min(89.0, max(abs(env.ymin), abs(env.ymax)))
+        return self.distance / (111_320.0 * max(0.017, math.cos(math.radians(lat))))
+
+
+@dataclass(frozen=True)
+class GeomEquals(Filter):
+    """Feature geometry exactly equals the query geometry (ECQL EQUALS)."""
+    prop: str
+    geometry: Geometry
 
 
 @dataclass(frozen=True)

@@ -18,8 +18,9 @@ import re
 
 from ..geometry.wkt import geometry_from_wkt
 from .ast import (
-    And, BBox, Between, Contains, During, DWithin, Exclude, Filter, IdFilter,
-    In, Include, Intersects, Like, Not, Or, PropertyCompare, Within,
+    And, BBox, Between, Contains, During, DWithin, Exclude, Filter,
+    GeomEquals, IdFilter, In, Include, Intersects, Like, Not, Or,
+    PropertyCompare, Within,
 )
 
 __all__ = ["parse_ecql", "parse_iso_ms"]
@@ -41,7 +42,7 @@ _TOKEN_RE = re.compile(
 _KEYWORDS = {
     "AND", "OR", "NOT", "IN", "LIKE", "ILIKE", "BETWEEN", "DURING", "BEFORE",
     "AFTER", "INCLUDE", "EXCLUDE", "BBOX", "INTERSECTS", "CONTAINS", "WITHIN",
-    "DWITHIN", "IS", "NULL", "TEQUALS",
+    "DWITHIN", "DISJOINT", "EQUALS", "BEYOND", "IS", "NULL", "TEQUALS",
 }
 
 _GEOM_WORDS = {
@@ -243,27 +244,45 @@ def _parse_predicate(toks: _Tokens) -> Filter:
         toks.expect(")")
         return BBox(prop, *nums)
 
-    if upper in ("INTERSECTS", "CONTAINS", "WITHIN"):
+    if upper in ("INTERSECTS", "CONTAINS", "WITHIN", "DISJOINT", "EQUALS"):
         toks.expect("(")
         _, prop = toks.next()
         toks.expect(",")
         geom = _parse_wkt(toks)
         toks.expect(")")
+        if upper == "DISJOINT":  # exact complement of INTERSECTS
+            return Not(Intersects(prop, geom))
+        if upper == "EQUALS":
+            return GeomEquals(prop, geom)
         cls = {"INTERSECTS": Intersects, "CONTAINS": Contains, "WITHIN": Within}[upper]
         return cls(prop, geom)
 
-    if upper == "DWITHIN":
+    if upper in ("DWITHIN", "BEYOND"):
         toks.expect("(")
         _, prop = toks.next()
         toks.expect(",")
         geom = _parse_wkt(toks)
         toks.expect(",")
         dist = float(toks.next()[1])
-        # optional units word
-        if toks.peek()[0] == "word" and toks.peek()[1].upper() not in _KEYWORDS:
+        # optional units, either ", kilometers" (ECQL) or a bare word —
+        # converted to meters via the reference's multiplier
+        # (GeometryProcessing.metersMultiplier); no units = degrees
+        meters = False
+        if toks.peek()[1] == ",":
             toks.next()
+        if toks.peek()[0] == "word" and toks.peek()[1].upper() not in _KEYWORDS:
+            unit = toks.next()[1].lower()
+            mult = {"meters": 1.0, "kilometers": 1000.0, "feet": 0.3048,
+                    "statute": None, "nautical": None}.get(unit, 1.0)
+            if mult is None:  # two-word units: 'statute miles' etc.
+                word2 = toks.next()[1].lower()
+                mult = {"statute miles": 1609.347,
+                        "nautical miles": 1852.0}.get(f"{unit} {word2}", 1.0)
+            dist *= mult
+            meters = True
         toks.expect(")")
-        return DWithin(prop, geom, dist)
+        dw = DWithin(prop, geom, dist, meters=meters)
+        return Not(dw) if upper == "BEYOND" else dw
 
     # property-led predicates
     return _parse_property_predicate(toks, val)

@@ -34,8 +34,9 @@ from ..geometry.types import (
     Polygon,
 )
 from .ast import (
-    And, BBox, Between, Contains, During, DWithin, Filter, IdFilter, In,
-    Intersects, Like, Not, Or, PropertyCompare, Within, _Exclude, _Include,
+    And, BBox, Between, Contains, During, DWithin, Filter, GeomEquals,
+    IdFilter, In, Intersects, Like, Not, Or, PropertyCompare, Within,
+    _Exclude, _Include,
 )
 
 __all__ = ["evaluate_filter"]
@@ -112,6 +113,45 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
         else:
             raise NotImplementedError(op)
     return out
+
+
+def _canonical_ring(coords: np.ndarray) -> tuple:
+    """Orientation- and start-point-invariant form of a closed ring: the
+    lexicographically smallest rotation over both directions (ECQL/JTS
+    EQUALS is topological, so POLYGON((0 0,2 0,2 2,0 2,0 0)) equals the
+    same ring started elsewhere or wound the other way)."""
+    pts = [tuple(p) for p in np.asarray(coords, dtype=np.float64)]
+    if len(pts) > 1 and pts[0] == pts[-1]:
+        pts = pts[:-1]
+    best = None
+    for seq in (pts, pts[::-1]):
+        for s in range(len(seq)):
+            rot = tuple(seq[s:] + seq[:s])
+            if best is None or rot < best:
+                best = rot
+    return best or ()
+
+
+def _canonical_geom(g) -> tuple:
+    """Hashable topological-equality key for a geometry."""
+    if isinstance(g, Point):
+        return ("point", (g.x, g.y))
+    if isinstance(g, MultiPoint):
+        return ("multipoint",
+                tuple(sorted(tuple(p) for p in np.asarray(g.coords))))
+    if isinstance(g, LineString):
+        pts = tuple(tuple(p) for p in np.asarray(g.coords))
+        return ("line", min(pts, pts[::-1]))
+    if isinstance(g, MultiLineString):
+        return ("multiline",
+                tuple(sorted(_canonical_geom(l)[1] for l in g.lines)))
+    if isinstance(g, Polygon):
+        return ("polygon", _canonical_ring(g.shell),
+                tuple(sorted(_canonical_ring(h) for h in g.holes)))
+    if isinstance(g, MultiPolygon):
+        return ("multipolygon",
+                tuple(sorted(_canonical_geom(p)[1:] for p in g.polygons)))
+    return ("other", repr(g))
 
 
 def _prop_column(batch: FeatureBatch, prop: str) -> np.ndarray:
@@ -207,14 +247,20 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
         return _geom_mask_polygonal(batch, f.prop, f.geometry, "contains")
     if isinstance(f, DWithin):
         env = f.geometry.envelope
-        window = (env.xmin - f.distance, env.ymin - f.distance,
-                  env.xmax + f.distance, env.ymax + f.distance)
+        deg = f.degrees
+        window = (env.xmin - deg, env.ymin - deg,
+                  env.xmax + deg, env.ymax + deg)
         if _use_xy_fast_path(batch, f.prop):
             x = batch.columns[f"{f.prop}_x"]
             y = batch.columns[f"{f.prop}_y"]
             if isinstance(f.geometry, Point):
+                if f.meters:
+                    # exact great-circle test for metric distances
+                    from ..process.knn import haversine_m
+                    return (haversine_m(f.geometry.x, f.geometry.y, x, y)
+                            <= f.distance)
                 d2 = (x - f.geometry.x) ** 2 + (y - f.geometry.y) ** 2
-                return d2 <= f.distance ** 2
+                return d2 <= deg ** 2
             # bbox prefilter bounds the (points × segments) distance work
             near = ((x >= window[0]) & (x <= window[2])
                     & (y >= window[1]) & (y <= window[3]))
@@ -223,7 +269,7 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
                 idx = np.flatnonzero(near)
                 out[idx] = (points_to_geometry_dist(x[idx], y[idx],
                                                     f.geometry)
-                            <= f.distance)
+                            <= deg)
             return out
         packed = batch.geoms
         if packed is None or f.prop != batch.sft.default_geom:
@@ -233,7 +279,29 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
         out = np.zeros(n, dtype=bool)
         for i in np.flatnonzero(cand):
             out[i] = (geometry_distance(packed.geometry(int(i)), f.geometry)
-                      <= f.distance)
+                      <= deg)
+        return out
+    if isinstance(f, GeomEquals):
+        from ..geometry.types import Point as _Pt
+        if _use_xy_fast_path(batch, f.prop):
+            x = batch.columns[f"{f.prop}_x"]
+            y = batch.columns[f"{f.prop}_y"]
+            if not isinstance(f.geometry, _Pt):
+                return np.zeros(n, dtype=bool)
+            return (x == f.geometry.x) & (y == f.geometry.y)
+        packed = batch.geoms
+        if packed is None or f.prop != batch.sft.default_geom:
+            raise KeyError(f"no geometry column for {f.prop!r}")
+        env = f.geometry.envelope
+        # exact-equality prefilter: equal geometries have equal bboxes
+        cand = ((packed.bbox[:, 0] == env.xmin)
+                & (packed.bbox[:, 1] == env.ymin)
+                & (packed.bbox[:, 2] == env.xmax)
+                & (packed.bbox[:, 3] == env.ymax))
+        out = np.zeros(n, dtype=bool)
+        want = _canonical_geom(f.geometry)
+        for i in np.flatnonzero(cand):
+            out[i] = _canonical_geom(packed.geometry(int(i))) == want
         return out
     if isinstance(f, During):
         col = _prop_column(batch, f.prop)

@@ -17,8 +17,8 @@ import numpy as np
 
 from ..geometry.types import Envelope, Geometry, Point, Polygon
 from .ast import (
-    And, BBox, Contains, During, DWithin, Exclude, Filter, Include,
-    Intersects, Not, Or, Within, _Exclude, _Include,
+    And, BBox, Contains, During, DWithin, Exclude, Filter, GeomEquals,
+    Include, Intersects, Not, Or, Within, _Exclude, _Include,
 )
 
 __all__ = ["FilterValues", "extract_geometries", "extract_intervals", "to_cnf",
@@ -112,12 +112,13 @@ def _geom_envelope_values(f: Filter, prop: str) -> "FilterValues | None":
     """Geometry values contributed by a single node (None = no constraint)."""
     if isinstance(f, BBox) and f.prop == prop:
         return FilterValues((Polygon.from_envelope(f.envelope),))
-    if isinstance(f, (Intersects, Within, Contains)) and f.prop == prop:
+    if isinstance(f, (Intersects, Within, Contains, GeomEquals)) and f.prop == prop:
         return FilterValues((f.geometry,))
     if isinstance(f, DWithin) and f.prop == prop:
         env = f.geometry.envelope
-        grown = Envelope(env.xmin - f.distance, env.ymin - f.distance,
-                         env.xmax + f.distance, env.ymax + f.distance)
+        deg = f.degrees  # covering degree equivalent for metric distances
+        grown = Envelope(env.xmin - deg, env.ymin - deg,
+                         env.xmax + deg, env.ymax + deg)
         return FilterValues((Polygon.from_envelope(grown),))
     return None
 

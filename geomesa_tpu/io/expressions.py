@@ -134,6 +134,79 @@ def _fn_uuid(cols):
     return np.asarray([str(_uuid.uuid4()) for _ in range(n)], dtype=object)
 
 
+def _fn_strip(cols, e, chars_e=None):
+    vals = _strcol(cols, e)
+    chars = chars_e.value if chars_e is not None else None
+    return np.asarray([v.strip(chars) for v in vals], dtype=object)
+
+
+def _fn_printf(cols, fmt_e, *es):
+    """printf('%s-%s', $1, $2) — java-format % conversions per row."""
+    fmt = fmt_e.value
+    parts = [e.evaluate(cols) for e in es]
+    n = len(parts[0]) if parts else (
+        len(next(iter(cols.values()))) if cols else 1)
+    return np.asarray([fmt % tuple(p[i] for p in parts)
+                       for i in range(n)], dtype=object)
+
+
+def _fn_with_default(cols, e, default_e):
+    vals = e.evaluate(cols)
+    default = default_e.evaluate(cols)
+    out = np.array(vals, dtype=object, copy=True)
+    missing = np.asarray([v is None or v != v if isinstance(v, float)
+                          else v is None for v in vals], dtype=bool)
+    out[missing] = default[missing] if np.ndim(default) else default
+    return out
+
+
+def _fn_require(cols, e):
+    vals = e.evaluate(cols)
+    bad = [i for i, v in enumerate(vals) if v is None or v == ""]
+    if bad:
+        raise ValueError(
+            f"require() failed for {len(bad)} record(s), first at row {bad[0]}")
+    return vals
+
+
+def _fn_list(cols, e, delim_e=None):
+    delim = delim_e.value if delim_e is not None else ","
+    vals = _strcol(cols, e)
+    # 1-D object array of python lists (equal-length splits would
+    # otherwise collapse into a 2-D array)
+    out = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        out[i] = v.split(delim)
+    return out
+
+
+def _fn_list_item(cols, e, idx_e):
+    idx = int(idx_e.value)
+    # short rows yield None instead of aborting the batch (ragged CSVs)
+    return np.asarray(
+        [v[idx] if isinstance(v, (list, tuple)) and -len(v) <= idx < len(v)
+         else None for v in e.evaluate(cols)], dtype=object)
+
+
+def _fn_mkstring(cols, delim_e, *es):
+    """mkstring('|', $a, $b) — delimiter-joined row values.  Arguments
+    evaluate once per column (not per row)."""
+    delim = delim_e.value
+    parts = [e.evaluate(cols) for e in es]
+    n = len(parts[0]) if parts else 0
+    return np.asarray([delim.join(str(p[i]) for p in parts)
+                       for i in range(n)], dtype=object)
+
+
+def _binop_math(op, identity=None):
+    def fn(cols, *es):
+        acc = _num(cols, es[0], np.float64)
+        for e in es[1:]:
+            acc = op(acc, _num(cols, e, np.float64))
+        return acc
+    return fn
+
+
 _FUNCTIONS = {
     "toint": lambda cols, e: _num(cols, e, np.int32),
     "tolong": lambda cols, e: _num(cols, e, np.int64),
@@ -156,6 +229,69 @@ _FUNCTIONS = {
     "uuid": lambda cols: _fn_uuid(cols),
     "cachelookup": lambda cols, name_e, key_e, field_e: _fn_cache_lookup(
         cols, name_e, key_e, field_e),
+    # strings (StringFunctionFactory.scala registry)
+    "capitalize": lambda cols, e: np.asarray(
+        [v.capitalize() for v in _strcol(cols, e)], dtype=object),
+    "strlen": lambda cols, e: np.asarray(
+        [len(v) for v in _strcol(cols, e)], dtype=np.int32),
+    "length": lambda cols, e: np.asarray(
+        [len(v) for v in _strcol(cols, e)], dtype=np.int32),
+    "strip": _fn_strip,
+    "stripquotes": lambda cols, e: np.asarray(
+        [v.strip("'\"") for v in _strcol(cols, e)], dtype=object),
+    "stripprefix": lambda cols, e, p: np.asarray(
+        [v[len(p.value):] if v.startswith(p.value) else v
+         for v in _strcol(cols, e)], dtype=object),
+    "stripsuffix": lambda cols, e, s: np.asarray(
+        [v[: -len(s.value)] if v.endswith(s.value) else v
+         for v in _strcol(cols, e)], dtype=object),
+    "replace": lambda cols, e, a, b: np.asarray(
+        [v.replace(a.value, b.value) for v in _strcol(cols, e)],
+        dtype=object),
+    "remove": lambda cols, e, a: np.asarray(
+        [v.replace(a.value, "") for v in _strcol(cols, e)], dtype=object),
+    "regexreplace": lambda cols, pat, rep, e: np.asarray(
+        [re.sub(pat.value, rep.value, v) for v in _strcol(cols, e)],
+        dtype=object),
+    "substr": lambda cols, e, a, b: np.asarray(
+        [v[int(a.value):int(b.value)] for v in _strcol(cols, e)],
+        dtype=object),
+    "substring": lambda cols, e, a, b: np.asarray(
+        [v[int(a.value):int(b.value)] for v in _strcol(cols, e)],
+        dtype=object),
+    "mkstring": lambda cols, d, *es: _fn_mkstring(cols, d, *es),
+    "emptytonull": lambda cols, e: np.asarray(
+        [None if v is None or str(v).strip() == "" else v
+         for v in e.evaluate(cols)], dtype=object),
+    "printf": _fn_printf,
+    # math (MathFunctionFactory.scala)
+    "add": _binop_math(np.add),
+    "subtract": _binop_math(np.subtract),
+    "multiply": _binop_math(np.multiply),
+    "divide": _binop_math(np.divide),
+    "mean": lambda cols, *es: np.mean(
+        [_num(cols, e, np.float64) for e in es], axis=0),
+    "min": lambda cols, *es: np.min(
+        [_num(cols, e, np.float64) for e in es], axis=0),
+    "max": lambda cols, *es: np.max(
+        [_num(cols, e, np.float64) for e in es], axis=0),
+    # misc (MiscFunctionFactory.scala)
+    "withdefault": _fn_with_default,
+    "require": _fn_require,
+    "inttoboolean": lambda cols, e: _num(cols, e, np.int64) != 0,
+    "lineno": lambda cols: np.arange(
+        len(next(iter(cols.values()))) if cols else 0, dtype=np.int64),
+    "linenumber": lambda cols: np.arange(
+        len(next(iter(cols.values()))) if cols else 0, dtype=np.int64),
+    "base64encode": lambda cols, e: np.asarray(
+        [__import__("base64").b64encode(str(v).encode()).decode()
+         for v in e.evaluate(cols)], dtype=object),
+    "base64decode": lambda cols, e: np.asarray(
+        [__import__("base64").b64decode(str(v)).decode()
+         for v in e.evaluate(cols)], dtype=object),
+    # collections (CollectionFunctionFactory.scala)
+    "list": _fn_list,
+    "listitem": _fn_list_item,
 }
 
 

@@ -300,3 +300,102 @@ def test_within_lineal_midpoint_violations():
     l_poly = Polygon([(0, 0), (10, 0), (10, 5), (5, 5), (5, 10), (0, 10)])
     assert not geometry_within(LineString([(10, 5), (5, 10)]), l_poly)
     assert geometry_within(LineString([(1, 1), (4, 4)]), l_poly)
+
+
+def test_disjoint_beyond_equals():
+    """DISJOINT/BEYOND as exact complements; EQUALS exact geometry match
+    (the remaining ECQL spatial relations)."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.filters import evaluate_filter, parse_ecql
+
+    rng = np.random.default_rng(4)
+    n = 5000
+    ds = TpuDataStore()
+    ds.create_schema("pts", "name:String,*geom:Point")
+    x = rng.uniform(-20, 20, n); y = rng.uniform(-20, 20, n)
+    x[17], y[17] = 3.25, -4.5  # exact-equality target
+    ds.write("pts", {"name": np.array(["p"] * n, object), "geom": (x, y)})
+
+    def positions(ecql):
+        return np.sort(ds.query_result("pts", ecql).positions)
+
+    poly = "POLYGON ((-5 -5, 5 -5, 5 5, -5 5, -5 -5))"
+    got_in = positions(f"INTERSECTS(geom, {poly})")
+    got_out = positions(f"DISJOINT(geom, {poly})")
+    assert len(got_in) + len(got_out) == n
+    assert len(np.intersect1d(got_in, got_out)) == 0
+
+    got_near = positions("DWITHIN(geom, POINT (0 0), 3.0, kilometers)")
+    got_far = positions("BEYOND(geom, POINT (0 0), 3.0, kilometers)")
+    assert len(got_near) + len(got_far) == n
+
+    got_eq = positions("EQUALS(geom, POINT (3.25 -4.5))")
+    assert 17 in got_eq
+    want = np.flatnonzero((x == 3.25) & (y == -4.5))
+    np.testing.assert_array_equal(got_eq, want)
+
+
+def test_equals_polygon_packed():
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry import geometry_from_wkt
+
+    ds = TpuDataStore()
+    ds.create_schema("polys", "name:String,*geom:Polygon")
+    w1 = "POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))"
+    w2 = "POLYGON ((1 1, 3 1, 3 3, 1 3, 1 1))"
+    ds.write("polys", {"name": np.array(["a", "b"], object),
+                       "geom": [geometry_from_wkt(w1), geometry_from_wkt(w2)]})
+    hits = ds.query_result("polys", f"EQUALS(geom, {w1})").positions
+    np.testing.assert_array_equal(hits, [0])
+    assert len(ds.query_result(
+        "polys",
+        "EQUALS(geom, POLYGON ((0 0, 9 0, 9 9, 0 9, 0 0)))").positions) == 0
+
+
+def test_dwithin_meters_haversine_exact():
+    """Units suffix means meters (reference metersMultiplier); point
+    columns get the exact great-circle test."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.process.knn import haversine_m
+
+    rng = np.random.default_rng(12)
+    n = 20_000
+    ds = TpuDataStore()
+    ds.create_schema("p", "name:String,*geom:Point")
+    x = rng.uniform(-1, 1, n); y = rng.uniform(44, 46, n)
+    ds.write("p", {"name": np.array(["v"] * n, object), "geom": (x, y)})
+    got = np.sort(ds.query_result(
+        "p", "DWITHIN(geom, POINT (0 45), 30, kilometers)").positions)
+    want = np.flatnonzero(haversine_m(0.0, 45.0, x, y) <= 30_000.0)
+    np.testing.assert_array_equal(got, want)
+    # 30km at lat 45 is ~0.38 deg lon; a degrees reading would match far more
+    assert len(got) < np.count_nonzero(
+        (np.abs(x) <= 30) & (np.abs(y - 45) <= 30))
+
+
+def test_equals_topological():
+    """EQUALS matches rotated ring starts and reversed orientation
+    (JTS-equals semantics, not textual WKT equality)."""
+    import numpy as np
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry import geometry_from_wkt
+
+    ds = TpuDataStore()
+    ds.create_schema("tp", "name:String,*geom:Polygon")
+    ds.write("tp", {"name": np.array(["a"], object),
+                    "geom": [geometry_from_wkt(
+                        "POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))")]})
+    # rotated start
+    hits = ds.query_result(
+        "tp", "EQUALS(geom, POLYGON ((2 0, 2 2, 0 2, 0 0, 2 0)))").positions
+    np.testing.assert_array_equal(hits, [0])
+    # reversed orientation
+    hits = ds.query_result(
+        "tp", "EQUALS(geom, POLYGON ((0 0, 0 2, 2 2, 2 0, 0 0)))").positions
+    np.testing.assert_array_equal(hits, [0])
+    # different polygon
+    assert len(ds.query_result(
+        "tp", "EQUALS(geom, POLYGON ((0 0, 3 0, 3 3, 0 3, 0 0)))").positions) == 0

@@ -174,3 +174,111 @@ def test_bin_roundtrip():
     assert len(blob24) == 48
     back24 = decode_bin(blob24, labelled=True)
     assert list(back24["label"]) == ["ab", "cdefghij"]
+
+
+class TestExpressionRegistry:
+    """The converter function registry breadth (String/Math/Misc/
+    Collection FunctionFactory analogs)."""
+
+    def _ev(self, text, cols):
+        from geomesa_tpu.io.expressions import parse_expression
+        return parse_expression(text).evaluate(cols)
+
+    def test_string_functions(self):
+        import numpy as np
+        cols = {"s": np.array(["  'Hello'  ", "  World  "], dtype=object)}
+        assert list(self._ev("stripQuotes(trim($s))", cols)) == ["Hello", "World"]
+        assert list(self._ev("capitalize(lowercase(trim($s)))", cols)) == ["'hello'", "World"]
+        assert list(self._ev("strlen(trim($s))", cols)) == [7, 5]
+        assert list(self._ev("replace(trim($s), 'l', 'L')", cols)) == ["'HeLLo'", "WorLd"]
+        assert list(self._ev("remove(trim($s), 'o')", cols)) == ["'Hell'", "Wrld"]
+        assert list(self._ev("regexReplace('[lo]+', '_', trim($s))", cols)) == ["'He_'", "W_r_d"]
+        assert list(self._ev("substr(trim($s), 1, 4)", cols)) == ["Hel", "orl"]
+        assert list(self._ev("stripPrefix(trim($s), 'W')", cols))[1] == "orld"
+        assert list(self._ev("stripSuffix(trim($s), 'd')", cols))[1] == "Worl"
+
+    def test_printf_mkstring(self):
+        import numpy as np
+        cols = {"a": np.array(["x", "y"], dtype=object),
+                "b": np.array([1, 2])}
+        assert list(self._ev("printf('%s-%s', $a, $b)", cols)) == ["x-1", "y-2"]
+        assert list(self._ev("mkstring('|', $a, $b)", cols)) == ["x|1", "y|2"]
+
+    def test_math_functions(self):
+        import numpy as np
+        cols = {"a": np.array([1.0, 2.0]), "b": np.array([3.0, 5.0])}
+        np.testing.assert_allclose(self._ev("add($a, $b, 1)", cols), [5, 8])
+        np.testing.assert_allclose(self._ev("subtract($b, $a)", cols), [2, 3])
+        np.testing.assert_allclose(self._ev("multiply($a, $b)", cols), [3, 10])
+        np.testing.assert_allclose(self._ev("divide($b, $a)", cols), [3, 2.5])
+        np.testing.assert_allclose(self._ev("mean($a, $b)", cols), [2, 3.5])
+        np.testing.assert_allclose(self._ev("min($a, $b)", cols), [1, 2])
+        np.testing.assert_allclose(self._ev("max($a, $b)", cols), [3, 5])
+
+    def test_misc_functions(self):
+        import numpy as np
+        import pytest
+        cols = {"v": np.array(["a", "", None], dtype=object)}
+        out = self._ev("emptyToNull($v)", cols)
+        assert out[0] == "a" and out[1] is None and out[2] is None
+        out = self._ev("withDefault($v, 'dflt')", cols)
+        assert list(out) == ["a", "", "dflt"]
+        with pytest.raises(ValueError, match="require"):
+            self._ev("require($v)", cols)
+        assert list(self._ev("lineNo()", cols)) == [0, 1, 2]
+        assert list(self._ev("intToBoolean($x)", {"x": np.array([0, 3])})) == [False, True]
+        assert list(self._ev("base64Decode(base64Encode($v))",
+                             {"v": np.array(["ab"], dtype=object)})) == ["ab"]
+
+    def test_collections(self):
+        import numpy as np
+        cols = {"csv": np.array(["a,b,c", "d,e,f"], dtype=object)}
+        lists = self._ev("list($csv)", cols)
+        assert lists[0] == ["a", "b", "c"]
+        assert list(self._ev("listItem(list($csv), 1)", cols)) == ["b", "e"]
+
+
+class TestExpressionEdgeCases:
+    """Regressions: empty columns, ragged lists, single-eval semantics."""
+
+    def _ev(self, text, cols):
+        from geomesa_tpu.io.expressions import parse_expression
+        return parse_expression(text).evaluate(cols)
+
+    def test_with_default_empty_column(self):
+        import numpy as np
+        out = self._ev("withDefault($v, 'd')",
+                       {"v": np.array([], dtype=object)})
+        assert len(out) == 0
+
+    def test_list_item_ragged(self):
+        import numpy as np
+        cols = {"csv": np.array(["a,b,c", "d,e"], dtype=object)}
+        out = self._ev("listItem(list($csv), 2)", cols)
+        assert out[0] == "c" and out[1] is None
+
+    def test_printf_no_args(self):
+        import numpy as np
+        out = self._ev("printf('hello')", {"x": np.array([1, 2, 3])})
+        assert list(out) == ["hello"] * 3
+
+    def test_mkstring_single_column_eval(self):
+        import numpy as np
+        from geomesa_tpu.io import expressions as ex
+
+        calls = {"n": 0}
+        orig = ex._Ref.evaluate
+
+        def counting(self, cols):
+            calls["n"] += 1
+            return orig(self, cols)
+
+        ex._Ref.evaluate = counting
+        try:
+            cols = {"a": np.array(["x"] * 100, dtype=object),
+                    "b": np.array(["y"] * 100, dtype=object)}
+            out = self._ev("mkstring('|', $a, $b)", cols)
+        finally:
+            ex._Ref.evaluate = orig
+        assert list(out)[:1] == ["x|y"]
+        assert calls["n"] == 2  # once per argument, not per row
