@@ -209,26 +209,20 @@ def candidate_mask(zc, rtlo_c, rthi_c, ixy, boxes, xc, yc, tc,
             & exact_pairs.any(axis=1) & in_time_exact)
 
 
-@partial(jax.jit, static_argnames=("capacity", "use_pallas"))
-def _query_packed(
+def _scan_core(
     bins, z, pos, x, y, dtg,
     rbin, rzlo, rzhi, rtlo, rthi,
     ixy, boxes, t_lo_ms, t_hi_ms,
     capacity: int, use_pallas: bool,
 ):
-    """The WHOLE scan as one dispatch: binary-search seeks + fixed-capacity
-    gather + fused candidate mask, returning a single packed int32 vector
-    ``[total, pos_0|-1, pos_1|-1, …]``.
-
-    One program + one transfer per query: through a remote-device tunnel a
-    host sync costs ~100ms, so the old plan (range bounds → host count →
-    scan → host mask) paid three round trips where this pays one.  The
-    mask fuses the reference's two server-side stages — the z-decode
-    int-space bounds test (Z3Iterator/Z3Filter, filters/Z3Filter.scala:
-    19-55) and the exact double-precision re-check
-    (FilterTransformIterator) — and ``total`` lets the host detect
-    capacity overflow and retry bigger (rare; capacity is adaptive).
-    """
+    """The scan body shared by every single-query program: binary-search
+    seeks + fixed-capacity gather + fused candidate mask.  The mask fuses
+    the reference's two server-side stages — the z-decode int-space
+    bounds test (Z3Iterator/Z3Filter, filters/Z3Filter.scala:19-55) and
+    the exact double-precision re-check (FilterTransformIterator).
+    Returns ``(posc, mask, total_candidates)``; only the wire packing
+    differs between the jitted wrappers, so the hit semantics cannot
+    diverge between them."""
     starts = searchsorted2(bins, z, rbin, rzlo, side="left")
     ends = searchsorted2(bins, z, rbin, rzhi, side="right")
     counts = jnp.maximum(ends - starts, 0)
@@ -253,11 +247,55 @@ def _query_packed(
     else:
         mask = candidate_mask(zc, rtlo[rid], rthi[rid], ixy, boxes,
                               xc, yc, tc, t_lo_ms, t_hi_ms)
-    mask = valid & mask
-    # int32 wire format: positions are int32 throughout (build sorts an
-    # int32 iota), and the device→host link pays ~125ms/MB — halving the
-    # packed bytes halves the dominant cost of a large-capacity query
+    return posc, valid & mask, total
+
+
+@partial(jax.jit, static_argnames=("capacity", "use_pallas"))
+def _query_packed(*args, capacity: int, use_pallas: bool):
+    """The WHOLE scan as one dispatch returning a single packed int32
+    vector ``[total_hi, total_lo, pos_0|-1, pos_1|-1, …]``.
+
+    One program + one transfer per query: through a remote-device tunnel
+    a host sync costs ~100ms, so the old plan (range bounds → host count
+    → scan → host mask) paid three round trips where this pays one.
+    ``total`` lets the host detect capacity overflow and retry bigger
+    (rare; capacity is adaptive).  int32 wire: positions are int32
+    throughout (build sorts an int32 iota), and the link pays ~125ms/MB
+    — halving the packed bytes halves the dominant cost of a
+    large-capacity query."""
+    posc, mask, total = _scan_core(*args, capacity=capacity,
+                                   use_pallas=use_pallas)
     return pack_wire(total, posc, mask, jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("capacity", "use_pallas"))
+def _scan_keep_device(*args, capacity: int, use_pallas: bool):
+    """Two-phase variant of :func:`_query_packed`: the packed vector
+    stays ON DEVICE and only ``[total_candidates, total_hits]`` crosses
+    to the host, which then dispatches :func:`_compact_hits` for a
+    hits-sized transfer.  Pays one extra round trip (~100ms) to avoid
+    shipping a capacity-sized buffer (~125ms/MB) — the winning trade
+    once capacity is large and selectivity low."""
+    posc, mask, total = _scan_core(*args, capacity=capacity,
+                                   use_pallas=use_pallas)
+    packed = jnp.where(mask, posc.astype(jnp.int32), jnp.int32(-1))
+    totals = jnp.stack([total.astype(jnp.int64),
+                        jnp.sum(mask).astype(jnp.int64)])
+    return packed, totals
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _compact_hits(packed, k: int):
+    """Descending sort floats the valid (>= 0) positions to the front;
+    the first ``k`` slots cover all hits (k = pow2 >= total_hits, so
+    compiles bucket like the capacities do)."""
+    return -jnp.sort(-packed)[:k]
+
+
+#: capacity at which the two-phase (device-compact) read beats the
+#: single-dispatch full-buffer transfer: an extra ~100ms round trip vs
+#: ~125ms/MB of padded buffer
+TWO_PHASE_MIN_CAPACITY = 1 << 19
 
 
 @partial(jax.jit, static_argnames=("capacity", "pos_bits"))
@@ -408,8 +446,37 @@ class Z3PointIndex:
                     _pallas_scan_ok = False
             return _query_packed(*args, capacity=capacity, use_pallas=False)
 
+        if self._capacity >= TWO_PHASE_MIN_CAPACITY:
+            return self._query_two_phase(args)
         hits, self._capacity = run_packed_query(dispatch, self._capacity)
         return hits
+
+    def _query_two_phase(self, args) -> np.ndarray:
+        """Large-capacity scan: keep the packed vector on device, read
+        the tiny totals, then transfer a device-compacted hits-sized
+        slice (see _scan_keep_device).  When the hits nearly fill the
+        capacity the compact dispatch buys nothing, so the packed buffer
+        is read directly (same bytes as the single-phase path; only the
+        totals round trip was extra)."""
+        capacity = self._capacity
+        while True:
+            packed, totals = _scan_keep_device(
+                *args, capacity=capacity, use_pallas=False)
+            total, nhits = (int(v) for v in np.asarray(totals))
+            if total > capacity:
+                capacity = gather_capacity(total)
+                continue
+            # decay toward the observed candidate volume so one huge
+            # query doesn't tax every later small one (re-growth costs a
+            # single cheap retry dispatch)
+            self._capacity = max(self.DEFAULT_CAPACITY,
+                                 gather_capacity(total))
+            k = gather_capacity(max(nhits, 1), minimum=8)
+            if k >= capacity:  # dense result: compacting wouldn't shrink
+                out = np.asarray(packed)
+            else:
+                out = np.asarray(_compact_hits(packed, k=k))
+            return np.sort(out[out >= 0]).astype(np.int64)
 
     def query_many(self, windows,
                    max_ranges: int = DEFAULT_MAX_RANGES) -> list[np.ndarray]:

@@ -134,6 +134,39 @@ def _fn_uuid(cols):
     return np.asarray([str(_uuid.uuid4()) for _ in range(n)], dtype=object)
 
 
+def _fn_uuid_z3(cols, x_e, y_e, dtg_e, period_e=None):
+    """uuidZ3($x, $y, $dtg [, 'week']) — version-4 UUIDs with a z3-prefix
+    for write locality (Z3FeatureIdGenerator,
+    utils/uuid/Z3FeatureIdGenerator.scala).  Columnar signature: the
+    reference passes a geometry; here x/y ride as separate columns.  The
+    optional period literal must match the target schema's
+    ``geomesa.z3.interval`` so id prefixes sort like the index keys."""
+    from ..utils.feature_id import z3_feature_ids
+
+    x = _num(cols, x_e, np.float64)
+    y = _num(cols, y_e, np.float64)
+    t = _num(cols, dtg_e, np.int64)
+    period = period_e.value if period_e is not None else "week"
+    return np.asarray(z3_feature_ids(x, y, t, period=period), dtype=object)
+
+
+def _fn_wkt_geom(kind: str):
+    """Typed WKT parser functions (GeometryFunctionFactory: polygon(),
+    linestring(), …): parse and verify the geometry kind."""
+    def fn(cols, wkt_e):
+        from ..geometry.wkt import geometry_from_wkt
+        wkts = _strcol(cols, wkt_e)
+        out = np.empty(len(wkts), dtype=object)
+        for i, w in enumerate(wkts):
+            g = geometry_from_wkt(w)
+            got = type(g).__name__.lower()
+            if got != kind:
+                raise ValueError(f"{kind}() parsed a {got}: {w!r}")
+            out[i] = g
+        return out
+    return fn
+
+
 def _fn_strip(cols, e, chars_e=None):
     vals = _strcol(cols, e)
     chars = chars_e.value if chars_e is not None else None
@@ -292,6 +325,16 @@ _FUNCTIONS = {
     # collections (CollectionFunctionFactory.scala)
     "list": _fn_list,
     "listitem": _fn_list_item,
+    # ids (IdFunctionFactory / Z3FeatureIdGenerator)
+    "uuidz3": _fn_uuid_z3,
+    "uuidz3centroid": _fn_uuid_z3,  # centroid variant: caller passes the
+                                    # centroid coords (we are columnar)
+    # typed WKT constructors (GeometryFunctionFactory)
+    "polygon": _fn_wkt_geom("polygon"),
+    "linestring": _fn_wkt_geom("linestring"),
+    "multipoint": _fn_wkt_geom("multipoint"),
+    "multilinestring": _fn_wkt_geom("multilinestring"),
+    "multipolygon": _fn_wkt_geom("multipolygon"),
 }
 
 

@@ -282,3 +282,21 @@ class TestExpressionEdgeCases:
             ex._Ref.evaluate = orig
         assert list(out)[:1] == ["x|y"]
         assert calls["n"] == 2  # once per argument, not per row
+
+
+def test_uuidz3_and_typed_geometry_functions():
+    import numpy as np
+    from geomesa_tpu.io.expressions import parse_expression
+
+    cols = {"x": np.array([-74.0, 30.0]), "y": np.array([40.7, -10.0]),
+            "t": np.array([1514764800000, 1514851200000])}
+    ids = parse_expression("uuidZ3($x, $y, $t)").evaluate(cols)
+    assert len(ids) == 2 and len(set(ids)) == 2
+    assert all(len(s) == 36 for s in ids)  # uuid-shaped
+
+    wkts = {"w": np.array(["LINESTRING (0 0, 1 1)"], dtype=object)}
+    geoms = parse_expression("linestring($w)").evaluate(wkts)
+    assert type(geoms[0]).__name__ == "LineString"
+    import pytest
+    with pytest.raises(ValueError, match="polygon"):
+        parse_expression("polygon($w)").evaluate(wkts)

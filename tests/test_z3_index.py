@@ -151,3 +151,29 @@ def test_query_open_time_bounds(index, dataset):
     brute = np.flatnonzero((x >= -74.5) & (x <= -73.5)
                            & (y >= 40.5) & (y <= 41.5))
     assert np.array_equal(got, brute)
+
+
+def test_two_phase_query_exact(monkeypatch):
+    """Force the two-phase (device-compact) path and check exactness."""
+    import numpy as np
+    from geomesa_tpu.index import z3 as z3mod
+
+    monkeypatch.setattr(z3mod, "TWO_PHASE_MIN_CAPACITY", 1)
+    rng = np.random.default_rng(31)
+    n = 50_000
+    ms = 1514764800000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(ms, ms + 14 * 86_400_000, n)
+    idx = z3mod.Z3PointIndex.build(x, y, t, period="week")
+    idx._capacity = 1 << 15
+    box = (-74.5, 40.5, -73.5, 41.5)
+    lo, hi = ms + 86_400_000, ms + 9 * 86_400_000
+    hits = idx.query([box], lo, hi)
+    want = np.flatnonzero(
+        (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        & (t >= lo) & (t <= hi))
+    np.testing.assert_array_equal(hits, want)
+    # empty result through the compact path
+    none = idx.query([(10.0, 10.0, 11.0, 11.0)], lo, hi)
+    assert len(none) == 0
