@@ -15,15 +15,21 @@ needed — the collective compiles into the program.
 """
 
 from .mesh import device_mesh, shard_batch
+from .multihost import (
+    global_device_mesh, initialize_distributed, process_local_shard,
+)
 from .rdd import (
     ConverterRDDProvider, FileSystemRDDProvider, SpatialRDD,
     SpatialRDDProvider, TpuStoreRDDProvider, save_rdd, spatial_rdd,
 )
-from .scan import ShardedZ3Index, sharded_density, sharded_range_count
+from .scan import (
+    ShardedZ3Index, ring_range_counts, sharded_density, sharded_range_count,
+)
 
 __all__ = [
     "device_mesh", "shard_batch", "ShardedZ3Index", "sharded_density",
-    "sharded_range_count", "SpatialRDD", "SpatialRDDProvider",
-    "TpuStoreRDDProvider", "ConverterRDDProvider", "FileSystemRDDProvider",
-    "spatial_rdd", "save_rdd",
+    "sharded_range_count", "ring_range_counts", "SpatialRDD",
+    "SpatialRDDProvider", "TpuStoreRDDProvider", "ConverterRDDProvider",
+    "FileSystemRDDProvider", "spatial_rdd", "save_rdd",
+    "initialize_distributed", "global_device_mesh", "process_local_shard",
 ]

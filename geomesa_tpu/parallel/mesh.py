@@ -23,6 +23,15 @@ def device_mesh(n_devices: int | None = None, axis: str = "shard") -> Mesh:
     return Mesh(mesh_utils.create_device_mesh((n,), devices=devices[:n]), (axis,))
 
 
+def pad_to_multiple(a: np.ndarray, padded_n: int) -> np.ndarray:
+    """Zero-pad the leading axis to ``padded_n`` rows."""
+    a = np.asarray(a)
+    if padded_n == len(a):
+        return a
+    pad = np.zeros((padded_n - len(a),) + a.shape[1:], dtype=a.dtype)
+    return np.concatenate([a, pad])
+
+
 def shard_batch(mesh: Mesh, *arrays, axis: str = "shard"):
     """Pad arrays to a multiple of the mesh size and place them sharded on
     the feature axis.  Returns (padded_arrays, valid_mask)."""
@@ -30,14 +39,8 @@ def shard_batch(mesh: Mesh, *arrays, axis: str = "shard"):
     n = len(arrays[0])
     padded_n = ((n + n_shards - 1) // n_shards) * n_shards
     sharding = NamedSharding(mesh, P(axis))
-    out = []
-    for a in arrays:
-        a = np.asarray(a)
-        if padded_n != n:
-            pad = np.zeros((padded_n - n,) + a.shape[1:], dtype=a.dtype)
-            a = np.concatenate([a, pad])
-        out.append(jax.device_put(jnp.asarray(a), sharding))
+    out = [jax.device_put(jnp.asarray(pad_to_multiple(a, padded_n)), sharding)
+           for a in arrays]
     valid = np.zeros(padded_n, dtype=bool)
     valid[:n] = True
-    out.append(jax.device_put(jnp.asarray(valid), sharding))
-    return out[:-1], out[-1]
+    return out, jax.device_put(jnp.asarray(valid), sharding)

@@ -1,0 +1,107 @@
+"""Multi-host (multi-process) mesh setup and data feeding.
+
+The reference scales across machines with its storage cluster's RPC
+fabric (Accumulo Thrift scans, HBase coprocessor streams, Zookeeper
+coordination — SURVEY.md §2.7/§5).  The TPU-native equivalent is JAX's
+multi-controller runtime: every host runs the same program, `jax.
+distributed` wires the processes into one system, and the collective
+programs in :mod:`geomesa_tpu.parallel.scan` run unchanged over a mesh
+spanning every host's devices — `psum`/`ppermute` ride ICI within a pod
+and DCN across pods, with no framework RPC layer at all.
+
+Two pieces make an existing single-host program multi-host:
+
+1. :func:`initialize_distributed` once at startup per process.
+2. Feed each process's local rows through
+   :func:`process_local_shard` (backed by
+   ``jax.make_array_from_process_local_data``), which assembles global
+   sharded arrays without any host ever holding the full dataset —
+   the distributed-ingest analog (SURVEY §2.7 "sharded device_put").
+
+**Position semantics.** The global layout is per-process blocks of
+equal padded length (agreed collectively via a host allgather of the
+local row counts), so a global position identifies
+``(process, local_row)`` — recover it with :func:`unrank_position`.
+Padding rows are marked invalid and can never appear in query results.
+With one process the layout degenerates to ``shard_batch``'s (padding
+at the tail, positions == input row order), which is what CI exercises.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import pad_to_multiple
+
+__all__ = ["initialize_distributed", "global_device_mesh",
+           "process_local_shard"]
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Join this process into a multi-controller JAX system.
+
+    Thin wrapper over ``jax.distributed.initialize`` — on most managed
+    TPU platforms all arguments auto-detect.  Call once per process
+    before any other JAX API.  Single-process runs may skip it."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def global_device_mesh(axis: str = "shard") -> Mesh:
+    """1-D mesh over EVERY device in the system (all processes), in
+    process-contiguous order (required by
+    ``make_array_from_process_local_data``)."""
+    devices = np.asarray(jax.devices())
+    return Mesh(devices, (axis,))
+
+
+def _agreed_padded_local(n_local: int, n_local_shards: int) -> int:
+    """Padded per-process block length, identical on every process.
+
+    Processes can hold unequal row counts, but the global array shape
+    must be agreed: allgather the local counts and pad every block to
+    the maximum (rounded to the local shard multiple)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        counts = np.asarray(
+            multihost_utils.process_allgather(np.int64(n_local)))
+        n_local = int(counts.max())
+    return ((n_local + n_local_shards - 1) // n_local_shards) * n_local_shards
+
+
+def process_local_shard(mesh: Mesh, *arrays, axis: str = "shard"):
+    """Assemble global sharded arrays from per-process local rows.
+
+    Each process passes only ITS rows; the result is a global jax.Array
+    laid out along the mesh's shard axis as ``process_count`` blocks of
+    one agreed padded length (see module doc for position semantics).
+    Returns ``(global_arrays, valid_mask)`` where the mask marks real
+    rows.
+    """
+    n_local_shards = sum(
+        1 for d in mesh.devices.flat if d.process_index == jax.process_index())
+    n_local_shards = max(1, n_local_shards)
+    n = len(arrays[0])
+    padded_n = _agreed_padded_local(n, n_local_shards)
+    global_n = padded_n * max(1, jax.process_count())
+    sharding = NamedSharding(mesh, P(axis))
+
+    def to_global(local: np.ndarray):
+        local = pad_to_multiple(local, padded_n)
+        return jax.make_array_from_process_local_data(
+            sharding, local, (global_n,) + local.shape[1:])
+
+    out = [to_global(np.asarray(a)) for a in arrays]
+    valid = np.zeros(padded_n, dtype=bool)
+    valid[:n] = True
+    return out, to_global(valid)

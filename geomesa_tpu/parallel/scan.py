@@ -65,16 +65,48 @@ class ShardedZ3Index:
     @classmethod
     def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK,
               mesh: Mesh | None = None) -> "ShardedZ3Index":
+        """Single-controller build: the full columns live on this host
+        and scatter over the mesh (shard_batch)."""
         mesh = mesh or device_mesh()
         period = TimePeriod.parse(period)
-        sfc = z3_sfc(period)
         dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
         host_bins, host_offs = to_binned_time(dtg_ms, period)
-        (xd, yd, td, bind, offd), valid = shard_batch(
+        sharded, valid = shard_batch(
             mesh,
             np.asarray(x, np.float64), np.asarray(y, np.float64), dtg_ms,
             host_bins.astype(np.int32), host_offs.astype(np.float64),
         )
+        return cls._finish_build(mesh, period, sharded, valid)
+
+    @classmethod
+    def build_multihost(cls, x, y, dtg_ms,
+                        period: TimePeriod | str = TimePeriod.WEEK,
+                        mesh: Mesh | None = None) -> "ShardedZ3Index":
+        """Multi-controller build: each process passes only its LOCAL
+        rows (distributed ingest); global sharded arrays assemble via
+        jax.make_array_from_process_local_data without any host holding
+        the whole dataset.  The global layout is per-process blocks of
+        one collectively-agreed padded length, so query() positions
+        identify ``(process, local_row)`` — decode with
+        :meth:`unrank_position`.  With one process this is the same
+        layout (and program) as :meth:`build`."""
+        from .multihost import global_device_mesh, process_local_shard
+
+        mesh = mesh or global_device_mesh()
+        period = TimePeriod.parse(period)
+        dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
+        host_bins, host_offs = to_binned_time(dtg_ms, period)
+        sharded, valid = process_local_shard(
+            mesh,
+            np.asarray(x, np.float64), np.asarray(y, np.float64), dtg_ms,
+            host_bins.astype(np.int32), host_offs.astype(np.float64),
+        )
+        return cls._finish_build(mesh, period, sharded, valid)
+
+    @classmethod
+    def _finish_build(cls, mesh, period, sharded, valid) -> "ShardedZ3Index":
+        sfc = z3_sfc(period)
+        xd, yd, td, bind, offd = sharded
 
         @partial(
             shard_map, mesh=mesh,
@@ -96,6 +128,18 @@ class ShardedZ3Index:
 
     def total(self) -> int:
         return int(np.asarray(jnp.sum(self.valid)))
+
+    def unrank_position(self, gpos: int) -> tuple[int, int]:
+        """Map a global query position to ``(process_index, local_row)``
+        under the multihost per-process block layout (for single-process
+        builds this is ``(0, gpos)``)."""
+        n_shards = int(self.mesh.devices.size)
+        per_shard = int(self.z.shape[0]) // n_shards
+        n_procs = max(1, jax.process_count())
+        shards_per_proc = max(1, n_shards // n_procs)
+        shard, local = divmod(int(gpos), per_shard)
+        proc = shard // shards_per_proc
+        return proc, (shard % shards_per_proc) * per_shard + local
 
     # -- collective queries ----------------------------------------------
     def range_count(self, boxes, t_lo_ms: int, t_hi_ms: int,

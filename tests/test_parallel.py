@@ -142,3 +142,28 @@ def test_ring_range_counts_oracle(sharded, data):
         want[i] = np.count_nonzero(
             (bins == plan.rbin[i]) & (z >= plan.rzlo[i]) & (z <= plan.rzhi[i]))
     np.testing.assert_array_equal(per_range, want)
+
+
+def test_build_multihost_matches_build(data):
+    """Single-process run of the multi-controller build path
+    (make_array_from_process_local_data) must produce an identical
+    index + query results to the scatter build."""
+    from geomesa_tpu.parallel import global_device_mesh
+    from geomesa_tpu.parallel.scan import ShardedZ3Index
+
+    x, y, t = data
+    mesh = global_device_mesh()
+    a = ShardedZ3Index.build(x, y, t, period="week", mesh=mesh)
+    b = ShardedZ3Index.build_multihost(x, y, t, period="week", mesh=mesh)
+    assert b.total() == a.total() == len(x)
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018 + 2 * 86_400_000, MS_2018 + 9 * 86_400_000
+    np.testing.assert_array_equal(
+        np.sort(a.query([box], tlo, thi)), np.sort(b.query([box], tlo, thi)))
+    assert a.range_count([box], tlo, thi) == b.range_count([box], tlo, thi)
+
+
+def test_unrank_position_single_process(sharded):
+    """Single-process layout: positions are original row indices."""
+    assert sharded.unrank_position(0) == (0, 0)
+    assert sharded.unrank_position(12345) == (0, 12345)
