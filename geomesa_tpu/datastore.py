@@ -246,16 +246,39 @@ class _SchemaStore:
             self._indexes["id"] = IdIndex.build(self.batch.ids)
         return self._indexes["id"]
 
+    def _z3_tier_keys(self):
+        """Host (bins, z) Z3 keys shared by every z3-tiered attribute
+        index of this schema — computed once per rebuild (cached in the
+        index map so rebuilds invalidate it with everything else)."""
+        if "attr-z3-keys" not in self._indexes:
+            from .curve import to_binned_time
+            from .curve.sfc import z3_sfc
+            dtg = self.batch.column(self.sft.dtg_field)
+            bins, offs = to_binned_time(
+                np.asarray(dtg, np.int64), self.sft.z3_interval)
+            x, y = self.batch.geom_xy(self.sft.geom_field)
+            sfc = z3_sfc(self.sft.z3_interval)
+            z = sfc.index(np.asarray(x), np.asarray(y),
+                          offs.astype(np.float64), xp=np)
+            self._indexes["attr-z3-keys"] = (bins, z)
+        return self._indexes["attr-z3-keys"]
+
     def attribute_index(self, attr: str) -> AttributeIndex:
         self._rebuild_if_dirty()
         key = f"attr:{attr}"
         if key not in self._indexes:
-            # date-tiered when the schema has a dtg field (the reference's
-            # secondary DateIndexKeySpace tier)
-            secondary = (self.batch.column(self.sft.dtg_field)
-                         if self.sft.dtg_field else None)
-            self._indexes[key] = AttributeIndex.build(
-                attr, self.batch.column(attr), secondary=secondary)
+            # secondary tier selection mirrors the reference: Z3 keys
+            # when the schema has point geometry + dtg, date keys when
+            # only dtg (AttributeIndexKeySpace secondary defaults)
+            if self.sft.dtg_field and self.sft.is_points and self.sft.geom_field:
+                bins, z = self._z3_tier_keys()
+                self._indexes[key] = AttributeIndex.build_z3(
+                    attr, self.batch.column(attr), bins, z)
+            else:
+                secondary = (self.batch.column(self.sft.dtg_field)
+                             if self.sft.dtg_field else None)
+                self._indexes[key] = AttributeIndex.build(
+                    attr, self.batch.column(attr), secondary=secondary)
         return self._indexes[key]
 
 

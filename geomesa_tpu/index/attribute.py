@@ -29,18 +29,33 @@ __all__ = ["AttributeIndex"]
 
 
 class AttributeIndex:
-    """Sorted-column index over one attribute, optionally date-tiered."""
+    """Sorted-column index over one attribute, optionally tiered.
+
+    Tier kinds (mirroring the reference's secondary-index selection —
+    Z3 when the schema has geometry + date, date when only a date):
+
+    * **date tier** — rows sorted by ``(value, dtg)``; equality runs
+      refine by a time window.
+    * **z3 tier** — rows sorted by ``(value, bin, z)``; equality runs
+      refine by a Z3 scan plan's covering ``(bin, zlo, zhi)`` ranges,
+      narrowing by space AND time.
+    """
 
     def __init__(self, attr: str, values: np.ndarray, pos: np.ndarray,
-                 secondary: np.ndarray | None = None):
+                 secondary: np.ndarray | None = None,
+                 sec_bins: np.ndarray | None = None,
+                 sec_z: np.ndarray | None = None):
         self.attr = attr
-        self.values = values      # sorted (by value, then secondary)
+        self.values = values      # sorted (by value, then tier keys)
         self.pos = pos
-        self.secondary = secondary  # int64, sorted within each value run
+        self.secondary = secondary  # date tier: int64 dtg, sorted per run
+        self.sec_bins = sec_bins    # z3 tier: int32 time bin
+        self.sec_z = sec_z          # z3 tier: int64 z, sorted within bin
 
     @classmethod
     def build(cls, attr: str, column: np.ndarray,
               secondary: np.ndarray | None = None) -> "AttributeIndex":
+        """Date-tiered (or untired) build."""
         col = np.asarray(column)
         if col.dtype == object:
             col = col.astype(str)
@@ -52,6 +67,44 @@ class AttributeIndex:
             order = np.lexsort((sec_col, col))
             sec = sec_col[order]
         return cls(attr, col[order], order.astype(np.int64), sec)
+
+    @classmethod
+    def build_z3(cls, attr: str, column: np.ndarray, bins: np.ndarray,
+                 z: np.ndarray) -> "AttributeIndex":
+        """Z3-tiered build: ``bins``/``z`` are the feature's Z3 key parts
+        (host-computed, same curve as the primary z3 index)."""
+        col = np.asarray(column)
+        if col.dtype == object:
+            col = col.astype(str)
+        bins = np.asarray(bins, dtype=np.int32)
+        z = np.asarray(z, dtype=np.int64)
+        order = np.lexsort((z, bins, col))
+        return cls(attr, col[order], order.astype(np.int64),
+                   sec_bins=bins[order], sec_z=z[order])
+
+    def _refine_z3(self, lo: int, hi: int, z3_ranges) -> np.ndarray:
+        """Positions of run [lo, hi) rows inside any covering
+        ``(bin, zlo, zhi)`` range — per-range seeks over the run's
+        (bin, z) sorted keys, the tiered-range assembly of
+        GeoMesaFeatureIndex.getQueryStrategy (:248-338)."""
+        rbin, rzlo, rzhi = z3_ranges
+        run_bins = self.sec_bins[lo:hi]
+        run_z = self.sec_z[lo:hi]
+        b0 = np.searchsorted(run_bins, rbin, side="left")
+        b1 = np.searchsorted(run_bins, rbin, side="right")
+        parts = []
+        for i in range(len(rbin)):
+            s, e = int(b0[i]), int(b1[i])
+            if s == e:
+                continue
+            zs = lo + s + np.searchsorted(run_z[s:e], rzlo[i], side="left")
+            ze = lo + s + np.searchsorted(run_z[s:e], rzhi[i], side="right")
+            if ze > zs:
+                parts.append(self.pos[zs:ze])
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        # plan ranges are disjoint per bin, so no dedupe needed
+        return np.concatenate(parts)
 
     def __len__(self) -> int:
         return len(self.values)
@@ -71,19 +124,24 @@ class AttributeIndex:
         i1 = hi if s_hi is None else lo + int(np.searchsorted(run, s_hi, side="right"))
         return slice(i0, i1)
 
-    def query_equals(self, value, sec_window=None) -> np.ndarray:
-        """Positions where attr == value, optionally tier-refined by an
-        inclusive ``(lo, hi)`` secondary (dtg-ms) window."""
+    def query_equals(self, value, sec_window=None,
+                     z3_ranges=None) -> np.ndarray:
+        """Positions where attr == value, tier-refined by an inclusive
+        ``(lo, hi)`` dtg window (date tier) or a covering
+        ``(rbin, rzlo, rzhi)`` plan (z3 tier)."""
         value = self._cast(value)
         lo = np.searchsorted(self.values, value, side="left")
         hi = np.searchsorted(self.values, value, side="right")
+        if z3_ranges is not None and self.sec_z is not None:
+            return np.sort(self._refine_z3(int(lo), int(hi), z3_ranges))
         return np.sort(self.pos[self._refine(lo, hi, sec_window)])
 
-    def query_in(self, values, sec_window=None) -> np.ndarray:
+    def query_in(self, values, sec_window=None,
+                 z3_ranges=None) -> np.ndarray:
         if not len(values):
             return np.empty(0, dtype=np.int64)
         return np.sort(np.unique(np.concatenate(
-            [self.query_equals(v, sec_window) for v in values])))
+            [self.query_equals(v, sec_window, z3_ranges) for v in values])))
 
     def query_range(self, lo=None, hi=None, lo_inclusive=True,
                     hi_inclusive=True) -> np.ndarray:

@@ -44,6 +44,18 @@ __all__ = ["ShardedZ3Index", "sharded_range_count", "sharded_density",
            "ring_range_counts"]
 
 
+def _fetch_global(a) -> np.ndarray:
+    """Materialize a possibly process-spanning sharded array on this
+    host.  Under multi-controller JAX a P('shard') output spans
+    non-addressable devices, so np.asarray would raise; process_allgather
+    assembles the global value on every host (single-process runs take
+    the plain path)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+    return np.asarray(a)
+
+
 class ShardedZ3Index:
     """Z3 point index sharded over the feature axis of a device mesh."""
 
@@ -209,12 +221,12 @@ class ShardedZ3Index:
                 jnp.asarray(r["rzhi"]), jnp.asarray(r["rtlo"]),
                 jnp.asarray(r["rthi"]), jnp.asarray(ixy), jnp.asarray(bxs),
                 jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
-            totals = np.asarray(totals)
+            totals = _fetch_global(totals)
             if int(totals.max(initial=0)) <= capacity:
                 # int32 wire: shard-LOCAL positions; the host re-bases by
                 # shard (it knows the row→shard mapping), halving the
                 # cross-host transfer (see z3._query_packed)
-                local = np.asarray(packed).reshape(
+                local = _fetch_global(packed).reshape(
                     self.mesh.devices.size, capacity)
                 hit = local >= 0
                 shard_of = np.nonzero(hit)[0].astype(np.int64)
@@ -330,7 +342,7 @@ def ring_range_counts(mesh, bins, z, rbin, rzlo, rzhi) -> np.ndarray:
             step, (rb, rlo, rhi, acc), None, length=n)
         return acc
 
-    return np.asarray(jax.jit(ring)(bins, z, rbin, rzlo, rzhi))
+    return _fetch_global(jax.jit(ring)(bins, z, rbin, rzlo, rzhi))
 
 
 def sharded_density(mesh, x, y, dtg, valid, weights, boxes,

@@ -175,18 +175,22 @@ class QueryPlanner:
             attr = name[5:]
             idx = store.attribute_index(attr)
             (a, kind, payload) = strategy.attr_values[0]
-            # covering secondary (dtg) window for the date tier; exactness
+            # covering secondary refinement for the tiers; exactness
             # comes from run()'s residual filter as always
             sec_window = None
+            z3_ranges = None
             if strategy.intervals and idx.secondary is not None:
                 los = [iv[0] for iv in strategy.intervals]
                 his = [iv[1] for iv in strategy.intervals]
                 sec_window = (None if any(v is None for v in los) else min(los),
                               None if any(v is None for v in his) else max(his))
+            if (idx.sec_z is not None
+                    and (strategy.geometries or strategy.intervals)):
+                z3_ranges = self._attr_z3_ranges(strategy)
             if kind == "equals":
-                return idx.query_equals(payload, sec_window)
+                return idx.query_equals(payload, sec_window, z3_ranges)
             if kind == "in":
-                return idx.query_in(payload, sec_window)
+                return idx.query_in(payload, sec_window, z3_ranges)
             if kind == "range":
                 lo, hi, lo_inc, hi_inc = payload
                 return idx.query_range(lo, hi, lo_inc, hi_inc)
@@ -213,6 +217,38 @@ class QueryPlanner:
             parts = [idx.query(g, exact=False) for g in strategy.geometries or ()]
             return _union(parts)
         raise ValueError(f"unknown strategy {name!r}")
+
+    def _attr_z3_ranges(self, strategy: FilterStrategy):
+        """Covering (bin, zlo, zhi) plan for the attribute index's z3
+        tier; open time bounds clamp to the data's extent (the same
+        clamping the primary z3 index applies)."""
+        from ..index.z3 import plan_z3_query
+
+        # data extent from the maintained MinMax stat (O(1)); fall back
+        # to one column scan only when stats are absent
+        mm = self.store.stats_map().get("dtg_minmax")
+        if mm is not None and not mm.is_empty:
+            data_lo, data_hi = int(mm.min), int(mm.max)
+        else:
+            dtg = self.store.batch.column(self.sft.dtg_field)
+            if len(dtg) == 0:
+                return None
+            data_lo, data_hi = int(dtg.min()), int(dtg.max())
+        lo, hi = data_lo, data_hi
+        if strategy.intervals:
+            los = [iv[0] for iv in strategy.intervals]
+            his = [iv[1] for iv in strategy.intervals]
+            if not any(v is None for v in los):
+                lo = max(lo, min(los))
+            if not any(v is None for v in his):
+                hi = min(hi, max(his))
+        boxes = ([g.envelope.as_tuple() for g in strategy.geometries]
+                 or [(-180.0, -90.0, 180.0, 90.0)])
+        plan = plan_z3_query(boxes, lo, hi, self.sft.z3_interval,
+                             max_ranges=256)
+        if plan.num_ranges == 0:
+            return None
+        return plan.rbin, plan.rzlo, plan.rzhi
 
     def _sort_limit(self, positions: np.ndarray, batch: FeatureBatch,
                     query: Query) -> np.ndarray:

@@ -181,16 +181,22 @@ class StrategyDecider:
         indexed = {a.name for a in sft.attributes if a.indexed}
         for attr, kind, payload in _collect_attr_predicates(f, indexed):
             cost = self._attr_cost(attr, kind, payload)
-            # the date tier narrows equality/IN runs by the temporal
-            # fraction (tiered-range assembly,
-            # api/GeoMesaFeatureIndex.scala:248-338)
-            tiered = all_ivs if dtg and kind in ("equals", "in") else ()
-            if tiered:
+            # secondary tiers narrow equality/IN runs (tiered-range
+            # assembly, api/GeoMesaFeatureIndex.scala:248-338): the date
+            # tier by the temporal fraction; the z3 tier (schemas with
+            # point geom + dtg) by the spatial fraction too
+            tiered_ivs = all_ivs if dtg and kind in ("equals", "in") else ()
+            tiered_geoms = ()
+            if tiered_ivs:
                 cost *= self._temporal_fraction(all_ivs)
+            if (dtg and geom and sft.is_points and kind in ("equals", "in")
+                    and spatial):
+                tiered_geoms = tuple(geoms.values)
+                cost *= sp_frac
             out.append(FilterStrategy(
                 f"attr:{attr}", max(1.0, cost),
                 attr_values=((attr, kind, payload),),
-                intervals=tiered))
+                intervals=tiered_ivs, geometries=tiered_geoms))
 
         out.append(FilterStrategy("full", float(self.total)))
         return out

@@ -110,10 +110,37 @@ def test_tier_discounts_strategy_cost(store):
 
 def test_tier_narrows_candidates(store):
     """The scan itself (pre-residual-filter) must return fewer candidates
-    with the window than without — the point of the tier."""
+    with the tier refinement than without — the point of the tier.
+    This schema has point geom + dtg, so the index carries the Z3 tier
+    (the reference's default secondary for such schemas)."""
+    from geomesa_tpu.index.z3 import plan_z3_query
+
     st = store._store("tiered")
     idx = st.attribute_index("name")
+    assert idx.sec_z is not None  # z3 tier selected
     full = idx.query_equals("c")
     lo, hi = MS_2018 + 2 * DAY, MS_2018 + 4 * DAY
-    narrowed = idx.query_equals("c", (lo, hi))
+    plan = plan_z3_query([(-180.0, -90.0, 180.0, 90.0)], lo, hi,
+                         st.sft.z3_interval, 256)
+    narrowed = idx.query_equals(
+        "c", None, (plan.rbin, plan.rzlo, plan.rzhi))
     assert 0 < len(narrowed) < len(full)
+    # spatial narrowing too: a small bbox plan shrinks further
+    plan_sp = plan_z3_query([(-5.0, -5.0, 5.0, 5.0)], lo, hi,
+                            st.sft.z3_interval, 256)
+    spatial = idx.query_equals(
+        "c", None, (plan_sp.rbin, plan_sp.rzlo, plan_sp.rzhi))
+    assert len(spatial) < len(narrowed)
+
+
+def test_z3_tier_planner_exact(store):
+    """attr = X AND bbox AND time through the planner: exact results,
+    attr strategy chosen with the z3-tier refinement wired in."""
+    ecql = ("name = 'b' AND BBOX(geom, -5, -5, 5, 5) AND dtg DURING "
+            "2018-01-02T00:00:00Z/2018-01-06T00:00:00Z")
+    res = store.query_result("tiered", ecql)
+    st = store._store("tiered")
+    want = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+    np.testing.assert_array_equal(np.sort(res.positions), want)
+    assert res.strategy.index == "attr:name"
+    assert res.strategy.geometries  # spatial tier info reached the plan
