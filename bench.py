@@ -25,6 +25,22 @@ SCAN_N = 4_000_000
 MS_2018 = 1514764800000
 
 
+
+def _median_time(fn, iters=5):
+    """Median per-iteration wall time — robust to tunnel stalls that
+    would skew a mean."""
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    mid = len(times) // 2
+    if len(times) % 2:
+        return times[mid]
+    return (times[mid - 1] + times[mid]) / 2
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -61,11 +77,9 @@ def main():
     # finishes on tunneled platforms
     _ = np.asarray(ingest(xd, yd, od, bd)[0][:1])
 
-    iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        _ = np.asarray(ingest(xd, yd, od, bd)[0][:1])
-    ingest_rate = iters * N / (time.perf_counter() - t0)
+    ingest_dt = _median_time(
+        lambda: np.asarray(ingest(xd, yd, od, bd)[0][:1]))
+    ingest_rate = N / ingest_dt
 
     # scan: selective bbox + 5-day window
     index = Z3PointIndex.build(x[:SCAN_N], y[:SCAN_N], t[:SCAN_N],
@@ -73,11 +87,7 @@ def main():
     box = (-80.0, 30.0, -60.0, 50.0)
     tlo, thi = MS_2018 + 2 * 86_400_000, MS_2018 + 7 * 86_400_000
     hits = index.query([box], tlo, thi)  # warm (compiles both phases)
-    t0 = time.perf_counter()
-    q_iters = 10
-    for _ in range(q_iters):
-        hits = index.query([box], tlo, thi)
-    q_dt = (time.perf_counter() - t0) / q_iters
+    q_dt = _median_time(lambda: index.query([box], tlo, thi), iters=10)
     scan_rate = len(hits) / q_dt
     # index-resident points covered per second of query wall time (the
     # reference's "tens of millions of points in seconds" claim scale)
@@ -94,10 +104,7 @@ def main():
         windows.append(([(cx - 3, cy - 3, cx + 3, cy + 3)],
                         lo, lo + 3 * 86_400_000))
     batched = index.query_many(windows)  # warm
-    t0 = time.perf_counter()
-    for _ in range(5):
-        batched = index.query_many(windows)
-    batched_dt = (time.perf_counter() - t0) / 5
+    batched_dt = _median_time(lambda: index.query_many(windows))
     batched_hits = int(sum(len(b) for b in batched))
 
     # density histogram (auto: sorted-segment at this N; Pallas MXU
@@ -109,12 +116,13 @@ def main():
     grid = density_grid_auto(xd, yd, dw, dmask,
                              (-180.0, -90.0, 180.0, 90.0), 256, 128)
     _ = np.asarray(grid)  # warm
-    t0 = time.perf_counter()
-    for _ in range(5):
-        grid = density_grid_auto(xd, yd, dw, dmask,
-                                 (-180.0, -90.0, 180.0, 90.0), 256, 128)
-        _ = np.asarray(grid[:1, :1])
-    density_dt = (time.perf_counter() - t0) / 5
+
+    def one_density():
+        g = density_grid_auto(xd, yd, dw, dmask,
+                              (-180.0, -90.0, 180.0, 90.0), 256, 128)
+        _ = np.asarray(g[:1, :1])
+
+    density_dt = _median_time(one_density)
 
     print(json.dumps({
         "metric": "z3_ingest_keys_per_sec_per_chip",
