@@ -107,7 +107,23 @@ class _SchemaStore:
         for s in self._stats.values():
             s.observe(batch)
         self._vis_masks: dict = {}
-        self._dirty = True
+        # incremental z3 maintenance: appended rows merge into the
+        # resident sorted columns in one gather pass (BatchWriter-style)
+        # instead of forcing a full device re-sort; every other index
+        # rebuilds lazily as before.  A z3 index cached across a prior
+        # unprocessed mutation (dirty) is stale and must NOT be appended
+        # to.
+        z3 = None if self._dirty else self._indexes.get("z3")
+        self._indexes.clear()
+        self._dev_xy = None
+        self._dirty = False
+        if (z3 is not None and self.sft.is_points and self.sft.geom_field
+                and self.sft.dtg_field):
+            x, y = batch.geom_xy(self.sft.geom_field)
+            self._indexes["z3"] = z3.append(
+                x, y, batch.column(self.sft.dtg_field))
+        else:
+            self._dirty = True
 
     def masked_batch(self, auths):
         """Batch with attribute-guarded values nulled for these auths —
@@ -197,12 +213,20 @@ class _SchemaStore:
 
     def device_xy(self):
         """The point columns uploaded once and shared by the z2 AND z3
-        builders (two separate uploads would double HBM + transfer)."""
+        builders (two separate uploads would double HBM + transfer).
+        After incremental appends the live z3 index already holds the
+        coordinates on device, so slice those (device-side copy) rather
+        than paying a full host→device re-upload."""
         if getattr(self, "_dev_xy", None) is None:
             import jax.numpy as jnp
-            x, y = self.batch.geom_xy()
-            self._dev_xy = (jnp.asarray(np.asarray(x, np.float64)),
-                            jnp.asarray(np.asarray(y, np.float64)))
+            z3 = self._indexes.get("z3")
+            if z3 is not None and len(z3) == len(self.batch):
+                n = len(z3)
+                self._dev_xy = (z3.x[:n], z3.y[:n])
+            else:
+                x, y = self.batch.geom_xy()
+                self._dev_xy = (jnp.asarray(np.asarray(x, np.float64)),
+                                jnp.asarray(np.asarray(y, np.float64)))
         return self._dev_xy
 
     # -- lazily-built indexes --------------------------------------------

@@ -336,6 +336,45 @@ def _query_many_packed(
 _pallas_scan_ok: bool | None = None
 
 
+#: sentinel keys for capacity-padding slots: sort after every real key
+#: and can never match a query range (real bins are small)
+_SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)
+_SENTINEL_Z = np.int64(np.iinfo(np.int64).max)
+
+
+@partial(jax.jit, static_argnames=("sfc",))
+def _append_step(sfc, bins_a, z_a, pos_a, x_a, y_a, dtg_a, r,
+                 xs, ys, offs, bs, ts, m_valid):
+    """One static-shaped incremental append: encode the (padded) new
+    batch, overwrite sentinel slots at the sorted tail with its keys,
+    and re-sort the capacity-padded columns in place — all device-side,
+    no host transfer.  On TPU the sort network (~230M keys/s) IS the
+    cheapest merge: fine-grained gather/scatter merges run orders of
+    magnitude slower than one dense sort, so the LSM "memtable merge"
+    becomes "write into padding + sort".  Shapes depend only on
+    (capacity, m_pad), so steady-state appends reuse one compile per
+    bucket; the new feature values land at ``[r, r + m_pad)`` of the
+    value columns (slots past m_valid belong to invalid rows that are
+    never gathered)."""
+    m_pad = xs.shape[0]
+    z_b = sfc.index(xs, ys, offs)
+    valid_b = jnp.arange(m_pad) < m_valid
+    bs = jnp.where(valid_b, bs, _SENTINEL_BIN)
+    z_b = jnp.where(valid_b, z_b, _SENTINEL_Z)
+    payload = jnp.where(valid_b, r.astype(jnp.int32)
+                        + jnp.arange(m_pad, dtype=jnp.int32), -1)
+    # sentinels occupy the sorted tail, so the write window starts at r
+    bins_w = jax.lax.dynamic_update_slice(bins_a, bs, (r,))
+    z_w = jax.lax.dynamic_update_slice(z_a, z_b, (r,))
+    pos_w = jax.lax.dynamic_update_slice(pos_a, payload, (r,))
+    bins_m, z_m, pos_m = jax.lax.sort(
+        (bins_w, z_w, pos_w), dimension=0, num_keys=2)
+    x_a = jax.lax.dynamic_update_slice(x_a, xs, (r,))
+    y_a = jax.lax.dynamic_update_slice(y_a, ys, (r,))
+    dtg_a = jax.lax.dynamic_update_slice(dtg_a, ts, (r,))
+    return bins_m, z_m, pos_m, x_a, y_a, dtg_a
+
+
 @partial(jax.jit, static_argnames=("sfc",))
 def _encode_sort_z3(sfc, xs, ys, os_, bs):
     """Key encode + 2-key variadic sort (bin-major), permutation as
@@ -363,6 +402,9 @@ class Z3PointIndex:
         self.x = x
         self.y = y
         self.dtg = dtg
+        #: valid rows; append() capacity-pads the arrays with sentinel
+        #: keys past this count
+        self._n_rows = int(z.shape[0])
         self._capacity = self.DEFAULT_CAPACITY
         #: data time extent; queries clamp to it so an unbounded interval
         #: plans over the data's bins, not every bin since the epoch
@@ -396,7 +438,62 @@ class Z3PointIndex:
         return idx
 
     def __len__(self) -> int:
-        return int(self.z.shape[0])
+        return self._n_rows
+
+    def _grow_capacity(self, cap: int) -> None:
+        """Extend the resident columns to ``cap`` slots with sentinel
+        keys (sort last, match nothing) — one reallocation per
+        power-of-two growth step."""
+        pad = cap - int(self.z.shape[0])
+        if pad <= 0:
+            return
+        self.bins = jnp.concatenate(
+            [self.bins, jnp.full((pad,), _SENTINEL_BIN, self.bins.dtype)])
+        self.z = jnp.concatenate(
+            [self.z, jnp.full((pad,), _SENTINEL_Z, self.z.dtype)])
+        self.pos = jnp.concatenate(
+            [self.pos, jnp.full((pad,), -1, self.pos.dtype)])
+        self.x = jnp.concatenate([self.x, jnp.zeros((pad,), self.x.dtype)])
+        self.y = jnp.concatenate([self.y, jnp.zeros((pad,), self.y.dtype)])
+        self.dtg = jnp.concatenate(
+            [self.dtg, jnp.zeros((pad,), self.dtg.dtype)])
+
+    def append(self, x, y, dtg_ms) -> "Z3PointIndex":
+        """Incremental ingest: encode the NEW batch, write its keys into
+        the sentinel padding, and re-sort the capacity-padded columns in
+        place, entirely device-resident — the win over a rebuild is
+        skipping the host→device re-upload of the whole dataset, not the
+        sort (on TPU the sort network IS the cheapest merge; see
+        _append_step).  Shapes bucket by (capacity, pow2(m)), so
+        steady-state appends reuse one compiled program (~270ms per 100k
+        rows at 16M resident).  Returns self (mutated)."""
+        x = np.asarray(x, dtype=np.float64)
+        m = len(x)
+        if m == 0:
+            return self
+        y = np.asarray(y, dtype=np.float64)
+        dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
+        m_pad = gather_capacity(m, minimum=8)
+        r = self._n_rows
+        if r + m_pad > int(self.z.shape[0]):
+            self._grow_capacity(gather_capacity(r + m_pad))
+        host_bins, host_offs = to_binned_time(dtg_ms, self.period)
+        pad = m_pad - m
+        self.bins, self.z, self.pos, self.x, self.y, self.dtg = _append_step(
+            self.sfc, self.bins, self.z, self.pos, self.x, self.y, self.dtg,
+            jnp.int32(r),
+            jnp.asarray(np.pad(x, (0, pad))),
+            jnp.asarray(np.pad(y, (0, pad))),
+            jnp.asarray(np.pad(host_offs.astype(np.float64), (0, pad))),
+            jnp.asarray(np.pad(host_bins.astype(np.int32), (0, pad))),
+            jnp.asarray(np.pad(dtg_ms, (0, pad))),
+            jnp.int32(m))
+        self._n_rows = r + m
+        t_min = int(dtg_ms.min())
+        t_max = int(dtg_ms.max())
+        self.t_min_ms = t_min if self.t_min_ms is None else min(self.t_min_ms, t_min)
+        self.t_max_ms = t_max if self.t_max_ms is None else max(self.t_max_ms, t_max)
+        return self
 
     def _clamp_time(self, t_lo_ms, t_hi_ms) -> tuple[int, int]:
         """Clamp to the data's time extent; ``None`` bounds are open (no

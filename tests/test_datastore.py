@@ -262,3 +262,49 @@ def test_z3_fid_strategy_auto_ids():
     batch = ds.query("zf")
     assert len(set(batch.ids)) == n
     assert all(len(i) == 36 and i[14] == "4" for i in batch.ids)
+
+
+def test_incremental_write_appends_z3_index():
+    """A write after the z3 index exists merges into it (no rebuild) and
+    stays oracle-exact."""
+    rng = np.random.default_rng(91)
+    ds = TpuDataStore()
+    ds.create_schema("inc", "name:String,dtg:Date,*geom:Point")
+    n0, m = 20_000, 3_000
+    x = rng.uniform(-75, -73, n0); y = rng.uniform(40, 42, n0)
+    t = rng.integers(MS_2018, MS_2018 + 14 * 86_400_000, n0)
+    ds.write("inc", {"name": np.array(["a"] * n0, object), "dtg": t,
+                     "geom": (x, y)})
+    ecql = ("BBOX(geom,-74.6,40.3,-73.4,41.7) AND dtg DURING "
+            "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+    _ = ds.query("inc", ecql)  # builds the z3 index
+    st = ds._store("inc")
+    z3_before = st._indexes.get("z3")
+    assert z3_before is not None
+
+    nx = rng.uniform(-75, -73, m); ny = rng.uniform(40, 42, m)
+    nt = rng.integers(MS_2018, MS_2018 + 14 * 86_400_000, m)
+    ds.write("inc", {"name": np.array(["b"] * m, object), "dtg": nt,
+                     "geom": (nx, ny)})
+    # same object, incrementally extended — not a rebuild
+    assert st._indexes.get("z3") is z3_before
+    assert len(z3_before) == n0 + m
+
+    res = ds.query_result("inc", ecql)
+    ax = np.concatenate([x, nx]); ay = np.concatenate([y, ny])
+    at = np.concatenate([t, nt])
+    want = np.flatnonzero(
+        (ax >= -74.6) & (ax <= -73.4) & (ay >= 40.3) & (ay <= 41.7)
+        & (at >= MS_2018 + 2 * 86_400_000)
+        & (at <= MS_2018 + 9 * 86_400_000))
+    np.testing.assert_array_equal(np.sort(res.positions), want)
+
+    # deletion invalidates: next write must NOT append to a stale index
+    ds.delete("inc", [st.batch.ids[0]])
+    ds.write("inc", {"name": np.array(["c"], object),
+                     "dtg": np.array([MS_2018 + 86_400_000]),
+                     "geom": (np.array([-74.0]), np.array([41.0]))})
+    res2 = ds.query_result("inc", ecql)
+    st2 = ds._store("inc")
+    oracle2 = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st2.batch))
+    np.testing.assert_array_equal(np.sort(res2.positions), oracle2)
