@@ -1,4 +1,4 @@
-"""Sharded index build, range counting and density over a device mesh.
+"""Sharded index build, scan, append and density over a device mesh.
 
 Per-shard sorted key segments + collective reductions — the mesh analog of
 the reference's range-partitioned parallel scans with client-side reduce
@@ -7,16 +7,28 @@ SURVEY.md §2.7):
 
 * ``ShardedZ3Index.build``: each device encodes and locally sorts its
   feature shard (per-tablet sorted layout), all inside one ``shard_map``.
-* ``sharded_range_count``: per-shard binary-search seeks over the local
-  sorted segment, counts summed with ``psum`` over ICI.
-* ``sharded_density``: per-shard masked grid histogram + ``psum`` — the
-  DensityScan + client-merge path as a single collective program
-  (BASELINE config 4).
+* ``ShardedZ3Index.query`` / ``query_many``: per-shard binary-search
+  seeks + fixed-capacity gather + fused candidate mask, results stacked
+  over the shard axis (the scatter-gather + client-merge pattern).
+* ``ShardedZ3Index.append``: distributed incremental ingest — each shard
+  writes its slice of the new batch into local sentinel padding and
+  re-sorts in place (the BatchWriter continuous-write role,
+  index/api/IndexAdapter.scala:95-106, as one collective program).
+* ``sharded_range_count`` / ``sharded_density``: psum reductions over
+  ICI (DensityScan + client-merge as a single collective program).
+
+**Row identity.** Every shard carries a global-id column as sort payload
+alongside its keys: scans emit gids directly, so query results never
+depend on block-layout arithmetic (shards may hold unequal row counts
+after appends, processes may hold unequal blocks under multihost).
+Single-controller gids are the input row order (int32); multihost gids
+code ``process << GID_PROC_SHIFT | local_row`` (int64) — decode with
+:meth:`ShardedZ3Index.unrank_position`.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -28,11 +40,9 @@ try:  # jax>=0.8 top-level API; the experimental path is deprecated
 except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
-from functools import lru_cache
-
 from ..curve.binnedtime import TimePeriod, to_binned_time
 from ..curve.sfc import z3_sfc
-from ..index.z3 import Z3QueryPlan, candidate_mask, plan_z3_query
+from ..index.z3 import candidate_mask, plan_z3_query
 from ..ops.density import density_grid, density_grid_auto
 from ..ops.search import (
     expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
@@ -41,7 +51,15 @@ from ..ops.search import (
 from .mesh import device_mesh, shard_batch
 
 __all__ = ["ShardedZ3Index", "sharded_range_count", "sharded_density",
-           "ring_range_counts"]
+           "ring_range_counts", "GID_PROC_SHIFT"]
+
+#: multihost gid coding: ``gid = process << GID_PROC_SHIFT | local_row``
+GID_PROC_SHIFT = 40
+
+#: sentinel keys for padding slots: sort after every real key and can
+#: never match a query range (real bins are small, z uses ≤63 bits)
+_SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)
+_SENTINEL_Z = np.int64(np.iinfo(np.int64).max)
 
 
 def _fetch_global(a) -> np.ndarray:
@@ -56,39 +74,201 @@ def _fetch_global(a) -> np.ndarray:
     return np.asarray(a)
 
 
-class ShardedZ3Index:
-    """Z3 point index sharded over the feature axis of a device mesh."""
+@lru_cache(maxsize=32)
+def _z3_build_program(mesh: Mesh, sfc):
+    """Per-shard encode + local 2-key sort, values travelling as sort
+    payload so the sorted layout IS the storage layout (no permutation
+    indirection on the scan path)."""
 
-    def __init__(self, mesh: Mesh, period: TimePeriod, bins, z, pos,
-                 x, y, dtg, valid):
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 7, out_specs=(P("shard"),) * 6,
+    )
+    def encode_sort(xs, ys, ts, bs, os_, gs, vs):
+        z = sfc.index(xs, ys, os_)
+        bs = jnp.where(vs, bs, _SENTINEL_BIN)
+        z = jnp.where(vs, z, _SENTINEL_Z)
+        gs = jnp.where(vs, gs, gs.dtype.type(-1))
+        return jax.lax.sort((bs, z, gs, xs, ys, ts), dimension=0, num_keys=2)
+
+    return jax.jit(encode_sort)
+
+
+@lru_cache(maxsize=64)
+def _z3_scan_program(mesh: Mesh, capacity: int):
+    """Jitted collective scan, cached per (mesh, capacity) — plan arrays
+    are traced arguments so new queries reuse the compile.  Emits global
+    ids (the gid payload) packed per shard; -1 marks empty slots."""
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 6 + (P(None),) * 7 + (P(), P()),
+        out_specs=(P("shard"), P("shard")),
+    )
+    def scan(lb, lz, lg, xs, ys, ts,
+             rb, rlo, rhi, rtl, rth, ixy, bxs, t_lo, t_hi):
+        starts = searchsorted2(lb, lz, rb, rlo, side="left")
+        ends = searchsorted2(lb, lz, rb, rhi, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        total = jnp.sum(counts)
+        idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
+        zc = lz[idx]
+        gc = lg[idx]
+        mask = valid_slot & (gc >= 0) & candidate_mask(
+            zc, rtl[rid], rth[rid], ixy, bxs,
+            xs[idx], ys[idx], ts[idx], t_lo, t_hi)
+        packed = jnp.where(mask, gc, gc.dtype.type(-1))
+        return packed, total[None].astype(jnp.int64)
+
+    return jax.jit(scan)
+
+
+@lru_cache(maxsize=64)
+def _z3_many_program(mesh: Mesh, capacity: int, pos_bits: int):
+    """Batched multi-window collective scan: Q independent bbox+time
+    queries in one dispatch, results coded ``qid << pos_bits | gid``
+    (see index/z3._query_many_packed for the coding rationale)."""
+    dt = jnp.int32 if pos_bits < 31 else jnp.int64
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 6 + (P(None),) * 11,
+        out_specs=(P("shard"), P("shard")),
+    )
+    def scan(lb, lz, lg, xs, ys, ts,
+             rb, rlo, rhi, rtl, rth, rqid, ixy, bxs, bqid, qtlo, qthi):
+        starts = searchsorted2(lb, lz, rb, rlo, side="left")
+        ends = searchsorted2(lb, lz, rb, rhi, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        total = jnp.sum(counts)
+        idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
+        zc = lz[idx]
+        gc = lg[idx]
+        cqid = rqid[rid]
+        mask = valid_slot & (gc >= 0) & candidate_mask(
+            zc, rtl[rid], rth[rid], ixy, bxs,
+            xs[idx], ys[idx], ts[idx], 0, 0,
+            cqid=cqid, bqid=bqid, qtlo=qtlo, qthi=qthi)
+        coded = (cqid.astype(dt) << dt(pos_bits)) | gc.astype(dt)
+        packed = jnp.where(mask, coded, dt(-1))
+        return packed, total[None].astype(jnp.int64)
+
+    return jax.jit(scan)
+
+
+@lru_cache(maxsize=32)
+def _z3_append_program(mesh: Mesh, sfc):
+    """Distributed incremental append: each shard encodes its slice of
+    the new batch, overwrites sentinel slots starting at its local row
+    count, and re-sorts its capacity-padded columns in place — the
+    single-chip ``_append_step`` (index/z3.py) as one collective.  On TPU
+    the local sort network IS the cheapest merge (see that docstring)."""
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 6 + (P("shard"),) * 6 + (P("shard"),),
+        out_specs=(P("shard"),) * 6,
+    )
+    def app(lb, lz, lg, lx, ly, lt, xs, ys, os_, bs, ts, gs, r):
+        z_new = sfc.index(xs, ys, os_)
+        invalid = gs < 0
+        bs = jnp.where(invalid, _SENTINEL_BIN, bs)
+        z_new = jnp.where(invalid, _SENTINEL_Z, z_new)
+        r0 = r[0]
+        lb = jax.lax.dynamic_update_slice(lb, bs, (r0,))
+        lz = jax.lax.dynamic_update_slice(lz, z_new, (r0,))
+        lg = jax.lax.dynamic_update_slice(lg, gs, (r0,))
+        lx = jax.lax.dynamic_update_slice(lx, xs, (r0,))
+        ly = jax.lax.dynamic_update_slice(ly, ys, (r0,))
+        lt = jax.lax.dynamic_update_slice(lt, ts, (r0,))
+        return jax.lax.sort((lb, lz, lg, lx, ly, lt), dimension=0, num_keys=2)
+
+    return jax.jit(app)
+
+
+@lru_cache(maxsize=32)
+def _z3_grow_program(mesh: Mesh, pad: int):
+    """Extend every shard's columns by ``pad`` sentinel slots (sorted
+    invariant holds: sentinels are the max key)."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard"),) * 6, out_specs=(P("shard"),) * 6)
+    def grow(lb, lz, lg, lx, ly, lt):
+        def ext(a, fill):
+            return jnp.concatenate(
+                [a, jnp.full((pad,), fill, dtype=a.dtype)])
+        return (ext(lb, _SENTINEL_BIN), ext(lz, _SENTINEL_Z),
+                ext(lg, -1), ext(lx, 0), ext(ly, 0), ext(lt, 0))
+
+    return jax.jit(grow)
+
+
+class ShardedZ3Index:
+    """Z3 point index sharded over the feature axis of a device mesh.
+
+    Per-shard state (all sharded jax.Arrays, sorted by ``(bins, z)``
+    within each shard, capacity-padded with sentinel keys):
+
+    * ``bins``/``z`` — the sort keys (the reference's
+      ``[2B bin][8B z]`` row-key order, Z3IndexKeySpace.scala:60)
+    * ``gid`` — global row id payload (-1 for padding)
+    * ``x``/``y``/``dtg`` — feature values in sorted order (no
+      permutation indirection on the scan path)
+    """
+
+    DEFAULT_CAPACITY = 1 << 15
+
+    def __init__(self, mesh: Mesh, period: TimePeriod,
+                 bins, z, gid, x, y, dtg, n_total: int,
+                 shard_counts: np.ndarray | None,
+                 t_min_ms: int | None = None, t_max_ms: int | None = None):
         self.mesh = mesh
         self.period = period
         self.sfc = z3_sfc(period)
-        # per-shard locally-sorted key columns (+ local permutation)
         self.bins = bins
         self.z = z
-        self.pos = pos
-        # sharded feature columns (original shard order)
+        self.gid = gid
         self.x = x
         self.y = y
         self.dtg = dtg
-        self.valid = valid
+        self._n_total = n_total
+        #: per-shard valid row counts (None under multihost — append and
+        #: exact per-shard bookkeeping are single-controller for now)
+        self._shard_counts = shard_counts
+        self.t_min_ms = t_min_ms
+        self.t_max_ms = t_max_ms
+        self._capacity = self.DEFAULT_CAPACITY
 
+    # -- builds -----------------------------------------------------------
     @classmethod
     def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK,
               mesh: Mesh | None = None) -> "ShardedZ3Index":
         """Single-controller build: the full columns live on this host
-        and scatter over the mesh (shard_batch)."""
+        and scatter over the mesh (shard_batch); gids are input row order."""
         mesh = mesh or device_mesh()
         period = TimePeriod.parse(period)
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
         dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
         host_bins, host_offs = to_binned_time(dtg_ms, period)
+        n = len(x)
+        gids = np.arange(n, dtype=np.int32)
         sharded, valid = shard_batch(
-            mesh,
-            np.asarray(x, np.float64), np.asarray(y, np.float64), dtg_ms,
-            host_bins.astype(np.int32), host_offs.astype(np.float64),
-        )
-        return cls._finish_build(mesh, period, sharded, valid)
+            mesh, x, y, dtg_ms, host_bins.astype(np.int32),
+            host_offs.astype(np.float64), gids)
+        xd, yd, td, bind, offd, gidd = sharded
+        prog = _z3_build_program(mesh, z3_sfc(period))
+        bins_s, z_s, gid_s, x_s, y_s, t_s = prog(
+            xd, yd, td, bind, offd, gidd, valid)
+        n_shards = int(mesh.devices.size)
+        per = int(bins_s.shape[0]) // n_shards
+        shard_counts = np.clip(n - np.arange(n_shards) * per, 0, per)
+        idx = cls(mesh, period, bins_s, z_s, gid_s, x_s, y_s, t_s,
+                  n_total=n, shard_counts=shard_counts.astype(np.int64))
+        if n:
+            idx.t_min_ms = int(dtg_ms.min())
+            idx.t_max_ms = int(dtg_ms.max())
+        return idx
 
     @classmethod
     def build_multihost(cls, x, y, dtg_ms,
@@ -97,66 +277,131 @@ class ShardedZ3Index:
         """Multi-controller build: each process passes only its LOCAL
         rows (distributed ingest); global sharded arrays assemble via
         jax.make_array_from_process_local_data without any host holding
-        the whole dataset.  The global layout is per-process blocks of
-        one collectively-agreed padded length, so query() positions
-        identify ``(process, local_row)`` — decode with
-        :meth:`unrank_position`.  With one process this is the same
-        layout (and program) as :meth:`build`."""
+        the whole dataset.  Gids code ``process << GID_PROC_SHIFT |
+        local_row`` (int64), so results identify rows regardless of
+        per-process block sizes — decode with :meth:`unrank_position`.
+        With one process this degenerates to plain local row ids."""
         from .multihost import global_device_mesh, process_local_shard
 
         mesh = mesh or global_device_mesh()
         period = TimePeriod.parse(period)
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
         dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
         host_bins, host_offs = to_binned_time(dtg_ms, period)
+        n_local = len(x)
+        proc = jax.process_index()
+        gids = (np.int64(proc) << GID_PROC_SHIFT) | np.arange(
+            n_local, dtype=np.int64)
         sharded, valid = process_local_shard(
-            mesh,
-            np.asarray(x, np.float64), np.asarray(y, np.float64), dtg_ms,
-            host_bins.astype(np.int32), host_offs.astype(np.float64),
-        )
-        return cls._finish_build(mesh, period, sharded, valid)
+            mesh, x, y, dtg_ms, host_bins.astype(np.int32),
+            host_offs.astype(np.float64), gids)
+        xd, yd, td, bind, offd, gidd = sharded
+        prog = _z3_build_program(mesh, z3_sfc(period))
+        bins_s, z_s, gid_s, x_s, y_s, t_s = prog(
+            xd, yd, td, bind, offd, gidd, valid)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            n_total = int(np.sum(multihost_utils.process_allgather(
+                np.int64(n_local))))
+            lo = multihost_utils.process_allgather(
+                np.int64(dtg_ms.min() if n_local else np.iinfo(np.int64).max))
+            hi = multihost_utils.process_allgather(
+                np.int64(dtg_ms.max() if n_local else np.iinfo(np.int64).min))
+            t_min, t_max = int(np.min(lo)), int(np.max(hi))
+        else:
+            n_total = n_local
+            t_min = int(dtg_ms.min()) if n_local else None
+            t_max = int(dtg_ms.max()) if n_local else None
+        return cls(mesh, period, bins_s, z_s, gid_s, x_s, y_s, t_s,
+                   n_total=n_total, shard_counts=None,
+                   t_min_ms=t_min, t_max_ms=t_max)
 
-    @classmethod
-    def _finish_build(cls, mesh, period, sharded, valid) -> "ShardedZ3Index":
-        sfc = z3_sfc(period)
-        xd, yd, td, bind, offd = sharded
-
-        @partial(
-            shard_map, mesh=mesh,
-            in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard")),
-            out_specs=(P("shard"), P("shard"), P("shard")),
-        )
-        def encode_sort(xs, ys, bs, os_, vs):
-            z = sfc.index(xs, ys, os_)
-            # invalid (padding) rows get bin -1 so no query range matches
-            bs = jnp.where(vs, bs, -1)
-            # variadic 2-key sort with the local permutation as payload
-            bs_s, z_s, pos = jax.lax.sort(
-                (bs, z, jnp.arange(z.shape[0], dtype=jnp.int32)),
-                dimension=0, num_keys=2)
-            return bs_s, z_s, pos
-
-        bins_s, z_s, pos = jax.jit(encode_sort)(xd, yd, bind, offd, valid)
-        return cls(mesh, period, bins_s, z_s, pos, xd, yd, td, valid)
-
+    # -- bookkeeping ------------------------------------------------------
     def total(self) -> int:
-        return int(np.asarray(jnp.sum(self.valid)))
+        return self._n_total
 
-    def unrank_position(self, gpos: int) -> tuple[int, int]:
-        """Map a global query position to ``(process_index, local_row)``
-        under the multihost per-process block layout (for single-process
-        builds this is ``(0, gpos)``)."""
+    def __len__(self) -> int:
+        return self._n_total
+
+    @staticmethod
+    def unrank_position(gid: int) -> tuple[int, int]:
+        """Decode a query-result gid to ``(process_index, local_row)``.
+        Single-controller gids have process 0; multihost gids carry the
+        producing process in the high bits (GID_PROC_SHIFT)."""
+        gid = int(gid)
+        return gid >> GID_PROC_SHIFT, gid & ((1 << GID_PROC_SHIFT) - 1)
+
+    def _clamp_time(self, t_lo_ms, t_hi_ms) -> tuple[int, int]:
+        """Clamp to the data's time extent; ``None`` bounds are open and
+        resolve to the extent itself (matching Z3PointIndex)."""
+        t_lo_ms = self.t_min_ms if t_lo_ms is None else int(t_lo_ms)
+        t_hi_ms = self.t_max_ms if t_hi_ms is None else int(t_hi_ms)
+        if self.t_min_ms is not None:
+            t_lo_ms = max(t_lo_ms, self.t_min_ms)
+        if self.t_max_ms is not None:
+            t_hi_ms = min(t_hi_ms, self.t_max_ms)
+        return t_lo_ms, t_hi_ms
+
+    # -- distributed incremental ingest -----------------------------------
+    def append(self, x, y, dtg_ms) -> "ShardedZ3Index":
+        """Distributed append: the new batch splits into per-shard slices
+        which each shard writes into its sentinel padding and locally
+        re-sorts, all in ONE collective dispatch — the BatchWriter
+        continuous-ingest role (IndexAdapter.scala:95-106).  Shapes
+        bucket by (capacity, pow2(m_per)), so steady-state appends reuse
+        one compiled program per bucket.  Returns self (mutated)."""
+        if self._shard_counts is None:
+            raise NotImplementedError(
+                "append on a multihost build is not supported yet — "
+                "rebuild via build_multihost with the new rows included")
+        x = np.asarray(x, dtype=np.float64)
+        m = len(x)
+        if m == 0:
+            return self
+        y = np.asarray(y, dtype=np.float64)
+        dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
         n_shards = int(self.mesh.devices.size)
-        per_shard = int(self.z.shape[0]) // n_shards
-        n_procs = max(1, jax.process_count())
-        shards_per_proc = max(1, n_shards // n_procs)
-        shard, local = divmod(int(gpos), per_shard)
-        proc = shard // shards_per_proc
-        return proc, (shard % shards_per_proc) * per_shard + local
+        m_per = gather_capacity(-(-m // n_shards), minimum=8)
+        slots = m_per * n_shards
+        pad = slots - m
+        host_bins, host_offs = to_binned_time(dtg_ms, self.period)
+        gids = np.concatenate([
+            np.arange(self._n_total, self._n_total + m, dtype=np.int32),
+            np.full(pad, -1, np.int32)])
+        # grow per-shard capacity when any shard's padding would overflow
+        cap = int(self.z.shape[0]) // n_shards
+        need = int(self._shard_counts.max()) + m_per
+        if need > cap:
+            new_cap = gather_capacity(need)
+            grow = _z3_grow_program(self.mesh, new_cap - cap)
+            self.bins, self.z, self.gid, self.x, self.y, self.dtg = grow(
+                self.bins, self.z, self.gid, self.x, self.y, self.dtg)
+        spec = NamedSharding(self.mesh, P("shard"))
+        put = lambda a: jax.device_put(jnp.asarray(a), spec)
+        prog = _z3_append_program(self.mesh, self.sfc)
+        self.bins, self.z, self.gid, self.x, self.y, self.dtg = prog(
+            self.bins, self.z, self.gid, self.x, self.y, self.dtg,
+            put(np.pad(x, (0, pad))), put(np.pad(y, (0, pad))),
+            put(np.pad(host_offs.astype(np.float64), (0, pad))),
+            put(np.pad(host_bins.astype(np.int32), (0, pad))),
+            put(np.pad(dtg_ms, (0, pad))), put(gids),
+            put(self._shard_counts.astype(np.int32)))
+        new_counts = np.clip(m - np.arange(n_shards) * m_per, 0, m_per)
+        self._shard_counts = self._shard_counts + new_counts
+        self._n_total += m
+        t_min, t_max = int(dtg_ms.min()), int(dtg_ms.max())
+        self.t_min_ms = (t_min if self.t_min_ms is None
+                         else min(self.t_min_ms, t_min))
+        self.t_max_ms = (t_max if self.t_max_ms is None
+                         else max(self.t_max_ms, t_max))
+        return self
 
     # -- collective queries ----------------------------------------------
     def range_count(self, boxes, t_lo_ms: int, t_hi_ms: int,
                     max_ranges: int = 2000) -> int:
         """Candidate count across all shards (index-key resolution)."""
+        t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
         if plan.num_ranges == 0:
             return 0
@@ -170,6 +415,7 @@ class ShardedZ3Index:
         """Global per-range candidate counts via the ring-parallel scan
         (ranges sharded + rotated, data stationary) — see
         :func:`ring_range_counts`."""
+        t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
         if plan.num_ranges == 0:
             return np.empty(0, dtype=np.int64)
@@ -188,92 +434,126 @@ class ShardedZ3Index:
         return counts[: plan.num_ranges]
 
     def query(self, boxes, t_lo_ms: int, t_hi_ms: int,
-              max_ranges: int = 2000, capacity: int = 1 << 15) -> np.ndarray:
-        """Exact global hit positions across all shards.
+              max_ranges: int = 2000,
+              capacity: int | None = None) -> np.ndarray:
+        """Exact global hit gids across all shards.
 
         Each shard scans its local sorted segment (seeks + fixed-capacity
-        gather + fused mask — the same candidate_mask as the single-chip
-        packed query) and emits shard-LOCAL int32 positions; results
-        stack along the shard axis so the host reads one
-        (n_shards × capacity) packed array plus per-shard totals for
-        overflow retry, then re-bases hits to global row ids (it knows
-        the row→shard mapping) — the scatter/gather + client-merge
-        pattern of the reference's BatchScanPlan, with the int32 wire
-        halving the cross-host transfer.  Programs are cached per
-        (mesh, capacity): plan arrays pad to power-of-two buckets and
-        travel as traced arguments, so repeat queries reuse the compile.
-        """
+        gather + the same fused candidate_mask as the single-chip packed
+        query) and emits its hits' gid payloads; results stack along the
+        shard axis so the host reads one (n_shards × capacity) packed
+        array plus per-shard totals for overflow retry — the
+        scatter/gather + client-merge pattern of the reference's
+        BatchScanPlan.  Programs are cached per (mesh, capacity): plan
+        arrays pad to power-of-two buckets and travel as traced
+        arguments, so repeat queries reuse the compile."""
+        t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
-        if plan.num_ranges == 0:
+        if plan.num_ranges == 0 or self._n_total == 0:
             return np.empty(0, dtype=np.int64)
-        per_shard = int(self.z.shape[0]) // self.mesh.devices.size
+        capacity = capacity or self._capacity
         r = pad_ranges({"rbin": plan.rbin, "rzlo": plan.rzlo,
                         "rzhi": plan.rzhi, "rtlo": plan.rtlo,
                         "rthi": plan.rthi}, pad_pow2(plan.num_ranges))
         ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
                              pad_pow2(len(plan.boxes), minimum=1))
         while True:
-            scan = _sharded_scan_program(self.mesh, capacity)
+            scan = _z3_scan_program(self.mesh, capacity)
             packed, totals = scan(
-                self.bins, self.z, self.pos, self.x, self.y, self.dtg,
-                self.valid,
+                self.bins, self.z, self.gid, self.x, self.y, self.dtg,
                 jnp.asarray(r["rbin"]), jnp.asarray(r["rzlo"]),
                 jnp.asarray(r["rzhi"]), jnp.asarray(r["rtlo"]),
                 jnp.asarray(r["rthi"]), jnp.asarray(ixy), jnp.asarray(bxs),
                 jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
             totals = _fetch_global(totals)
             if int(totals.max(initial=0)) <= capacity:
-                # int32 wire: shard-LOCAL positions; the host re-bases by
-                # shard (it knows the row→shard mapping), halving the
-                # cross-host transfer (see z3._query_packed)
-                local = _fetch_global(packed).reshape(
-                    self.mesh.devices.size, capacity)
-                hit = local >= 0
-                shard_of = np.nonzero(hit)[0].astype(np.int64)
-                gpos = shard_of * per_shard + local[hit].astype(np.int64)
-                return np.sort(gpos)
+                self._capacity = capacity
+                flat = _fetch_global(packed).ravel()
+                return np.sort(flat[flat >= 0]).astype(np.int64)
             capacity = gather_capacity(int(totals.max()))
+
+    def query_many(self, windows, max_ranges: int = 2000) -> list[np.ndarray]:
+        """Batched collective queries: ``windows`` is a list of
+        ``(boxes, t_lo_ms, t_hi_ms)``; all windows scan in ONE collective
+        dispatch (the BatchScanner-over-many-range-sets pattern the
+        analytics processes are built on); returns one sorted gid array
+        per window."""
+        n_q = len(windows)
+        if n_q == 0 or self._n_total == 0:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        rbin, rzlo, rzhi, rtlo, rthi, rqid = [], [], [], [], [], []
+        ixy, boxes, bqid = [], [], []
+        qtlo = np.empty(n_q, dtype=np.int64)
+        qthi = np.empty(n_q, dtype=np.int64)
+        for q, (bxs, lo, hi) in enumerate(windows):
+            lo, hi = self._clamp_time(lo, hi)
+            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges)
+            qtlo[q] = plan.t_lo_ms
+            qthi[q] = plan.t_hi_ms
+            if plan.num_ranges == 0:
+                continue
+            rbin.append(plan.rbin)
+            rzlo.append(plan.rzlo)
+            rzhi.append(plan.rzhi)
+            rtlo.append(plan.rtlo)
+            rthi.append(plan.rthi)
+            rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+            ixy.append(plan.ixy)
+            boxes.append(plan.boxes)
+            bqid.append(np.full(len(plan.boxes), q, dtype=np.int32))
+        if not rbin:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        ra = {"rbin": np.concatenate(rbin), "rzlo": np.concatenate(rzlo),
+              "rzhi": np.concatenate(rzhi), "rtlo": np.concatenate(rtlo),
+              "rthi": np.concatenate(rthi), "rqid": np.concatenate(rqid)}
+        ra = pad_ranges(ra, pad_pow2(len(ra["rbin"])))
+        ixy_c, boxes_c, bqid_c = pad_boxes(
+            np.concatenate(ixy), np.concatenate(boxes),
+            pad_pow2(sum(len(b) for b in boxes), minimum=1),
+            np.concatenate(bqid))
+        # gid space: multihost gids use the full coded range
+        gid_span = (self._n_total if self._shard_counts is not None
+                    else 1 << (GID_PROC_SHIFT + 8))
+        from ..ops.search import coded_pos_bits
+        pos_bits = coded_pos_bits(gid_span, n_q)
+        capacity = self._capacity
+        while True:
+            scan = _z3_many_program(self.mesh, capacity, pos_bits)
+            packed, totals = scan(
+                self.bins, self.z, self.gid, self.x, self.y, self.dtg,
+                jnp.asarray(ra["rbin"]), jnp.asarray(ra["rzlo"]),
+                jnp.asarray(ra["rzhi"]), jnp.asarray(ra["rtlo"]),
+                jnp.asarray(ra["rthi"]), jnp.asarray(ra["rqid"]),
+                jnp.asarray(ixy_c), jnp.asarray(boxes_c),
+                jnp.asarray(bqid_c), jnp.asarray(qtlo), jnp.asarray(qthi))
+            totals = _fetch_global(totals)
+            if int(totals.max(initial=0)) <= capacity:
+                self._capacity = capacity
+                flat = _fetch_global(packed).ravel()
+                coded = flat[flat >= 0].astype(np.int64)
+                break
+            capacity = gather_capacity(int(totals.max()))
+        qids = coded >> pos_bits
+        gids = coded & ((np.int64(1) << pos_bits) - 1)
+        # a feature can land in several of a query's covering ranges
+        return [np.unique(gids[qids == q]) for q in range(n_q)]
 
     def density(self, boxes, t_lo_ms: int, t_hi_ms: int, env,
                 width: int = 256, height: int = 256,
                 weights=None) -> np.ndarray:
         """Global density grid for bbox(es) + interval — per-shard masked
-        histogram + psum."""
+        histogram + psum.  ``weights`` (optional) is a host array indexed
+        by gid (original row order), gathered per shard via a replicated
+        lookup."""
+        t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
         boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
-        w = weights if weights is not None else jnp.ones_like(self.x)
+        valid = self.gid  # >= 0 marks real rows
+        w_tab = (jnp.asarray(np.asarray(weights, np.float64))
+                 if weights is not None else None)
         return sharded_density(
-            self.mesh, self.x, self.y, self.dtg, self.valid, w,
+            self.mesh, self.x, self.y, self.dtg, valid, w_tab,
             jnp.asarray(boxes), int(t_lo_ms), int(t_hi_ms),
             tuple(float(v) for v in env), width, height)
-
-
-@lru_cache(maxsize=64)
-def _sharded_scan_program(mesh: Mesh, capacity: int):
-    """Jitted collective scan, cached per (mesh, capacity) — plan arrays
-    are traced arguments so new queries reuse the compile.  Emits
-    shard-local int32 positions; the caller re-bases them globally."""
-
-    @partial(
-        shard_map, mesh=mesh,
-        in_specs=(P("shard"),) * 7 + (P(None),) * 7 + (P(), P()),
-        out_specs=(P("shard"), P("shard")),
-    )
-    def scan(lb, lz, lp, xs, ys, ts, vs,
-             rb, rlo, rhi, rtl, rth, ixy, bxs, t_lo, t_hi):
-        starts = searchsorted2(lb, lz, rb, rlo, side="left")
-        ends = searchsorted2(lb, lz, rb, rhi, side="right")
-        counts = jnp.maximum(ends - starts, 0)
-        total = jnp.sum(counts)
-        idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
-        zc = lz[idx]
-        posc = lp[idx]
-        mask = valid_slot & vs[posc] & candidate_mask(
-            zc, rtl[rid], rth[rid], ixy, bxs,
-            xs[posc], ys[posc], ts[posc], t_lo, t_hi)
-        packed = jnp.where(mask, posc.astype(jnp.int32), jnp.int32(-1))
-        return packed, total[None].astype(jnp.int64)
-
-    return jax.jit(scan)
 
 
 def sharded_range_count(mesh, bins, z, rbin, rzlo, rzhi) -> int:
@@ -345,28 +625,39 @@ def ring_range_counts(mesh, bins, z, rbin, rzlo, rzhi) -> np.ndarray:
     return _fetch_global(jax.jit(ring)(bins, z, rbin, rzlo, rzhi))
 
 
-def sharded_density(mesh, x, y, dtg, valid, weights, boxes,
+def sharded_density(mesh, x, y, dtg, gid, weights, boxes,
                     t_lo_ms: int, t_hi_ms: int, env,
                     width: int, height: int) -> np.ndarray:
+    """Collective density grid: per-shard masked histogram + psum.
+    ``gid`` doubles as the validity mask (>= 0 marks real rows);
+    ``weights`` is an optional REPLICATED per-row weight table indexed
+    by gid."""
     def make(dens_grid):
-        @partial(
-            shard_map, mesh=mesh,
-            in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
-                      P("shard"), P(None)),
-            out_specs=P(None, None),
-        )
-        def dens(xs, ys, ts, vs, ws, bx):
+        specs = [P("shard")] * 4 + [P(None)]
+        if weights is not None:
+            specs.append(P(None))
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=tuple(specs), out_specs=P(None, None))
+        def dens(xs, ys, ts, gs, bx, *wt):
             in_box = (
                 (xs[:, None] >= bx[None, :, 0])
                 & (ys[:, None] >= bx[None, :, 1])
                 & (xs[:, None] <= bx[None, :, 2])
                 & (ys[:, None] <= bx[None, :, 3])
             ).any(axis=1)
-            mask = vs & in_box & (ts >= t_lo_ms) & (ts <= t_hi_ms)
+            mask = (gs >= 0) & in_box & (ts >= t_lo_ms) & (ts <= t_hi_ms)
+            if wt:
+                ws = wt[0][jnp.maximum(gs, 0).astype(jnp.int64) & (
+                    (jnp.int64(1) << GID_PROC_SHIFT) - 1)]
+            else:
+                ws = jnp.ones_like(xs)
             grid = dens_grid(xs, ys, ws, mask, env, width, height)
             return jax.lax.psum(grid, "shard")
 
-        return np.asarray(jax.jit(dens)(x, y, dtg, valid, weights, boxes))
+        args = (x, y, dtg, gid, boxes) + (
+            (weights,) if weights is not None else ())
+        return np.asarray(jax.jit(dens)(*args))
 
     from ..ops.pallas_kernels import on_tpu
 
@@ -375,5 +666,6 @@ def sharded_density(mesh, x, y, dtg, valid, weights, boxes,
         try:
             return make(density_grid_auto)
         except Exception:
-            pass
+            from ..metrics import registry as _metrics
+            _metrics.counter("pallas.density.fallback").inc()
     return make(density_grid)

@@ -67,14 +67,11 @@ def test_density_matches_oracle(sharded, data):
 
 
 def test_density_weighted(sharded, data):
-    import jax.numpy as jnp
     x, y, t = data
     box = (-74.5, 40.5, -73.5, 41.5)
     w_host = np.arange(len(x), dtype=np.float64) % 7
-    from geomesa_tpu.parallel.mesh import shard_batch
-    (w_sharded,), _ = shard_batch(sharded.mesh, w_host)
     grid = sharded.density([box], MS_2018, MS_2018 + 7 * 86_400_000, box,
-                           32, 32, weights=w_sharded)
+                           32, 32, weights=w_host)
     mask = ((x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
             & (t >= MS_2018) & (t <= MS_2018 + 7 * 86_400_000))
     assert grid.sum() == pytest.approx(w_host[mask].sum())
@@ -167,3 +164,78 @@ def test_unrank_position_single_process(sharded):
     """Single-process layout: positions are original row indices."""
     assert sharded.unrank_position(0) == (0, 0)
     assert sharded.unrank_position(12345) == (0, 12345)
+
+
+def test_unrank_position_multihost_coding():
+    """Multihost gids code (process, local_row) in the high bits."""
+    from geomesa_tpu.parallel.scan import GID_PROC_SHIFT
+    gid = (np.int64(3) << GID_PROC_SHIFT) | 4321
+    assert ShardedZ3Index.unrank_position(gid) == (3, 4321)
+
+
+def test_sharded_query_many_matches_per_window(sharded, data):
+    """Collective batched windows == per-window collective queries."""
+    x, y, t = data
+    windows = [
+        ([(-74.5, 40.5, -73.5, 41.5)],
+         MS_2018 + 86_400_000, MS_2018 + 6 * 86_400_000),
+        ([(-74.9, 40.1, -74.4, 40.9), (-73.9, 41.1, -73.2, 41.9)],
+         MS_2018, MS_2018 + 3 * 86_400_000),
+        ([(-74.2, 40.8, -74.0, 41.0)],
+         MS_2018 + 8 * 86_400_000, MS_2018 + 13 * 86_400_000),
+    ]
+    batched = sharded.query_many(windows)
+    assert len(batched) == len(windows)
+    for got, (boxes, lo, hi) in zip(batched, windows):
+        brute = np.flatnonzero(
+            np.any([(x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+                    for b in boxes], axis=0)
+            & (t >= lo) & (t <= hi))
+        np.testing.assert_array_equal(np.sort(got), brute)
+
+
+def test_sharded_append_exact(data):
+    """Distributed append: interleaved appends/queries keep hit sets
+    oracle-equal, per-shard capacity grows, one steady-state compile."""
+    x, y, t = data
+    n0 = 40_001
+    idx = ShardedZ3Index.build(
+        x[:n0], y[:n0], t[:n0], period="week", mesh=device_mesh())
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018 + 86_400_000, MS_2018 + 6 * 86_400_000
+
+    def oracle(n):
+        return np.flatnonzero(
+            (x[:n] >= box[0]) & (x[:n] <= box[2])
+            & (y[:n] >= box[1]) & (y[:n] <= box[3])
+            & (t[:n] >= tlo) & (t[:n] <= thi))
+
+    np.testing.assert_array_equal(idx.query([box], tlo, thi), oracle(n0))
+    # append in three uneven slices, querying between appends
+    cuts = [n0, 55_000, 55_003, 90_000, len(x)]
+    for a, b in zip(cuts[:-1], cuts[1:]):
+        idx.append(x[a:b], y[a:b], t[a:b])
+        assert idx.total() == b
+        np.testing.assert_array_equal(idx.query([box], tlo, thi), oracle(b))
+    # density over the appended index still matches
+    grid = idx.density([box], tlo, thi, box, 32, 32)
+    assert grid.sum() == pytest.approx(len(oracle(len(x))))
+
+
+def test_sharded_append_empty_and_fresh_rows(sharded):
+    """Appending zero rows is a no-op; appended row timestamps extend the
+    data time extent used for open-bound clamping."""
+    rng = np.random.default_rng(3)
+    idx = ShardedZ3Index.build(
+        rng.uniform(-75, -73, 1000), rng.uniform(40, 42, 1000),
+        rng.integers(MS_2018, MS_2018 + 86_400_000, 1000),
+        period="week", mesh=device_mesh())
+    n = idx.total()
+    idx.append([], [], [])
+    assert idx.total() == n
+    t_new = MS_2018 + 20 * 86_400_000
+    idx.append([-74.0], [41.0], [t_new])
+    assert idx.total() == n + 1
+    assert idx.t_max_ms == t_new
+    hits = idx.query([(-74.1, 40.9, -73.9, 41.1)], None, None)
+    assert n in hits  # the appended row (gid == n) is found
