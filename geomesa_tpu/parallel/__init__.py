@@ -22,12 +22,17 @@ from .rdd import (
     ConverterRDDProvider, FileSystemRDDProvider, SpatialRDD,
     SpatialRDDProvider, TpuStoreRDDProvider, save_rdd, spatial_rdd,
 )
+from .attribute import ShardedAttributeIndex
 from .scan import (
     ShardedZ3Index, ring_range_counts, sharded_density, sharded_range_count,
 )
+from .xz import ShardedXZ2Index, ShardedXZ3Index
+from .z2 import ShardedZ2Index
 
 __all__ = [
-    "device_mesh", "shard_batch", "ShardedZ3Index", "sharded_density",
+    "device_mesh", "shard_batch", "ShardedZ3Index", "ShardedZ2Index",
+    "ShardedXZ2Index", "ShardedXZ3Index", "ShardedAttributeIndex",
+    "sharded_density",
     "sharded_range_count", "ring_range_counts", "SpatialRDD",
     "SpatialRDDProvider", "TpuStoreRDDProvider", "ConverterRDDProvider",
     "FileSystemRDDProvider", "spatial_rdd", "save_rdd",

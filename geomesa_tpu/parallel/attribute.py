@@ -1,0 +1,216 @@
+"""ShardedAttributeIndex: attribute equality/range/prefix scans on a mesh.
+
+The reference serves attribute queries through the same distributed scan
+as the spatial indexes (lexicoded value keys + tablet seeks,
+.../index/attribute/AttributeIndexKey.scala:38).  Lexicoding is replaced
+by **rank encoding**: the host keeps the sorted unique values (the
+dictionary) and each row carries its value's rank as an int64 device key —
+numpy sort order equals lexicoder order for numerics and strings, so rank
+order IS key order.  Per-shard state: sorted ``(rank, secondary)`` key
+columns + the gid payload; queries map value predicates to rank ranges on
+the host and run one collective seek+gather scan.
+
+The **date tier** mirrors the single-chip index
+(:class:`geomesa_tpu.index.attribute.AttributeIndex`): rows sort by
+``(rank, dtg)``, so equality lookups refine by a time window inside the
+value run via the lexicographic 2-key seek.  As in the reference, tiers
+apply only to point lookups (equality / IN); range and prefix scans span
+many value runs and rely on the planner's residual filter.  The z3 tier
+is not materialized on the mesh — spatial refinement of attribute hits
+comes from the planner's residual filter (exactness is unaffected; only
+candidate-set size differs).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.search import (
+    expand_ranges, gather_capacity, pad_pow2, pad_ranges, searchsorted2,
+)
+from .mesh import device_mesh, shard_batch
+from .scan import _fetch_global
+
+__all__ = ["ShardedAttributeIndex"]
+
+_SENTINEL_RANK = np.int64(np.iinfo(np.int64).max)
+_SEC_LO = np.int64(np.iinfo(np.int64).min)
+_SEC_HI = np.int64(np.iinfo(np.int64).max)
+
+
+@lru_cache(maxsize=32)
+def _attr_build_program(mesh: Mesh):
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard"),) * 4, out_specs=(P("shard"),) * 3)
+    def sort(rk, sec, gs, vs):
+        rk = jnp.where(vs, rk, _SENTINEL_RANK)
+        gs = jnp.where(vs, gs, gs.dtype.type(-1))
+        return jax.lax.sort((rk, sec, gs), dimension=0, num_keys=2)
+
+    return jax.jit(sort)
+
+
+@lru_cache(maxsize=64)
+def _attr_scan_program(mesh: Mesh, capacity: int):
+    """Collective seek+gather over the sorted (rank, secondary) columns.
+    Ranges are lexicographic [(rank_lo, sec_lo), (rank_hi, sec_hi)]
+    pairs; hits are exact at index-key granularity (the planner's
+    residual filter guarantees final exactness, as everywhere)."""
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 3 + (P(None),) * 4,
+        out_specs=(P("shard"), P("shard")),
+    )
+    def scan(lr, ls, lg, rlo_r, rlo_s, rhi_r, rhi_s):
+        starts = searchsorted2(lr, ls, rlo_r, rlo_s, side="left")
+        ends = searchsorted2(lr, ls, rhi_r, rhi_s, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        total = jnp.sum(counts)
+        idx, valid_slot, _ = expand_ranges(starts, counts, capacity)
+        gc = lg[idx]
+        mask = valid_slot & (gc >= 0)
+        packed = jnp.where(mask, gc, gc.dtype.type(-1))
+        return packed, total[None].astype(jnp.int64)
+
+    return jax.jit(scan)
+
+
+class ShardedAttributeIndex:
+    """Rank-encoded attribute index sharded over a device mesh."""
+
+    DEFAULT_CAPACITY = 1 << 14
+
+    def __init__(self, mesh: Mesh, attr: str, uniques: np.ndarray,
+                 ranks, sec, gid, n_total: int, has_secondary: bool):
+        self.mesh = mesh
+        self.attr = attr
+        self.uniques = uniques      # host dictionary, sorted
+        self.ranks = ranks          # sharded sorted int64 rank keys
+        self.sec = sec              # sharded int64 secondary (dtg or 0)
+        self.gid = gid
+        self._n_total = n_total
+        self.has_secondary = has_secondary
+        self._capacity = self.DEFAULT_CAPACITY
+        #: parity with the single-chip AttributeIndex attributes the
+        #: planner probes (attribute.py): no z3 tier on the mesh
+        self.secondary = sec if has_secondary else None
+        self.sec_z = None
+
+    @classmethod
+    def build(cls, attr: str, column: np.ndarray, secondary=None,
+              mesh: Mesh | None = None) -> "ShardedAttributeIndex":
+        mesh = mesh or device_mesh()
+        col = np.asarray(column)
+        if col.dtype == object:
+            col = col.astype(str)
+        uniques, inv = np.unique(col, return_inverse=True)
+        ranks = inv.astype(np.int64)
+        n = len(col)
+        sec = (np.asarray(secondary, dtype=np.int64) if secondary is not None
+               else np.zeros(n, dtype=np.int64))
+        gids = np.arange(n, dtype=np.int32)
+        sharded, valid = shard_batch(mesh, ranks, sec, gids)
+        rk_s, sec_s, gid_s = _attr_build_program(mesh)(*sharded, valid)
+        return cls(mesh, attr, uniques, rk_s, sec_s, gid_s, n,
+                   has_secondary=secondary is not None)
+
+    def __len__(self) -> int:
+        return self._n_total
+
+    def _cast(self, v):
+        if self.uniques.dtype.kind in ("U", "S"):
+            return str(v)
+        return v
+
+    def _scan(self, ranges: list[tuple[int, int, int, int]]) -> np.ndarray:
+        """Run lexicographic (rank, sec) ranges as one collective scan."""
+        if not ranges or self._n_total == 0:
+            return np.empty(0, dtype=np.int64)
+        arr = np.asarray(ranges, dtype=np.int64)
+        r = pad_ranges({"rzlo": arr[:, 0], "rtlo": arr[:, 1],
+                        "rzhi": arr[:, 2], "rthi": arr[:, 3]},
+                       pad_pow2(len(arr)))
+        # padding must be non-matching in LEX order: (1,0) > (0,0) works
+        # because pad_ranges fills rzlo=1 > rzhi=0 with equal sec fills
+        capacity = self._capacity
+        while True:
+            scan = _attr_scan_program(self.mesh, capacity)
+            packed, totals = scan(
+                self.ranks, self.sec, self.gid,
+                jnp.asarray(r["rzlo"]), jnp.asarray(r["rtlo"]),
+                jnp.asarray(r["rzhi"]), jnp.asarray(r["rthi"]))
+            totals = _fetch_global(totals)
+            if int(totals.max(initial=0)) <= capacity:
+                self._capacity = capacity
+                flat = _fetch_global(packed).ravel()
+                return np.unique(flat[flat >= 0]).astype(np.int64)
+            capacity = gather_capacity(int(totals.max()))
+
+    def _sec_bounds(self, sec_window) -> tuple[int, int]:
+        if sec_window is None or not self.has_secondary:
+            return int(_SEC_LO), int(_SEC_HI)
+        lo, hi = sec_window
+        return (int(_SEC_LO) if lo is None else int(lo),
+                int(_SEC_HI) if hi is None else int(hi))
+
+    def query_equals(self, value, sec_window=None,
+                     z3_ranges=None) -> np.ndarray:
+        """Gids where attr == value, optionally date-tier refined.
+        ``z3_ranges`` is accepted for API parity but unused (see module
+        doc: spatial refinement is the planner's residual filter)."""
+        value = self._cast(value)
+        i = np.searchsorted(self.uniques, value)
+        if i >= len(self.uniques) or self.uniques[i] != value:
+            return np.empty(0, dtype=np.int64)
+        s_lo, s_hi = self._sec_bounds(sec_window)
+        return self._scan([(int(i), s_lo, int(i), s_hi)])
+
+    def query_in(self, values, sec_window=None,
+                 z3_ranges=None) -> np.ndarray:
+        """Gids where attr IN values — all values in ONE collective scan."""
+        s_lo, s_hi = self._sec_bounds(sec_window)
+        ranges = []
+        for v in values:
+            v = self._cast(v)
+            i = np.searchsorted(self.uniques, v)
+            if i < len(self.uniques) and self.uniques[i] == v:
+                ranges.append((int(i), s_lo, int(i), s_hi))
+        return self._scan(ranges)
+
+    def query_range(self, lo=None, hi=None, lo_inclusive=True,
+                    hi_inclusive=True) -> np.ndarray:
+        i0 = 0
+        i1 = len(self.uniques) - 1
+        if lo is not None:
+            i0 = int(np.searchsorted(
+                self.uniques, self._cast(lo),
+                side="left" if lo_inclusive else "right"))
+        if hi is not None:
+            i1 = int(np.searchsorted(
+                self.uniques, self._cast(hi),
+                side="right" if hi_inclusive else "left")) - 1
+        if i1 < i0:
+            return np.empty(0, dtype=np.int64)
+        return self._scan([(i0, int(_SEC_LO), i1, int(_SEC_HI))])
+
+    def query_prefix(self, prefix: str) -> np.ndarray:
+        """String prefix scan — serves LIKE 'abc%'."""
+        if self.uniques.dtype.kind not in ("U", "S"):
+            raise TypeError("prefix queries require a string attribute")
+        i0 = int(np.searchsorted(self.uniques, prefix, side="left"))
+        i1 = int(np.searchsorted(self.uniques, prefix + "￿",
+                                 side="right")) - 1
+        if i1 < i0:
+            return np.empty(0, dtype=np.int64)
+        return self._scan([(i0, int(_SEC_LO), i1, int(_SEC_HI))])
