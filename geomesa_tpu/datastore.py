@@ -53,10 +53,17 @@ class CatalogVersionError(RuntimeError):
 
 
 class _SchemaStore:
-    """Per-schema storage: the column batch + lazily-built indexes + stats."""
+    """Per-schema storage: the column batch + lazily-built indexes + stats.
 
-    def __init__(self, sft: FeatureType):
+    With ``mesh`` set, every index builds its SHARDED variant over the
+    device mesh (geomesa_tpu.parallel), so the same store facade scales
+    from one chip to a pod unchanged — the reference's defining
+    laptop-to-cluster property (GeoMesaDataStore.scala:48-431 +
+    ShardStrategy.scala:17-75 applied uniformly)."""
+
+    def __init__(self, sft: FeatureType, mesh=None):
         self.sft = sft
+        self.mesh = mesh
         self.batch: FeatureBatch | None = None
         self.visibilities: np.ndarray | None = None  # per-feature vis strings
         #: attr name → per-feature vis strings (attribute-level visibility,
@@ -235,33 +242,56 @@ class _SchemaStore:
         if "z3" not in self._indexes:
             x, y = self.batch.geom_xy()
             dtg = self.batch.column(self.sft.dtg_field)
-            xd, yd = self.device_xy()
-            self._indexes["z3"] = Z3PointIndex.build(
-                x, y, dtg, period=self.sft.z3_interval, xd=xd, yd=yd)
+            if self.mesh is not None:
+                from .parallel.scan import ShardedZ3Index
+                self._indexes["z3"] = ShardedZ3Index.build(
+                    np.asarray(x), np.asarray(y), dtg,
+                    period=self.sft.z3_interval, mesh=self.mesh)
+            else:
+                xd, yd = self.device_xy()
+                self._indexes["z3"] = Z3PointIndex.build(
+                    x, y, dtg, period=self.sft.z3_interval, xd=xd, yd=yd)
         return self._indexes["z3"]
 
     def z2_index(self) -> Z2PointIndex:
         self._rebuild_if_dirty()
         if "z2" not in self._indexes:
             x, y = self.batch.geom_xy()
-            xd, yd = self.device_xy()
-            self._indexes["z2"] = Z2PointIndex.build(x, y, xd=xd, yd=yd)
+            if self.mesh is not None:
+                from .parallel.z2 import ShardedZ2Index
+                self._indexes["z2"] = ShardedZ2Index.build(
+                    np.asarray(x), np.asarray(y), mesh=self.mesh)
+            else:
+                xd, yd = self.device_xy()
+                self._indexes["z2"] = Z2PointIndex.build(x, y, xd=xd, yd=yd)
         return self._indexes["z2"]
 
     def xz3_index(self) -> XZ3Index:
         self._rebuild_if_dirty()
         if "xz3" not in self._indexes:
             dtg = self.batch.column(self.sft.dtg_field)
-            self._indexes["xz3"] = XZ3Index.build(
-                self.batch.geoms, dtg, period=self.sft.z3_interval,
-                g=self.sft.xz_precision)
+            if self.mesh is not None:
+                from .parallel.xz import ShardedXZ3Index
+                self._indexes["xz3"] = ShardedXZ3Index.build(
+                    self.batch.geoms, dtg, period=self.sft.z3_interval,
+                    g=self.sft.xz_precision, mesh=self.mesh)
+            else:
+                self._indexes["xz3"] = XZ3Index.build(
+                    self.batch.geoms, dtg, period=self.sft.z3_interval,
+                    g=self.sft.xz_precision)
         return self._indexes["xz3"]
 
     def xz2_index(self) -> XZ2Index:
         self._rebuild_if_dirty()
         if "xz2" not in self._indexes:
-            self._indexes["xz2"] = XZ2Index.build(
-                self.batch.geoms, g=self.sft.xz_precision)
+            if self.mesh is not None:
+                from .parallel.xz import ShardedXZ2Index
+                self._indexes["xz2"] = ShardedXZ2Index.build(
+                    self.batch.geoms, g=self.sft.xz_precision,
+                    mesh=self.mesh)
+            else:
+                self._indexes["xz2"] = XZ2Index.build(
+                    self.batch.geoms, g=self.sft.xz_precision)
         return self._indexes["xz2"]
 
     def id_index(self) -> IdIndex:
@@ -291,6 +321,19 @@ class _SchemaStore:
         self._rebuild_if_dirty()
         key = f"attr:{attr}"
         if key not in self._indexes:
+            if self.mesh is not None:
+                # mesh mode: date-tiered collective scans (the z3 tier's
+                # spatial refinement comes from the planner's residual
+                # filter — see parallel/attribute.py module doc)
+                from .parallel.attribute import ShardedAttributeIndex
+                secondary = (
+                    np.asarray(self.batch.column(self.sft.dtg_field),
+                               np.int64)
+                    if self.sft.dtg_field else None)
+                self._indexes[key] = ShardedAttributeIndex.build(
+                    attr, self.batch.column(attr), secondary=secondary,
+                    mesh=self.mesh)
+                return self._indexes[key]
             # secondary tier selection mirrors the reference: Z3 keys
             # when the schema has point geometry + dtg, date keys when
             # only dtg (AttributeIndexKeySpace secondary defaults)
@@ -322,8 +365,14 @@ class TpuDataStore:
     """In-process spatio-temporal datastore over columnar TPU indexes."""
 
     def __init__(self, catalog_dir: str | None = None, *,
-                 auth_provider=None, audit_writer=None, user: str = "unknown"):
+                 mesh=None, auth_provider=None, audit_writer=None,
+                 user: str = "unknown"):
+        """``mesh``: an optional ``jax.sharding.Mesh``; when given, every
+        index builds its sharded variant and all scans run as collectives
+        over the mesh — the same facade, laptop-to-pod (the reference's
+        GeoMesaDataStore property, geotools/GeoMesaDataStore.scala:48)."""
         self._schemas: dict[str, _SchemaStore] = {}
+        self._mesh = mesh
         self._catalog_dir = catalog_dir
         self._auth_provider = auth_provider
         self._audit_writer = audit_writer
@@ -399,7 +448,7 @@ class TpuDataStore:
                 raise ValueError(
                     f"schema {sft.name!r} already exists in the catalog "
                     "(created by another process)")
-            self._schemas[sft.name] = _SchemaStore(sft)
+            self._schemas[sft.name] = _SchemaStore(sft, mesh=self._mesh)
             self._persist_schema(sft)
         return sft
 
@@ -600,6 +649,15 @@ class TpuDataStore:
         batch = self.query(name, query)
         if len(batch) == 0:
             return schema.empty_table()
+        if self._mesh is not None:
+            # distributed reduce: per-shard delta-dictionary streams
+            # k-way merged client-side (ArrowScan.scala:35 reduce step);
+            # dictionary columns decode on merge (per-shard dictionaries
+            # index different accumulations)
+            from .parallel.stats import merged_arrow
+            return merged_arrow(
+                batch, sft, int(self._mesh.devices.size),
+                dictionary_fields, sort_field, reverse)
         if sort_field is not None:
             order = np.argsort(np.asarray(batch.columns[sort_field]),
                                kind="stable")
@@ -858,5 +916,5 @@ class TpuDataStore:
                 except FileNotFoundError:
                     continue  # removed by a concurrent process mid-listing
                 sft = parse_spec(meta["name"], meta["spec"])
-                self._schemas[sft.name] = _SchemaStore(sft)
+                self._schemas[sft.name] = _SchemaStore(sft, mesh=self._mesh)
                 self._load_data(sft.name)

@@ -539,8 +539,19 @@ class Z3PointIndex:
                         *args, capacity=capacity, use_pallas=True))
                     _pallas_scan_ok = True
                     return out
-                except Exception:  # Mosaic failure → XLA path
+                except Exception as e:  # Mosaic failure → XLA path
+                    # LOUD fallback (VERDICT r1 weak #1): a silent switch
+                    # would quietly cost the Pallas speedup forever after
                     _pallas_scan_ok = False
+                    import logging
+                    logging.getLogger("geomesa_tpu.pallas").warning(
+                        "pallas z3 scan failed (%s: %s); falling back to "
+                        "the XLA path for the rest of this process — "
+                        "check bench 'pallas_active' and the "
+                        "pallas.z3_scan.fallback metric",
+                        type(e).__name__, e)
+                    from ..metrics import registry as _metrics
+                    _metrics.counter("pallas.z3_scan.fallback").inc()
             return _query_packed(*args, capacity=capacity, use_pallas=False)
 
         if self._capacity >= TWO_PHASE_MIN_CAPACITY:

@@ -1,0 +1,165 @@
+"""Distributed stats + arrow reduction over the mesh.
+
+The reference runs StatsScan on every data node and merges partial
+sketches client-side (index/iterators/StatsScan.scala:125 + the
+QueryPlan.Reducer, api/QueryPlan.scala:16-39); ArrowScan does the same
+with delta-dictionary record batches (iterators/ArrowScan.scala:35).
+Two mesh analogs:
+
+* :func:`sharded_stats_scan` — numeric moments + histogram computed
+  INSIDE shard_map with ``psum``/``pmin``/``pmax`` over ICI: the fully
+  device-resident path (no host materialization of candidates at all).
+* :func:`merged_stats` / :func:`merged_arrow` — the host-merge reduce:
+  per-shard partial results fold through the Stat monoid
+  (``stats/stat.py`` sketches are mergeable by design) or the delta
+  Arrow writer + ``merge_deltas`` k-way merge.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..stats.stat import Stat, parse_stat
+
+__all__ = ["sharded_stats_scan", "merged_stats", "merged_arrow"]
+
+
+@lru_cache(maxsize=32)
+def _moments_program(mesh: Mesh, hist_bins: int, with_values: bool):
+    """Per-shard masked moments (+ optional fixed-bin histogram) reduced
+    with psum/pmin/pmax — the StatsScan iterator as one collective."""
+
+    n_sharded = 5 if with_values else 4
+    specs = (P("shard"),) * n_sharded + (P(None),) + (P(),) * 4
+
+    @partial(shard_map, mesh=mesh, in_specs=specs,
+             out_specs=(P(None),) * 6)
+    def moments(*args):
+        if with_values:
+            xs, ys, ts, gs, vals, bx, t_lo, t_hi, h_lo, h_hi = args
+        else:
+            xs, ys, ts, gs, bx, t_lo, t_hi, h_lo, h_hi = args
+            vals = xs
+        in_box = (
+            (xs[:, None] >= bx[None, :, 0])
+            & (ys[:, None] >= bx[None, :, 1])
+            & (xs[:, None] <= bx[None, :, 2])
+            & (ys[:, None] <= bx[None, :, 3])
+        ).any(axis=1)
+        mask = (gs >= 0) & in_box & (ts >= t_lo) & (ts <= t_hi)
+        cnt = jax.lax.psum(jnp.sum(mask)[None].astype(jnp.int64), "shard")
+        s = jax.lax.psum(
+            jnp.sum(jnp.where(mask, vals, 0.0))[None], "shard")
+        s2 = jax.lax.psum(
+            jnp.sum(jnp.where(mask, vals * vals, 0.0))[None], "shard")
+        vmin = jax.lax.pmin(
+            jnp.min(jnp.where(mask, vals, jnp.inf))[None], "shard")
+        vmax = jax.lax.pmax(
+            jnp.max(jnp.where(mask, vals, -jnp.inf))[None], "shard")
+        if hist_bins:
+            w = (h_hi - h_lo) / hist_bins
+            b = jnp.clip(((vals - h_lo) / w).astype(jnp.int32),
+                         0, hist_bins - 1)
+            hist = jnp.zeros((hist_bins,), jnp.int64).at[b].add(
+                jnp.where(mask, 1, 0).astype(jnp.int64))
+            hist = jax.lax.psum(hist, "shard")
+        else:
+            hist = jax.lax.psum(jnp.zeros((1,), jnp.int64), "shard")
+        return cnt, s, s2, vmin, vmax, hist
+
+    return jax.jit(moments)
+
+
+def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
+                       hist_bins: int = 0, hist_range=None) -> dict:
+    """Collective stats over a :class:`ShardedZ3Index` for a bbox+time
+    window: count / sum / sumsq / min / max (+ a fixed-bin histogram when
+    ``hist_bins`` > 0) of ``values`` — a host table indexed by gid — or
+    of the x coordinate when no values are given.  One device dispatch,
+    partials merged over ICI; nothing but the scalars crosses to host."""
+    t_lo_ms, t_hi_ms = idx._clamp_time(t_lo_ms, t_hi_ms)
+    boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+    with_values = values is not None
+    h_lo, h_hi = (float(hist_range[0]), float(hist_range[1])) \
+        if hist_range else (0.0, 1.0)
+    prog = _moments_program(idx.mesh, int(hist_bins), with_values)
+    args = [idx.x, idx.y, idx.dtg, idx.gid]
+    if with_values:
+        from .scan import GID_PROC_SHIFT
+        table = jnp.asarray(np.asarray(values, np.float64))
+        # per-shard gather from the replicated table by gid
+        mask_bits = (jnp.int64(1) << GID_PROC_SHIFT) - 1
+
+        @partial(shard_map, mesh=idx.mesh,
+                 in_specs=(P("shard"), P(None)), out_specs=P("shard"))
+        def gather(gs, tab):
+            return tab[jnp.maximum(gs.astype(jnp.int64) & mask_bits, 0)]
+
+        args.append(jax.jit(gather)(idx.gid, table))
+    args.append(jnp.asarray(boxes))
+    out = prog(*args, jnp.int64(t_lo_ms), jnp.int64(t_hi_ms),
+               jnp.float64(h_lo), jnp.float64(h_hi))
+    cnt, s, s2, vmin, vmax, hist = (np.asarray(v) for v in out)
+    res = {"count": int(cnt[0]), "sum": float(s[0]), "sumsq": float(s2[0]),
+           "min": float(vmin[0]), "max": float(vmax[0])}
+    if hist_bins:
+        res["histogram"] = hist
+    return res
+
+
+def _shard_slices(n: int, n_shards: int):
+    """Contiguous per-shard row slices (the per-tablet partial-result
+    partition for the host-merge reducers)."""
+    per = -(-n // n_shards) if n else 0
+    return [slice(s, min(s + per, n))
+            for s in range(0, n, per)] if per else []
+
+
+def merged_stats(batch, stat_spec: str, n_shards: int) -> Stat:
+    """Per-shard observe + monoid merge (the client-side Reducer): each
+    shard's rows fold into a fresh stat, partials merge pairwise.  For
+    exact stats (count, minmax, histogram, enumeration, descriptive)
+    the merge is exactly the single-pass result; sketches (TopK,
+    Frequency) merge within their approximation guarantees — the same
+    contract as the reference's Stat.+ (Stat.scala:31-90)."""
+    proto = parse_stat(stat_spec)
+    partials = []
+    for sl in _shard_slices(len(batch), n_shards):
+        part = proto.fresh_copy()
+        part.observe(batch.take(np.arange(sl.start, sl.stop)))
+        partials.append(part)
+    if not partials:
+        return proto
+    merged = partials[0]
+    for p in partials[1:]:
+        merged = merged + p
+    return merged
+
+
+def merged_arrow(batch, sft, n_shards: int,
+                 dictionary_fields: tuple[str, ...] = (),
+                 sort_field: str | None = None, reverse: bool = False):
+    """Per-shard DeltaWriter streams + merge_deltas k-way merge (the
+    ArrowScan reduce): each shard's rows stream through an independent
+    delta-dictionary writer (its dictionary accumulates only ITS values,
+    as on a data node), and the client merge decodes + merges.  Returns
+    a pyarrow Table."""
+    from ..arrow.delta import DeltaWriter
+    from ..arrow.reader import merge_deltas
+
+    streams = []
+    for sl in _shard_slices(len(batch), n_shards):
+        w = DeltaWriter(sft, dictionary_fields, sort_field, reverse)
+        w.write(batch.take(np.arange(sl.start, sl.stop)))
+        streams.append(w.finish())
+    return merge_deltas(streams, sort_field=sort_field, reverse=reverse)

@@ -11,8 +11,17 @@ __all__ = ["stats_process"]
 
 def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
     """Evaluate ``stat_spec`` (e.g. "Count();MinMax(score)") over the
-    features matching ``query``."""
+    features matching ``query``.
+
+    On a mesh-backed store the stat runs as the distributed reduce:
+    per-shard partials fold through the Stat monoid (the reference's
+    per-node StatsScan + client Reducer, iterators/StatsScan.scala:125)."""
     result = store.query_result(schema, query)
+    mesh = getattr(store, "_mesh", None)
+    if mesh is not None and len(result.batch):
+        from ..parallel.stats import merged_stats
+        return merged_stats(result.batch, stat_spec,
+                            int(mesh.devices.size))
     stat = parse_stat(stat_spec)
     if len(result.batch):
         stat.observe(result.batch)
