@@ -27,9 +27,22 @@ __all__ = ["Converter", "EvaluationContext", "converter_from_config"]
 
 @dataclass
 class EvaluationContext:
+    """Ingest counters (the reference's EvaluationContext success/failure
+    metrics, convert2/EvaluationContext.scala): per-RECORD accounting —
+    a malformed row increments ``failure`` and leaves the rest of the
+    batch intact (error-mode skip-bad-records semantics)."""
+
     success: int = 0
     failure: int = 0
     errors: list = field(default_factory=list)
+
+    #: cap on retained error samples (counters keep counting past it)
+    MAX_ERRORS = 32
+
+    def record_failure(self, count: int, reason: str) -> None:
+        self.failure += count
+        if len(self.errors) < self.MAX_ERRORS:
+            self.errors.append(reason)
 
 
 class Converter:
@@ -81,34 +94,161 @@ class Converter:
 
     # -- shared pipeline --------------------------------------------------
     def convert(self, source, ec: EvaluationContext | None = None) -> FeatureBatch:
+        """Parse → transform (vectorized) → validate → assemble.
+
+        Error handling mirrors AbstractConverter's modes
+        (convert2/AbstractConverter.scala): ``raise`` propagates the
+        first failure; ``skip`` (default) and ``log`` drop bad RECORDS —
+        when a vectorized transform fails, rows are retried one at a
+        time so only the malformed ones are lost (per-record failure
+        accounting, not per-batch)."""
         ec = ec if ec is not None else EvaluationContext()
+        #: parse-level per-record failures (ragged CSV rows etc.) noted
+        #: by raw_columns; folded into the context here
+        self._parse_failures: list[str] = []
         cols = self.raw_columns(source)
+        for msg in self._parse_failures:
+            ec.record_failure(1, msg)
         n = len(next(iter(cols.values()))) if cols else 0
-        data: dict = {}
         from .enrichment import pop_active_caches, push_active_caches
         push_active_caches(self._caches)
         try:
-            for name, expr in self.fields:
-                if expr is None:
-                    data[name] = cols[name]
-                else:
-                    data[name] = expr.evaluate(cols)
-            ids = self.id_expr.evaluate(cols) if self.id_expr else None
-        except Exception as e:
-            if self.error_mode == "raise":
-                raise
-            ec.failure += n
-            ec.errors.append(repr(e))
-            return FeatureBatch(self.sft, {})
+            try:
+                data, ids = self._transform(cols)
+            except Exception as e:
+                if self.error_mode == "raise":
+                    raise
+                if self.error_mode == "log":
+                    import logging
+                    logging.getLogger("geomesa_tpu.convert").warning(
+                        "vectorized transform failed (%r); retrying "
+                        "row-by-row to isolate bad records", e)
+                data, ids = self._transform_salvage(cols, n, ec)
         finally:
             pop_active_caches()
+        batch = self._assemble(data, ids, ec)
+        batch = self._validate(batch, ec)
+        ec.success += len(batch)
+        return batch
+
+    def _transform(self, cols: dict):
+        data: dict = {}
+        for name, expr in self.fields:
+            if expr is None:
+                data[name] = cols[name]
+            else:
+                data[name] = expr.evaluate(cols)
+        ids = self.id_expr.evaluate(cols) if self.id_expr else None
+        return data, ids
+
+    def _transform_salvage(self, cols: dict, n: int, ec: EvaluationContext):
+        """Per-record retry after a vectorized transform failure: each
+        row evaluates alone; rows that still fail are counted and
+        dropped (skip-bad-records).  O(rows) Python — the failure path
+        only; clean files never pay it."""
+        good: list[dict] = []
+        good_ids: list = []
+        for i in range(n):
+            row = {k: v[i:i + 1] for k, v in cols.items()}
+            try:
+                d, ids = self._transform(row)
+                # scalar-ize: each value is a 1-element array
+                good.append(d)
+                good_ids.append(ids[0] if ids is not None else None)
+            except Exception as e:
+                ec.record_failure(1, f"row {i}: {e!r}")
+        if not good:
+            return {name: np.empty(0, dtype=object)
+                    for name, _ in self.fields}, None
+
+        def cat(k):
+            first = good[0][k]
+            if isinstance(first, tuple):  # e.g. point() → (x, y)
+                return tuple(
+                    np.concatenate([np.asarray(g[k][j]) for g in good])
+                    for j in range(len(first)))
+            return np.concatenate([np.asarray(g[k]) for g in good])
+
+        data = {k: cat(k) for k in good[0]}
+        ids = (None if self.id_expr is None
+               else np.asarray(good_ids, dtype=object))
+        return data, ids
+
+    def _assemble(self, data: dict, ids, ec: EvaluationContext) -> FeatureBatch:
         # geometry attrs: object arrays of Geometry objects → packed
         for attr in self.sft.attributes:
             v = data.get(attr.name)
             if attr.is_geometry and isinstance(v, np.ndarray) and v.dtype == object:
                 data[attr.name] = list(v)
-        batch = FeatureBatch.from_dict(self.sft, data, ids=ids)
-        ec.success += len(batch)
+        try:
+            return FeatureBatch.from_dict(self.sft, data, ids=ids)
+        except Exception as e:
+            if self.error_mode == "raise":
+                raise
+            n = len(next(iter(data.values()))) if data else 0
+            ec.record_failure(n, f"batch assembly: {e!r}")
+            return FeatureBatch(self.sft, {})
+
+    def _validate(self, batch: FeatureBatch,
+                  ec: EvaluationContext) -> FeatureBatch:
+        """Index validators (the reference's SimpleFeatureValidator:
+        ``has-geo``, ``has-dtg``, ``z-index`` — convert2/validators):
+        drop (or raise on) records an index could not serve."""
+        validators = self.config.get("options", {}).get("validators", [])
+        if not validators or len(batch) == 0:
+            return batch
+        n = len(batch)
+        keep = np.ones(n, dtype=bool)
+        reasons: dict[str, int] = {}
+
+        def fail(mask: np.ndarray, why: str):
+            bad = ~mask
+            cnt = int((keep & bad).sum())
+            if cnt:
+                if self.error_mode == "raise":
+                    raise ValueError(
+                        f"validator {why}: {cnt} invalid record(s)")
+                reasons[why] = reasons.get(why, 0) + cnt
+            return mask
+
+        sft = self.sft
+        for v in validators:
+            if v not in ("has-geo", "has-dtg", "z-index", "index"):
+                raise ValueError(f"unknown validator {v!r}")
+            if v in ("has-geo", "z-index", "index") and sft.geom_field:
+                x, y = batch.geom_xy(sft.geom_field)
+                x = np.asarray(x, np.float64)
+                y = np.asarray(y, np.float64)
+                keep &= fail(~(np.isnan(x) | np.isnan(y)), "has-geo")
+                if v != "has-geo":
+                    keep &= fail((x >= -180) & (x <= 180)
+                                 & (y >= -90) & (y <= 90), "z-index-bounds")
+            if v in ("has-dtg", "z-index", "index") and sft.dtg_field:
+                dtg = batch.columns.get(sft.dtg_field)
+                if dtg is None:
+                    keep &= fail(np.zeros(n, dtype=bool), "has-dtg")
+                    continue
+                if dtg.dtype == object:
+                    ok = np.asarray([d is not None for d in dtg])
+                else:
+                    ok = ~np.isnan(dtg.astype(np.float64))
+                keep &= fail(ok, "has-dtg")
+                if v != "has-dtg":
+                    from ..curve.binnedtime import max_date_ms
+                    ms = np.where(ok, dtg.astype(np.int64,
+                                                 casting="unsafe"), 0)
+                    in_range = (ms >= 0) & (ms < max_date_ms(
+                        sft.z3_interval))
+                    keep &= fail(in_range | ~ok, "z-index-time")
+        dropped = int((~keep).sum())
+        if dropped:
+            for why, cnt in reasons.items():
+                ec.record_failure(cnt, f"validator {why}: {cnt} record(s)")
+            if self.error_mode == "log":
+                import logging
+                logging.getLogger("geomesa_tpu.convert").warning(
+                    "validators dropped %d record(s): %s", dropped, reasons)
+            batch = batch.take(np.flatnonzero(keep))
         return batch
 
 
@@ -134,9 +274,22 @@ class DelimitedTextConverter(Converter):
             buf = source
         read_opts = pacsv.ReadOptions(
             skip_rows=skip, autogenerate_column_names=not has_header)
+        parse_opts = {"delimiter": delim}
+        if self.error_mode != "raise":
+            # ragged rows are per-RECORD failures, not file failures
+            # (AbstractConverter skip-bad-records at the parse stage)
+            failures = getattr(self, "_parse_failures", [])
+
+            def _skip_row(row):
+                failures.append(
+                    f"parse: expected {row.expected_columns} columns, "
+                    f"got {row.actual_columns}: {row.text!r}")
+                return "skip"
+
+            parse_opts["invalid_row_handler"] = _skip_row
         table = pacsv.read_csv(
             buf, read_opts,
-            pacsv.ParseOptions(delimiter=delim),
+            pacsv.ParseOptions(**parse_opts),
             pacsv.ConvertOptions(strings_can_be_null=True),
         )
         cols = {}
