@@ -76,6 +76,19 @@ class LegacyZ2SFC:
         ix, iy = deinterleave2(z, xp=xp)
         return self.lon.denormalize(ix, xp=xp), self.lat.denormalize(iy, xp=xp)
 
+    def ranges(self, xy, max_ranges=None, max_levels=None) -> np.ndarray:
+        """Covering z ranges in the LEGACY normalization space — lets v1
+        index layouts serve queries (the reference keeps LegacyZ2SFC
+        queryable, index/index/z2/legacy/Z2IndexV1.scala)."""
+        from .ranges import zranges
+        boxes = np.atleast_2d(np.asarray(xy, dtype=np.float64))
+        mins = np.stack([[self.lon.normalize_scalar(b[0]),
+                          self.lat.normalize_scalar(b[1])] for b in boxes])
+        maxs = np.stack([[self.lon.normalize_scalar(b[2]),
+                          self.lat.normalize_scalar(b[3])] for b in boxes])
+        return zranges(mins, maxs, dims=2, bits=self.bits,
+                       max_ranges=max_ranges, max_levels=max_levels)
+
 
 @dataclass(frozen=True)
 class LegacyZ3SFC:
@@ -107,6 +120,31 @@ class LegacyZ3SFC:
         return (self.lon.denormalize(ix, xp=xp),
                 self.lat.denormalize(iy, xp=xp),
                 self.time.denormalize(it, xp=xp))
+
+    @property
+    def whole_period(self) -> tuple[int, int]:
+        return (0, int(self.time.max_index))
+
+    def ranges(self, xy, t, max_ranges=None, max_levels=None) -> np.ndarray:
+        """Covering z ranges in the LEGACY normalization space (21-bit
+        lon/lat × 20-bit time; the time dim's high bit is simply never
+        set, so the uniform-bit decomposition stays valid) — lets v1
+        layouts serve queries (LegacyZ3SFC.scala / Z3IndexV1)."""
+        from .ranges import zranges
+        boxes = np.atleast_2d(np.asarray(xy, dtype=np.float64))
+        times = np.atleast_2d(np.asarray(t, dtype=np.int64))
+        mins, maxs = [], []
+        for b in boxes:
+            for tlo, thi in times:
+                mins.append([self.lon.normalize_scalar(b[0]),
+                             self.lat.normalize_scalar(b[1]),
+                             self.time.normalize_scalar(float(tlo))])
+                maxs.append([self.lon.normalize_scalar(b[2]),
+                             self.lat.normalize_scalar(b[3]),
+                             self.time.normalize_scalar(float(thi))])
+        return zranges(np.asarray(mins), np.asarray(maxs), dims=3,
+                       bits=21, max_ranges=max_ranges,
+                       max_levels=max_levels)
 
 
 _Z2 = LegacyZ2SFC()

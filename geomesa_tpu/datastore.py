@@ -39,10 +39,39 @@ from .stats.stat import (
     CountStat, EnumerationStat, Histogram, MinMax, Stat, TopK, stat_from_json,
 )
 
-__all__ = ["TpuDataStore", "CatalogVersionError"]
+__all__ = ["TpuDataStore", "CatalogVersionError", "CURRENT_INDEX_VERSIONS"]
 
 #: on-disk catalog format version; bumped on incompatible layout changes
-CATALOG_VERSION = 1
+#: (v2 added per-index layout versions; v1 catalogs read as all-current)
+CATALOG_VERSION = 2
+
+#: current per-index key-layout versions (the reference's Z3IndexV7-style
+#: version registry, index/api/GeoMesaFeatureIndexFactory); v1 of z3/z2
+#: is the legacy semi-normalized curve (curve/legacy.py)
+def _current_index_versions() -> dict:
+    from .index.z2 import Z2_INDEX_VERSION
+    from .index.z3 import Z3_INDEX_VERSION
+    return {"z3": Z3_INDEX_VERSION, "z2": Z2_INDEX_VERSION,
+            "xz2": 1, "xz3": 1, "attr": 1, "id": 1}
+
+
+CURRENT_INDEX_VERSIONS = _current_index_versions()
+
+
+def _parse_index_versions(user_data: dict) -> dict:
+    """Per-schema overrides from user data: ``geomesa.index.versions =
+    "z3:1,z2:1"`` pins listed indexes to old layouts (data imported from
+    a system that wrote legacy keys)."""
+    versions = dict(CURRENT_INDEX_VERSIONS)
+    raw = (user_data or {}).get("geomesa.index.versions", "")
+    if raw and raw != "current":
+        for part in raw.split(","):
+            name, _, v = part.strip().partition(":")
+            if name not in versions:
+                raise ValueError(f"unknown index {name!r} in "
+                                 "geomesa.index.versions")
+            versions[name] = int(v)
+    return versions
 
 
 class CatalogVersionError(RuntimeError):
@@ -64,6 +93,9 @@ class _SchemaStore:
     def __init__(self, sft: FeatureType, mesh=None):
         self.sft = sft
         self.mesh = mesh
+        #: per-index key-layout versions (versioned indices: reads of
+        #: old catalogs keep their recorded layout; see migrate_schema)
+        self.index_versions: dict = _parse_index_versions(sft.user_data)
         self.batch: FeatureBatch | None = None
         self.visibilities: np.ndarray | None = None  # per-feature vis strings
         #: attr name → per-feature vis strings (attribute-level visibility,
@@ -246,11 +278,13 @@ class _SchemaStore:
                 from .parallel.scan import ShardedZ3Index
                 self._indexes["z3"] = ShardedZ3Index.build(
                     np.asarray(x), np.asarray(y), dtg,
-                    period=self.sft.z3_interval, mesh=self.mesh)
+                    period=self.sft.z3_interval, mesh=self.mesh,
+                    version=self.index_versions["z3"])
             else:
                 xd, yd = self.device_xy()
                 self._indexes["z3"] = Z3PointIndex.build(
-                    x, y, dtg, period=self.sft.z3_interval, xd=xd, yd=yd)
+                    x, y, dtg, period=self.sft.z3_interval, xd=xd, yd=yd,
+                    version=self.index_versions["z3"])
         return self._indexes["z3"]
 
     def z2_index(self) -> Z2PointIndex:
@@ -260,10 +294,13 @@ class _SchemaStore:
             if self.mesh is not None:
                 from .parallel.z2 import ShardedZ2Index
                 self._indexes["z2"] = ShardedZ2Index.build(
-                    np.asarray(x), np.asarray(y), mesh=self.mesh)
+                    np.asarray(x), np.asarray(y), mesh=self.mesh,
+                    version=self.index_versions["z2"])
             else:
                 xd, yd = self.device_xy()
-                self._indexes["z2"] = Z2PointIndex.build(x, y, xd=xd, yd=yd)
+                self._indexes["z2"] = Z2PointIndex.build(
+                    x, y, xd=xd, yd=yd,
+                    version=self.index_versions["z2"])
         return self._indexes["z2"]
 
     def xz3_index(self) -> XZ3Index:
@@ -461,6 +498,10 @@ class TpuDataStore:
         store = self._store(name)
         if [a.name for a in sft.attributes] != [a.name for a in store.sft.attributes]:
             raise ValueError("updateSchema cannot add/remove attributes")
+        if sft.user_data.get("geomesa.index.versions") == "current":
+            # explicit layout upgrade request piggybacking on the schema
+            # update (the reference's index-migration path)
+            self.migrate_schema(name)
         with self._catalog_lock():
             store.sft = sft
             self._interceptors.pop(name, None)
@@ -814,10 +855,35 @@ class TpuDataStore:
     def _persist_schema(self, sft: FeatureType) -> None:
         if not self._catalog_dir:
             return
+        store = self._schemas.get(sft.name)
+        versions = (store.index_versions if store is not None
+                    else dict(CURRENT_INDEX_VERSIONS))
         path = os.path.join(self._catalog_dir, f"{sft.name}.schema.json")
         with open(path, "w") as f:
             json.dump({"name": sft.name, "spec": sft.spec_string(),
+                       "index_versions": versions,
                        "updated": time.time()}, f)
+
+    def migrate_schema(self, name: str) -> dict:
+        """Upgrade a schema's index layouts to the CURRENT versions (the
+        reference's index-format migration on update, e.g.
+        AttributeIndexV2..V7 upgrades): indexes rebuild from the column
+        store with current key math on next use, and the catalog records
+        the new versions.  Returns the pre-migration versions."""
+        store = self._store(name)
+        old = dict(store.index_versions)
+        with self._catalog_lock():
+            store.index_versions = dict(CURRENT_INDEX_VERSIONS)
+            # stale layouts must not serve another query
+            store._indexes.clear()
+            store._dirty = True
+            if "geomesa.index.versions" in store.sft.user_data:
+                ud = dict(store.sft.user_data)
+                del ud["geomesa.index.versions"]
+                store.sft = FeatureType(store.sft.name, store.sft.attributes,
+                                        store.sft.default_geom, ud)
+            self._persist_schema(store.sft)
+        return old
 
     def stats_analyze(self, name: str) -> int:
         """Recompute a schema's sketches from its stored rows and persist
@@ -916,5 +982,14 @@ class TpuDataStore:
                 except FileNotFoundError:
                     continue  # removed by a concurrent process mid-listing
                 sft = parse_spec(meta["name"], meta["spec"])
-                self._schemas[sft.name] = _SchemaStore(sft, mesh=self._mesh)
+                store = _SchemaStore(sft, mesh=self._mesh)
+                # recorded layout versions win over spec defaults; v1
+                # (pre-versioning) catalogs were written with the then-
+                # current layouts, which match today's defaults
+                if "index_versions" in meta:
+                    store.index_versions = {
+                        **CURRENT_INDEX_VERSIONS,
+                        **{k: int(v) for k, v in
+                           meta["index_versions"].items()}}
+                self._schemas[sft.name] = store
                 self._load_data(sft.name)

@@ -39,8 +39,22 @@ class Z2QueryPlan:
         return len(self.rzlo)
 
 
-def plan_z2_query(boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> Z2QueryPlan:
-    sfc = z2_sfc()
+#: current z2 key-layout version (v1 = legacy semi-normalized curve)
+Z2_INDEX_VERSION = 2
+
+
+def z2_sfc_for_version(version: int):
+    """Curve for a persisted index-layout version (the reference's
+    Z2IndexV1..Vn read-path dispatch, index/index/z2/legacy/)."""
+    if version >= 2:
+        return z2_sfc()
+    from ..curve.legacy import legacy_z2_sfc
+    return legacy_z2_sfc()
+
+
+def plan_z2_query(boxes, max_ranges: int = DEFAULT_MAX_RANGES,
+                  sfc=None) -> Z2QueryPlan:
+    sfc = sfc if sfc is not None else z2_sfc()
     boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
     zr = sfc.ranges(boxes, max_ranges=max_ranges)
     ixy = np.stack(
@@ -142,8 +156,9 @@ class Z2PointIndex:
 
     DEFAULT_CAPACITY = 1 << 15
 
-    def __init__(self, z, pos, x, y):
-        self.sfc: Z2SFC = z2_sfc()
+    def __init__(self, z, pos, x, y, version: int = Z2_INDEX_VERSION):
+        self.version = version
+        self.sfc = z2_sfc_for_version(version)
         self.z = z
         self.pos = pos
         self.x = x
@@ -151,21 +166,22 @@ class Z2PointIndex:
         self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
-    def build(cls, x, y, xd=None, yd=None) -> "Z2PointIndex":
+    def build(cls, x, y, xd=None, yd=None,
+              version: int = Z2_INDEX_VERSION) -> "Z2PointIndex":
         x = np.asarray(x, dtype=np.float64)
         y = np.asarray(y, dtype=np.float64)
-        sfc = z2_sfc()
+        sfc = z2_sfc_for_version(version)
         xd = jnp.asarray(x) if xd is None else xd
         yd = jnp.asarray(y) if yd is None else yd
         z_s, pos = _encode_sort_z2(sfc, xd, yd)
-        return cls(z=z_s, pos=pos, x=xd, y=yd)
+        return cls(z=z_s, pos=pos, x=xd, y=yd, version=version)
 
     def __len__(self) -> int:
         return int(self.z.shape[0])
 
     def query(self, boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> np.ndarray:
         """Original-order positions matching any of the bboxes, exactly."""
-        plan = plan_z2_query(boxes, max_ranges)
+        plan = plan_z2_query(boxes, max_ranges, sfc=self.sfc)
         if plan.num_ranges == 0 or len(self) == 0:
             return np.empty(0, dtype=np.int64)
         r = pad_ranges({"rzlo": plan.rzlo, "rzhi": plan.rzhi},
@@ -194,7 +210,7 @@ class Z2PointIndex:
         rzlo, rzhi, rqid, ixy, bxs, bqid = [], [], [], [], [], []
         for q, boxes in enumerate(boxes_list):
             # per-window scan-ranges budget (see z3.query_many)
-            plan = plan_z2_query(boxes, max_ranges)
+            plan = plan_z2_query(boxes, max_ranges, sfc=self.sfc)
             if plan.num_ranges == 0:
                 continue
             rzlo.append(plan.rzlo)

@@ -103,15 +103,18 @@ def plan_z3_query(
     t_hi_ms: int,
     period: TimePeriod | str = TimePeriod.WEEK,
     max_ranges: int = DEFAULT_MAX_RANGES,
+    sfc=None,
 ) -> Z3QueryPlan:
     """Decompose bbox(es) + time interval into a covering-range scan plan.
 
     The scan-ranges budget is split across time bins as in
     Z3IndexKeySpace.getRanges (:166-168); whole-period bins share one
-    decomposition, partial (boundary) bins get their own.
-    """
+    decomposition, partial (boundary) bins get their own.  ``sfc``
+    selects the curve (versioned index layouts: the legacy
+    semi-normalized curve for v1, the current curve by default — the
+    reference's Z3IndexV1..Vn read-path dispatch)."""
     period = TimePeriod.parse(period)
-    sfc = z3_sfc(period)
+    sfc = sfc if sfc is not None else z3_sfc(period)
     boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
     windows = _time_windows_by_bin(t_lo_ms, t_hi_ms, period)
     empty = np.empty(0, dtype=np.int64)
@@ -386,6 +389,21 @@ def _encode_sort_z3(sfc, xs, ys, os_, bs):
         dimension=0, num_keys=2)
 
 
+#: current z3 key-layout version (v1 = legacy semi-normalized curve —
+#: the reference's Z3IndexV1 era; see curve/legacy.py)
+Z3_INDEX_VERSION = 2
+
+
+def z3_sfc_for_version(period: TimePeriod, version: int):
+    """Curve for a persisted index-layout version (the read-path
+    dispatch of the reference's versioned indices,
+    index/index/z3/legacy/Z3IndexV1.scala)."""
+    if version >= 2:
+        return z3_sfc(period)
+    from ..curve.legacy import legacy_z3_sfc
+    return legacy_z3_sfc(period)
+
+
 class Z3PointIndex:
     """Device-resident Z3 index over point features with timestamps."""
 
@@ -393,9 +411,11 @@ class Z3PointIndex:
     #: common case is exactly ONE device dispatch + ONE transfer per query
     DEFAULT_CAPACITY = 1 << 15
 
-    def __init__(self, period, bins, z, pos, x, y, dtg):
+    def __init__(self, period, bins, z, pos, x, y, dtg,
+                 version: int = Z3_INDEX_VERSION):
         self.period = TimePeriod.parse(period)
-        self.sfc: Z3SFC = z3_sfc(self.period)
+        self.version = version
+        self.sfc = z3_sfc_for_version(self.period, version)
         self.bins = bins
         self.z = z
         self.pos = pos
@@ -413,12 +433,14 @@ class Z3PointIndex:
 
     @classmethod
     def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK,
-              xd=None, yd=None) -> "Z3PointIndex":
+              xd=None, yd=None,
+              version: int = Z3_INDEX_VERSION) -> "Z3PointIndex":
         """Encode keys (device) and sort (device lexsort, bin-major).
         ``xd``/``yd`` optionally supply already-device-resident coordinate
-        arrays (shared with other indexes) to skip re-upload."""
+        arrays (shared with other indexes) to skip re-upload;
+        ``version`` selects the key-layout curve (legacy for v1)."""
         period = TimePeriod.parse(period)
-        sfc = z3_sfc(period)
+        sfc = z3_sfc_for_version(period, version)
         x = np.asarray(x, dtype=np.float64)
         y = np.asarray(y, dtype=np.float64)
         dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
@@ -433,7 +455,8 @@ class Z3PointIndex:
         offd = jnp.asarray(host_offs.astype(np.float64))
 
         bins_s, z_s, pos = _encode_sort_z3(sfc, xd, yd, offd, bind)
-        idx = cls(period, bins=bins_s, z=z_s, pos=pos, x=xd, y=yd, dtg=td)
+        idx = cls(period, bins=bins_s, z=z_s, pos=pos, x=xd, y=yd, dtg=td,
+                  version=version)
         idx.t_min_ms, idx.t_max_ms = t_min, t_max
         return idx
 
@@ -511,7 +534,8 @@ class Z3PointIndex:
         """Return original-order positions of features matching
         bbox(es) ∧ time interval, exactly (oracle-equal hit sets)."""
         t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
-        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges,
+                             sfc=self.sfc)
         if plan.num_ranges == 0 or len(self) == 0:
             return np.empty(0, dtype=np.int64)
         # bucket the plan shapes so differently-shaped queries share
@@ -606,7 +630,8 @@ class Z3PointIndex:
             # covering ranges cost a bigger searchsorted batch (cheap)
             # but shrink the candidate gather + transfer (the dominant
             # cost)
-            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges)
+            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges,
+                                 sfc=self.sfc)
             qtlo[q] = plan.t_lo_ms
             qthi[q] = plan.t_hi_ms
             if plan.num_ranges == 0:

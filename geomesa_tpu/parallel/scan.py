@@ -221,10 +221,13 @@ class ShardedZ3Index:
     def __init__(self, mesh: Mesh, period: TimePeriod,
                  bins, z, gid, x, y, dtg, n_total: int,
                  shard_counts: np.ndarray | None,
-                 t_min_ms: int | None = None, t_max_ms: int | None = None):
+                 t_min_ms: int | None = None, t_max_ms: int | None = None,
+                 version: int | None = None):
+        from ..index.z3 import Z3_INDEX_VERSION, z3_sfc_for_version
         self.mesh = mesh
         self.period = period
-        self.sfc = z3_sfc(period)
+        self.version = Z3_INDEX_VERSION if version is None else version
+        self.sfc = z3_sfc_for_version(period, self.version)
         self.bins = bins
         self.z = z
         self.gid = gid
@@ -242,11 +245,16 @@ class ShardedZ3Index:
     # -- builds -----------------------------------------------------------
     @classmethod
     def build(cls, x, y, dtg_ms, period: TimePeriod | str = TimePeriod.WEEK,
-              mesh: Mesh | None = None) -> "ShardedZ3Index":
+              mesh: Mesh | None = None,
+              version: int | None = None) -> "ShardedZ3Index":
         """Single-controller build: the full columns live on this host
-        and scatter over the mesh (shard_batch); gids are input row order."""
+        and scatter over the mesh (shard_batch); gids are input row
+        order.  ``version`` selects the key-layout curve (legacy for
+        v1 — versioned index layouts)."""
+        from ..index.z3 import Z3_INDEX_VERSION, z3_sfc_for_version
         mesh = mesh or device_mesh()
         period = TimePeriod.parse(period)
+        version = Z3_INDEX_VERSION if version is None else version
         x = np.asarray(x, np.float64)
         y = np.asarray(y, np.float64)
         dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
@@ -257,14 +265,15 @@ class ShardedZ3Index:
             mesh, x, y, dtg_ms, host_bins.astype(np.int32),
             host_offs.astype(np.float64), gids)
         xd, yd, td, bind, offd, gidd = sharded
-        prog = _z3_build_program(mesh, z3_sfc(period))
+        prog = _z3_build_program(mesh, z3_sfc_for_version(period, version))
         bins_s, z_s, gid_s, x_s, y_s, t_s = prog(
             xd, yd, td, bind, offd, gidd, valid)
         n_shards = int(mesh.devices.size)
         per = int(bins_s.shape[0]) // n_shards
         shard_counts = np.clip(n - np.arange(n_shards) * per, 0, per)
         idx = cls(mesh, period, bins_s, z_s, gid_s, x_s, y_s, t_s,
-                  n_total=n, shard_counts=shard_counts.astype(np.int64))
+                  n_total=n, shard_counts=shard_counts.astype(np.int64),
+                  version=version)
         if n:
             idx.t_min_ms = int(dtg_ms.min())
             idx.t_max_ms = int(dtg_ms.max())
@@ -402,7 +411,8 @@ class ShardedZ3Index:
                     max_ranges: int = 2000) -> int:
         """Candidate count across all shards (index-key resolution)."""
         t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
-        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges,
+                             sfc=self.sfc)
         if plan.num_ranges == 0:
             return 0
         return sharded_range_count(
@@ -416,7 +426,8 @@ class ShardedZ3Index:
         (ranges sharded + rotated, data stationary) — see
         :func:`ring_range_counts`."""
         t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
-        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges,
+                             sfc=self.sfc)
         if plan.num_ranges == 0:
             return np.empty(0, dtype=np.int64)
         n = self.mesh.devices.size
@@ -448,7 +459,8 @@ class ShardedZ3Index:
         arrays pad to power-of-two buckets and travel as traced
         arguments, so repeat queries reuse the compile."""
         t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
-        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges)
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges,
+                             sfc=self.sfc)
         if plan.num_ranges == 0 or self._n_total == 0:
             return np.empty(0, dtype=np.int64)
         capacity = capacity or self._capacity
@@ -487,7 +499,8 @@ class ShardedZ3Index:
         qthi = np.empty(n_q, dtype=np.int64)
         for q, (bxs, lo, hi) in enumerate(windows):
             lo, hi = self._clamp_time(lo, hi)
-            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges)
+            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges,
+                                 sfc=self.sfc)
             qtlo[q] = plan.t_lo_ms
             qthi[q] = plan.t_hi_ms
             if plan.num_ranges == 0:

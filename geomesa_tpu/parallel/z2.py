@@ -160,9 +160,12 @@ class ShardedZ2Index:
     DEFAULT_CAPACITY = 1 << 15
 
     def __init__(self, mesh: Mesh, z, gid, x, y, n_total: int,
-                 shard_counts: np.ndarray | None):
+                 shard_counts: np.ndarray | None,
+                 version: int | None = None):
+        from ..index.z2 import Z2_INDEX_VERSION, z2_sfc_for_version
         self.mesh = mesh
-        self.sfc = z2_sfc()
+        self.version = Z2_INDEX_VERSION if version is None else version
+        self.sfc = z2_sfc_for_version(self.version)
         self.z = z
         self.gid = gid
         self.x = x
@@ -172,21 +175,25 @@ class ShardedZ2Index:
         self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
-    def build(cls, x, y, mesh: Mesh | None = None) -> "ShardedZ2Index":
+    def build(cls, x, y, mesh: Mesh | None = None,
+              version: int | None = None) -> "ShardedZ2Index":
+        from ..index.z2 import Z2_INDEX_VERSION, z2_sfc_for_version
         mesh = mesh or device_mesh()
+        version = Z2_INDEX_VERSION if version is None else version
         x = np.asarray(x, np.float64)
         y = np.asarray(y, np.float64)
         n = len(x)
         gids = np.arange(n, dtype=np.int32)
         sharded, valid = shard_batch(mesh, x, y, gids)
         xd, yd, gidd = sharded
-        z_s, gid_s, x_s, y_s = _z2_build_program(mesh, z2_sfc())(
-            xd, yd, gidd, valid)
+        z_s, gid_s, x_s, y_s = _z2_build_program(
+            mesh, z2_sfc_for_version(version))(xd, yd, gidd, valid)
         n_shards = int(mesh.devices.size)
         per = int(z_s.shape[0]) // n_shards
         shard_counts = np.clip(n - np.arange(n_shards) * per, 0, per)
         return cls(mesh, z_s, gid_s, x_s, y_s, n_total=n,
-                   shard_counts=shard_counts.astype(np.int64))
+                   shard_counts=shard_counts.astype(np.int64),
+                   version=version)
 
     def total(self) -> int:
         return self._n_total
@@ -232,7 +239,7 @@ class ShardedZ2Index:
     def query(self, boxes, max_ranges: int = 2000,
               capacity: int | None = None) -> np.ndarray:
         """Exact global hit gids matching any of the bboxes."""
-        plan = plan_z2_query(boxes, max_ranges)
+        plan = plan_z2_query(boxes, max_ranges, sfc=self.sfc)
         if plan.num_ranges == 0 or self._n_total == 0:
             return np.empty(0, dtype=np.int64)
         capacity = capacity or self._capacity
@@ -262,7 +269,7 @@ class ShardedZ2Index:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
         rzlo, rzhi, rqid, ixy, bxs, bqid = [], [], [], [], [], []
         for q, boxes in enumerate(boxes_list):
-            plan = plan_z2_query(boxes, max_ranges)
+            plan = plan_z2_query(boxes, max_ranges, sfc=self.sfc)
             if plan.num_ranges == 0:
                 continue
             rzlo.append(plan.rzlo)

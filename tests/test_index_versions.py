@@ -1,0 +1,156 @@
+"""Versioned index layouts (VERDICT r1 item 7): v1 (legacy
+semi-normalized curve) layouts stay fully queryable, the catalog records
+per-index versions, and migration rebuilds at current layouts — the
+reference's Z3IndexV1../AttributeIndexV2..V7 + BackCompatibilityTest
+machinery (index/index/z3/legacy/)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import CURRENT_INDEX_VERSIONS, TpuDataStore
+from geomesa_tpu.filters import evaluate_filter, parse_ecql
+
+MS = 1514764800000
+DAY = 86_400_000
+N = 20_003
+
+SPEC_LEGACY = ("name:String:index=true,dtg:Date,*geom:Point;"
+               "geomesa.index.versions='z3:1,z2:1'")
+Z3_ECQL = ("BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg DURING "
+           "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+Z2_ECQL = "BBOX(geom, -74.2, 40.8, -73.9, 41.1)"
+
+
+def _data(rng):
+    return {
+        "name": rng.choice(["a", "b", "c"], N),
+        "dtg": rng.integers(MS, MS + 21 * DAY, N),
+        "geom": (rng.uniform(-75.0, -73.0, N), rng.uniform(40.0, 42.0, N)),
+    }
+
+
+def _oracle(ds, name, ecql):
+    return np.flatnonzero(
+        evaluate_filter(parse_ecql(ecql), ds._store(name).batch))
+
+
+def test_legacy_curves_differ_from_current():
+    """Sanity: v1 keys really are a different layout (else the version
+    machinery tests nothing)."""
+    from geomesa_tpu.curve.legacy import legacy_z3_sfc
+    from geomesa_tpu.curve.sfc import z3_sfc
+    x = np.array([-74.3, 10.0])
+    y = np.array([40.7, -45.0])
+    t = np.array([3.6e5, 1.0e6])
+    a = np.asarray(z3_sfc("week").index(x, y, t, xp=np))
+    b = np.asarray(legacy_z3_sfc("week").index(x, y, t, xp=np))
+    assert not np.array_equal(a, b)
+
+
+def test_v1_layout_serves_queries_exactly():
+    """A schema pinned to v1 layouts plans ranges in the LEGACY curve
+    space and still returns oracle-equal hits."""
+    ds = TpuDataStore()
+    ds.create_schema("ev", SPEC_LEGACY)
+    ds.write("ev", _data(np.random.default_rng(3)))
+    st = ds._store("ev")
+    assert st.index_versions["z3"] == 1 and st.index_versions["z2"] == 1
+    for ecql in (Z3_ECQL, Z2_ECQL):
+        got = ds.query_result("ev", ecql)
+        np.testing.assert_array_equal(np.sort(got.positions),
+                                      _oracle(ds, "ev", ecql))
+    assert st.z3_index().version == 1
+    assert st.z2_index().version == 1
+
+
+def test_v1_layout_mesh_store():
+    """Versioned layouts apply to the sharded indexes too."""
+    from geomesa_tpu.parallel import device_mesh
+    ds = TpuDataStore(mesh=device_mesh())
+    ds.create_schema("ev", SPEC_LEGACY)
+    ds.write("ev", _data(np.random.default_rng(5)))
+    got = ds.query_result("ev", Z3_ECQL)
+    np.testing.assert_array_equal(np.sort(got.positions),
+                                  _oracle(ds, "ev", Z3_ECQL))
+    assert ds._store("ev").z3_index().version == 1
+
+
+def test_catalog_records_and_reloads_versions(tmp_path):
+    cat = str(tmp_path / "cat")
+    ds = TpuDataStore(cat)
+    ds.create_schema("ev", SPEC_LEGACY)
+    ds.write("ev", _data(np.random.default_rng(7)))
+    ds.flush("ev")
+    with open(os.path.join(cat, "ev.schema.json")) as f:
+        meta = json.load(f)
+    assert meta["index_versions"]["z3"] == 1
+    # reopen: the recorded layout version must win
+    ds2 = TpuDataStore(cat)
+    st = ds2._store("ev")
+    assert st.index_versions["z3"] == 1
+    got = ds2.query_result("ev", Z3_ECQL)
+    np.testing.assert_array_equal(np.sort(got.positions),
+                                  _oracle(ds2, "ev", Z3_ECQL))
+
+
+def test_pre_versioning_catalog_defaults_to_current(tmp_path):
+    """A v1-era catalog entry (no index_versions key) reads as current
+    layouts — that is what the round-1 code wrote."""
+    cat = str(tmp_path / "cat")
+    ds = TpuDataStore(cat)
+    ds.create_schema("ev", "name:String,dtg:Date,*geom:Point")
+    ds.write("ev", _data(np.random.default_rng(9)))
+    ds.flush("ev")
+    # strip the versions key, simulating the old writer
+    path = os.path.join(cat, "ev.schema.json")
+    with open(path) as f:
+        meta = json.load(f)
+    del meta["index_versions"]
+    with open(path, "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(cat, "catalog.version"), "w") as f:
+        f.write("1")
+    ds2 = TpuDataStore(cat)
+    assert ds2._store("ev").index_versions == CURRENT_INDEX_VERSIONS
+    got = ds2.query_result("ev", Z3_ECQL)
+    np.testing.assert_array_equal(np.sort(got.positions),
+                                  _oracle(ds2, "ev", Z3_ECQL))
+
+
+def test_migrate_schema_rebuilds_current(tmp_path):
+    cat = str(tmp_path / "cat")
+    ds = TpuDataStore(cat)
+    ds.create_schema("ev", SPEC_LEGACY)
+    ds.write("ev", _data(np.random.default_rng(11)))
+    before = ds.query_result("ev", Z3_ECQL).positions
+    assert ds._store("ev").z3_index().version == 1
+    old = ds.migrate_schema("ev")
+    assert old["z3"] == 1
+    st = ds._store("ev")
+    assert st.index_versions == CURRENT_INDEX_VERSIONS
+    # indexes rebuilt at the new layout; hits unchanged
+    assert st.z3_index().version == CURRENT_INDEX_VERSIONS["z3"]
+    after = ds.query_result("ev", Z3_ECQL).positions
+    np.testing.assert_array_equal(np.sort(before), np.sort(after))
+    with open(os.path.join(cat, "ev.schema.json")) as f:
+        assert json.load(f)["index_versions"]["z3"] \
+            == CURRENT_INDEX_VERSIONS["z3"]
+
+
+def test_update_schema_current_triggers_migration():
+    from geomesa_tpu.features.feature_type import parse_spec
+    ds = TpuDataStore()
+    ds.create_schema("ev", SPEC_LEGACY)
+    ds.write("ev", _data(np.random.default_rng(13)))
+    assert ds._store("ev").index_versions["z3"] == 1
+    new_sft = parse_spec(
+        "ev", "name:String:index=true,dtg:Date,*geom:Point;"
+              "geomesa.index.versions=current")
+    ds.update_schema("ev", new_sft)
+    assert ds._store("ev").index_versions == CURRENT_INDEX_VERSIONS
+    got = ds.query_result("ev", Z3_ECQL)
+    np.testing.assert_array_equal(np.sort(got.positions),
+                                  _oracle(ds, "ev", Z3_ECQL))
