@@ -268,74 +268,91 @@ class _SchemaStore:
                                 jnp.asarray(np.asarray(y, np.float64)))
         return self._dev_xy
 
-    # -- lazily-built indexes --------------------------------------------
-    def z3_index(self) -> Z3PointIndex:
+    # -- lazily-built indexes (via the pluggable registry) ----------------
+    def index(self, name: str):
+        """Generic registry-backed index accessor (the reference's
+        GeoMesaFeatureIndexFactory lookup): builds lazily, honors the
+        schema's enabled-index restriction and applicability."""
+        from .index.registry import get_index
         self._rebuild_if_dirty()
-        if "z3" not in self._indexes:
-            x, y = self.batch.geom_xy()
-            dtg = self.batch.column(self.sft.dtg_field)
-            if self.mesh is not None:
-                from .parallel.scan import ShardedZ3Index
-                self._indexes["z3"] = ShardedZ3Index.build(
-                    np.asarray(x), np.asarray(y), dtg,
-                    period=self.sft.z3_interval, mesh=self.mesh,
-                    version=self.index_versions["z3"])
+        if name not in self._indexes:
+            desc = get_index(name)
+            enabled = self.sft.enabled_indices
+            if enabled is not None and name not in enabled:
+                raise ValueError(
+                    f"index {name!r} is disabled on schema "
+                    f"{self.sft.name!r} (geomesa.indices.enabled)")
+            if not desc.applicable(self.sft):
+                raise ValueError(f"schema {self.sft.name!r} does not "
+                                 f"support the {name!r} index")
+            if self.mesh is not None and desc.build_sharded is not None:
+                self._indexes[name] = desc.build_sharded(self, self.mesh)
             else:
-                xd, yd = self.device_xy()
-                self._indexes["z3"] = Z3PointIndex.build(
-                    x, y, dtg, period=self.sft.z3_interval, xd=xd, yd=yd,
-                    version=self.index_versions["z3"])
-        return self._indexes["z3"]
+                self._indexes[name] = desc.build(self)
+        return self._indexes[name]
+
+    def z3_index(self) -> Z3PointIndex:
+        return self.index("z3")
 
     def z2_index(self) -> Z2PointIndex:
-        self._rebuild_if_dirty()
-        if "z2" not in self._indexes:
-            x, y = self.batch.geom_xy()
-            if self.mesh is not None:
-                from .parallel.z2 import ShardedZ2Index
-                self._indexes["z2"] = ShardedZ2Index.build(
-                    np.asarray(x), np.asarray(y), mesh=self.mesh,
-                    version=self.index_versions["z2"])
-            else:
-                xd, yd = self.device_xy()
-                self._indexes["z2"] = Z2PointIndex.build(
-                    x, y, xd=xd, yd=yd,
-                    version=self.index_versions["z2"])
-        return self._indexes["z2"]
+        return self.index("z2")
 
     def xz3_index(self) -> XZ3Index:
-        self._rebuild_if_dirty()
-        if "xz3" not in self._indexes:
-            dtg = self.batch.column(self.sft.dtg_field)
-            if self.mesh is not None:
-                from .parallel.xz import ShardedXZ3Index
-                self._indexes["xz3"] = ShardedXZ3Index.build(
-                    self.batch.geoms, dtg, period=self.sft.z3_interval,
-                    g=self.sft.xz_precision, mesh=self.mesh)
-            else:
-                self._indexes["xz3"] = XZ3Index.build(
-                    self.batch.geoms, dtg, period=self.sft.z3_interval,
-                    g=self.sft.xz_precision)
-        return self._indexes["xz3"]
+        return self.index("xz3")
 
     def xz2_index(self) -> XZ2Index:
-        self._rebuild_if_dirty()
-        if "xz2" not in self._indexes:
-            if self.mesh is not None:
-                from .parallel.xz import ShardedXZ2Index
-                self._indexes["xz2"] = ShardedXZ2Index.build(
-                    self.batch.geoms, g=self.sft.xz_precision,
-                    mesh=self.mesh)
-            else:
-                self._indexes["xz2"] = XZ2Index.build(
-                    self.batch.geoms, g=self.sft.xz_precision)
-        return self._indexes["xz2"]
+        return self.index("xz2")
 
     def id_index(self) -> IdIndex:
-        self._rebuild_if_dirty()
-        if "id" not in self._indexes:
-            self._indexes["id"] = IdIndex.build(self.batch.ids)
-        return self._indexes["id"]
+        return self.index("id")
+
+    # registry build callbacks (each returns a fresh index; caching and
+    # mesh dispatch live in index())
+    def _build_z3(self):
+        x, y = self.batch.geom_xy()
+        dtg = self.batch.column(self.sft.dtg_field)
+        if self.mesh is not None:
+            from .parallel.scan import ShardedZ3Index
+            return ShardedZ3Index.build(
+                np.asarray(x), np.asarray(y), dtg,
+                period=self.sft.z3_interval, mesh=self.mesh,
+                version=self.index_versions["z3"])
+        xd, yd = self.device_xy()
+        return Z3PointIndex.build(
+            x, y, dtg, period=self.sft.z3_interval, xd=xd, yd=yd,
+            version=self.index_versions["z3"])
+
+    def _build_z2(self):
+        x, y = self.batch.geom_xy()
+        if self.mesh is not None:
+            from .parallel.z2 import ShardedZ2Index
+            return ShardedZ2Index.build(
+                np.asarray(x), np.asarray(y), mesh=self.mesh,
+                version=self.index_versions["z2"])
+        xd, yd = self.device_xy()
+        return Z2PointIndex.build(x, y, xd=xd, yd=yd,
+                                  version=self.index_versions["z2"])
+
+    def _build_xz3(self):
+        dtg = self.batch.column(self.sft.dtg_field)
+        if self.mesh is not None:
+            from .parallel.xz import ShardedXZ3Index
+            return ShardedXZ3Index.build(
+                self.batch.geoms, dtg, period=self.sft.z3_interval,
+                g=self.sft.xz_precision, mesh=self.mesh)
+        return XZ3Index.build(self.batch.geoms, dtg,
+                              period=self.sft.z3_interval,
+                              g=self.sft.xz_precision)
+
+    def _build_xz2(self):
+        if self.mesh is not None:
+            from .parallel.xz import ShardedXZ2Index
+            return ShardedXZ2Index.build(
+                self.batch.geoms, g=self.sft.xz_precision, mesh=self.mesh)
+        return XZ2Index.build(self.batch.geoms, g=self.sft.xz_precision)
+
+    def _build_id(self):
+        return IdIndex.build(self.batch.ids)
 
     def _z3_tier_keys(self):
         """Host (bins, z) Z3 keys shared by every z3-tiered attribute
@@ -356,6 +373,11 @@ class _SchemaStore:
 
     def attribute_index(self, attr: str) -> AttributeIndex:
         self._rebuild_if_dirty()
+        enabled = self.sft.enabled_indices
+        if enabled is not None and "attr" not in enabled:
+            raise ValueError(
+                f"index 'attr' is disabled on schema {self.sft.name!r} "
+                "(geomesa.indices.enabled)")
         key = f"attr:{attr}"
         if key not in self._indexes:
             if self.mesh is not None:
@@ -724,9 +746,14 @@ class TpuDataStore:
             from .planning.interceptor import load_interceptors
             self._interceptors[sft.name] = load_interceptors(sft)
         # guards/rewrites must see every scan: with interceptors configured
-        # take the (slower) per-window planner path, which applies them
+        # take the (slower) per-window planner path, which applies them;
+        # schemas restricting their index set also take the planner path
+        # (it honors the restriction)
+        enabled = sft.enabled_indices
         use_fast = (sft.is_points and sft.dtg_field
-                    and not self._interceptors[sft.name])
+                    and not self._interceptors[sft.name]
+                    and (enabled is None
+                         or {"z2", "z3"} <= set(enabled)))
         if not use_fast:
             from .filters.ast import And, BBox, During, Or
             out = []

@@ -1,0 +1,111 @@
+"""Pluggable index registry: name → builder factories + applicability.
+
+The analog of the reference's GeoMesaFeatureIndexFactory SPI
+(index/api/GeoMesaFeatureIndexFactory.scala: pluggable index
+implementations discovered by name, with per-schema enabled-index
+configuration via the ``geomesa.indices`` user data —
+utils/geotools/Conversions/RichSimpleFeatureType).  The built-in spatial/
+temporal/attribute/id indexes register here; custom index types can
+register too and are then buildable through ``TpuDataStore`` /
+``_SchemaStore.index(name)`` and forceable with the ``QUERY_INDEX``
+query hint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+__all__ = ["IndexDescriptor", "register_index", "get_index",
+           "available_indices", "supported_indices"]
+
+
+@dataclass(frozen=True)
+class IndexDescriptor:
+    """One registered index type.
+
+    ``build(store)`` → index instance for a single-chip store;
+    ``build_sharded(store, mesh)`` → the mesh variant (may be None when
+    the type has no sharded form — the host build is used);
+    ``applicable(sft)`` → whether the schema supports this index
+    (point/geometry/dtg requirements — the reference's
+    ``GeoMesaFeatureIndex.supports``)."""
+
+    name: str
+    applicable: Callable
+    build: Callable
+    build_sharded: Callable | None = None
+
+
+_REGISTRY: dict[str, IndexDescriptor] = {}
+
+
+def register_index(desc: IndexDescriptor) -> None:
+    """Register (or replace) an index type by name."""
+    _REGISTRY[desc.name] = desc
+
+
+def get_index(name: str) -> IndexDescriptor:
+    if name not in _REGISTRY:
+        raise KeyError(f"no index type {name!r} registered "
+                       f"(have: {sorted(_REGISTRY)})")
+    return _REGISTRY[name]
+
+
+def available_indices() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def supported_indices(sft) -> list[str]:
+    """Index types this schema can serve, honoring the schema's
+    ``geomesa.indices.enabled`` restriction (None = all applicable) —
+    the reference's per-schema index configuration."""
+    enabled = sft.enabled_indices
+    out = []
+    for name, desc in _REGISTRY.items():
+        if enabled is not None and name not in enabled:
+            continue
+        if desc.applicable(sft):
+            out.append(name)
+    return sorted(out)
+
+
+# -- built-in registrations -------------------------------------------------
+
+def _points(sft) -> bool:
+    return bool(sft.geom_field and sft.is_points)
+
+
+def _geoms(sft) -> bool:
+    return bool(sft.geom_field)
+
+
+def _register_builtins() -> None:
+    register_index(IndexDescriptor(
+        "z3",
+        applicable=lambda sft: _points(sft) and bool(sft.dtg_field),
+        build=lambda store: store._build_z3(),
+        build_sharded=lambda store, mesh: store._build_z3()))
+    register_index(IndexDescriptor(
+        "z2", applicable=_points,
+        build=lambda store: store._build_z2(),
+        build_sharded=lambda store, mesh: store._build_z2()))
+    register_index(IndexDescriptor(
+        "xz3",
+        applicable=lambda sft: _geoms(sft) and bool(sft.dtg_field),
+        build=lambda store: store._build_xz3(),
+        build_sharded=lambda store, mesh: store._build_xz3()))
+    register_index(IndexDescriptor(
+        "xz2", applicable=_geoms,
+        build=lambda store: store._build_xz2(),
+        build_sharded=lambda store, mesh: store._build_xz2()))
+    register_index(IndexDescriptor(
+        "id", applicable=lambda sft: True,
+        build=lambda store: store._build_id()))
+    register_index(IndexDescriptor(
+        "attr",
+        applicable=lambda sft: any(a.indexed for a in sft.attributes),
+        build=lambda store: None))  # built per attribute, see _SchemaStore
+
+
+_register_builtins()

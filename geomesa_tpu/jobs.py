@@ -85,3 +85,80 @@ class IngestJob:
 def run_ingest(store, type_name: str, converter_config: dict,
                paths: list[str], workers: int = 4) -> IngestResult:
     return IngestJob(store, type_name, converter_config, workers).run(paths)
+
+
+def local_paths_for_process(paths: list[str], process_index: int,
+                            process_count: int) -> list[str]:
+    """Round-robin file split across processes — the MapReduce input
+    split of DistributedConverterIngest (each mapper gets a file
+    subset)."""
+    return [p for i, p in enumerate(paths)
+            if i % max(1, process_count) == process_index]
+
+
+def run_distributed_ingest(sft, converter_config: dict, paths: list[str],
+                           period="week", mesh=None, workers: int = 4):
+    """Multi-process converter ingest → global sharded Z3 index (the
+    reference's DistributedConverterIngest + GeoMesaOutputFormat,
+    tools/ingest/DistributedConverterIngest.scala): every process runs
+    this SAME function (multi-controller SPMD), parses its round-robin
+    share of the files with a local thread pool (the mapper stage), and
+    feeds only its LOCAL rows into ``ShardedZ3Index.build_multihost`` —
+    the global index assembles via collective device placement with no
+    host ever holding the full dataset.
+
+    Returns ``(index, IngestResult)`` where the result carries THIS
+    process's counters (job counters are per-mapper in the reference
+    too).  Single-process runs degenerate to a local parse + sharded
+    build, which is what CI exercises."""
+    import jax
+    import numpy as np
+
+    from .io.converters import EvaluationContext, converter_from_config
+
+    proc = jax.process_index()
+    nproc = max(1, jax.process_count())
+    my_paths = local_paths_for_process(paths, proc, nproc)
+    conv = converter_from_config(sft, converter_config)
+    result = IngestResult()
+    batches = []
+
+    def parse(path: str):
+        ec = EvaluationContext()
+        if conv.wants_path:
+            return conv.convert(path, ec), ec
+        with open(path, "rb") as f:
+            return conv.convert(f.read(), ec), ec
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = {pool.submit(parse, p): p for p in my_paths}
+        for fut in as_completed(futures):
+            path = futures[fut]
+            result.files += 1
+            try:
+                batch, ec = fut.result()
+            except Exception as e:  # noqa: BLE001 — count, keep going
+                result.errors.append(f"{path}: {e!r}")
+                result.failed += 1
+                continue
+            result.failed += ec.failure
+            result.errors.extend(ec.errors)
+            if len(batch):
+                batches.append(batch)
+                result.ingested += len(batch)
+
+    from .parallel.scan import ShardedZ3Index
+
+    if batches:
+        local = batches[0]
+        for b in batches[1:]:
+            local = local.concat(b)
+        x, y = local.geom_xy(sft.geom_field)
+        dtg = local.column(sft.dtg_field)
+    else:  # a process may legitimately hold zero rows; it still must
+        # join the collective build with an empty block
+        x = y = np.empty(0, dtype=np.float64)
+        dtg = np.empty(0, dtype=np.int64)
+    index = ShardedZ3Index.build_multihost(x, y, dtg, period=period,
+                                           mesh=mesh)
+    return index, result

@@ -96,7 +96,8 @@ class QueryPlanner:
         from ..utils.profiling import profile
         with profile("query.plan") as plan_span:
             decider = StrategyDecider(self.sft, store.stats_map(), len(batch))
-            strategy = decider.decide(query.filter, explain)
+            strategy = decider.decide(query.filter, explain,
+                                      forced=query.hints.get("QUERY_INDEX"))
         plan_ms = plan_span.ms
         check_deadline("planning")
 

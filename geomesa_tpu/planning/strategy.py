@@ -133,12 +133,20 @@ class StrategyDecider:
         return self.total / 4
 
     # -- strategy enumeration ---------------------------------------------
+    def _enabled(self, index: str) -> bool:
+        """Schema-level index restriction (``geomesa.indices.enabled``
+        user data — the reference's per-schema index configuration,
+        RichSimpleFeatureType.getIndices): a disabled index is never
+        offered as a strategy."""
+        enabled = self.sft.enabled_indices
+        return enabled is None or index in enabled
+
     def strategies(self, f: Filter) -> list[FilterStrategy]:
         sft = self.sft
         out: list[FilterStrategy] = []
 
         ids = _collect_id_filters(f)
-        if ids:
+        if ids and self._enabled("id"):
             out.append(FilterStrategy("id", float(len(ids)), ids=ids))
 
         geom = sft.geom_field
@@ -165,20 +173,24 @@ class StrategyDecider:
 
         if temporal and dtg:
             idx = "z3" if sft.is_points else "xz3"
-            cost = self.total * sp_frac * tm_frac
-            out.append(FilterStrategy(
-                idx, max(1.0, cost),
-                geometries=tuple(geoms.values) if geoms else (),
-                intervals=usable))
+            if self._enabled(idx):
+                cost = self.total * sp_frac * tm_frac
+                out.append(FilterStrategy(
+                    idx, max(1.0, cost),
+                    geometries=tuple(geoms.values) if geoms else (),
+                    intervals=usable))
         if spatial:
             idx = "z2" if sft.is_points else "xz2"
-            cost = self.total * sp_frac
-            # de-prioritize pure-spatial when a tighter temporal plan exists
-            out.append(FilterStrategy(
-                idx, max(1.0, cost), geometries=tuple(geoms.values),
-                intervals=tuple(intervals.values) if intervals else ()))
+            if self._enabled(idx):
+                cost = self.total * sp_frac
+                # de-prioritize pure-spatial when a tighter temporal plan
+                # exists
+                out.append(FilterStrategy(
+                    idx, max(1.0, cost), geometries=tuple(geoms.values),
+                    intervals=tuple(intervals.values) if intervals else ()))
 
-        indexed = {a.name for a in sft.attributes if a.indexed}
+        indexed = ({a.name for a in sft.attributes if a.indexed}
+                   if self._enabled("attr") else set())
         for attr, kind, payload in _collect_attr_predicates(f, indexed):
             cost = self._attr_cost(attr, kind, payload)
             # secondary tiers narrow equality/IN runs (tiered-range
@@ -201,12 +213,26 @@ class StrategyDecider:
         out.append(FilterStrategy("full", float(self.total)))
         return out
 
-    def decide(self, f: Filter, explain: Explainer | None = None) -> FilterStrategy:
+    def decide(self, f: Filter, explain: Explainer | None = None,
+               forced: str | None = None) -> FilterStrategy:
+        """``forced`` pins the strategy to a named index (the reference's
+        QUERY_INDEX hint, index/planning/StrategyDecider.scala:67-79:
+        a requested index bypasses cost comparison)."""
         explain = explain or ExplainNull()
         chosen, options = self._decide(f)
         explain.push("Strategy selection:")
         for o in options:
             explain(lambda o=o: f"option {o.index}: estimated cost {o.cost:.0f}")
+        if forced is not None:
+            match = [o for o in options
+                     if o.index == forced or o.index.startswith(f"{forced}:")]
+            if not match:
+                raise ValueError(
+                    f"QUERY_INDEX hint requested {forced!r} but no such "
+                    f"strategy applies (have: "
+                    f"{sorted(o.index for o in options)})")
+            chosen = min(match, key=lambda o: o.cost)
+            explain(lambda: f"forced by QUERY_INDEX hint: {chosen.index}")
         if chosen.index == "full" and QueryProperties.BLOCK_FULL_TABLE_SCANS.to_bool():
             raise RuntimeError(
                 "full-table scan required but blocked "

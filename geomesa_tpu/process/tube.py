@@ -33,17 +33,31 @@ def _point_segment_dist_deg(px, py, ax, ay, bx, by):
 
 
 def tube_select(store, schema: str, track_xy, track_t_ms,
-                buffer_m: float, time_buffer_ms: int):
-    """Positions of features within ``buffer_m`` meters of the track line
-    and within ``time_buffer_ms`` of the track's interpolated time.
+                buffer_m: float, time_buffer_ms: int,
+                gap_fill: str = "line"):
+    """Positions of features within ``buffer_m`` meters of the track and
+    within ``time_buffer_ms`` of the track's (interpolated) time.
 
-    ``track_xy``: (T, 2) ordered track vertices; ``track_t_ms``: (T,) times.
+    ``track_xy``: (T, 2) ordered track vertices; ``track_t_ms``: (T,)
+    times.  ``gap_fill`` mirrors the reference's TubeBuilder modes
+    (process/tube/TubeBuilder.scala:128-216, GapFill enum at
+    TubeSelectProcess.scala:106):
+
+    * ``"nofill"`` — buffer each track VERTEX only; a feature matches if
+      it is within ``buffer_m`` of some vertex and ``time_buffer_ms``
+      of that vertex's own time (no interpolation across gaps).
+    * ``"line"`` (default) / ``"interpolated"`` — buffer the corridor
+      along the segments between vertices with linearly interpolated
+      times; the vectorized exact pass interpolates continuously, which
+      subsumes the reference's point-subdivided InterpolatedGapFill.
     """
     sft = store.get_schema(schema)
     geom = sft.geom_field
     dtg = sft.dtg_field
     track = np.asarray(track_xy, dtype=np.float64)
     times = np.asarray(track_t_ms, dtype=np.int64)
+    if gap_fill not in ("nofill", "line", "interpolated"):
+        raise ValueError(f"unknown gap_fill {gap_fill!r}")
     if len(track) < 2:
         raise ValueError("track needs at least 2 vertices")
 
@@ -51,6 +65,10 @@ def tube_select(store, schema: str, track_xy, track_t_ms,
     cos = np.maximum(0.01, np.cos(np.radians(track[:, 1])))
     dlon = float(np.max(dlat / cos))
     pad = max(dlat, dlon)
+
+    if gap_fill == "nofill":
+        return _tube_nofill(store, schema, geom, dtg, track, times,
+                            buffer_m, time_buffer_ms, pad)
 
     # one indexed window per segment (bbox × time slab) — all segments
     # scanned in a single batched dispatch (datastore.query_windows)
@@ -94,3 +112,37 @@ def tube_select(store, schema: str, track_xy, track_t_ms,
         t_interp = t0[seg_idx] + t_best * (t1[seg_idx] - t0[seg_idx])
         keep &= np.abs(ft - t_interp) <= time_buffer_ms
     return cand[keep]
+
+
+def _tube_nofill(store, schema, geom, dtg, track, times,
+                 buffer_m, time_buffer_ms, pad):
+    """NoGapFill: one window per track VERTEX (bbox × that vertex's own
+    time slab), exact pass against the vertices — matching the
+    reference's default mode (TubeBuilder.scala:128-177)."""
+    windows = []
+    for i in range(len(track)):
+        vx, vy = track[i]
+        box = (vx - pad, vy - pad, vx + pad, vy + pad)
+        if dtg:
+            lo = int(times[i]) - int(time_buffer_ms)
+            hi = int(times[i]) + int(time_buffer_ms)
+        else:
+            lo, hi = 0, (1 << 62)
+        windows.append(([box], lo, hi))
+    parts = [p for p in store.query_windows(schema, windows) if len(p)]
+    if not parts:
+        return np.empty(0, dtype=np.int64)
+    cand = np.unique(np.concatenate(parts))
+    batch = store._store(schema).batch
+    px, py = batch.geom_xy(geom)
+    px, py = px[cand], py[cand]
+    # (candidates × vertices) haversine distances; match against the
+    # vertex's OWN time — no interpolation across gaps
+    d = haversine_m(px[:, None], py[:, None],
+                    track[None, :, 0], track[None, :, 1])
+    near = d <= buffer_m
+    if dtg:
+        ft = batch.column(dtg)[cand].astype(np.float64)
+        near &= np.abs(ft[:, None] - times[None, :].astype(np.float64)) \
+            <= time_buffer_ms
+    return cand[near.any(axis=1)]

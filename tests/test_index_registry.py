@@ -1,0 +1,133 @@
+"""Index registry + per-schema index configuration + QUERY_INDEX hint
+(VERDICT r1 §2.2 partial: index factory/manager — the reference's
+GeoMesaFeatureIndexFactory SPI, per-schema geomesa.indices config, and
+the forced-index query hint, planning/StrategyDecider.scala:67-79)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.filters import evaluate_filter, parse_ecql
+from geomesa_tpu.index.registry import (
+    IndexDescriptor, available_indices, get_index, register_index,
+    supported_indices,
+)
+from geomesa_tpu.planning.planner import Query
+
+MS = 1514764800000
+DAY = 86_400_000
+N = 5_003
+
+
+def _store(spec):
+    rng = np.random.default_rng(19)
+    ds = TpuDataStore()
+    ds.create_schema("ev", spec)
+    ds.write("ev", {
+        "name": rng.choice(["a", "b", "c"], N),
+        "dtg": rng.integers(MS, MS + 14 * DAY, N),
+        "geom": (rng.uniform(-75.0, -73.0, N), rng.uniform(40.0, 42.0, N)),
+    })
+    return ds
+
+
+def test_builtin_registrations():
+    assert {"z3", "z2", "xz2", "xz3", "id", "attr"} <= set(
+        available_indices())
+    with pytest.raises(KeyError):
+        get_index("nope")
+
+
+def test_supported_indices_by_schema():
+    from geomesa_tpu.features.feature_type import parse_spec
+    pts = parse_spec("a", "name:String,dtg:Date,*geom:Point")
+    assert {"z3", "z2", "xz2", "xz3", "id"} <= set(supported_indices(pts))
+    nodtg = parse_spec("b", "name:String,*geom:Point")
+    sup = supported_indices(nodtg)
+    assert "z3" not in sup and "z2" in sup
+    polys = parse_spec("c", "dtg:Date,*geom:Polygon")
+    sup = supported_indices(polys)
+    assert "z2" not in sup and "xz2" in sup and "xz3" in sup
+
+
+def test_enabled_indices_restrict_planner():
+    """A schema restricted to attr+id must not use spatial indexes: the
+    bbox query degrades to a full scan, still exact."""
+    ds = _store("name:String:index=true,dtg:Date,*geom:Point;"
+                "geomesa.indices.enabled='attr,id'")
+    ecql = "BBOX(geom, -74.5, 40.5, -73.5, 41.5)"
+    r = ds.query_result("ev", ecql)
+    assert r.strategy.index == "full"
+    want = np.flatnonzero(
+        evaluate_filter(parse_ecql(ecql), ds._store("ev").batch))
+    np.testing.assert_array_equal(np.sort(r.positions), want)
+    # the attribute path still works
+    assert ds.query_result("ev", "name = 'a'").strategy.index == "attr:name"
+    # direct access to a disabled index raises
+    with pytest.raises(ValueError, match="disabled"):
+        ds._store("ev").z3_index()
+
+
+def test_enabled_indices_query_windows_falls_back():
+    ds = _store("name:String,dtg:Date,*geom:Point;"
+                "geomesa.indices.enabled='xz2,xz3,id'")
+    windows = [([(-74.5, 40.5, -73.5, 41.5)], MS, MS + 7 * DAY)]
+    hits = ds.query_windows("ev", windows)
+    st = ds._store("ev")
+    assert "z3" not in st._indexes  # fast path not taken
+    x, y = st.batch.geom_xy()
+    t = st.batch.column("dtg")
+    want = np.flatnonzero(
+        (x >= -74.5) & (x <= -73.5) & (y >= 40.5) & (y <= 41.5)
+        & (t >= MS) & (t <= MS + 7 * DAY))
+    np.testing.assert_array_equal(np.sort(hits[0]), want)
+
+
+def test_query_index_hint_forces_strategy():
+    ds = _store("name:String:index=true,dtg:Date,*geom:Point")
+    ecql = ("name = 'a' AND BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg "
+            "DURING 2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+    # unforced: the attribute index wins on cost for a selective equality
+    default_idx = ds.query_result("ev", ecql).strategy.index
+    q = Query.of(ecql, hints={"QUERY_INDEX": "z3"})
+    r = ds.query_result("ev", q)
+    assert r.strategy.index == "z3" != default_idx or \
+        default_idx == "z3"  # cost model may already pick z3
+    want = np.flatnonzero(
+        evaluate_filter(parse_ecql(ecql), ds._store("ev").batch))
+    np.testing.assert_array_equal(np.sort(r.positions), want)
+    # forcing the attribute index works via its prefix name
+    r2 = ds.query_result("ev", Query.of(ecql, hints={"QUERY_INDEX": "attr"}))
+    assert r2.strategy.index == "attr:name"
+    np.testing.assert_array_equal(np.sort(r2.positions), want)
+    # an inapplicable hint raises
+    with pytest.raises(ValueError, match="QUERY_INDEX"):
+        ds.query_result("ev", Query.of("name = 'a'",
+                                       hints={"QUERY_INDEX": "xz2"}))
+
+
+def test_custom_index_registration():
+    """Third-party index types plug in by name and build through the
+    generic accessor (the SPI role)."""
+    class GridCountIndex:
+        def __init__(self, counts):
+            self.counts = counts
+
+    def build(store):
+        x, y = store.batch.geom_xy()
+        h, _, _ = np.histogram2d(np.asarray(x), np.asarray(y), bins=8)
+        return GridCountIndex(h)
+
+    register_index(IndexDescriptor(
+        "grid-count", applicable=lambda sft: bool(sft.geom_field),
+        build=build))
+    try:
+        ds = _store("name:String,dtg:Date,*geom:Point")
+        idx = ds._store("ev").index("grid-count")
+        assert isinstance(idx, GridCountIndex)
+        assert idx.counts.sum() == N
+        # cached on repeat access
+        assert ds._store("ev").index("grid-count") is idx
+    finally:
+        from geomesa_tpu.index import registry as reg
+        reg._REGISTRY.pop("grid-count", None)

@@ -167,3 +167,34 @@ def test_bin_conversion_process(store):
     np.testing.assert_allclose(cols["lat"], by.astype(np.float32))
     assert bin_conversion_process(store, "ais",
                                   "bbox(geom, 100, 10, 101, 11)") == b""
+
+
+def test_tube_select_nofill(store):
+    """NoGapFill (the reference's default TubeBuilder mode): vertex-only
+    buffers, each with its own time slab — no interpolation across gaps."""
+    track = np.array([[-2.0, 47.0], [0.0, 50.0], [2.0, 53.0]])
+    times = np.array([MS_2018, MS_2018 + 3_600_000, MS_2018 + 7_200_000])
+    buffer_m, tbuf = 50_000.0, 1_800_000
+    got = tube_select(store, "ais", track, times, buffer_m, tbuf,
+                      gap_fill="nofill")
+    batch = store._store("ais").batch
+    bx, by = batch.geom_xy()
+    t = batch.column("dtg").astype(np.float64)
+    d = haversine_m(bx[:, None], by[:, None],
+                    track[None, :, 0], track[None, :, 1])
+    ok = (d <= buffer_m) & (
+        np.abs(t[:, None] - times[None, :].astype(float)) <= tbuf)
+    expected = np.flatnonzero(ok.any(axis=1))
+    np.testing.assert_array_equal(got, expected)
+    # nofill is a subset of the line corridor around the same vertices
+    line = tube_select(store, "ais", track, times, buffer_m, tbuf)
+    assert set(got) <= set(line)
+
+
+def test_tube_select_bad_mode(store):
+    track = np.array([[-2.0, 47.0], [0.0, 50.0]])
+    times = np.array([MS_2018, MS_2018 + 3_600_000])
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="gap_fill"):
+        tube_select(store, "ais", track, times, 1000.0, 1000,
+                    gap_fill="bogus")

@@ -145,3 +145,52 @@ def test_ingest_job(tmp_path, store):
     assert result.ingested == 120 and result.files == 7
     assert result.failed >= 1
     assert store.get_count("pts") == before + 120
+
+
+def test_distributed_ingest_single_process(tmp_path):
+    """run_distributed_ingest: parse → build_multihost end-to-end (the
+    DistributedConverterIngest analog; single-process degenerate case)."""
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.jobs import run_distributed_ingest
+
+    sft = parse_spec("pts", "name:String,dtg:Date,*geom:Point")
+    config = {
+        "type": "csv",
+        "fields": [
+            {"name": "name", "transform": "toString($0)"},
+            {"name": "dtg", "transform": "toLong($1)"},
+            {"name": "geom", "transform": "point($2, $3)"},
+        ],
+    }
+    rng = np.random.default_rng(3)
+    paths = []
+    all_rows = []
+    for f in range(3):
+        rows = [(f"u{f}_{i}", 1514764800000 + i * 60_000,
+                 float(rng.uniform(-74.5, -73.5)),
+                 float(rng.uniform(40.2, 41.8))) for i in range(50)]
+        all_rows.extend(rows)
+        p = tmp_path / f"f{f}.csv"
+        p.write_text("\n".join(
+            f"{n},{t},{x},{y}" for n, t, x, y in rows) + "\n")
+        paths.append(str(p))
+    idx, result = run_distributed_ingest(sft, config, paths)
+    assert result.files == 3 and result.failed == 0
+    assert idx.total() == result.ingested == len(all_rows)
+    box = (-74.2, 40.5, -73.8, 41.5)
+    hits = idx.query([box], None, None)
+    xs = np.array([r[2] for r in all_rows])
+    ys = np.array([r[3] for r in all_rows])
+    # file parse order is nondeterministic (as_completed), so compare
+    # hit COUNTS against the oracle mask over all rows
+    want = np.count_nonzero((xs >= box[0]) & (xs <= box[2])
+                            & (ys >= box[1]) & (ys <= box[3]))
+    assert len(hits) == want
+
+
+def test_distributed_ingest_path_split():
+    from geomesa_tpu.jobs import local_paths_for_process
+    paths = [f"p{i}" for i in range(7)]
+    shares = [local_paths_for_process(paths, i, 3) for i in range(3)]
+    assert sorted(sum(shares, [])) == sorted(paths)
+    assert max(len(s) for s in shares) - min(len(s) for s in shares) <= 1
