@@ -50,6 +50,32 @@ def cmd_remove_schema(args):
     print(f"removed {args.feature_name}")
 
 
+def cmd_migrate_schema(args):
+    """Upgrade a schema's index layouts to the current versions (the
+    reference's index-format migration commands)."""
+    ds = _store(args)
+    old = ds.migrate_schema(args.feature_name)
+    from ..datastore import CURRENT_INDEX_VERSIONS
+    changed = {k: (v, CURRENT_INDEX_VERSIONS[k])
+               for k, v in old.items() if v != CURRENT_INDEX_VERSIONS[k]}
+    if not changed:
+        print(f"{args.feature_name}: already at current index versions")
+    else:
+        for k, (a, b) in sorted(changed.items()):
+            print(f"{args.feature_name}: {k} v{a} -> v{b}")
+
+
+def cmd_index_versions(args):
+    """Show a schema's recorded index-layout versions."""
+    ds = _store(args)
+    store = ds._store(args.feature_name)
+    from ..index.registry import supported_indices
+    supported = set(supported_indices(store.sft))
+    for name, v in sorted(store.index_versions.items()):
+        mark = "" if name in supported else "  (not applicable)"
+        print(f"{name}: v{v}{mark}")
+
+
 def cmd_ingest(args):
     ds = _store(args)
     sft = ds.get_schema(args.feature_name)
@@ -252,6 +278,14 @@ def build_parser() -> argparse.ArgumentParser:
     catalog(sp)
 
     sp = add("remove-schema", cmd_remove_schema, help="remove a schema")
+    catalog(sp)
+
+    sp = add("migrate-schema", cmd_migrate_schema,
+             help="upgrade index layouts to current versions")
+    catalog(sp)
+
+    sp = add("index-versions", cmd_index_versions,
+             help="show a schema's index-layout versions")
     catalog(sp)
 
     sp = add("ingest", cmd_ingest, help="ingest files")

@@ -122,6 +122,83 @@ class RasterStore:
         return [RasterTile(level.tiles[i], level.bboxes[i])
                 for i in np.flatnonzero(hit)]
 
+    def bounds(self, resolution: float | None = None) -> tuple | None:
+        """Union envelope of stored tiles (AccumuloRasterStore.getBounds):
+        over one level when given, else over all levels."""
+        if resolution is not None:
+            lvl = self._levels.get(round(resolution, 12))
+            levels = [lvl] if lvl is not None else []
+        else:
+            levels = list(self._levels.values())
+        boxes = [b for lvl in levels for b in lvl.bboxes]
+        if not boxes:
+            return None
+        arr = np.asarray(boxes)
+        return (float(arr[:, 0].min()), float(arr[:, 1].min()),
+                float(arr[:, 2].max()), float(arr[:, 3].max()))
+
+    def grid_range(self, resolution: float | None = None):
+        """(cols, rows) covered by the level's extent at its resolution
+        (the reference's getGridRange)."""
+        res = self._pick_resolution(resolution)
+        if res is None:
+            return None
+        bb = self.bounds(res)
+        return (int(round((bb[2] - bb[0]) / res)),
+                int(round((bb[3] - bb[1]) / res)))
+
+    # -- pyramid ----------------------------------------------------------
+    def build_pyramid(self, levels: int = 3) -> list[float]:
+        """Derive coarser resolution levels from the finest by 2×2 mean
+        pooling each tile (the ingest-time pyramid the reference stores
+        per lexicoded resolution; raster/ingest RasterMetadata) — one
+        vectorized pooling op per level over the stacked tiles.  Returns
+        the resolutions now available."""
+        if not self._levels:
+            return []
+        res = self.available_resolutions[0]
+        for _ in range(levels):
+            src = self._levels[round(res, 12)]
+            th, tw = src.tile_shape
+            if th % 2 or tw % 2 or th < 2 or tw < 2:
+                break
+            stacked = np.stack(src.tiles)
+            pooled = stacked.reshape(
+                len(src.tiles), th // 2, 2, tw // 2, 2).mean(axis=(2, 4))
+            res = res * 2
+            key = round(res, 12)
+            if key in self._levels:
+                continue
+            lvl = self._levels[key] = _Level((th // 2, tw // 2))
+            for i, bb in enumerate(src.bboxes):
+                lvl.tiles.append(pooled[i].astype(np.float32))
+                lvl.bboxes.append(bb)
+        return self.available_resolutions
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Persist every level as one npz (stacked tiles + bboxes) — the
+        durable-store role of the reference's raster tables."""
+        payload: dict = {"name": np.asarray(self.name)}
+        for i, (res, lvl) in enumerate(sorted(self._levels.items())):
+            payload[f"res_{i}"] = np.asarray(res)
+            payload[f"tiles_{i}"] = np.stack(lvl.tiles)
+            payload[f"bboxes_{i}"] = np.asarray(lvl.bboxes)
+        np.savez_compressed(path, **payload)
+
+    @classmethod
+    def load(cls, path: str) -> "RasterStore":
+        with np.load(path) as z:
+            store = cls(str(z["name"]))
+            i = 0
+            while f"res_{i}" in z:
+                tiles = z[f"tiles_{i}"]
+                bboxes = z[f"bboxes_{i}"]
+                for t, bb in zip(tiles, bboxes):
+                    store.put(t, tuple(bb))
+                i += 1
+        return store
+
     def mosaic(self, bbox: tuple, width: int, height: int,
                resolution: float | None = None, nodata: float = np.nan):
         """Resample every intersecting tile into one ``(height, width)``

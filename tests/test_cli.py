@@ -139,3 +139,22 @@ def test_cli_fs_partitions(tmp_path, capsys):
     main(["fs-partitions", "-r", root, "-f", "evt", "--compact"])
     out = capsys.readouterr().out
     assert "compacted evt" in out and "1 file(s)" in out
+
+
+def test_cli_migrate_and_index_versions(tmp_path, capsys):
+    cat = str(tmp_path / "cat")
+    main(["create-schema", "-c", cat, "-f", "legacy",
+          "-s", "name:String,dtg:Date,*geom:Point;"
+                "geomesa.index.versions='z3:1,z2:1'"])
+    main(["index-versions", "-c", cat, "-f", "legacy"])
+    out = capsys.readouterr().out
+    assert "z3: v1" in out and "z2: v1" in out
+    main(["migrate-schema", "-c", cat, "-f", "legacy"])
+    out = capsys.readouterr().out
+    assert "z3 v1 -> v2" in out
+    main(["index-versions", "-c", cat, "-f", "legacy"])
+    out = capsys.readouterr().out
+    assert "z3: v2" in out
+    # idempotent
+    main(["migrate-schema", "-c", cat, "-f", "legacy"])
+    assert "already at current" in capsys.readouterr().out

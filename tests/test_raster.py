@@ -105,3 +105,52 @@ def test_count_accepts_tile_resolution():
     t = RasterTile(np.zeros((16, 16), dtype=np.float32), (0, 0, 1.0 / 3, 1))
     rs.put(t.data, t.bbox)
     assert rs.count(t.resolution) == 1
+
+
+def test_raster_bounds_and_grid_range():
+    from geomesa_tpu.raster import RasterStore
+    rs = RasterStore()
+    rs.put(np.ones((16, 16)), (0.0, 0.0, 1.0, 1.0))
+    rs.put(np.ones((16, 16)), (1.0, 0.0, 2.0, 1.0))
+    assert rs.bounds() == (0.0, 0.0, 2.0, 1.0)
+    cols, rows = rs.grid_range()
+    assert (cols, rows) == (32, 16)
+
+
+def test_raster_pyramid_and_mosaic_consistency():
+    from geomesa_tpu.raster import RasterStore
+    rng = np.random.default_rng(5)
+    rs = RasterStore()
+    for i in range(2):
+        rs.put(rng.uniform(0, 10, (32, 32)).astype(np.float32),
+               (i * 1.0, 0.0, (i + 1) * 1.0, 1.0))
+    resolutions = rs.build_pyramid(levels=2)
+    assert len(resolutions) == 3
+    assert resolutions[1] == resolutions[0] * 2
+    # coarser level serves a coarse request; tile count preserved
+    assert rs.count(resolutions[1]) == 2
+    coarse = rs.mosaic((0.0, 0.0, 2.0, 1.0), 16, 8,
+                       resolution=resolutions[2])
+    fine = rs.mosaic((0.0, 0.0, 2.0, 1.0), 16, 8)
+    # pooled pyramid approximates the fine mosaic at coarse output sizes
+    assert np.nanmean(np.abs(coarse - fine)) < 3.0
+    assert not np.isnan(coarse).any()
+
+
+def test_raster_save_load_roundtrip(tmp_path):
+    from geomesa_tpu.raster import RasterStore
+    rng = np.random.default_rng(7)
+    rs = RasterStore("elev")
+    for i in range(3):
+        rs.put(rng.uniform(0, 100, (8, 8)).astype(np.float32),
+               (i * 1.0, 0.0, (i + 1) * 1.0, 1.0))
+    rs.build_pyramid(levels=1)
+    path = str(tmp_path / "raster.npz")
+    rs.save(path)
+    rs2 = RasterStore.load(path)
+    assert rs2.name == "elev"
+    assert rs2.available_resolutions == rs.available_resolutions
+    assert rs2.count() == rs.count()
+    a = rs.mosaic((0.0, 0.0, 3.0, 1.0), 24, 8)
+    b = rs2.mosaic((0.0, 0.0, 3.0, 1.0), 24, 8)
+    np.testing.assert_allclose(a, b)
