@@ -187,3 +187,32 @@ def test_mesh_nonpoint_schema_xz_paths():
                                       np.sort(b.positions))
     assert mesh.query_result("areas", queries[0]).strategy.index == "xz2"
     assert mesh.query_result("areas", queries[1]).strategy.index == "xz3"
+
+
+def test_mesh_store_visibility_masks():
+    """Row-level visibility applies to collective scan results (gids are
+    row positions, so auth masks align)."""
+    from geomesa_tpu.security import StaticAuthorizationsProvider
+    rng = np.random.default_rng(61)
+    n = 4_001
+    data_open = {
+        "name": np.array(["a", "b"], dtype=object)[rng.integers(0, 2, n)],
+        "score": rng.uniform(0, 10, n),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * DAY, n),
+        "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n)),
+    }
+    ds = TpuDataStore(mesh=device_mesh(),
+                      auth_provider=StaticAuthorizationsProvider(["user"]))
+    ds.create_schema("ev", SPEC)
+    ds.write("ev", data_open, visibility="user")
+    secret = {k: (v[0][:100], v[1][:100]) if isinstance(v, tuple)
+              else v[:100] for k, v in data_open.items()}
+    ds.write("ev", secret, visibility="admin")
+    ecql = "BBOX(geom, -74.8, 40.2, -73.2, 41.8)"
+    r = ds.query_result("ev", ecql)
+    # no admin-visible row may appear (they are rows n..n+100)
+    assert (r.positions < n).all()
+    st = ds._store("ev")
+    want = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch)[:n])
+    np.testing.assert_array_equal(np.sort(r.positions), want)
+    assert ds.get_count("ev") == n  # restricted count hides secret rows
