@@ -28,6 +28,12 @@ def _data(rng):
     }
 
 
+
+def _slice(data, sl):
+    """Slice every column, handling the (x, y) geometry tuple."""
+    return {k: (v[0][sl], v[1][sl]) if isinstance(v, tuple) else v[sl]
+            for k, v in data.items()}
+
 @pytest.fixture(scope="module")
 def stores():
     data = _data(np.random.default_rng(77))
@@ -100,10 +106,8 @@ def test_mesh_incremental_write_appends(stores):
     mesh = TpuDataStore(mesh=device_mesh())
     mesh.create_schema("events", SPEC)
     half = N // 2
-    first = {k: (v[0][:half], v[1][:half]) if isinstance(v, tuple)
-             else v[:half] for k, v in data.items()}
-    second = {k: (v[0][half:], v[1][half:]) if isinstance(v, tuple)
-              else v[half:] for k, v in data.items()}
+    first = _slice(data, slice(None, half))
+    second = _slice(data, slice(half, None))
     mesh.write("events", first)
     # force the z3 index to exist so the next write appends incrementally
     ecql = ("BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg DURING "
@@ -205,8 +209,7 @@ def test_mesh_store_visibility_masks():
                       auth_provider=StaticAuthorizationsProvider(["user"]))
     ds.create_schema("ev", SPEC)
     ds.write("ev", data_open, visibility="user")
-    secret = {k: (v[0][:100], v[1][:100]) if isinstance(v, tuple)
-              else v[:100] for k, v in data_open.items()}
+    secret = _slice(data_open, slice(None, 100))
     ds.write("ev", secret, visibility="admin")
     ecql = "BBOX(geom, -74.8, 40.2, -73.2, 41.8)"
     r = ds.query_result("ev", ecql)
