@@ -124,6 +124,58 @@ def _z3_scan_program(mesh: Mesh, capacity: int):
 
 
 @lru_cache(maxsize=64)
+def _z3_scan_compact_program(mesh: Mesh, capacity: int):
+    """Two-phase variant of :func:`_z3_scan_program`: each shard sorts
+    its packed vector descending (hits float to the front) and also
+    reports its hit count, so the host can fetch a hits-sized head
+    instead of the full (n_shards × capacity) buffer — the mesh analog
+    of index/z3._scan_keep_device (the device→host link costs
+    ~125ms/MB; capacity-sized buffers dominate selective queries)."""
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 6 + (P(None),) * 7 + (P(), P()),
+        out_specs=(P("shard"), P("shard")),
+    )
+    def scan(lb, lz, lg, xs, ys, ts,
+             rb, rlo, rhi, rtl, rth, ixy, bxs, t_lo, t_hi):
+        starts = searchsorted2(lb, lz, rb, rlo, side="left")
+        ends = searchsorted2(lb, lz, rb, rhi, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        total = jnp.sum(counts)
+        idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
+        zc = lz[idx]
+        gc = lg[idx]
+        mask = valid_slot & (gc >= 0) & candidate_mask(
+            zc, rtl[rid], rth[rid], ixy, bxs,
+            xs[idx], ys[idx], ts[idx], t_lo, t_hi)
+        packed = jnp.where(mask, gc, gc.dtype.type(-1))
+        packed = -jnp.sort(-packed)  # hits first, -1 padding last
+        totals = jnp.stack([total, jnp.sum(mask)]).astype(jnp.int64)
+        return packed, totals
+
+    return jax.jit(scan)
+
+
+@lru_cache(maxsize=32)
+def _z3_head_program(mesh: Mesh, capacity: int, k: int):
+    """Per-shard head slice: fetch only the first k (hit-bearing) slots
+    of each shard's compacted vector."""
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("shard"),),
+             out_specs=P("shard"))
+    def head(p):
+        return p[:k]
+
+    return jax.jit(head)
+
+
+#: capacity at which the two-phase collective read beats shipping the
+#: full per-shard buffers (see index/z3.TWO_PHASE_MIN_CAPACITY)
+SHARDED_TWO_PHASE_MIN_CAPACITY = 1 << 17
+
+
+@lru_cache(maxsize=64)
 def _z3_many_program(mesh: Mesh, capacity: int, pos_bits: int):
     """Batched multi-window collective scan: Q independent bbox+time
     queries in one dispatch, results coded ``qid << pos_bits | gid``
@@ -469,14 +521,35 @@ class ShardedZ3Index:
                         "rthi": plan.rthi}, pad_pow2(plan.num_ranges))
         ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
                              pad_pow2(len(plan.boxes), minimum=1))
+        args_tail = (
+            jnp.asarray(r["rbin"]), jnp.asarray(r["rzlo"]),
+            jnp.asarray(r["rzhi"]), jnp.asarray(r["rtlo"]),
+            jnp.asarray(r["rthi"]), jnp.asarray(ixy), jnp.asarray(bxs),
+            jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
+        cols = (self.bins, self.z, self.gid, self.x, self.y, self.dtg)
         while True:
+            if capacity >= SHARDED_TWO_PHASE_MIN_CAPACITY:
+                # two-phase: tiny totals first, then a hits-sized head
+                # per shard instead of the full capacity buffer
+                scan = _z3_scan_compact_program(self.mesh, capacity)
+                packed, totals = scan(*cols, *args_tail)
+                tot = _fetch_global(totals).reshape(-1, 2)
+                if int(tot[:, 0].max(initial=0)) > capacity:
+                    capacity = gather_capacity(int(tot[:, 0].max()))
+                    continue
+                # decay toward the observed candidate volume (one huge
+                # query must not tax every later small one)
+                self._capacity = max(self.DEFAULT_CAPACITY,
+                                     gather_capacity(int(tot[:, 0].max())))
+                k = gather_capacity(max(int(tot[:, 1].max(initial=0)), 1),
+                                    minimum=8)
+                if k < capacity:
+                    packed = _z3_head_program(self.mesh, capacity,
+                                              k)(packed)
+                flat = _fetch_global(packed).ravel()
+                return np.sort(flat[flat >= 0]).astype(np.int64)
             scan = _z3_scan_program(self.mesh, capacity)
-            packed, totals = scan(
-                self.bins, self.z, self.gid, self.x, self.y, self.dtg,
-                jnp.asarray(r["rbin"]), jnp.asarray(r["rzlo"]),
-                jnp.asarray(r["rzhi"]), jnp.asarray(r["rtlo"]),
-                jnp.asarray(r["rthi"]), jnp.asarray(ixy), jnp.asarray(bxs),
-                jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
+            packed, totals = scan(*cols, *args_tail)
             totals = _fetch_global(totals)
             if int(totals.max(initial=0)) <= capacity:
                 self._capacity = capacity

@@ -219,3 +219,34 @@ def test_mesh_store_visibility_masks():
     want = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch)[:n])
     np.testing.assert_array_equal(np.sort(r.positions), want)
     assert ds.get_count("ev") == n  # restricted count hides secret rows
+
+
+def test_mesh_store_knn_and_tube_processes():
+    """Config-5 analytics (kNN expanding rings, tube-select) run through
+    the mesh store's collective batched windows, oracle-equal to the
+    single-chip store."""
+    from geomesa_tpu.process import knn_process, tube_select
+    rng = np.random.default_rng(67)
+    n = 20_003
+    data = {
+        "name": rng.choice(["a", "b"], n),
+        "score": rng.uniform(0, 1, n),
+        "dtg": rng.integers(MS_2018, MS_2018 + 5 * DAY, n),
+        "geom": (rng.uniform(-75.0, -73.0, n), rng.uniform(40.0, 42.0, n)),
+    }
+    plain = TpuDataStore()
+    mesh = TpuDataStore(mesh=device_mesh())
+    for ds in (plain, mesh):
+        ds.create_schema("ais", SPEC.replace("N", str(n)))
+        ds.write("ais", data)
+    pa, da = knn_process(plain, "ais", -74.0, 41.0, 15)
+    pb, db = knn_process(mesh, "ais", -74.0, 41.0, 15)
+    np.testing.assert_array_equal(pa, pb)
+    np.testing.assert_allclose(da, db)
+    tk = np.linspace(0, 1, 9)
+    track = np.column_stack([-75.0 + 2.0 * tk, 40.2 + 1.6 * tk])
+    track_t = (MS_2018 + tk * 4 * DAY).astype(np.int64)
+    ta = tube_select(plain, "ais", track, track_t, 20_000.0, 6 * 3_600_000)
+    tb = tube_select(mesh, "ais", track, track_t, 20_000.0, 6 * 3_600_000)
+    np.testing.assert_array_equal(ta, tb)
+    assert len(ta) > 0

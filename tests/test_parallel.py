@@ -324,3 +324,23 @@ def test_multihost_gid_coding_per_process(monkeypatch):
     brute = np.flatnonzero(
         (x >= -74.5) & (x <= -73.5) & (y >= 40.5) & (y <= 41.5))
     np.testing.assert_array_equal(np.sort(rows), brute)
+
+
+def test_sharded_two_phase_read(data):
+    """Large-capacity collective queries take the two-phase compacted
+    read (hits-sized head transfer) and stay exact; capacity decays."""
+    from geomesa_tpu.parallel import scan as scan_mod
+    x, y, t = data
+    idx = ShardedZ3Index.build(x, y, t, period="week", mesh=device_mesh())
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018 + 86_400_000, MS_2018 + 6 * 86_400_000
+    brute = np.flatnonzero(
+        (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        & (t >= tlo) & (t <= thi))
+    big = scan_mod.SHARDED_TWO_PHASE_MIN_CAPACITY
+    hits = idx.query([box], tlo, thi, capacity=big)
+    np.testing.assert_array_equal(np.sort(hits), brute)
+    # the sticky capacity decayed toward the observed candidate volume
+    assert idx._capacity < big
+    # and the follow-up (single-phase) query still agrees
+    np.testing.assert_array_equal(np.sort(idx.query([box], tlo, thi)), brute)
