@@ -81,11 +81,17 @@ def age_off(store, type_name: str, older_than_ms: int | None = None,
             retention=None, dry_run: bool = False) -> int:
     """Physically delete rows whose dtg is before the cutoff (the
     compaction-time AgeOffIterator role).  Returns the affected count."""
+    sft = store.get_schema(type_name)
     if older_than_ms is None:
         if retention is None:
-            raise ValueError("need older_than_ms or retention")
+            # fall back to the schema's configured retention — the
+            # reference drives compaction-time age-off from the same
+            # table config as the scan-time filter (geomesa.age.off)
+            retention = sft.user_data.get(AGE_OFF_KEY)
+        if retention is None:
+            raise ValueError("need older_than_ms or retention (schema has "
+                             f"no {AGE_OFF_KEY})")
         older_than_ms = int(time.time() * 1000) - parse_duration_ms(retention)
-    sft = store.get_schema(type_name)
     if not sft.dtg_field:
         raise ValueError(f"schema {type_name!r} has no dtg field")
     schema_store = store._store(type_name)
