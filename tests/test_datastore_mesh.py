@@ -282,3 +282,33 @@ def test_mesh_store_age_off_and_delete():
     # the rebuilt sharded z3 index serves exact scans
     st = ds._store("ev")
     assert st.z3_index().total() == fresh
+
+
+def test_mesh_store_sql_frame_and_rdd():
+    """The SQL frame and RDD layers ride the mesh store unchanged."""
+    from geomesa_tpu.parallel.rdd import spatial_rdd
+    from geomesa_tpu.sql.frame import SpatialFrame
+    rng = np.random.default_rng(73)
+    n = 6_007
+    data = {
+        "name": rng.choice(["a", "b", "c"], n),
+        "score": rng.uniform(0, 1, n),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * DAY, n),
+        "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n)),
+    }
+    plain = TpuDataStore()
+    mesh = TpuDataStore(mesh=device_mesh())
+    for ds in (plain, mesh):
+        ds.create_schema("ev", SPEC)
+        ds.write("ev", data)
+    q = "BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND name = 'a'"
+    fa = SpatialFrame(plain, "ev").where(q).collect()
+    fb = SpatialFrame(mesh, "ev").where(q).collect()
+    assert len(fa) == len(fb)
+    np.testing.assert_array_equal(np.sort(fa.column("score")),
+                                  np.sort(fb.column("score")))
+    rdd = spatial_rdd({"store": mesh}, "ev",
+                      "BBOX(geom, -74.5, 40.5, -73.5, 41.5)",
+                      num_partitions=4)
+    assert sum(len(p) for p in rdd.partitions) == len(
+        plain.query("ev", "BBOX(geom, -74.5, 40.5, -73.5, 41.5)"))
