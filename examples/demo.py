@@ -97,6 +97,33 @@ def main():
     print(f"live cache: {len(live.query('ships', 'INCLUDE')):,} current "
           "vessels")
 
+    # 7. multi-chip: the SAME facade over a device mesh — every index
+    # builds sharded, scans run as collectives (psum/ppermute over ICI)
+    import jax
+    from geomesa_tpu.parallel import device_mesh
+    if (len(jax.devices()) == 1
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+        # the container pins a single-chip TPU plugin that ignores
+        # JAX_PLATFORMS; honor the caller's cpu request (see
+        # __graft_entry__.dryrun_multichip)
+        from jax.extend import backend as _backend
+        _backend.clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) > 1:
+        dsm = TpuDataStore(mesh=device_mesh())
+        dsm.create_schema(
+            "gdelt", "actor:String:index=true,score:Double,dtg:Date,"
+                     "*geom:Point;geomesa.z3.interval=week")
+        dsm.write("gdelt", conv.convert(csv))
+        hits_mesh = dsm.query("gdelt", q)
+        print(f"mesh store ({len(jax.devices())} devices): "
+              f"{len(hits_mesh):,} hits (single-chip store found "
+              f"{len(ds.query('gdelt', q)):,})")
+    else:
+        print("mesh store: single device visible — run under "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              "JAX_PLATFORMS=cpu to demo the collectives")
+
 
 if __name__ == "__main__":
     main()
