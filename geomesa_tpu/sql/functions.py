@@ -337,3 +337,369 @@ def st_bufferPoint(col, distance_m: float, segments: int = 32) -> np.ndarray:
                          yi + dlat * np.sin(ang)], axis=1)
         out.append(Polygon(ring))
     return np.array(out, dtype=object)
+
+
+# -- round-2 additions: the remaining UDFs of the reference's set -----------
+# (SpatialRelationFunctions / GeometricAccessorFunctions /
+#  GeometricProcessingFunctions / GeometricOutputFunctions — see module doc)
+
+def st_boundary(col) -> np.ndarray:
+    """OGC boundary: polygon → exterior ring LineString (holes →
+    MultiLineString), line → MultiPoint endpoints, point → empty
+    MultiPoint (ST_Boundary)."""
+    from ..geometry.types import MultiLineString
+
+    def boundary(g):
+        if isinstance(g, Polygon):
+            rings = [np.vstack([g.shell, g.shell[:1]])
+                     if not np.array_equal(g.shell[0], g.shell[-1])
+                     else g.shell]
+            rings += [np.vstack([h, h[:1]])
+                      if not np.array_equal(h[0], h[-1]) else h
+                      for h in g.holes]
+            if len(rings) == 1:
+                return LineString(rings[0])
+            return MultiLineString(tuple(LineString(r) for r in rings))
+        if isinstance(g, LineString):
+            return MultiPoint(np.vstack([g.coords[0], g.coords[-1]]))
+        return MultiPoint(np.empty((0, 2)))
+    return np.array([boundary(g) for g in _geoms(col)], dtype=object)
+
+
+def st_dimension(col) -> np.ndarray:
+    """Topological dimension (ST_Dimension): point 0, line 1, area 2."""
+    def dim(g):
+        if isinstance(g, (Point, MultiPoint)):
+            return 0
+        if isinstance(g, LineString) or hasattr(g, "lines"):
+            return 1
+        return 2
+    return np.array([dim(g) for g in _geoms(col)], dtype=np.int32)
+
+
+def st_coordDim(col) -> np.ndarray:
+    """Coordinate dimension — always 2 here (ST_CoordDim)."""
+    return np.full(len(_geoms(col)), 2, dtype=np.int32)
+
+
+def st_isEmpty(col) -> np.ndarray:
+    def empty(g):
+        if isinstance(g, Point):
+            return False
+        if isinstance(g, MultiPoint):
+            return len(g.coords) == 0
+        if isinstance(g, LineString):
+            return len(g.coords) == 0
+        if isinstance(g, Polygon):
+            return len(g.shell) == 0
+        if hasattr(g, "geoms"):
+            return len(g.geoms) == 0
+        if hasattr(g, "lines"):
+            return len(g.lines) == 0
+        if hasattr(g, "polygons"):
+            return len(g.polygons) == 0
+        return False
+    return np.array([empty(g) for g in _geoms(col)], dtype=bool)
+
+
+def st_isClosed(col) -> np.ndarray:
+    """Line start == end (ST_IsClosed; non-lines are vacuously closed)."""
+    def closed(g):
+        if isinstance(g, LineString):
+            return bool(len(g.coords) > 1
+                        and np.array_equal(g.coords[0], g.coords[-1]))
+        if hasattr(g, "lines"):
+            return all(closed(l) for l in g.lines)
+        return True
+    return np.array([closed(g) for g in _geoms(col)], dtype=bool)
+
+
+def st_isCollection(col) -> np.ndarray:
+    from ..geometry.types import MultiLineString
+    return np.array([isinstance(g, (MultiPoint, MultiLineString,
+                                    MultiPolygon))
+                     for g in _geoms(col)], dtype=bool)
+
+
+def st_isSimple(col) -> np.ndarray:
+    """No self-intersection (ST_IsSimple) — proper segment-crossing test
+    for lines; points/valid polygons are simple."""
+    from ..geometry.predicates import segments_cross_properly
+
+    def simple(g):
+        if isinstance(g, LineString) and len(g.coords) > 2:
+            p1, p2 = g.coords[:-1], g.coords[1:]
+            n = len(p1)
+            for i in range(n):
+                # non-adjacent segment pairs only
+                js = np.arange(i + 2, n)
+                if i == 0 and len(js) and np.array_equal(
+                        g.coords[0], g.coords[-1]):
+                    js = js[:-1]  # closing segment is adjacent to first
+                if len(js):
+                    hit = segments_cross_properly(
+                        np.repeat(p1[i:i + 1], len(js), 0),
+                        np.repeat(p2[i:i + 1], len(js), 0),
+                        p1[js], p2[js])
+                    if hit.any():
+                        return False
+        return True
+    return np.array([simple(g) for g in _geoms(col)], dtype=bool)
+
+
+def st_isRing(col) -> np.ndarray:
+    """Closed AND simple (ST_IsRing)."""
+    return st_isClosed(col) & st_isSimple(col)
+
+
+def st_numGeometries(col) -> np.ndarray:
+    def num(g):
+        for attr in ("geoms", "lines", "polygons"):
+            if hasattr(g, attr):
+                return len(getattr(g, attr))
+        if isinstance(g, MultiPoint):
+            return len(g.coords)
+        return 1
+    return np.array([num(g) for g in _geoms(col)], dtype=np.int32)
+
+
+def st_geometryN(col, n: int) -> np.ndarray:
+    """1-based n-th member geometry, None when out of range
+    (ST_GeometryN null semantics)."""
+    def nth(g):
+        if isinstance(g, MultiPoint):
+            return (Point(*g.coords[n - 1])
+                    if 1 <= n <= len(g.coords) else None)
+        for attr in ("geoms", "lines", "polygons"):
+            if hasattr(g, attr):
+                members = getattr(g, attr)
+                return members[n - 1] if 1 <= n <= len(members) else None
+        return g if n == 1 else None
+    return np.array([nth(g) for g in _geoms(col)], dtype=object)
+
+
+def st_interiorRingN(col, n: int) -> np.ndarray:
+    """1-based n-th interior ring of a polygon (ST_InteriorRingN)."""
+    def ring(g):
+        if isinstance(g, Polygon) and len(g.holes) >= n:
+            return LineString(g.holes[n - 1])
+        return None
+    return np.array([ring(g) for g in _geoms(col)], dtype=object)
+
+
+def st_closestPoint(col, target: Geometry) -> np.ndarray:
+    """Closest point ON each column geometry to ``target``'s
+    representative point (ST_ClosestPoint, planar)."""
+    tx, ty = (target.x, target.y) if isinstance(target, Point) else (
+        st_centroid([target])[0].x, st_centroid([target])[0].y)
+
+    def closest(g):
+        from ..geometry.predicates import all_vertices
+        if isinstance(g, Point):
+            return Point(g.x, g.y)
+        segs = []
+        if isinstance(g, LineString):
+            segs = [(g.coords[:-1], g.coords[1:])]
+        elif isinstance(g, Polygon):
+            sh = np.vstack([g.shell, g.shell[:1]])
+            segs = [(sh[:-1], sh[1:])]
+        if segs:
+            best, bd = None, np.inf
+            for p1, p2 in segs:
+                d = p2 - p1
+                denom = np.maximum((d ** 2).sum(axis=1), 1e-18)
+                t = np.clip(((tx - p1[:, 0]) * d[:, 0]
+                             + (ty - p1[:, 1]) * d[:, 1]) / denom, 0, 1)
+                cx = p1[:, 0] + t * d[:, 0]
+                cy = p1[:, 1] + t * d[:, 1]
+                dist = np.hypot(cx - tx, cy - ty)
+                i = int(np.argmin(dist))
+                if dist[i] < bd:
+                    bd, best = dist[i], Point(float(cx[i]), float(cy[i]))
+            return best
+        v = all_vertices(g)
+        d = np.hypot(v[:, 0] - tx, v[:, 1] - ty)
+        i = int(np.argmin(d))
+        return Point(float(v[i, 0]), float(v[i, 1]))
+    return np.array([closest(g) for g in _geoms(col)], dtype=object)
+
+
+def st_covers(geom: Geometry, col) -> np.ndarray:
+    """geom covers the column geometries — containment including the
+    boundary (ST_Covers; for point columns equals boundary-inclusive
+    contains)."""
+    x, y = _points_xy(col)
+    if isinstance(geom, (Polygon, MultiPolygon)):
+        return point_in_polygon(x, y, geom, include_boundary=True)
+    env = geom.envelope
+    return (x >= env.xmin) & (x <= env.xmax) & (y >= env.ymin) & (y <= env.ymax)
+
+
+def st_touches(geom: Geometry, col) -> np.ndarray:
+    """Boundaries meet but interiors do not (ST_Touches) — for point
+    columns: the point lies ON geom's boundary."""
+    from ..geometry.predicates import points_on_rings, _rings_of
+    x, y = _points_xy(col)
+    if isinstance(geom, (Polygon, MultiPolygon)):
+        return points_on_rings(x, y, _rings_of(geom), eps=1e-12)
+    if isinstance(geom, LineString):
+        a, b = geom.coords[0], geom.coords[-1]
+        return (((x == a[0]) & (y == a[1]))
+                | ((x == b[0]) & (y == b[1])))
+    return np.zeros(len(x), dtype=bool)
+
+
+def st_overlaps(col_a, col_b) -> np.ndarray:
+    """Same-dimension geometries whose interiors intersect but neither
+    contains the other (ST_Overlaps).  Point columns can never overlap
+    (equal points are ST_Equals, not overlaps)."""
+    ga, gb = _geoms(col_a), _geoms(col_b)
+    if len(ga) and isinstance(ga[0], tuple):
+        return np.zeros(len(ga), dtype=bool)
+    from ..geometry.predicates import geometry_intersects, geometry_within
+    out = np.zeros(len(ga), dtype=bool)
+    for i, (a, b) in enumerate(zip(ga, gb)):
+        if isinstance(a, Point) or isinstance(b, Point):
+            continue
+        out[i] = (geometry_intersects(a, b)
+                  and not geometry_within(a, b)
+                  and not geometry_within(b, a))
+    return out
+
+
+def st_geoHash(col, precision: int = 9) -> np.ndarray:
+    """Geohash of each point (ST_GeoHash)."""
+    from ..utils.geohash import geohash_encode
+    x, y = _points_xy(col)
+    return geohash_encode(x, y, precision)
+
+
+def st_pointFromGeoHash(col) -> tuple:
+    """Cell-center point column from geohashes (ST_PointFromGeoHash)."""
+    from ..utils.geohash import geohash_decode
+    lon, lat, _, _ = geohash_decode(np.asarray(col, dtype=object))
+    return lon, lat
+
+
+def st_geomFromGeoHash(col) -> np.ndarray:
+    """Cell polygon from geohashes (ST_GeomFromGeoHash)."""
+    from ..utils.geohash import geohash_decode
+    lon, lat, elon, elat = geohash_decode(np.asarray(col, dtype=object))
+    out = []
+    for cx, cy, ex, ey in zip(lon, lat, elon, elat):
+        out.append(Polygon([(cx - ex, cy - ey), (cx + ex, cy - ey),
+                            (cx + ex, cy + ey), (cx - ex, cy + ey)]))
+    return np.array(out, dtype=object)
+
+
+def st_asGeoJSON(col) -> np.ndarray:
+    """GeoJSON geometry strings (ST_AsGeoJSON)."""
+    import json as _json
+    from ..geometry.geojson import geometry_to_geojson
+    if isinstance(col, tuple):
+        x, y = col
+        return np.array([_json.dumps({"type": "Point",
+                                      "coordinates": [float(a), float(b)]})
+                         for a, b in zip(np.atleast_1d(x), np.atleast_1d(y))],
+                        dtype=object)
+    return np.array([_json.dumps(geometry_to_geojson(g))
+                     for g in _geoms(col)], dtype=object)
+
+
+def st_asLatLonText(col) -> np.ndarray:
+    """DMS "DDdMM'SS.sss"N DDDdMM'SS.sss"E" strings for points
+    (ST_AsLatLonText)."""
+    x, y = _points_xy(col)
+
+    def dms(v, pos, neg):
+        h = pos if v >= 0 else neg
+        v = abs(v)
+        d = int(v)
+        m = int((v - d) * 60)
+        s = (v - d - m / 60) * 3600
+        return f"{d}°{m:02d}'{s:06.3f}\"{h}"
+
+    return np.array([f"{dms(b, 'N', 'S')} {dms(a, 'E', 'W')}"
+                     for a, b in zip(x, y)], dtype=object)
+
+
+def st_aggregateDistanceSphere(col) -> float:
+    """Total haversine path length over an ordered point column
+    (ST_AggregateDistanceSphere)."""
+    x, y = _points_xy(col)
+    if len(x) < 2:
+        return 0.0
+    return float(haversine_m(x[:-1], y[:-1], x[1:], y[1:]).sum())
+
+
+def st_antimeridianSafeGeom(col) -> np.ndarray:
+    """Split polygons that cross the ±180 antimeridian into a
+    MultiPolygon of in-range halves (ST_antimeridianSafeGeom)."""
+    def fix(g):
+        if not isinstance(g, Polygon):
+            return g
+        xs = g.shell[:, 0]
+        if xs.max() - xs.min() <= 180.0:
+            return g
+        # treat west-positive wrap: shift negative lons +360, split at 180
+        sx = np.where(xs < 0, xs + 360.0, xs)
+        lo, hi = g.shell[:, 1].min(), g.shell[:, 1].max()
+        east = Polygon([(sx.min(), lo), (180.0, lo), (180.0, hi),
+                        (sx.min(), hi)])
+        west = Polygon([(-180.0, lo), (sx.max() - 360.0, lo),
+                        (sx.max() - 360.0, hi), (-180.0, hi)])
+        return MultiPolygon((east, west))
+    return np.array([fix(g) for g in _geoms(col)], dtype=object)
+
+
+def _typed_from_wkt(col, want: type, name: str) -> np.ndarray:
+    geoms = st_geomFromWKT(col)
+    for g in geoms:
+        if not isinstance(g, want):
+            raise ValueError(f"{name}: expected {want.__name__}, "
+                             f"got {type(g).__name__}")
+    return geoms
+
+
+def st_pointFromText(col) -> np.ndarray:
+    return _typed_from_wkt(col, Point, "st_pointFromText")
+
+
+def st_lineFromText(col) -> np.ndarray:
+    return _typed_from_wkt(col, LineString, "st_lineFromText")
+
+
+def st_polygonFromText(col) -> np.ndarray:
+    return _typed_from_wkt(col, Polygon, "st_polygonFromText")
+
+
+def st_mPointFromText(col) -> np.ndarray:
+    return _typed_from_wkt(col, MultiPoint, "st_mPointFromText")
+
+
+def st_mLineFromText(col) -> np.ndarray:
+    from ..geometry.types import MultiLineString
+    return _typed_from_wkt(col, MultiLineString, "st_mLineFromText")
+
+
+def st_mPolyFromText(col) -> np.ndarray:
+    return _typed_from_wkt(col, MultiPolygon, "st_mPolyFromText")
+
+
+def st_byteArray(col) -> np.ndarray:
+    """UTF-8 bytes of strings (ST_ByteArray)."""
+    return np.array([s.encode("utf-8") for s in np.atleast_1d(
+        np.asarray(col, dtype=object))], dtype=object)
+
+
+__all__ += [
+    "st_boundary", "st_dimension", "st_coordDim", "st_isEmpty",
+    "st_isClosed", "st_isCollection", "st_isSimple", "st_isRing",
+    "st_numGeometries", "st_geometryN", "st_interiorRingN",
+    "st_closestPoint", "st_covers", "st_touches", "st_overlaps",
+    "st_geoHash", "st_pointFromGeoHash", "st_geomFromGeoHash",
+    "st_asGeoJSON", "st_asLatLonText", "st_aggregateDistanceSphere",
+    "st_antimeridianSafeGeom", "st_pointFromText", "st_lineFromText",
+    "st_polygonFromText", "st_mPointFromText", "st_mLineFromText",
+    "st_mPolyFromText", "st_byteArray",
+]

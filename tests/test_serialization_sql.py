@@ -161,3 +161,113 @@ def test_spatial_frame_pushdown_and_aggregation():
 
     tbl = frame.to_arrow()
     assert tbl.num_rows == len(out)
+
+
+# -- round-2 st_* additions (toward the reference's full UDF set) --------
+def test_st_boundary_dimension_and_flags():
+    from geomesa_tpu.geometry.types import (
+        LineString, MultiLineString, MultiPoint, Point, Polygon,
+    )
+    from geomesa_tpu.sql import functions as F
+    poly = Polygon([(0, 0), (4, 0), (4, 4), (0, 4)])
+    line = LineString(np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 0.0]]))
+    closed = LineString(np.array([[0, 0], [1, 0], [1, 1], [0, 0]], float))
+    bowtie = LineString(np.array([[0, 0], [2, 2], [2, 0], [0, 2]], float))
+    col = np.array([poly, line, Point(1, 1)], dtype=object)
+    b = F.st_boundary(col)
+    assert isinstance(b[0], LineString)
+    assert isinstance(b[1], MultiPoint) and len(b[1].coords) == 2
+    assert isinstance(b[2], MultiPoint) and len(b[2].coords) == 0
+    np.testing.assert_array_equal(F.st_dimension(col), [2, 1, 0])
+    np.testing.assert_array_equal(F.st_coordDim(col), [2, 2, 2])
+    np.testing.assert_array_equal(
+        F.st_isClosed(np.array([line, closed], dtype=object)),
+        [False, True])
+    np.testing.assert_array_equal(
+        F.st_isSimple(np.array([line, bowtie], dtype=object)),
+        [True, False])
+    np.testing.assert_array_equal(
+        F.st_isRing(np.array([closed, bowtie], dtype=object)),
+        [True, False])
+    assert not F.st_isEmpty(col).any()
+    assert F.st_isCollection(np.array(
+        [MultiLineString((line,)), poly], dtype=object)).tolist() \
+        == [True, False]
+
+
+def test_st_multi_accessors():
+    from geomesa_tpu.geometry.types import MultiPolygon, Point, Polygon
+    from geomesa_tpu.sql import functions as F
+    a = Polygon([(0, 0), (1, 0), (1, 1), (0, 1)])
+    hole = Polygon([(0, 0), (10, 0), (10, 10), (0, 10)],
+                   (np.array([(4, 4), (6, 4), (6, 6), (4, 6)], float),))
+    mp = MultiPolygon((a, hole))
+    col = np.array([mp, a], dtype=object)
+    np.testing.assert_array_equal(F.st_numGeometries(col), [2, 1])
+    assert F.st_geometryN(col, 2)[0] is hole
+    rings = F.st_interiorRingN(np.array([hole, a], dtype=object), 1)
+    assert rings[0] is not None and rings[1] is None
+    cp = F.st_closestPoint(np.array([a], dtype=object), Point(2.0, 0.5))
+    assert cp[0].x == 1.0 and abs(cp[0].y - 0.5) < 1e-9
+
+
+def test_st_touch_cover_overlap():
+    from geomesa_tpu.geometry.types import Point, Polygon
+    from geomesa_tpu.sql import functions as F
+    poly = Polygon([(0, 0), (4, 0), (4, 4), (0, 4)])
+    pts = (np.array([4.0, 2.0, 9.0]), np.array([2.0, 2.0, 9.0]))
+    np.testing.assert_array_equal(F.st_touches(poly, pts),
+                                  [True, False, False])
+    np.testing.assert_array_equal(F.st_covers(poly, pts),
+                                  [True, True, False])
+    b = Polygon([(2, 2), (6, 2), (6, 6), (2, 6)])
+    c = Polygon([(10, 10), (11, 10), (11, 11), (10, 11)])
+    inner = Polygon([(1, 1), (2, 1), (2, 2), (1, 2)])
+    got = F.st_overlaps(np.array([poly, poly, poly], dtype=object),
+                        np.array([b, c, inner], dtype=object))
+    np.testing.assert_array_equal(got, [True, False, False])
+
+
+def test_st_geohash_roundtrip():
+    from geomesa_tpu.sql import functions as F
+    x = np.array([-74.0060, 2.3522])
+    y = np.array([40.7128, 48.8566])
+    h = F.st_geoHash((x, y), 9)
+    assert h[0].startswith("dr5")  # NYC geohash prefix
+    px, py = F.st_pointFromGeoHash(h)
+    np.testing.assert_allclose(px, x, atol=1e-3)
+    np.testing.assert_allclose(py, y, atol=1e-3)
+    cells = F.st_geomFromGeoHash(h)
+    inside = F.st_covers(cells[0], (x[:1], y[:1]))
+    assert inside[0]
+
+
+def test_st_output_and_text_constructors():
+    import json
+    from geomesa_tpu.geometry.types import LineString, Point
+    from geomesa_tpu.sql import functions as F
+    gj = F.st_asGeoJSON((np.array([-74.0]), np.array([40.7])))
+    assert json.loads(gj[0])["type"] == "Point"
+    txt = F.st_asLatLonText((np.array([-74.5]), np.array([40.25])))
+    assert txt[0].startswith("40°15'") and txt[0].endswith("W")
+    pts = F.st_pointFromText(np.array(["POINT (1 2)"], dtype=object))
+    assert isinstance(pts[0], Point)
+    with pytest.raises(ValueError):
+        F.st_lineFromText(np.array(["POINT (1 2)"], dtype=object))
+    d = F.st_aggregateDistanceSphere(
+        (np.array([0.0, 0.0]), np.array([0.0, 1.0])))
+    assert abs(d - 111_195) < 500  # one degree of latitude
+    assert F.st_byteArray(np.array(["ab"], dtype=object))[0] == b"ab"
+
+
+def test_st_antimeridian_safe():
+    from geomesa_tpu.geometry.types import MultiPolygon, Polygon
+    from geomesa_tpu.sql import functions as F
+    crossing = Polygon([(170, 10), (-170, 10), (-170, 20), (170, 20)])
+    plain = Polygon([(0, 0), (1, 0), (1, 1), (0, 1)])
+    out = F.st_antimeridianSafeGeom(np.array([crossing, plain],
+                                             dtype=object))
+    assert isinstance(out[0], MultiPolygon)
+    for p in out[0].polygons:
+        assert -180.0 <= p.shell[:, 0].min() <= p.shell[:, 0].max() <= 180.0
+    assert out[1] is plain
