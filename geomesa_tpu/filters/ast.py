@@ -17,7 +17,8 @@ from ..geometry.types import Envelope, Geometry
 
 __all__ = [
     "Filter", "Include", "Exclude", "And", "Or", "Not", "BBox", "Intersects",
-    "Contains", "Within", "DWithin", "GeomEquals", "During",
+    "Contains", "Within", "DWithin", "GeomEquals", "Touches",
+    "Crosses", "Overlaps", "During",
     "PropertyCompare", "Between", "In", "IdFilter", "Like", "Attribute",
 ]
 
@@ -135,6 +136,27 @@ class DWithin(Filter):
         env = self.geometry.envelope
         lat = min(89.0, max(abs(env.ymin), abs(env.ymax)))
         return self.distance / (111_320.0 * max(0.017, math.cos(math.radians(lat))))
+
+
+@dataclass(frozen=True)
+class Touches(Filter):
+    """Boundaries meet, interiors do not (CQL TOUCHES)."""
+    prop: str
+    geometry: Geometry
+
+
+@dataclass(frozen=True)
+class Crosses(Filter):
+    """Interiors intersect in a lower dimension (CQL CROSSES)."""
+    prop: str
+    geometry: Geometry
+
+
+@dataclass(frozen=True)
+class Overlaps(Filter):
+    """Same-dimension interiors partially shared (CQL OVERLAPS)."""
+    prop: str
+    geometry: Geometry
 
 
 @dataclass(frozen=True)

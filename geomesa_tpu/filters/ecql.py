@@ -20,7 +20,7 @@ from ..geometry.wkt import geometry_from_wkt
 from .ast import (
     And, BBox, Between, Contains, During, DWithin, Exclude, Filter,
     GeomEquals, IdFilter, In, Include, Intersects, Like, Not, Or,
-    PropertyCompare, Within,
+    PropertyCompare, Within, Touches, Crosses, Overlaps,
 )
 
 __all__ = ["parse_ecql", "parse_iso_ms"]
@@ -43,6 +43,7 @@ _KEYWORDS = {
     "AND", "OR", "NOT", "IN", "LIKE", "ILIKE", "BETWEEN", "DURING", "BEFORE",
     "AFTER", "INCLUDE", "EXCLUDE", "BBOX", "INTERSECTS", "CONTAINS", "WITHIN",
     "DWITHIN", "DISJOINT", "EQUALS", "BEYOND", "IS", "NULL", "TEQUALS",
+    "TOUCHES", "CROSSES", "OVERLAPS",
 }
 
 _GEOM_WORDS = {
@@ -244,7 +245,8 @@ def _parse_predicate(toks: _Tokens) -> Filter:
         toks.expect(")")
         return BBox(prop, *nums)
 
-    if upper in ("INTERSECTS", "CONTAINS", "WITHIN", "DISJOINT", "EQUALS"):
+    if upper in ("INTERSECTS", "CONTAINS", "WITHIN", "DISJOINT", "EQUALS",
+                 "TOUCHES", "CROSSES", "OVERLAPS"):
         toks.expect("(")
         _, prop = toks.next()
         toks.expect(",")
@@ -254,7 +256,9 @@ def _parse_predicate(toks: _Tokens) -> Filter:
             return Not(Intersects(prop, geom))
         if upper == "EQUALS":
             return GeomEquals(prop, geom)
-        cls = {"INTERSECTS": Intersects, "CONTAINS": Contains, "WITHIN": Within}[upper]
+        cls = {"INTERSECTS": Intersects, "CONTAINS": Contains,
+               "WITHIN": Within, "TOUCHES": Touches, "CROSSES": Crosses,
+               "OVERLAPS": Overlaps}[upper]
         return cls(prop, geom)
 
     if upper in ("DWITHIN", "BEYOND"):

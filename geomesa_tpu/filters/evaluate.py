@@ -34,7 +34,8 @@ from ..geometry.types import (
     Polygon,
 )
 from .ast import (
-    And, BBox, Between, Contains, During, DWithin, Filter, GeomEquals,
+    And, BBox, Between, Contains, Crosses, During, DWithin, Filter,
+    GeomEquals, Overlaps, Touches,
     IdFilter, In, Intersects, Like, Not, Or, PropertyCompare, Within,
     _Exclude, _Include,
 )
@@ -64,6 +65,24 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
     n = len(batch)
     if _use_xy_fast_path(batch, prop):
         x, y = batch.columns[f"{prop}_x"], batch.columns[f"{prop}_y"]
+        if op in ("crosses", "overlaps"):
+            # a point feature can never cross anything (its interior has
+            # dimension 0) and overlaps requires equal dimensions with a
+            # partial interior share a lone point cannot provide
+            return np.zeros(n, dtype=bool)
+        if op == "touches":
+            from ..geometry.predicates import _rings_of
+            if isinstance(geom, (Polygon, MultiPolygon)):
+                return points_on_rings(x, y, _rings_of(geom))
+            if isinstance(geom, (LineString, MultiLineString)):
+                lines = ([geom] if isinstance(geom, LineString)
+                         else list(geom.lines))
+                out = np.zeros(n, dtype=bool)
+                for l in lines:
+                    for e in (l.coords[0], l.coords[-1]):
+                        out |= (x == e[0]) & (y == e[1])
+                return out
+            return np.zeros(n, dtype=bool)
         if op == "contains":
             # a point can only contain (and only intersects-equal) a point
             if isinstance(geom, Point):
@@ -110,6 +129,15 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
             out[i] = geometry_within(gi, geom)
         elif op == "contains":
             out[i] = geometry_within(geom, gi)
+        elif op == "touches":
+            from ..geometry.predicates import geometry_touches
+            out[i] = geometry_touches(gi, geom)
+        elif op == "crosses":
+            from ..geometry.predicates import geometry_crosses
+            out[i] = geometry_crosses(gi, geom)
+        elif op == "overlaps":
+            from ..geometry.predicates import geometry_overlaps
+            out[i] = geometry_overlaps(gi, geom)
         else:
             raise NotImplementedError(op)
     return out
@@ -245,6 +273,12 @@ def evaluate_filter(f: Filter, batch: FeatureBatch) -> np.ndarray:
         return _geom_mask_polygonal(batch, f.prop, f.geometry, "within")
     if isinstance(f, Contains):
         return _geom_mask_polygonal(batch, f.prop, f.geometry, "contains")
+    if isinstance(f, Touches):
+        return _geom_mask_polygonal(batch, f.prop, f.geometry, "touches")
+    if isinstance(f, Crosses):
+        return _geom_mask_polygonal(batch, f.prop, f.geometry, "crosses")
+    if isinstance(f, Overlaps):
+        return _geom_mask_polygonal(batch, f.prop, f.geometry, "overlaps")
     if isinstance(f, DWithin):
         env = f.geometry.envelope
         deg = f.degrees

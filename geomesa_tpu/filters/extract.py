@@ -17,7 +17,8 @@ import numpy as np
 
 from ..geometry.types import Envelope, Geometry, Point, Polygon
 from .ast import (
-    And, BBox, Contains, During, DWithin, Exclude, Filter, GeomEquals,
+    And, BBox, Contains, Crosses, During, DWithin, Exclude, Filter,
+    GeomEquals, Overlaps, Touches,
     Include, Intersects, Not, Or, Within, _Exclude, _Include,
 )
 
@@ -112,7 +113,8 @@ def _geom_envelope_values(f: Filter, prop: str) -> "FilterValues | None":
     """Geometry values contributed by a single node (None = no constraint)."""
     if isinstance(f, BBox) and f.prop == prop:
         return FilterValues((Polygon.from_envelope(f.envelope),))
-    if isinstance(f, (Intersects, Within, Contains, GeomEquals)) and f.prop == prop:
+    if isinstance(f, (Intersects, Within, Contains, GeomEquals,
+                      Touches, Crosses, Overlaps)) and f.prop == prop:
         return FilterValues((f.geometry,))
     if isinstance(f, DWithin) and f.prop == prop:
         env = f.geometry.envelope

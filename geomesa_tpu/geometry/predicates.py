@@ -362,3 +362,124 @@ def geometry_intersects(a: Geometry, b: Geometry) -> bool:
             if segments_intersect(a1[s:s + _EDGE_CHUNK], a2[s:s + _EDGE_CHUNK], b1, b2).any():
                 return True
     return False
+
+
+def _strict_inside(pts: np.ndarray, poly: Geometry) -> np.ndarray:
+    """Points strictly interior to a polygonal geometry (boundary
+    excluded)."""
+    if not len(pts):
+        return np.zeros(0, dtype=bool)
+    inside = point_in_polygon(pts[:, 0], pts[:, 1], poly,
+                              include_boundary=True)
+    on = points_on_rings(pts[:, 0], pts[:, 1], _rings_of(poly))
+    return inside & ~on
+
+
+def _interiors_intersect(a: Geometry, b: Geometry) -> bool:
+    """Do the interiors of a and b intersect? (approximate DE-9IM
+    interior-interior test: proper segment crossings + strict vertex /
+    midpoint containment — exact for the supported lattice up to
+    collinear-overlap degeneracies)."""
+    a_poly = isinstance(a, (Polygon, MultiPolygon))
+    b_poly = isinstance(b, (Polygon, MultiPolygon))
+    a1, a2 = _segments(a)
+    b1, b2 = _segments(b)
+    if a1.size and b1.size and bool(
+            segments_cross_properly(a1, a2, b1, b2).any()):
+        return True
+    if b_poly:
+        va = all_vertices(a)
+        if bool(_strict_inside(va, b).any()):
+            return True
+        if a1.size:
+            mid = np.stack([(a1[:, 0] + a2[:, 0]) / 2,
+                            (a1[:, 1] + a2[:, 1]) / 2], axis=1)
+            if bool(_strict_inside(mid, b).any()):
+                return True
+    if a_poly:
+        vb = all_vertices(b)
+        if bool(_strict_inside(vb, a).any()):
+            return True
+        if b1.size:
+            mid = np.stack([(b1[:, 0] + b2[:, 0]) / 2,
+                            (b1[:, 1] + b2[:, 1]) / 2], axis=1)
+            if bool(_strict_inside(mid, a).any()):
+                return True
+    if not a_poly and not b_poly and a1.size and b1.size:
+        # line/line: shared collinear stretch — a segment midpoint of one
+        # lying ON the other marks a 1-D shared interior
+        mids_a = np.stack([(a1[:, 0] + a2[:, 0]) / 2,
+                           (a1[:, 1] + a2[:, 1]) / 2], axis=1)
+        rings_b = [np.vstack([p1, p2]) for p1, p2 in zip(b1, b2)]
+        if bool(points_on_rings(mids_a[:, 0], mids_a[:, 1],
+                                rings_b).any()):
+            return True
+    return False
+
+
+def geometry_touches(a: Geometry, b: Geometry) -> bool:
+    """JTS-style ``touches``: geometries intersect but their interiors do
+    not (boundary-only contact)."""
+    if not geometry_intersects(a, b):
+        return False
+    if isinstance(a, (Point, MultiPoint)):
+        pts = _points_of(a)
+        if isinstance(b, (Polygon, MultiPolygon)):
+            return bool(points_on_rings(pts[:, 0], pts[:, 1],
+                                        _rings_of(b)).any()
+                        and not _strict_inside(pts, b).any())
+        if isinstance(b, (LineString, MultiLineString)):
+            lines = [b] if isinstance(b, LineString) else list(b.lines)
+            ends = np.vstack([np.vstack([l.coords[0], l.coords[-1]])
+                              for l in lines])
+            return bool((np.abs(pts[:, None, :] - ends[None, :, :])
+                         .sum(axis=2) == 0).any())
+        return False  # point/point contact is equality, not touches
+    if isinstance(b, (Point, MultiPoint)):
+        return geometry_touches(b, a)
+    return not _interiors_intersect(a, b)
+
+
+def geometry_crosses(a: Geometry, b: Geometry) -> bool:
+    """JTS-style ``crosses``: interiors intersect and the intersection's
+    dimension is lower than the operands' max (line/line meeting at
+    points; a line passing through a polygon)."""
+    a_line = isinstance(a, (LineString, MultiLineString))
+    b_line = isinstance(b, (LineString, MultiLineString))
+    a_poly = isinstance(a, (Polygon, MultiPolygon))
+    b_poly = isinstance(b, (Polygon, MultiPolygon))
+    if a_line and b_line:
+        a1, a2 = _segments(a)
+        b1, b2 = _segments(b)
+        return bool(a1.size and b1.size
+                    and segments_cross_properly(a1, a2, b1, b2).any())
+    if (a_line and b_poly) or (a_poly and b_line):
+        line, poly = (a, b) if a_line else (b, a)
+        v = all_vertices(line)
+        s1, s2 = _segments(line)
+        mids = np.vstack([v, np.stack(
+            [(s1[:, 0] + s2[:, 0]) / 2, (s1[:, 1] + s2[:, 1]) / 2],
+            axis=1)]) if s1.size else v
+        inside = _strict_inside(mids, poly)
+        outside = ~point_in_polygon(mids[:, 0], mids[:, 1], poly,
+                                    include_boundary=True)
+        return bool(inside.any() and outside.any())
+    return False
+
+
+def geometry_overlaps(a: Geometry, b: Geometry) -> bool:
+    """JTS-style ``overlaps``: same dimension, interiors intersect,
+    neither contains the other."""
+    a_pt = isinstance(a, (Point, MultiPoint))
+    b_pt = isinstance(b, (Point, MultiPoint))
+    a_line = isinstance(a, (LineString, MultiLineString))
+    b_line = isinstance(b, (LineString, MultiLineString))
+    if a_pt != b_pt or a_line != b_line:
+        return False  # different dimensions
+    if a_pt:
+        pa = {tuple(p) for p in _points_of(a)}
+        pb = {tuple(p) for p in _points_of(b)}
+        return bool(pa & pb) and bool(pa - pb) and bool(pb - pa)
+    if not _interiors_intersect(a, b):
+        return False
+    return not geometry_within(a, b) and not geometry_within(b, a)

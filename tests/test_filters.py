@@ -399,3 +399,51 @@ def test_equals_topological():
     # different polygon
     assert len(ds.query_result(
         "tp", "EQUALS(geom, POLYGON ((0 0, 3 0, 3 3, 0 3, 0 0)))").positions) == 0
+
+
+def test_touches_crosses_overlaps_point_schema():
+    """TOUCHES/CROSSES/OVERLAPS through the full store stack on point
+    features: touches = boundary contact only; crosses/overlaps are
+    impossible for dimension-0 features."""
+    from geomesa_tpu.datastore import TpuDataStore
+    ds = TpuDataStore()
+    ds.create_schema("pts", "name:String,*geom:Point")
+    ds.write("pts", {
+        "name": np.array(["edge", "inside", "outside"], dtype=object),
+        "geom": (np.array([4.0, 2.0, 9.0]), np.array([2.0, 2.0, 9.0])),
+    })
+    poly = "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"
+    got = ds.query("pts", f"TOUCHES(geom, {poly})")
+    assert list(got.column("name")) == ["edge"]
+    assert len(ds.query("pts", f"CROSSES(geom, {poly})")) == 0
+    assert len(ds.query("pts", f"OVERLAPS(geom, {poly})")) == 0
+
+
+def test_touches_crosses_overlaps_polygon_schema():
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry import LineString, Polygon
+    ds = TpuDataStore()
+    ds.create_schema("areas", "name:String,*geom:Geometry")
+    sq = lambda x0, y0, s: Polygon([(x0, y0), (x0 + s, y0),
+                                    (x0 + s, y0 + s), (x0, y0 + s)])
+    geoms = [sq(4, 0, 4),                       # shares edge with query
+             sq(2, 2, 4),                       # overlaps query
+             sq(1, 1, 1),                       # within query
+             sq(20, 20, 2),                     # disjoint
+             LineString(np.array([[-1.0, 2.0], [5.0, 2.0]]))]  # crosses
+    ds.write("areas", {
+        "name": np.array(["touch", "overlap", "inner", "far", "line"],
+                         dtype=object),
+        "geom": geoms,
+    })
+    q = "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"
+    assert list(ds.query("areas", f"TOUCHES(geom, {q})")
+                .column("name")) == ["touch"]
+    assert list(ds.query("areas", f"OVERLAPS(geom, {q})")
+                .column("name")) == ["overlap"]
+    assert list(ds.query("areas", f"CROSSES(geom, {q})")
+                .column("name")) == ["line"]
+    # oracle cross-check: every new predicate result is a subset of
+    # INTERSECTS
+    inter = set(ds.query("areas", f"INTERSECTS(geom, {q})").column("name"))
+    assert {"touch", "overlap", "inner", "line"} == inter
