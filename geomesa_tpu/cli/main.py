@@ -146,6 +146,11 @@ def cmd_export(args):
         if not args.output:
             raise SystemExit("avro export requires -o/--output")
         to_avro(batch, args.output)
+    elif fmt == "shp":
+        from ..io.export import to_shapefile
+        if not args.output:
+            raise SystemExit("shp export requires -o/--output")
+        to_shapefile(batch, args.output)
     elif fmt == "bin":
         from ..io.bin_encoder import encode_bin
         x, y = batch.geom_xy()
@@ -298,7 +303,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-q", "--cql", default="INCLUDE")
     sp.add_argument("-F", "--format", default="csv",
                     choices=["csv", "geojson", "parquet", "arrow", "bin",
-                             "gml", "leaflet", "avro"])
+                             "gml", "leaflet", "avro", "shp"])
     sp.add_argument("-o", "--output")
     sp.add_argument("-m", "--max-features", type=int)
     sp.add_argument("--track", help="track-id attribute for bin export")

@@ -319,3 +319,132 @@ def to_leaflet(batch: FeatureBatch, *, title: str | None = None) -> str:
     geojson = to_geojson(batch).replace("<", "\\u003c")
     return _LEAFLET_PAGE.format(
         title=escape(title or batch.sft.name), geojson=geojson)
+
+
+def to_shapefile(batch: FeatureBatch, path: str) -> None:
+    """Write an ESRI shapefile trio (.shp/.shx/.dbf) — the export half of
+    the reference's shp support (tools/export/formats/ShapefileExporter).
+
+    Geometry types map to shape types 1 (point), 3 (polyline),
+    5 (polygon), 8 (multipoint); one file holds ONE shape type (the
+    format's rule), chosen from the first geometry.  Attributes land in
+    the DBF as character/numeric fields (strings truncate at 254 bytes,
+    the format's limit); ``path`` may omit the .shp suffix.
+    """
+    import struct
+
+    from ..geometry.types import (
+        LineString, MultiLineString, MultiPoint, Point, Polygon,
+    )
+
+    base = path[:-4] if path.endswith(".shp") else path
+    n = len(batch)
+    if batch.geoms is not None:
+        geoms = [batch.geoms.geometry(i) for i in range(n)]
+    else:  # point fast path: x/y columns
+        gx, gy = batch.geom_xy()
+        geoms = [Point(float(a), float(b)) for a, b in zip(gx, gy)]
+    first = geoms[0] if geoms else Point(0, 0)
+    if isinstance(first, Point):
+        stype = 1
+    elif isinstance(first, (LineString, MultiLineString)):
+        stype = 3
+    elif isinstance(first, Polygon):
+        stype = 5
+    elif isinstance(first, MultiPoint):
+        stype = 8
+    else:
+        raise ValueError(f"unsupported shapefile geometry "
+                         f"{first.geom_type}")
+
+    def rec_body(g) -> bytes:
+        if stype == 1:
+            if not isinstance(g, Point):
+                raise ValueError("mixed geometry types in one shapefile")
+            return struct.pack("<idd", 1, g.x, g.y)
+        if stype == 8:
+            pts = g.coords
+            env = g.envelope
+            return (struct.pack("<i4di", 8, env.xmin, env.ymin,
+                                env.xmax, env.ymax, len(pts))
+                    + pts.astype("<f8").tobytes())
+        # polyline / polygon: parts + points
+        if stype == 3:
+            rings = ([g.coords] if isinstance(g, LineString)
+                     else [l.coords for l in g.lines])
+        else:
+            def closed(r):
+                r = np.asarray(r, float)
+                return (r if len(r) and np.array_equal(r[0], r[-1])
+                        else np.vstack([r, r[:1]]))
+            rings = [closed(g.shell)] + [closed(h) for h in g.holes]
+        env = g.envelope
+        parts, off = [], 0
+        for r in rings:
+            parts.append(off)
+            off += len(r)
+        pts = np.vstack(rings)
+        return (struct.pack("<i4dii", stype, env.xmin, env.ymin,
+                            env.xmax, env.ymax, len(rings), len(pts))
+                + struct.pack(f"<{len(parts)}i", *parts)
+                + pts.astype("<f8").tobytes())
+
+    bodies = [rec_body(g) for g in geoms]
+    if geoms:
+        gxmin = min(g.envelope.xmin for g in geoms)
+        gymin = min(g.envelope.ymin for g in geoms)
+        gxmax = max(g.envelope.xmax for g in geoms)
+        gymax = max(g.envelope.ymax for g in geoms)
+    else:
+        gxmin = gymin = gxmax = gymax = 0.0
+
+    def header(file_words: int) -> bytes:
+        return (struct.pack(">i5i i", 9994, 0, 0, 0, 0, 0, file_words)
+                + struct.pack("<ii4d4d", 1000, stype,
+                              gxmin, gymin, gxmax, gymax, 0, 0, 0, 0))
+
+    shp_words = 50 + sum((8 + len(b)) // 2 for b in bodies)
+    with open(base + ".shp", "wb") as f:
+        f.write(header(shp_words))
+        for i, b in enumerate(bodies):
+            f.write(struct.pack(">ii", i + 1, len(b) // 2))
+            f.write(b)
+    with open(base + ".shx", "wb") as f:
+        f.write(header(50 + 4 * len(bodies)))
+        off = 50
+        for b in bodies:
+            f.write(struct.pack(">ii", off, len(b) // 2))
+            off += (8 + len(b)) // 2
+
+    # DBF: non-geometry attributes as C (string) / N (numeric) fields
+    attrs = [a for a in batch.sft.attributes if not a.is_geometry]
+    fields = []
+    for a in attrs:
+        col = batch.column(a.name)
+        if a.type in ("int", "long", "date"):
+            fields.append((a.name[:10], b"N", 19, 0, col))
+        elif a.type in ("float", "double"):
+            fields.append((a.name[:10], b"N", 24, 10, col))
+        else:
+            width = min(254, max([1] + [len(str(v)) for v in col]))
+            fields.append((a.name[:10], b"C", width, 0, col))
+    rec_len = 1 + sum(w for _, _, w, _, _ in fields)
+    with open(base + ".dbf", "wb") as f:
+        f.write(struct.pack("<B3BIHH20x", 3, 26, 7, 30, n,
+                            32 + 32 * len(fields) + 1, rec_len))
+        for name, kind, width, dec, _ in fields:
+            f.write(struct.pack("<11s c IBB 14x",
+                                name.encode("ascii", "replace"), kind,
+                                0, width, dec))
+        f.write(b"\r")
+        for i in range(n):
+            f.write(b" ")
+            for name, kind, width, dec, col in fields:
+                v = col[i]
+                if kind == b"N":
+                    s = (f"{float(v):.{dec}f}" if dec
+                         else str(int(v))).rjust(width)[:width]
+                else:
+                    s = str(v if v is not None else "").ljust(width)[:width]
+                f.write(s.encode("utf-8", "replace")[:width].ljust(width))
+        f.write(b"\x1a")

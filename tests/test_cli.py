@@ -158,3 +158,13 @@ def test_cli_migrate_and_index_versions(tmp_path, capsys):
     # idempotent
     main(["migrate-schema", "-c", cat, "-f", "legacy"])
     assert "already at current" in capsys.readouterr().out
+
+
+def test_export_shapefile(catalog, tmp_path):
+    cat, _ = catalog
+    out = str(tmp_path / "out.shp")
+    main(["export", "-c", cat, "-f", "people", "-F", "shp", "-o", out])
+    from geomesa_tpu.io.formats import read_shapefile
+    geoms, attrs = read_shapefile(out, str(tmp_path / "out.dbf"))
+    assert len(geoms) == 3
+    assert {s.strip() for s in attrs["name"]} == {"alice", "bob", "carol"}

@@ -300,3 +300,49 @@ def test_uuidz3_and_typed_geometry_functions():
     import pytest
     with pytest.raises(ValueError, match="polygon"):
         parse_expression("polygon($w)").evaluate(wkts)
+
+
+def test_shapefile_export_roundtrip(tmp_path):
+    """to_shapefile writes .shp/.shx/.dbf that our own reader (and hence
+    the converter stack) reads back identically."""
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.features import FeatureBatch
+    from geomesa_tpu.io.export import to_shapefile
+    from geomesa_tpu.io.formats import read_shapefile
+
+    sft = parse_spec("pts", "name:String,age:Int,*geom:Point")
+    batch = FeatureBatch.from_dict(sft, {
+        "name": ["alice", "bob", "carol"],
+        "age": [30, 41, 25],
+        "geom": (np.array([-74.0, 2.35, 139.7]),
+                 np.array([40.7, 48.85, 35.6])),
+    })
+    path = str(tmp_path / "people.shp")
+    to_shapefile(batch, path)
+    geoms, attrs = read_shapefile(path, str(tmp_path / "people.dbf"))
+    assert len(geoms) == 3
+    np.testing.assert_allclose([g.x for g in geoms], [-74.0, 2.35, 139.7])
+    assert [s.strip() for s in attrs["name"]] == ["alice", "bob", "carol"]
+    assert [int(v) for v in attrs["age"]] == [30, 41, 25]
+
+
+def test_shapefile_export_polygons(tmp_path):
+    from geomesa_tpu.features.feature_type import parse_spec
+    from geomesa_tpu.features import FeatureBatch
+    from geomesa_tpu.geometry import Polygon
+    from geomesa_tpu.io.export import to_shapefile
+    from geomesa_tpu.io.formats import read_shapefile
+
+    hole = Polygon([(0, 0), (10, 0), (10, 10), (0, 10)],
+                   (np.array([(4, 4), (6, 4), (6, 6), (4, 6)], float),))
+    plain = Polygon([(20, 20), (24, 20), (24, 24), (20, 24)])
+    sft = parse_spec("areas", "name:String,*geom:Polygon")
+    batch = FeatureBatch.from_dict(sft, {
+        "name": ["holed", "plain"], "geom": [hole, plain]})
+    path = str(tmp_path / "areas")
+    to_shapefile(batch, path)
+    geoms, _ = read_shapefile(path + ".shp", path + ".dbf")
+    assert len(geoms) == 2
+    assert len(geoms[0].holes) == 1
+    assert geoms[0].envelope.as_tuple() == (0.0, 0.0, 10.0, 10.0)
+    assert geoms[1].envelope.as_tuple() == (20.0, 20.0, 24.0, 24.0)
