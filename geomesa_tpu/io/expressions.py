@@ -91,7 +91,11 @@ def _fn_date(cols, fmt_e, val_e):
         ts = pd.to_datetime(vals, format=fmt, utc=True)
     else:
         ts = pd.to_datetime(vals, utc=True)
-    return (ts.astype(np.int64) // 1_000_000).to_numpy()
+    # resolution-robust: pandas may infer s/ms/ns units depending on the
+    # format (date-only patterns parse at second resolution in pandas 2);
+    # drop the UTC tz before the numpy view (values are already UTC)
+    return (ts.tz_localize(None).to_numpy()
+            .astype("datetime64[ms]").astype(np.int64))
 
 
 def _fn_isodate(cols, val_e):
@@ -240,6 +244,89 @@ def _binop_math(op, identity=None):
     return fn
 
 
+
+
+def _fn_named_date(fmt):
+    """Named date-format parser (the reference's joda-named formats,
+    DateFunctionFactory.scala: basicDate, isoLocalDate, ...)."""
+    def parse(cols, e):
+        return _fn_date(cols, _Lit(fmt), e)
+    return parse
+
+
+def _fn_date_to_string(cols, fmt_e, e):
+    """Format epoch-ms dates back to strings (dateToString)."""
+    import pandas as pd
+    fmt = (fmt_e.value
+           .replace("yyyy", "%Y").replace("MM", "%m").replace("dd", "%d")
+           .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S")
+           .replace("SSS", "%f").replace("'T'", "T").replace("'Z'", "Z"))
+    ms = np.asarray(e.evaluate(cols), dtype=np.int64)
+    ts = pd.to_datetime(ms, unit="ms", utc=True)
+    out = ts.strftime(fmt)
+    if "%f" in fmt:  # strftime %f is microseconds; the pattern asked millis
+        out = [v[:-3] if v.endswith("000") else v for v in out]
+    return np.asarray(list(out), dtype=object)
+
+
+def _fn_project_from(cols, epsg_e, xy_e):
+    """Reproject a point column from the given EPSG code to 4326
+    (projectFrom, GeometryFunctionFactory.scala)."""
+    from ..geometry.crs import transform
+    xy = xy_e.evaluate(cols)
+    if not isinstance(xy, tuple):
+        raise ValueError("projectFrom expects a point() argument")
+    x, y = xy
+    return transform(np.asarray(x, np.float64), np.asarray(y, np.float64),
+                     str(epsg_e.value), "EPSG:4326")
+
+
+def _fn_parse_list(cols, type_e, e, delim_e=None):
+    """parseList('int', $0[, ';']) — typed list column
+    (CollectionFunctionFactory.scala)."""
+    delim = delim_e.value if delim_e is not None else ","
+    cast = {"int": int, "integer": int, "long": int, "float": float,
+            "double": float, "string": str, "str": str,
+            "bool": lambda v: v.lower() in ("true", "1"),
+            "boolean": lambda v: v.lower() in ("true", "1")}[
+        str(type_e.value).lower()]
+    return np.asarray(
+        [[cast(p.strip()) for p in str(v).split(delim) if p.strip()]
+         if v is not None and str(v).strip() else []
+         for v in e.evaluate(cols)], dtype=object)
+
+
+def _fn_parse_map(cols, types_e, e, kv_delim_e=None, delim_e=None):
+    """parseMap('string->int', $0[, '->'[, ',']]) — typed dict column."""
+    kv = kv_delim_e.value if kv_delim_e is not None else "->"
+    delim = delim_e.value if delim_e is not None else ","
+    vt = str(types_e.value).split("->")[-1].strip().lower()
+    cast = {"int": int, "integer": int, "long": int, "float": float,
+            "double": float, "string": str, "str": str}.get(vt, str)
+    out = []
+    for v in e.evaluate(cols):
+        d = {}
+        if v is not None and str(v).strip():
+            for part in str(v).split(delim):
+                if kv in part:
+                    k, _, val = part.partition(kv)
+                    d[k.strip()] = cast(val.strip())
+        out.append(d)
+    return np.asarray(out, dtype=object)
+
+
+def _fn_map_value(cols, map_e, key_e):
+    key = key_e.value if isinstance(key_e, _Lit) else None
+    maps = map_e.evaluate(cols)
+    if key is not None:
+        return np.asarray([m.get(key) if isinstance(m, dict) else None
+                           for m in maps], dtype=object)
+    keys = key_e.evaluate(cols)
+    return np.asarray(
+        [m.get(k) if isinstance(m, dict) else None
+         for m, k in zip(maps, keys)], dtype=object)
+
+
 _FUNCTIONS = {
     "toint": lambda cols, e: _num(cols, e, np.int32),
     "tolong": lambda cols, e: _num(cols, e, np.int64),
@@ -325,6 +412,41 @@ _FUNCTIONS = {
     # collections (CollectionFunctionFactory.scala)
     "list": _fn_list,
     "listitem": _fn_list_item,
+    "parselist": _fn_parse_list,
+    "parsemap": _fn_parse_map,
+    "mapvalue": _fn_map_value,
+    # named date formats + helpers (DateFunctionFactory.scala)
+    "now": lambda cols: np.full(
+        len(next(iter(cols.values()))) if cols else 1,
+        np.int64(__import__("time").time() * 1000)),
+    "datetostring": _fn_date_to_string,
+    "basicdate": _fn_named_date("yyyyMMdd"),
+    "basicisodate": _fn_named_date("yyyyMMdd"),
+    "basicdatetime": _fn_named_date("yyyyMMdd'T'HHmmss.SSSZ"),
+    "basicdatetimenomillis": _fn_named_date("yyyyMMdd'T'HHmmssZ"),
+    "isolocaldate": _fn_named_date("yyyy-MM-dd"),
+    "isolocaldatetime": _fn_named_date("yyyy-MM-dd'T'HH:mm:ss"),
+    "isooffsetdatetime": _fn_named_date(None),
+    "datehourminutesecondmillis": _fn_named_date(
+        "yyyy-MM-dd'T'HH:mm:ss.SSS"),
+    # cast aliases (CastFunctionFactory.scala)
+    "stringtoint": lambda cols, e: _num(cols, e, np.int32),
+    "stringtointeger": lambda cols, e: _num(cols, e, np.int32),
+    "stringtolong": lambda cols, e: _num(cols, e, np.int64),
+    "stringtofloat": lambda cols, e: _num(cols, e, np.float32),
+    "stringtodouble": lambda cols, e: _num(cols, e, np.float64),
+    "stringtobool": lambda cols, e: np.asarray(
+        [str(v).strip().lower() in ("true", "1", "t")
+         for v in e.evaluate(cols)]),
+    "stringtoboolean": lambda cols, e: np.asarray(
+        [str(v).strip().lower() in ("true", "1", "t")
+         for v in e.evaluate(cols)]),
+    "stringtobytes": lambda cols, e: np.asarray(
+        [str(v).encode("utf-8") for v in e.evaluate(cols)], dtype=object),
+    "string2bytes": lambda cols, e: np.asarray(
+        [str(v).encode("utf-8") for v in e.evaluate(cols)], dtype=object),
+    # geometry (GeometryFunctionFactory.scala)
+    "projectfrom": _fn_project_from,
     # ids (IdFunctionFactory / Z3FeatureIdGenerator)
     "uuidz3": _fn_uuid_z3,
     "uuidz3centroid": _fn_uuid_z3,  # centroid variant: caller passes the

@@ -346,3 +346,37 @@ def test_shapefile_export_polygons(tmp_path):
     assert len(geoms[0].holes) == 1
     assert geoms[0].envelope.as_tuple() == (0.0, 0.0, 10.0, 10.0)
     assert geoms[1].envelope.as_tuple() == (20.0, 20.0, 24.0, 24.0)
+
+
+def test_expression_functions_round2():
+    """Round-2 expression additions: named date formats, dateToString,
+    parseList/parseMap/mapValue, cast aliases, projectFrom."""
+    from geomesa_tpu.io.expressions import parse_expression as pe
+
+    cols = {
+        "d": np.array(["20180105", "20180203"], dtype=object),
+        "l": np.array(["1;2;3", "4"], dtype=object),
+        "m": np.array(["a->1,b->2", ""], dtype=object),
+        "n": np.array(["7", "8"], dtype=object),
+    }
+    ms = pe("basicDate($d)").evaluate(cols)
+    np.testing.assert_array_equal(ms, [1515110400000, 1517616000000])
+    assert list(pe("dateToString('yyyy-MM-dd', basicDate($d))")
+                .evaluate(cols)) == ["2018-01-05", "2018-02-03"]
+    assert list(pe("isoLocalDate($d)").evaluate(
+        {"d": np.array(["2018-01-05"], dtype=object)})) == [1515110400000]
+    lst = pe("parseList('int', $l, ';')").evaluate(cols)
+    assert lst[0] == [1, 2, 3] and lst[1] == [4]
+    mv = pe("mapValue(parseMap('string->int', $m), 'b')").evaluate(cols)
+    assert mv[0] == 2 and mv[1] is None
+    np.testing.assert_array_equal(pe("stringToLong($n)").evaluate(cols),
+                                  [7, 8])
+    assert pe("stringToBoolean($n)").evaluate(
+        {"n": np.array(["true", "0"], dtype=object)}).tolist() == [True, False]
+    assert pe("string2bytes($n)").evaluate(cols)[0] == b"7"
+    now = pe("now()").evaluate(cols)
+    assert len(now) == 2 and now[0] > 1_600_000_000_000
+    # projectFrom: web-mercator meters back to lon/lat degrees
+    x, y = pe("projectFrom('EPSG:3857', point($x, $y))").evaluate({
+        "x": np.array([0.0]), "y": np.array([0.0])})
+    assert abs(x[0]) < 1e-9 and abs(y[0]) < 1e-9
