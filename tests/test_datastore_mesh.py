@@ -312,3 +312,43 @@ def test_mesh_store_sql_frame_and_rdd():
                       num_partitions=4)
     assert sum(len(p) for p in rdd.partitions) == len(
         plain.query("ev", "BBOX(geom, -74.5, 40.5, -73.5, 41.5)"))
+
+
+def test_mesh_differential_fuzz(stores):
+    """Seeded random ECQL sweep: the mesh store must equal the plain
+    store (and the filter oracle) on every generated query shape."""
+    plain, mesh = stores
+    rng = np.random.default_rng(83)
+    names = ["alpha", "beta", "gamma", "delta"]
+
+    def rand_query():
+        parts = []
+        kind = rng.integers(0, 5)
+        if kind in (0, 1, 3):
+            x0 = rng.uniform(-75, -73.4)
+            y0 = rng.uniform(40, 41.4)
+            w, h = rng.uniform(0.1, 1.2, 2)
+            parts.append(f"BBOX(geom, {x0:.3f}, {y0:.3f}, "
+                         f"{x0 + w:.3f}, {y0 + h:.3f})")
+        if kind in (1, 2):
+            d0 = int(rng.integers(1, 15))
+            d1 = d0 + int(rng.integers(1, 6))
+            parts.append(
+                f"dtg DURING 2018-01-{d0:02d}T00:00:00Z/"
+                f"2018-01-{d1:02d}T00:00:00Z")
+        if kind in (3, 4):
+            parts.append(f"name = '{names[rng.integers(0, 4)]}'")
+        if kind == 4:
+            parts.append(f"score < {rng.uniform(10, 90):.1f}")
+        return " AND ".join(parts)
+
+    for _ in range(25):
+        ecql = rand_query()
+        a = plain.query_result("events", ecql).positions
+        b = mesh.query_result("events", ecql).positions
+        np.testing.assert_array_equal(np.sort(a), np.sort(b),
+                                      err_msg=f"mesh != plain for {ecql}")
+        want = np.flatnonzero(evaluate_filter(
+            parse_ecql(ecql), plain._store("events").batch))
+        np.testing.assert_array_equal(np.sort(b), want,
+                                      err_msg=f"oracle mismatch for {ecql}")
