@@ -1,6 +1,12 @@
 """Density process: heatmap grids over query results (the reference's
 DensityProcess / DENSITY_* query hints, process/analytic/
-DensityProcess.scala + iterators/DensityScan.scala)."""
+DensityProcess.scala + iterators/DensityScan.scala).
+
+On a mesh-backed store, pure bbox+time queries take the PUSH-DOWN path:
+the grid accumulates per shard inside ``shard_map`` and merges with
+``psum`` over ICI (`ShardedZ3Index.density`) — no candidate ever
+materializes on the host, exactly the reference's server-side
+DensityScan + client-merge split."""
 
 from __future__ import annotations
 
@@ -12,11 +18,57 @@ from ..ops.density import density_grid_auto as density_grid
 __all__ = ["density_process"]
 
 
+def _bbox_time_only(f, geom_field, dtg_field):
+    """Structurally decompose a filter that is EXACTLY a conjunction of
+    bbox/during constraints (the shape the collective density can serve
+    without a residual filter).  Returns (boxes, lo_ms, hi_ms) or None."""
+    from ..filters.ast import And, BBox, During, _Include
+
+    boxes, lo, hi = [], None, None
+
+    def walk(node) -> bool:
+        nonlocal lo, hi
+        if isinstance(node, _Include):
+            return True
+        if isinstance(node, And):
+            return all(walk(p) for p in node.filters)
+        if isinstance(node, BBox) and node.prop == geom_field:
+            boxes.append((node.xmin, node.ymin, node.xmax, node.ymax))
+            return True
+        if isinstance(node, During) and node.prop == dtg_field:
+            if node.lo_ms is not None:
+                lo = node.lo_ms if lo is None else max(lo, node.lo_ms)
+            if node.hi_ms is not None:
+                hi = node.hi_ms if hi is None else min(hi, node.hi_ms)
+            return True
+        return False
+
+    if not walk(f):
+        return None
+    return (boxes or [(-180.0, -90.0, 180.0, 90.0)]), lo, hi
+
+
 def density_process(store, schema: str, query, env,
                     width: int = 256, height: int = 256,
                     weight_attr: str | None = None) -> np.ndarray:
     """Run ``query`` and accumulate matching features into a (height, width)
     weighted grid over envelope ``env`` (xmin, ymin, xmax, ymax)."""
+    mesh = getattr(store, "_mesh", None)
+    if mesh is not None and getattr(store, "_auth_provider", None) is None:
+        from ..planning.planner import Query
+        q = query if isinstance(query, Query) else Query.of(query)
+        sft = store.get_schema(schema)
+        st = store._store(schema)
+        if (sft.is_points and sft.dtg_field and st.batch is not None
+                and len(st.batch)):
+            plan = _bbox_time_only(q.filter, sft.geom_field, sft.dtg_field)
+            if plan is not None:
+                boxes, lo, hi = plan
+                weights = (st.batch.column(weight_attr).astype(np.float64)
+                           if weight_attr else None)
+                grid = st.z3_index().density(
+                    boxes, lo, hi, env, width, height, weights=weights)
+                return np.asarray(grid)
     result = store.query_result(schema, query)
     batch = result.batch
     if len(batch) == 0:

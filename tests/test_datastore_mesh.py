@@ -352,3 +352,29 @@ def test_mesh_differential_fuzz(stores):
             parse_ecql(ecql), plain._store("events").batch))
         np.testing.assert_array_equal(np.sort(b), want,
                                       err_msg=f"oracle mismatch for {ecql}")
+
+
+def test_mesh_density_pushdown(stores):
+    """Pure bbox+time density on the mesh takes the collective psum path
+    (no host candidate materialization) and matches the plain store's
+    grid; attribute-filtered queries fall back to the query path."""
+    from geomesa_tpu.process import density_process
+    plain, mesh = stores
+    env = (-74.5, 40.5, -73.5, 41.5)
+    q = ("BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg DURING "
+         "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+    ga = density_process(plain, "events", q, env, 64, 32)
+    gb = density_process(mesh, "events", q, env, 64, 32)
+    np.testing.assert_allclose(ga, gb)
+    assert ga.sum() > 0
+    # weighted
+    ga = density_process(plain, "events", q, env, 32, 32,
+                         weight_attr="score")
+    gb = density_process(mesh, "events", q, env, 32, 32,
+                         weight_attr="score")
+    np.testing.assert_allclose(ga, gb, rtol=1e-10)
+    # attribute predicate → residual filter required → fallback path
+    q2 = q + " AND name = 'alpha'"
+    ga = density_process(plain, "events", q2, env, 32, 32)
+    gb = density_process(mesh, "events", q2, env, 32, 32)
+    np.testing.assert_allclose(ga, gb)
