@@ -14,10 +14,18 @@ def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
     features matching ``query``.
 
     On a mesh-backed store the stat runs as the distributed reduce:
-    per-shard partials fold through the Stat monoid (the reference's
+    pure bbox+time queries with Count/MinMax/Histogram specs take the
+    PUSH-DOWN path — per-shard moments/histograms merged with psum over
+    ICI, no host candidate materialization (`parallel.stats.
+    sharded_stats_scan`); everything else materializes the hits and
+    folds per-shard partials through the Stat monoid (the reference's
     per-node StatsScan + client Reducer, iterators/StatsScan.scala:125)."""
-    result = store.query_result(schema, query)
     mesh = getattr(store, "_mesh", None)
+    if mesh is not None and getattr(store, "_auth_provider", None) is None:
+        pushed = _collective_stats(store, schema, query, stat_spec)
+        if pushed is not None:
+            return pushed
+    result = store.query_result(schema, query)
     if mesh is not None and len(result.batch):
         from ..parallel.stats import merged_stats
         return merged_stats(result.batch, stat_spec,
@@ -25,4 +33,69 @@ def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
     stat = parse_stat(stat_spec)
     if len(result.batch):
         stat.observe(result.batch)
+    return stat
+
+
+def _collective_stats(store, schema: str, query, stat_spec: str):
+    """Fully device-resident stats for bbox+time filters over point
+    schemas: one collective scan per requested attribute.  Returns None
+    whenever the filter needs a residual check or the spec contains a
+    kind the collective path cannot serve (the caller falls back)."""
+    import numpy as np
+
+    from ..planning.planner import Query
+    from ..stats.stat import CountStat, Histogram, MinMax, SeqStat
+    from .density import _bbox_time_only
+
+    q = query if isinstance(query, Query) else Query.of(query)
+    sft = store.get_schema(schema)
+    st = store._store(schema)
+    if not (sft.is_points and sft.dtg_field and st.batch is not None
+            and len(st.batch)):
+        return None
+    plan = _bbox_time_only(q.filter, sft.geom_field, sft.dtg_field)
+    if plan is None:
+        return None
+    boxes, lo, hi = plan
+    stat = parse_stat(stat_spec)
+    stats = stat.stats if isinstance(stat, SeqStat) else [stat]
+    per_attr: dict[str, list] = {}
+    for s in stats:
+        if isinstance(s, CountStat):
+            continue
+        if isinstance(s, (MinMax, Histogram)):
+            per_attr.setdefault(s.attr, []).append(s)
+        else:
+            return None  # sketch kinds fold via the monoid path instead
+    if any(len([s for s in ss if isinstance(s, Histogram)]) > 1
+           for ss in per_attr.values()):
+        return None
+    from ..parallel.stats import sharded_stats_scan
+
+    idx = st.z3_index()
+    count = None
+    for attr, ss in per_attr.items():
+        col = st.batch.columns.get(attr)
+        if col is None or col.dtype.kind not in "if":
+            return None
+        hist = next((s for s in ss if isinstance(s, Histogram)), None)
+        res = sharded_stats_scan(
+            idx, boxes, lo, hi, values=col,
+            hist_bins=hist.bins if hist else 0,
+            hist_range=(hist.lo, hist.hi) if hist else None)
+        count = res["count"]
+        for s in ss:
+            if isinstance(s, MinMax) and count:
+                if col.dtype.kind == "i":
+                    s.min = int(round(res["min"]))
+                    s.max = int(round(res["max"]))
+                else:
+                    s.min, s.max = res["min"], res["max"]
+            elif isinstance(s, Histogram):
+                s.counts = np.asarray(res["histogram"], dtype=np.int64)
+    if count is None and any(isinstance(s, CountStat) for s in stats):
+        count = sharded_stats_scan(idx, boxes, lo, hi)["count"]
+    for s in stats:
+        if isinstance(s, CountStat):
+            s.count = int(count)
     return stat
