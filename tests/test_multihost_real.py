@@ -58,8 +58,42 @@ assert count >= len(hits)
 grid = idx.density([box], MS, MS + 7 * 86_400_000, box, 16, 16)
 # the density psum spans both processes' rows
 assert grid.sum() == len(hits), (grid.sum(), len(hits))
+
+# distributed converter ingest: every process parses its file share,
+# the global index assembles collectively (run_distributed_ingest)
+from geomesa_tpu.features.feature_type import parse_spec
+from geomesa_tpu.jobs import run_distributed_ingest
+work = os.environ["GEOMESA_WORK"]
+paths = []
+for f in range(3):   # shared file list; each process parses its share
+    p = os.path.join(work, f"f{f}.csv")
+    if proc == 0:    # one writer; files exist before both processes read
+        frng = np.random.default_rng(100 + f)
+        rows = [f"u{f}_{i},{MS + i * 60_000},"
+                f"{frng.uniform(-74.5, -73.5):.6f},"
+                f"{frng.uniform(40.2, 41.8):.6f}" for i in range(40)]
+        with open(p + ".tmp", "w") as fh:
+            fh.write("\n".join(rows) + "\n")
+        os.replace(p + ".tmp", p)
+    paths.append(p)
+import time as _time
+while not all(os.path.exists(p) for p in paths):
+    _time.sleep(0.05)
+sft = parse_spec("pts", "name:String,dtg:Date,*geom:Point")
+config = {"type": "csv", "fields": [
+    {"name": "name", "transform": "toString($0)"},
+    {"name": "dtg", "transform": "toLong($1)"},
+    {"name": "geom", "transform": "point($2, $3)"},
+]}
+ing_idx, result = run_distributed_ingest(sft, config, paths,
+                                         period="week", mesh=mesh)
+assert ing_idx.total() == 120, ing_idx.total()  # 3 files x 40 rows
+ing_hits = ing_idx.query([(-75.0, 40.0, -73.0, 42.0)], None, None)
+assert len(ing_hits) == 120
+
 print(f"MULTIHOST-OK proc={proc} total={idx.total()} "
-      f"hits={len(hits)} mine={len(mine)} count={count}", flush=True)
+      f"hits={len(hits)} mine={len(mine)} count={count} "
+      f"ingested={result.ingested}", flush=True)
 '''
 
 
@@ -77,6 +111,7 @@ def test_two_process_multihost(tmp_path):
     env = dict(os.environ)
     env["GEOMESA_REPO"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
+    env["GEOMESA_WORK"] = str(tmp_path)
     env.pop("JAX_PLATFORMS", None)
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(i), port],
