@@ -9,9 +9,10 @@ from .partitions import (
     Z2Scheme,
     scheme_from_config,
 )
-from .storage import FileSystemDataStore
+from .storage import FileSystemDataStore, to_device_store
 
 __all__ = [
     "PartitionScheme", "Z2Scheme", "DateTimeScheme", "AttributeScheme",
     "CompositeScheme", "scheme_from_config", "FileSystemDataStore",
+    "to_device_store",
 ]

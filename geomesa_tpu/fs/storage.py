@@ -259,3 +259,27 @@ class FileSystemDataStore:
         ts = self._storage(name)
         for part in ([partition] if partition else ts.partitions()):
             ts.compact(part)
+
+
+def to_device_store(fs: "FileSystemDataStore", name: str, mesh=None,
+                    catalog_dir: str | None = None):
+    """Lift an FSDS schema into a (optionally mesh-backed) TpuDataStore —
+    the reference's pattern of running analytics over FSDS data through
+    a compute engine (geomesa-fs-spark): partitions stream in as one
+    columnar batch and every device index/collective becomes available.
+
+    Returns the new ``TpuDataStore`` holding the schema's features.
+    """
+    from ..datastore import TpuDataStore
+
+    storage = fs._storage(name)
+    ds = TpuDataStore(catalog_dir, mesh=mesh)
+    ds.create_schema(name, storage.sft.spec_string())
+    batches = [b for b in (storage.read_partition(p)
+                           for p in fs.partitions(name)) if b is not None]
+    if batches:
+        merged = batches[0]
+        for b in batches[1:]:
+            merged = merged.concat(b)
+        ds.write(name, merged)
+    return ds

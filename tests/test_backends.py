@@ -574,3 +574,32 @@ def test_z3_feature_ids_exact_key_order():
     zkey = (bins.astype(np.uint64) << np.uint64(44)) | (z >> np.uint64(19))
     np.testing.assert_array_equal(zkey[np.argsort(ids, kind="stable")],
                                   np.sort(zkey))
+
+
+def test_fsds_to_device_store(tmp_path):
+    """FSDS partitions lift into a mesh-backed TpuDataStore for device
+    queries (the FSDS-through-compute-engine pattern)."""
+    import numpy as np
+    from geomesa_tpu.fs import FileSystemDataStore, to_device_store
+    from geomesa_tpu.parallel import device_mesh
+
+    MS = 1514764800000
+    rng = np.random.default_rng(5)
+    fs = FileSystemDataStore(str(tmp_path / "fsroot"))
+    fs.create_schema("evt", "name:String,dtg:Date,*geom:Point")
+    n = 3_000
+    for k in range(2):  # two writes → multiple partition files
+        fs.write("evt", {
+            "name": rng.choice(["a", "b"], n).astype(object),
+            "dtg": rng.integers(MS, MS + 10 * 86_400_000, n),
+            "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n)),
+        })
+    ds = to_device_store(fs, "evt", mesh=device_mesh())
+    assert ds.get_count("evt") == 2 * n
+    ecql = ("BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg DURING "
+            "2018-01-02T00:00:00Z/2018-01-08T00:00:00Z")
+    got = ds.query_result("evt", ecql)
+    assert got.strategy.index == "z3"
+    # oracle over the FSDS's own (host) query path
+    want = fs.query("evt", ecql)
+    assert len(got.positions) == len(want)
