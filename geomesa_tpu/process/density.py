@@ -45,7 +45,18 @@ def _bbox_time_only(f, geom_field, dtg_field):
 
     if not walk(f):
         return None
-    return (boxes or [(-180.0, -90.0, 180.0, 90.0)]), lo, hi
+    if not boxes:
+        return [(-180.0, -90.0, 180.0, 90.0)], lo, hi
+    # every collected bbox came from an AND context, so they INTERSECT
+    # (the collective density treats a box list as OR of boxes)
+    x0 = max(b[0] for b in boxes)
+    y0 = max(b[1] for b in boxes)
+    x1 = min(b[2] for b in boxes)
+    y1 = min(b[3] for b in boxes)
+    if x0 > x1 or y0 > y1:  # empty intersection
+        x0 = y0 = 1.0
+        x1 = y1 = 0.0
+    return [(x0, y0, x1, y1)], lo, hi
 
 
 def density_process(store, schema: str, query, env,

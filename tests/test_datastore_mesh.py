@@ -406,3 +406,25 @@ def test_mesh_stats_pushdown(stores):
     a2 = stats_process(plain, "events", q2, "Count()")
     b2 = stats_process(mesh, "events", q2, "Count()")
     assert a2.count == b2.count > 0
+
+
+def test_mesh_pushdown_anded_bboxes_intersect(stores):
+    """Regression: AND of two bboxes must intersect (not union) on the
+    push-down paths."""
+    from geomesa_tpu.process import density_process, stats_process
+    plain, mesh = stores
+    env = (-75.0, 40.0, -73.0, 42.0)
+    q = ("BBOX(geom, -74.8, 40.2, -73.8, 41.2) AND "
+         "BBOX(geom, -74.2, 40.8, -73.2, 41.8) AND dtg DURING "
+         "2018-01-02T00:00:00Z/2018-01-12T00:00:00Z")
+    ga = density_process(plain, "events", q, env, 32, 32)
+    gb = density_process(mesh, "events", q, env, 32, 32)
+    np.testing.assert_allclose(ga, gb)
+    a = stats_process(plain, "events", q, "Count()")
+    b = stats_process(mesh, "events", q, "Count()")
+    assert a.count == b.count > 0
+    # disjoint AND → zero
+    q0 = ("BBOX(geom, -74.8, 40.2, -74.5, 40.4) AND "
+          "BBOX(geom, -73.5, 41.5, -73.2, 41.8)")
+    assert stats_process(mesh, "events", q0, "Count()").count == 0
+    assert density_process(mesh, "events", q0, env, 16, 16).sum() == 0
