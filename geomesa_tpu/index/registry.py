@@ -102,10 +102,15 @@ def _register_builtins() -> None:
     register_index(IndexDescriptor(
         "id", applicable=lambda sft: True,
         build=lambda store: store._build_id()))
+    def _attr_build(store):
+        raise ValueError(
+            "the attribute index is built per attribute — use "
+            "_SchemaStore.attribute_index(name)")
+
     register_index(IndexDescriptor(
         "attr",
         applicable=lambda sft: any(a.indexed for a in sft.attributes),
-        build=lambda store: None))  # built per attribute, see _SchemaStore
+        build=_attr_build))
 
 
 _register_builtins()
