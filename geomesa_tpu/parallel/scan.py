@@ -624,6 +624,52 @@ class ShardedZ3Index:
         # a feature can land in several of a query's covering ranges
         return [np.unique(gids[qids == q]) for q in range(n_q)]
 
+    def query_ring(self, boxes, t_lo_ms: int, t_hi_ms: int,
+                   max_ranges: int = 2000,
+                   capacity: int = 1 << 12) -> np.ndarray:
+        """Exact query via the RING-PARALLEL scan: the plan shards over
+        the mesh and rotates (ppermute) while data stays stationary, so
+        no device ever replicates more than 1/N of the ranges — the
+        long-context path for plans too large to broadcast (see
+        :func:`_z3_ring_query_program`).  Returns sorted global gids,
+        identical to :meth:`query`."""
+        t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period,
+                             max_ranges, sfc=self.sfc)
+        if plan.num_ranges == 0 or self._n_total == 0:
+            return np.empty(0, dtype=np.int64)
+        n = int(self.mesh.devices.size)
+        pad = (-plan.num_ranges) % n
+        r = {
+            "rbin": np.concatenate(
+                [plan.rbin, np.full(pad, -2, plan.rbin.dtype)]),
+            "rzlo": np.concatenate(
+                [plan.rzlo, np.ones(pad, plan.rzlo.dtype)]),
+            "rzhi": np.concatenate(
+                [plan.rzhi, np.zeros(pad, plan.rzhi.dtype)]),
+            "rtlo": np.concatenate(
+                [plan.rtlo, np.ones(pad, plan.rtlo.dtype)]),
+            "rthi": np.concatenate(
+                [plan.rthi, np.zeros(pad, plan.rthi.dtype)]),
+        }
+        ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
+                             pad_pow2(len(plan.boxes), minimum=1))
+        spec = NamedSharding(self.mesh, P("shard"))
+        put = lambda a: jax.device_put(jnp.asarray(a), spec)
+        while True:
+            ring = _z3_ring_query_program(self.mesh, capacity)
+            packed, totals = ring(
+                self.bins, self.z, self.gid, self.x, self.y, self.dtg,
+                put(r["rbin"]), put(r["rzlo"]), put(r["rzhi"]),
+                put(r["rtlo"]), put(r["rthi"]),
+                jnp.asarray(ixy), jnp.asarray(bxs),
+                jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
+            tot = _fetch_global(totals)
+            if int(tot.max(initial=0)) <= capacity:
+                flat = _fetch_global(packed).ravel()
+                return np.unique(flat[flat >= 0]).astype(np.int64)
+            capacity = gather_capacity(int(tot.max()))
+
     def density(self, boxes, t_lo_ms: int, t_hi_ms: int, env,
                 width: int = 256, height: int = 256,
                 weights=None) -> np.ndarray:
@@ -709,6 +755,69 @@ def ring_range_counts(mesh, bins, z, rbin, rzlo, rzhi) -> np.ndarray:
         return acc
 
     return _fetch_global(jax.jit(ring)(bins, z, rbin, rzlo, rzhi))
+
+
+@lru_cache(maxsize=32)
+def _z3_ring_query_program(mesh: Mesh, capacity: int):
+    """Ring-parallel FULL query: the covering-range plan is sharded over
+    the mesh and rotates with ``ppermute`` while each device's sorted
+    data shard stays stationary — the ring-attention communication
+    pattern applied to index scanning (SURVEY §5 long-context analog).
+
+    Each of N hops seeks the resident range block against the local
+    segment, packs that hop's hit gids into the block's travelling
+    buffer, and rotates block + buffer to the neighbor; after N hops
+    every block is home carrying hits from ALL shards.  Unlike the
+    replicated-plan scan, no device ever holds more than 1/N of the
+    ranges — the path for plans too large to replicate (massive
+    multi-window tube/kNN batches, planner cost sweeps)."""
+    n = mesh.devices.size
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"),) * 6 + (P("shard"),) * 5 + (P(None),) * 2
+        + (P(), P()),
+        out_specs=(P("shard"), P("shard")),
+    )
+    def ring(lb, lz, lg, xs, ys, ts, rb, rlo, rhi, rtl, rth,
+             ixy, bxs, t_lo, t_hi):
+        # anchor the travelling buffers to a sharded operand so the scan
+        # carry is device-varying from step 0 (shard_map requires carried
+        # ppermute values to be varying; see ring_range_counts)
+        anchor = rb[0] * 0
+        out0 = (jnp.full((n, capacity), -1, dtype=lg.dtype)
+                + anchor.astype(lg.dtype))
+        tot0 = jnp.zeros((n,), jnp.int64) + anchor.astype(jnp.int64)
+
+        def step(carry, i):
+            rb, rlo, rhi, rtl, rth, out, tot = carry
+            starts = searchsorted2(lb, lz, rb, rlo, side="left")
+            ends = searchsorted2(lb, lz, rb, rhi, side="right")
+            counts = jnp.maximum(ends - starts, 0)
+            idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
+            gc = lg[idx]
+            mask = valid_slot & (gc >= 0) & candidate_mask(
+                lz[idx], rtl[rid], rth[rid], ixy, bxs,
+                xs[idx], ys[idx], ts[idx], t_lo, t_hi)
+            out = out.at[i].set(
+                jnp.where(mask, gc, gc.dtype.type(-1)))
+            tot = tot.at[i].set(jnp.sum(counts))
+            rb = jax.lax.ppermute(rb, "shard", perm)
+            rlo = jax.lax.ppermute(rlo, "shard", perm)
+            rhi = jax.lax.ppermute(rhi, "shard", perm)
+            rtl = jax.lax.ppermute(rtl, "shard", perm)
+            rth = jax.lax.ppermute(rth, "shard", perm)
+            out = jax.lax.ppermute(out, "shard", perm)
+            tot = jax.lax.ppermute(tot, "shard", perm)
+            return (rb, rlo, rhi, rtl, rth, out, tot), None
+
+        (rb, rlo, rhi, rtl, rth, out, tot), _ = jax.lax.scan(
+            step, (rb, rlo, rhi, rtl, rth, out0, tot0),
+            jnp.arange(n), length=n)
+        return out.reshape(n * capacity), tot
+
+    return jax.jit(ring)
 
 
 def sharded_density(mesh, x, y, dtg, gid, weights, boxes,
