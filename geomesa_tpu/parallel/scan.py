@@ -496,6 +496,11 @@ class ShardedZ3Index:
             jax.device_put(jnp.asarray(rzhi), spec))
         return counts[: plan.num_ranges]
 
+    #: plans with more ranges than this PER DEVICE route through the
+    #: ring scan automatically (replicating a huge plan to every device
+    #: is the thing the ring path exists to avoid)
+    RING_MIN_RANGES_PER_DEVICE = 4096
+
     def query(self, boxes, t_lo_ms: int, t_hi_ms: int,
               max_ranges: int = 2000,
               capacity: int | None = None) -> np.ndarray:
@@ -509,12 +514,17 @@ class ShardedZ3Index:
         scatter/gather + client-merge pattern of the reference's
         BatchScanPlan.  Programs are cached per (mesh, capacity): plan
         arrays pad to power-of-two buckets and travel as traced
-        arguments, so repeat queries reuse the compile."""
+        arguments, so repeat queries reuse the compile.  Plans too large
+        to replicate route through :meth:`query_ring` automatically."""
         t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period, max_ranges,
                              sfc=self.sfc)
         if plan.num_ranges == 0 or self._n_total == 0:
             return np.empty(0, dtype=np.int64)
+        n_dev = int(self.mesh.devices.size)
+        if plan.num_ranges > self.RING_MIN_RANGES_PER_DEVICE * n_dev:
+            hits = self._query_ring_plan(plan)
+            return hits
         capacity = capacity or self._capacity
         r = pad_ranges({"rbin": plan.rbin, "rzlo": plan.rzlo,
                         "rzhi": plan.rzhi, "rtlo": plan.rtlo,
@@ -638,6 +648,10 @@ class ShardedZ3Index:
                              max_ranges, sfc=self.sfc)
         if plan.num_ranges == 0 or self._n_total == 0:
             return np.empty(0, dtype=np.int64)
+        return self._query_ring_plan(plan, capacity)
+
+    def _query_ring_plan(self, plan,
+                         capacity: int = 1 << 12) -> np.ndarray:
         n = int(self.mesh.devices.size)
         pad = (-plan.num_ranges) % n
         r = {

@@ -361,3 +361,26 @@ def test_ring_query_matches_replicated(sharded, data):
     # range count not divisible by mesh size exercises plan padding
     ring3 = sharded.query_ring([box], tlo, thi, max_ranges=509)
     np.testing.assert_array_equal(ring3, np.sort(rep))
+
+
+def test_huge_plan_routes_through_ring(sharded, data, monkeypatch):
+    """Plans above the per-device replication threshold take the ring
+    path automatically and stay exact."""
+    calls = {"ring": 0}
+    orig = ShardedZ3Index._query_ring_plan
+
+    def spy(self, plan, capacity=1 << 12):
+        calls["ring"] += 1
+        return orig(self, plan, capacity)
+
+    monkeypatch.setattr(ShardedZ3Index, "_query_ring_plan", spy)
+    monkeypatch.setattr(ShardedZ3Index, "RING_MIN_RANGES_PER_DEVICE", 8)
+    x, y, t = data
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018 + 86_400_000, MS_2018 + 6 * 86_400_000
+    hits = sharded.query([box], tlo, thi, max_ranges=2000)
+    assert calls["ring"] == 1
+    brute = np.flatnonzero(
+        (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+        & (t >= tlo) & (t <= thi))
+    np.testing.assert_array_equal(np.sort(hits), brute)
