@@ -8,5 +8,14 @@ HBM; queries decompose filters into key ranges on host and evaluate
 seek + candidate-filter as fused array kernels on device.
 """
 
+from .registry import (
+    IndexDescriptor, available_indices, get_index, register_index,
+    supported_indices,
+)
 from .z2 import Z2PointIndex
 from .z3 import Z3PointIndex
+
+__all__ = [
+    "Z2PointIndex", "Z3PointIndex", "IndexDescriptor", "register_index",
+    "get_index", "available_indices", "supported_indices",
+]
