@@ -74,6 +74,10 @@ class QueryProperties:
     #: query timeout in seconds; 0 disables (ThreadManagement reaper analog)
     QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", 0)
     #: skip the exact geometry re-check and trust index-key resolution
+    #: accepted for parity with the reference (QueryProperties.scala); a
+    #: deliberate no-op here: the exact double-precision re-check is FUSED
+    #: into the scan kernel's candidate mask, so "loose" would save
+    #: nothing — results are always exact at zero extra cost
     LOOSE_BBOX = SystemProperty("geomesa.query.loose.bounding.box", False)
     #: refuse queries that would scan the full table (opt-in, like the
     #: reference's BlockFullTableScans)

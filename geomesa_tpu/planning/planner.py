@@ -122,6 +122,17 @@ class QueryPlanner:
 
         if allowed is not None and len(positions):
             positions = positions[allowed[positions]]
+        if "SAMPLING" in query.hints and len(positions):
+            # 1-in-n result thinning, optionally per attribute group —
+            # the reference's SAMPLING/SAMPLE_BY query hints
+            # (SamplingIterator + FeatureSampler)
+            from ..process.sampling import sample_positions
+            n_samp = int(query.hints["SAMPLING"])
+            by = query.hints.get("SAMPLE_BY")
+            keys = batch.column(by)[positions] if by else None
+            positions = sample_positions(positions, n_samp, keys)
+            explain(lambda: f"Sampled 1-in-{n_samp}"
+                            + (f" per {by}" if by else ""))
         positions = self._sort_limit(positions, batch, query)
         result_batch = batch.take(positions)
         properties = query.properties

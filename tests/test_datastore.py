@@ -308,3 +308,17 @@ def test_incremental_write_appends_z3_index():
     st2 = ds._store("inc")
     oracle2 = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st2.batch))
     np.testing.assert_array_equal(np.sort(res2.positions), oracle2)
+
+
+def test_sampling_hints(store):
+    """SAMPLING / SAMPLE_BY query hints thin results 1-in-n (the
+    reference's SamplingIterator hints)."""
+    full = store.query_result("events", "name = 'alpha'").positions
+    q = Query.of("name = 'alpha'", hints={"SAMPLING": 4})
+    got = store.query_result("events", q).positions
+    np.testing.assert_array_equal(got, full[::4])
+    # per-group sampling keeps at least one row per group
+    q2 = Query.of("INCLUDE", hints={"SAMPLING": 1000, "SAMPLE_BY": "name"})
+    got2 = store.query("events", q2)
+    assert set(got2.column("name")) == {"alpha", "beta", "gamma", "delta"}
+    assert len(got2) < 100
