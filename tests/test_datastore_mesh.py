@@ -428,3 +428,36 @@ def test_mesh_pushdown_anded_bboxes_intersect(stores):
           "BBOX(geom, -73.5, 41.5, -73.2, 41.8)")
     assert stats_process(mesh, "events", q0, "Count()").count == 0
     assert density_process(mesh, "events", q0, env, 16, 16).sum() == 0
+
+
+def test_merged_view_mixes_mesh_and_plain():
+    """A merged view unions a mesh-backed store with a single-chip store
+    (the reference's MergedDataStoreView over heterogeneous backends)."""
+    from geomesa_tpu.views import MergedDataStoreView
+    rng = np.random.default_rng(91)
+    n = 2_001
+    spec = "name:String,dtg:Date,*geom:Point"
+
+    def data(seed):
+        r = np.random.default_rng(seed)
+        return {
+            "name": r.choice(["a", "b"], n),
+            "dtg": r.integers(MS_2018, MS_2018 + 7 * DAY, n),
+            "geom": (r.uniform(-75, -73, n), r.uniform(40, 42, n)),
+        }
+
+    mesh_ds = TpuDataStore(mesh=device_mesh())
+    plain_ds = TpuDataStore()
+    mesh_ds.create_schema("ev", spec)
+    plain_ds.create_schema("ev", spec)
+    d1, d2 = data(1), data(2)
+    mesh_ds.write("ev", d1)
+    plain_ds.write("ev", d2)
+    view = MergedDataStoreView([mesh_ds, plain_ds])
+    ecql = "BBOX(geom, -74.5, 40.5, -73.5, 41.5)"
+    got = view.query("ev", ecql)
+    def count(d):
+        x, y = d["geom"]
+        return int(((x >= -74.5) & (x <= -73.5)
+                    & (y >= 40.5) & (y <= 41.5)).sum())
+    assert len(got) == count(d1) + count(d2)
