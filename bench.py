@@ -178,6 +178,10 @@ def main():
               (110.0, -40.0, 125.0, -25.0)]
     z2_hits = z2.query(boxes2)  # warm
     z2_dt = _median_time(lambda: z2.query(boxes2), iters=10)
+    # world heatmap straight from the sorted column (z-prefix boundary
+    # seeks, one dispatch; device time ~1-2ms — tunnel RTT dominates)
+    _ = z2.density_world(256, 128)  # warm
+    dw_dt = _median_time(lambda: z2.density_world(256, 128), iters=5)
 
     # -- config 3: XZ2 polygon intersects (OSM buildings)
     from geomesa_tpu.geometry.types import Polygon
@@ -272,6 +276,7 @@ def main():
                                       else 8 * CH),
             "z2_or3_ms": round(z2_dt * 1e3, 1),
             "z2_or3_hits": int(len(z2_hits)),
+            "density_world_zprefix_ms": round(dw_dt * 1e3, 1),
             "xz2_build_s": round(xz2_build_s, 2),
             "xz2_query_ms": round(xz2_dt * 1e3, 2),
             "xz2_candidates": int(len(xz2_hits)),

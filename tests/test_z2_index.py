@@ -127,3 +127,28 @@ def test_query_windows_untimed_routes_to_z2():
     np.testing.assert_array_equal(hits[1], b1)
     b2 = np.flatnonzero((x >= 2) & (x <= 4) & (y >= 44) & (y <= 46))
     np.testing.assert_array_equal(hits[2], b2)
+
+
+def test_density_world_matches_grid_histogram():
+    """z-prefix boundary histogram == the masked scatter histogram over
+    the world envelope (clamping semantics included)."""
+    import jax.numpy as jnp
+    from geomesa_tpu.index.z2 import Z2PointIndex
+    from geomesa_tpu.ops.density import density_grid
+
+    rng = np.random.default_rng(17)
+    n = 80_003
+    x = rng.uniform(-180, 180, n)
+    y = rng.uniform(-90, 90, n)
+    # include exact boundary values (clamp into edge cells)
+    x[:3] = [-180.0, 180.0, 0.0]
+    y[:3] = [-90.0, 90.0, 0.0]
+    idx = Z2PointIndex.build(x, y)
+    for w, h in [(256, 128), (64, 64), (16, 8)]:
+        fast = idx.density_world(w, h)
+        ref = np.asarray(density_grid(
+            jnp.asarray(x), jnp.asarray(y), jnp.ones(n),
+            jnp.ones(n, bool), (-180.0, -90.0, 180.0, 90.0), w, h))
+        np.testing.assert_allclose(fast, ref, err_msg=f"{w}x{h}")
+    with pytest.raises(ValueError):
+        idx.density_world(100, 64)
