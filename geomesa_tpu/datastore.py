@@ -80,6 +80,21 @@ class CatalogVersionError(RuntimeError):
     run rather than corrupt data written by a newer layout)."""
 
 
+def _max_numeric_id(ids: np.ndarray) -> int:
+    """Largest plain-integer feature id in ``ids`` (−1 when none).
+
+    Explicit numeric ids must advance the auto-id counter, or later
+    auto-generated ids would collide with them.  isdecimal, not isdigit:
+    unicode digit characters like '²' pass isdigit but fail int parsing."""
+    s = np.asarray(ids).astype(str)
+    if not len(s):
+        return -1
+    mask = np.char.isdecimal(s) & (np.char.str_len(s) <= 18)
+    if not mask.any():
+        return -1
+    return int(s[mask].astype(np.int64).max())
+
+
 
 class _SchemaStore:
     """Per-schema storage: the column batch + lazily-built indexes + stats.
@@ -105,6 +120,10 @@ class _SchemaStore:
         self._dirty = True
         self._indexes: dict = {}
         self._stats: dict[str, Stat] = {}
+        #: monotonic auto feature-id counter — never decremented on
+        #: delete, so ids are never reused (the reference's generators
+        #: never recycle ids, utils/uuid/Z3FeatureIdGenerator.scala)
+        self.next_fid: int = 0
         self._init_stats()
 
     def _init_stats(self):
@@ -590,7 +609,8 @@ class TpuDataStore:
                 parse_visibility(expr)
         batch = (data if isinstance(data, FeatureBatch)
                  else FeatureBatch.from_dict(store.sft, data, ids=ids))
-        if not batch.ids_explicit:
+        auto_ids = not batch.ids_explicit
+        if auto_ids:
             # feature ids must be unique across writes: re-base auto ids on
             # a shallow copy so the caller's batch (and any prior-write
             # alias held by the store) is never mutated.  With
@@ -604,14 +624,41 @@ class TpuDataStore:
                     x, y, batch.column(store.sft.dtg_field),
                     period=store.sft.z3_interval)
             else:
-                base = 0 if store.batch is None else len(store.batch)
+                # monotonic counter, NOT len(batch): deletes shrink the
+                # batch but minted ids must never come back (delete 2 of
+                # 4 then write 2 → reused ids '2','3' would make id-index
+                # lookups and delete-by-id hit two rows each)
+                base = store.next_fid
                 new_ids = np.array(
                     [str(base + i) for i in range(len(batch))], dtype=object)
             batch = FeatureBatch(
                 batch.sft, dict(batch.columns), geoms=batch.geoms,
                 ids=new_ids)
+            next_fid = store.next_fid + len(batch)
+        else:
+            # explicit ids: reject collisions at the writer (the id
+            # index enforces uniqueness too, but failing there — at lazy
+            # build, deep inside a later query — would permanently break
+            # the schema's id queries long after the bad write)
+            ids_in = batch.ids.astype(str)
+            uniq, counts = np.unique(ids_in, return_counts=True)
+            if (counts > 1).any():
+                raise ValueError(
+                    f"duplicate feature id {uniq[counts > 1][0]!r} "
+                    "within the write batch")
+            if store.batch is not None and len(store.batch):
+                clash = np.isin(ids_in, store.batch.ids.astype(str))
+                if clash.any():
+                    raise ValueError(
+                        f"feature id {ids_in[clash][0]!r} already exists "
+                        f"in schema {name!r} (delete it first, or use "
+                        "auto-generated ids)")
+            # numeric-id max computed BEFORE the append so a parse issue
+            # can never leave the store mutated with the counter behind
+            next_fid = max(store.next_fid, _max_numeric_id(batch.ids) + 1)
         store.write(batch, visibility=visibility,
                     attribute_visibilities=attribute_visibilities)
+        store.next_fid = next_fid
         from .metrics import registry as _metrics
         _metrics.counter(f"write.{name}.features").inc(len(batch))
         return len(batch)
@@ -927,7 +974,13 @@ class TpuDataStore:
         store = self._store(name)
         path = os.path.join(self._catalog_dir, f"{name}.stats.json")
         with open(path, "w") as f:
-            json.dump({k: s.to_json() for k, s in store._stats.items()}, f)
+            # __meta__ rides along with the sketches: the auto-id
+            # counter must survive reload, or deleting the highest ids
+            # then reopening would re-derive a lower counter from the
+            # surviving rows and resurrect deleted ids
+            json.dump({"__meta__": {"next_fid": store.next_fid},
+                       **{k: s.to_json()
+                          for k, s in store._stats.items()}}, f)
 
     def load_stats(self, name: str) -> None:
         if not self._catalog_dir:
@@ -936,8 +989,12 @@ class TpuDataStore:
         if os.path.exists(path):
             with open(path) as f:
                 raw = json.load(f)
-            self._store(name)._stats = {
-                k: stat_from_json(v) for k, v in raw.items()}
+            store = self._store(name)
+            meta = raw.pop("__meta__", None)  # absent in older catalogs
+            if meta is not None:
+                store.next_fid = max(store.next_fid,
+                                     int(meta.get("next_fid", 0)))
+            store._stats = {k: stat_from_json(v) for k, v in raw.items()}
 
     # -- data persistence (FSDS-analog: parquet files under the catalog) --
     def flush(self, name: str) -> None:
@@ -976,6 +1033,7 @@ class TpuDataStore:
             from .io.export import from_parquet
             store = self._schemas[name]
             store.batch = from_parquet(path, store.sft)
+            store.next_fid = _max_numeric_id(store.batch.ids) + 1
             store._dirty = True
             vis_path = os.path.join(self._catalog_dir, f"{name}.vis.json")
             if os.path.exists(vis_path):

@@ -87,6 +87,16 @@ class _TypeStorage:
             np.r_[True, sorted_names[1:] != sorted_names[:-1]])
         with self._lock:
             meta = self._load_meta()
+            if not batch.ids_explicit:
+                # auto ids rebase on a per-schema monotonic counter kept
+                # in the metadata: per-write 0..n-1 ids would collide
+                # across writes (every partition file would restart at 0)
+                base = int(meta.get("next_fid", self.count()))
+                batch = FeatureBatch(
+                    batch.sft, dict(batch.columns), geoms=batch.geoms,
+                    ids=np.array([str(base + i) for i in range(len(batch))],
+                                 dtype=object))
+                meta["next_fid"] = base + len(batch)
             for s, e in zip(bounds, np.r_[bounds[1:], len(sorted_names)]):
                 part = str(sorted_names[s])
                 sub = batch.take(order[s:e])

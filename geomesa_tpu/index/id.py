@@ -21,7 +21,18 @@ class IdIndex:
     def build(cls, ids) -> "IdIndex":
         ids = np.asarray(ids).astype(str)
         order = np.argsort(ids, kind="stable")
-        return cls(ids[order], order.astype(np.int64))
+        srt = ids[order]
+        if len(srt) > 1:
+            dup = srt[1:] == srt[:-1]
+            if dup.any():
+                # ids identify exactly one row (the reference's id
+                # generators never reuse ids); a duplicate here means a
+                # broken writer upstream — failing beats silently
+                # returning two rows for one id
+                raise ValueError(
+                    f"duplicate feature id {srt[1:][dup][0]!r}: feature "
+                    "ids must be unique within a schema")
+        return cls(srt, order.astype(np.int64))
 
     def __len__(self) -> int:
         return len(self.ids)

@@ -26,13 +26,24 @@ _TOTAL_SPLIT = 30
 def coded_pos_bits(n_rows: int, n_queries: int) -> int:
     """Wire coding for multi-window scans: bits reserved for the position
     field of the ``qid << pos_bits | pos`` code.  Prefers an
-    int32-fitting layout (qid_bits + pos_bits <= 31); falls back to the
-    40-bit int64 layout for huge shards.  :func:`wire_dtype` maps the
-    result to the wire dtype — keep the two in sync via this module."""
+    int32-fitting layout (qid_bits + pos_bits <= 31); falls back to a
+    40-bit int64 layout for huge shards, widening further for position
+    spans beyond 2^40 (multihost gids code ``process << 40 | row``, so
+    their span needs ``40 + proc_bits`` position bits — truncating to 40
+    would bleed process bits into the qid field).  :func:`wire_dtype`
+    maps the result to the wire dtype — keep the two in sync via this
+    module."""
     import numpy as np
     pos_bits = max(1, int(np.ceil(np.log2(max(2, n_rows)))))
     qid_bits = max(1, int(np.ceil(np.log2(max(2, n_queries)))))
-    return pos_bits if pos_bits + qid_bits <= 31 else 40
+    if pos_bits + qid_bits <= 31:
+        return pos_bits
+    pos_bits = max(40, pos_bits)
+    if pos_bits + qid_bits > 63:
+        raise ValueError(
+            f"coded layout overflow: {pos_bits} position bits + "
+            f"{qid_bits} query bits exceed int64 — batch fewer windows")
+    return pos_bits
 
 
 def wire_dtype(pos_bits: int):

@@ -607,9 +607,15 @@ class ShardedZ3Index:
             np.concatenate(ixy), np.concatenate(boxes),
             pad_pow2(sum(len(b) for b in boxes), minimum=1),
             np.concatenate(bqid))
-        # gid space: multihost gids use the full coded range
-        gid_span = (self._n_total if self._shard_counts is not None
-                    else 1 << (GID_PROC_SHIFT + 8))
+        # gid space: multihost gids code process<<GID_PROC_SHIFT|row, so
+        # their span is GID_PROC_SHIFT + proc_bits — coded_pos_bits must
+        # see the full span or process bits would bleed into qids
+        if self._shard_counts is not None:
+            gid_span = self._n_total
+        else:
+            proc_bits = max(1, int(np.ceil(np.log2(
+                max(2, jax.process_count())))))
+            gid_span = 1 << (GID_PROC_SHIFT + proc_bits)
         from ..ops.search import coded_pos_bits
         pos_bits = coded_pos_bits(gid_span, n_q)
         capacity = self._capacity

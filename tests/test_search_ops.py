@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from geomesa_tpu.ops import expand_ranges, searchsorted2
 
@@ -71,6 +72,12 @@ def test_coded_pos_bits_boundaries():
     assert coded_pos_bits(1 << 21, 1 << 11) == 40
     assert coded_pos_bits(2, 2) == 1
     assert coded_pos_bits((1 << 40), 2) == 40
+    # multihost gids span > 2^40 (process << 40 | row): the layout must
+    # widen, not truncate process bits into the qid field
+    assert coded_pos_bits(1 << 41, 4) == 41
+    assert coded_pos_bits(1 << 42, 1 << 21) == 42
+    with pytest.raises(ValueError, match="coded layout overflow"):
+        coded_pos_bits(1 << 60, 1 << 10)
 
 
 def test_query_many_int64_wire_path(monkeypatch):
