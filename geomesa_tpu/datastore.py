@@ -105,9 +105,20 @@ class _SchemaStore:
     laptop-to-cluster property (GeoMesaDataStore.scala:48-431 +
     ShardStrategy.scala:17-75 applied uniformly)."""
 
-    def __init__(self, sft: FeatureType, mesh=None):
+    def __init__(self, sft: FeatureType, mesh=None, multihost: bool = False):
         self.sft = sft
         self.mesh = mesh
+        #: multihost mode: this process holds only ITS rows in ``batch``;
+        #: indexes build via the build_multihost variants (gids code
+        #: process << GID_PROC_SHIFT | local_row), every store operation
+        #: is a collective all processes enter together (SPMD), and
+        #: residual filtering runs per process on gid-decoded local
+        #: candidates — no process ever materializes the full dataset
+        #: (GeoMesaDataStore.scala:48 data-lives-on-the-cluster property)
+        self.multihost = bool(multihost and mesh is not None)
+        #: bumped on every mutation; versions the merged-stats cache
+        self._mutation_version = 0
+        self._merged_stats: tuple[int, dict] | None = None
         #: per-index key-layout versions (versioned indices: reads of
         #: old catalogs keep their recorded layout; see migrate_schema)
         self.index_versions: dict = _parse_index_versions(sft.user_data)
@@ -124,6 +135,9 @@ class _SchemaStore:
         #: delete, so ids are never reused (the reference's generators
         #: never recycle ids, utils/uuid/Z3FeatureIdGenerator.scala)
         self.next_fid: int = 0
+        #: lazily-built id set for O(m) explicit-id collision checks
+        #: (built on the first explicit-id write, maintained after)
+        self._id_set: set | None = None
         self._init_stats()
 
     def _init_stats(self):
@@ -164,6 +178,9 @@ class _SchemaStore:
             self.attr_visibilities[attr] = col
         for s in self._stats.values():
             s.observe(batch)
+        if self._id_set is not None:
+            self._id_set.update(batch.ids.astype(str).tolist())
+        self._mutation_version += 1
         self._vis_masks: dict = {}
         # incremental z3 maintenance: appended rows merge into the
         # resident sorted columns in one gather pass (BatchWriter-style)
@@ -237,7 +254,72 @@ class _SchemaStore:
         return cache[key]
 
     def stats_map(self) -> dict:
-        return self._stats
+        """Planning/stat sketches.  Multihost: the per-process sketches
+        merge through the Stat monoid into one GLOBAL view (cached per
+        mutation) — cost-based strategy decisions must be identical on
+        every process or collective dispatch would diverge."""
+        if not self.multihost:
+            return self._stats
+        import jax
+        if jax.process_count() == 1:
+            return self._stats
+        if (self._merged_stats is not None
+                and self._merged_stats[0] == self._mutation_version):
+            return self._merged_stats[1]
+        from .parallel.multihost import allgather_strings
+        payload = json.dumps({k: s.to_json()
+                              for k, s in self._stats.items()})
+        merged: dict[str, Stat] = {}
+        for blob in allgather_strings(np.array([payload], dtype=object)):
+            for k, sj in json.loads(blob).items():
+                st = stat_from_json(sj)
+                merged[k] = st if k not in merged else merged[k] + st
+        self._merged_stats = (self._mutation_version, merged)
+        return merged
+
+    # -- multihost row identity -------------------------------------------
+    def local_rows_of(self, gids: np.ndarray) -> np.ndarray:
+        """Rows of THIS process among global candidate gids (multihost:
+        decode ``process << GID_PROC_SHIFT | local_row``; single
+        controller: identity)."""
+        if not self.multihost:
+            return gids
+        import jax
+        from .parallel.scan import decode_gids
+        procs, rows = decode_gids(gids)
+        return rows[procs == jax.process_index()]
+
+    def gids_of(self, rows: np.ndarray) -> np.ndarray:
+        """Global gids of this process's rows (inverse of
+        local_rows_of)."""
+        if not self.multihost:
+            return rows
+        from .parallel.scan import encode_gids
+        return encode_gids(rows)
+
+    def to_global_candidates(self, rows: np.ndarray) -> np.ndarray:
+        """Lift host-index results (id index: per-process local rows)
+        into the global candidate space: encode + allgather.  Identity
+        for single-controller stores."""
+        if not self.multihost:
+            return rows
+        from .parallel.multihost import allgather_concat
+        return np.sort(allgather_concat(self.gids_of(rows)))
+
+    def merge_stat_global(self, s: Stat) -> Stat:
+        """Merge one per-process stat through the monoid across all
+        processes (used for restricted-caller re-observations, which are
+        computed over local rows)."""
+        import jax
+        if not self.multihost or jax.process_count() == 1:
+            return s
+        from .parallel.multihost import allgather_strings
+        merged = None
+        for blob in allgather_strings(
+                np.array([json.dumps(s.to_json())], dtype=object)):
+            st = stat_from_json(json.loads(blob))
+            merged = st if merged is None else merged + st
+        return merged
 
     def recompute_stats(self) -> None:
         """Rebuild every sketch from the current rows (sketches are not
@@ -332,7 +414,9 @@ class _SchemaStore:
         dtg = self.batch.column(self.sft.dtg_field)
         if self.mesh is not None:
             from .parallel.scan import ShardedZ3Index
-            return ShardedZ3Index.build(
+            builder = (ShardedZ3Index.build_multihost if self.multihost
+                       else ShardedZ3Index.build)
+            return builder(
                 np.asarray(x), np.asarray(y), dtg,
                 period=self.sft.z3_interval, mesh=self.mesh,
                 version=self.index_versions["z3"])
@@ -345,7 +429,9 @@ class _SchemaStore:
         x, y = self.batch.geom_xy()
         if self.mesh is not None:
             from .parallel.z2 import ShardedZ2Index
-            return ShardedZ2Index.build(
+            builder = (ShardedZ2Index.build_multihost if self.multihost
+                       else ShardedZ2Index.build)
+            return builder(
                 np.asarray(x), np.asarray(y), mesh=self.mesh,
                 version=self.index_versions["z2"])
         xd, yd = self.device_xy()
@@ -356,7 +442,9 @@ class _SchemaStore:
         dtg = self.batch.column(self.sft.dtg_field)
         if self.mesh is not None:
             from .parallel.xz import ShardedXZ3Index
-            return ShardedXZ3Index.build(
+            builder = (ShardedXZ3Index.build_multihost if self.multihost
+                       else ShardedXZ3Index.build)
+            return builder(
                 self.batch.geoms, dtg, period=self.sft.z3_interval,
                 g=self.sft.xz_precision, mesh=self.mesh)
         return XZ3Index.build(self.batch.geoms, dtg,
@@ -366,7 +454,9 @@ class _SchemaStore:
     def _build_xz2(self):
         if self.mesh is not None:
             from .parallel.xz import ShardedXZ2Index
-            return ShardedXZ2Index.build(
+            builder = (ShardedXZ2Index.build_multihost if self.multihost
+                       else ShardedXZ2Index.build)
+            return builder(
                 self.batch.geoms, g=self.sft.xz_precision, mesh=self.mesh)
         return XZ2Index.build(self.batch.geoms, g=self.sft.xz_precision)
 
@@ -408,7 +498,10 @@ class _SchemaStore:
                     np.asarray(self.batch.column(self.sft.dtg_field),
                                np.int64)
                     if self.sft.dtg_field else None)
-                self._indexes[key] = ShardedAttributeIndex.build(
+                builder = (ShardedAttributeIndex.build_multihost
+                           if self.multihost
+                           else ShardedAttributeIndex.build)
+                self._indexes[key] = builder(
                     attr, self.batch.column(attr), secondary=secondary,
                     mesh=self.mesh)
                 return self._indexes[key]
@@ -443,14 +536,25 @@ class TpuDataStore:
     """In-process spatio-temporal datastore over columnar TPU indexes."""
 
     def __init__(self, catalog_dir: str | None = None, *,
-                 mesh=None, auth_provider=None, audit_writer=None,
-                 user: str = "unknown"):
+                 mesh=None, multihost: bool = False, auth_provider=None,
+                 audit_writer=None, user: str = "unknown"):
         """``mesh``: an optional ``jax.sharding.Mesh``; when given, every
         index builds its sharded variant and all scans run as collectives
         over the mesh — the same facade, laptop-to-pod (the reference's
-        GeoMesaDataStore property, geotools/GeoMesaDataStore.scala:48)."""
+        GeoMesaDataStore property, geotools/GeoMesaDataStore.scala:48).
+
+        ``multihost``: multi-controller mode — every process runs the
+        same store program (SPMD) but feeds only its LOCAL rows to
+        ``write``; no process ever holds the full dataset.  Query
+        results return each process's local slice of the hits plus the
+        global gid list (``QueryResult.positions`` codes
+        ``process << GID_PROC_SHIFT | local_row``).  Requires ``mesh``
+        (usually ``global_device_mesh()``)."""
+        if multihost and mesh is None:
+            raise ValueError("multihost=True requires a mesh")
         self._schemas: dict[str, _SchemaStore] = {}
         self._mesh = mesh
+        self._multihost = multihost
         self._catalog_dir = catalog_dir
         self._auth_provider = auth_provider
         self._audit_writer = audit_writer
@@ -526,7 +630,8 @@ class TpuDataStore:
                 raise ValueError(
                     f"schema {sft.name!r} already exists in the catalog "
                     "(created by another process)")
-            self._schemas[sft.name] = _SchemaStore(sft, mesh=self._mesh)
+            self._schemas[sft.name] = _SchemaStore(sft, mesh=self._mesh,
+                                         multihost=self._multihost)
             self._persist_schema(sft)
         return sft
 
@@ -627,10 +732,18 @@ class TpuDataStore:
                 # monotonic counter, NOT len(batch): deletes shrink the
                 # batch but minted ids must never come back (delete 2 of
                 # 4 then write 2 → reused ids '2','3' would make id-index
-                # lookups and delete-by-id hit two rows each)
+                # lookups and delete-by-id hit two rows each).  Multihost
+                # processes each mint from their own prefixed sequence —
+                # no cross-process coordination, no collisions.
                 base = store.next_fid
+                prefix = ""
+                if store.multihost:
+                    import jax
+                    if jax.process_count() > 1:
+                        prefix = f"p{jax.process_index()}."
                 new_ids = np.array(
-                    [str(base + i) for i in range(len(batch))], dtype=object)
+                    [f"{prefix}{base + i}" for i in range(len(batch))],
+                    dtype=object)
             batch = FeatureBatch(
                 batch.sft, dict(batch.columns), geoms=batch.geoms,
                 ids=new_ids)
@@ -641,18 +754,47 @@ class TpuDataStore:
             # build, deep inside a later query — would permanently break
             # the schema's id queries long after the bad write)
             ids_in = batch.ids.astype(str)
+            err = ""
             uniq, counts = np.unique(ids_in, return_counts=True)
             if (counts > 1).any():
-                raise ValueError(
-                    f"duplicate feature id {uniq[counts > 1][0]!r} "
-                    "within the write batch")
-            if store.batch is not None and len(store.batch):
-                clash = np.isin(ids_in, store.batch.ids.astype(str))
-                if clash.any():
-                    raise ValueError(
-                        f"feature id {ids_in[clash][0]!r} already exists "
-                        f"in schema {name!r} (delete it first, or use "
-                        "auto-generated ids)")
+                err = (f"duplicate feature id {uniq[counts > 1][0]!r} "
+                       "within the write batch")
+            elif store.batch is not None and len(store.batch):
+                # incrementally-maintained id set: a small append to a
+                # huge schema must not rescan every stored id
+                if store._id_set is None:
+                    store._id_set = set(store.batch.ids.astype(str)
+                                        .tolist())
+                clash = next((i for i in ids_in if i in store._id_set),
+                             None)
+                if clash is not None:
+                    err = (f"feature id {clash!r} already exists in "
+                           f"schema {name!r} (delete it first, or use "
+                           "auto-generated ids)")
+            if store.multihost:
+                # collective validation: cross-process duplicates within
+                # the write, and an AGREED raise — a one-sided exception
+                # would desync the SPMD store at its next collective
+                import jax
+                if jax.process_count() > 1:
+                    from .parallel.multihost import allgather_strings
+                    if not err:
+                        g_ids = allgather_strings(ids_in)
+                        gu, gc = np.unique(g_ids, return_counts=True)
+                        dup_here = np.isin(ids_in, gu[gc > 1])
+                        if dup_here.any() or (gc > 1).any():
+                            bad = gu[gc > 1][0] if (gc > 1).any() else ""
+                            err = (f"duplicate feature id {bad!r} across "
+                                   "processes in the write batch")
+                    else:
+                        allgather_strings(ids_in)  # keep collectives
+                    errs = [e for e in allgather_strings(
+                        np.array([err], dtype=object)) if e]
+                    if errs:
+                        raise ValueError(errs[0])
+                    err = ""
+            if err:
+                raise ValueError(err)
             # numeric-id max computed BEFORE the append so a parse issue
             # can never leave the store mutated with the counter behind
             next_fid = max(store.next_fid, _max_numeric_id(batch.ids) + 1)
@@ -668,21 +810,39 @@ class TpuDataStore:
         removeFeatures path).  Stats are recomputed from the surviving
         rows — sketches are not invertible."""
         store = self._store(name)
-        if store.batch is None or len(store.batch) == 0:
+        n_here = 0 if store.batch is None else len(store.batch)
+        if n_here == 0 and not store.multihost:
             return 0
-        drop = set(str(i) for i in np.atleast_1d(np.asarray(ids, dtype=object)))
-        keep = np.array([str(i) not in drop for i in store.batch.ids])
-        removed = int((~keep).sum())
-        if removed == 0:
-            return 0
-        store.batch = store.batch.take(np.flatnonzero(keep))
-        if store.visibilities is not None:
-            store.visibilities = store.visibilities[keep]
-        for attr in list(store.attr_visibilities):
-            store.attr_visibilities[attr] = store.attr_visibilities[attr][keep]
-        store._vis_masks = {}
-        store._dirty = True
-        store.recompute_stats()
+        removed = 0
+        if n_here:
+            drop = set(str(i)
+                       for i in np.atleast_1d(np.asarray(ids, dtype=object)))
+            keep = np.array([str(i) not in drop for i in store.batch.ids])
+            removed = int((~keep).sum())
+            if removed:
+                if store._id_set is not None:
+                    store._id_set.difference_update(
+                        str(i) for i in store.batch.ids[~keep])
+                store.batch = store.batch.take(np.flatnonzero(keep))
+                if store.visibilities is not None:
+                    store.visibilities = store.visibilities[keep]
+                for attr in list(store.attr_visibilities):
+                    store.attr_visibilities[attr] = \
+                        store.attr_visibilities[attr][keep]
+                store._vis_masks = {}
+                store._dirty = True
+                store._mutation_version += 1
+                store.recompute_stats()
+        if store.multihost:
+            # collective: every process drops its local matches; removal
+            # anywhere invalidates gid row-order everywhere, and the
+            # returned count is global
+            from .parallel.multihost import agreed_int
+            global_removed = agreed_int(removed, "sum")
+            if global_removed and not removed:
+                store._dirty = True
+                store._mutation_version += 1
+            return global_removed
         return removed
 
     # -- query ------------------------------------------------------------
@@ -696,12 +856,19 @@ class TpuDataStore:
         q = query if isinstance(query, Query) else Query.of(query)
         q = self._intercept(store.sft, q)
         if store.batch is None or len(store.batch) == 0:
-            empty = FeatureBatch.empty(store.sft)
-            from .planning.strategy import FilterStrategy
-            result = QueryResult(empty, np.empty(0, dtype=np.int64),
-                                 FilterStrategy("none", 0), 0.0, 0.0)
-            self._audit(name, q, result)
-            return result
+            if store.multihost:
+                # a locally-empty process must still ENTER the planner's
+                # collectives (other processes may hold rows); an empty
+                # local batch feeds zero rows to the sharded builds
+                if store.batch is None:
+                    store.batch = FeatureBatch.empty(store.sft)
+            else:
+                empty = FeatureBatch.empty(store.sft)
+                from .planning.strategy import FilterStrategy
+                result = QueryResult(empty, np.empty(0, dtype=np.int64),
+                                     FilterStrategy("none", 0), 0.0, 0.0)
+                self._audit(name, q, result)
+                return result
         allowed = None
         eval_store = store
         if self._auth_provider is not None:
@@ -836,7 +1003,14 @@ class TpuDataStore:
         allowed = (store.vis_mask(self._auth_provider.get_authorizations())
                    if self._auth_provider is not None else None)
         if allowed is not None:
-            hits = [h[allowed[h]] for h in hits]
+            if store.multihost:
+                # gids → per-process local rows → mask → allgather back
+                from .parallel.multihost import allgather_concat
+                hits = [np.sort(allgather_concat(store.gids_of(
+                            r[allowed[r]])))
+                        for r in (store.local_rows_of(h) for h in hits)]
+            else:
+                hits = [h[allowed[h]] for h in hits]
         from .metrics import registry as _metrics
         _metrics.counter(f"query.{name}.windows").inc(len(windows))
         if self._audit_writer is not None:
@@ -858,10 +1032,22 @@ class TpuDataStore:
     def _restricted_mask(self, store: _SchemaStore) -> np.ndarray | None:
         """Visibility mask when this caller cannot see every row (stats are
         observed over ALL writes, so restricted callers must not read them
-        directly — that would leak counts/values/extents of hidden rows)."""
-        if self._auth_provider is None or store.batch is None:
+        directly — that would leak counts/values/extents of hidden rows).
+
+        Multihost: the restricted/unrestricted decision must be AGREED —
+        one process's rows may all be visible while another's are not,
+        and the restricted path runs collectives; a divergent decision
+        would hang the store."""
+        if self._auth_provider is None:
             return None
-        return store.vis_mask(self._auth_provider.get_authorizations())
+        mask = (store.vis_mask(self._auth_provider.get_authorizations())
+                if store.batch is not None else None)
+        if store.multihost:
+            from .parallel.multihost import agreed_int
+            if agreed_int(0 if mask is None else 1, "max") and mask is None:
+                mask = np.ones(0 if store.batch is None
+                               else len(store.batch), dtype=bool)
+        return mask
 
     def get_count(self, name: str, query=None) -> int:
         store = self._store(name)
@@ -869,31 +1055,53 @@ class TpuDataStore:
             return len(self.query(name, query))
         mask = self._restricted_mask(store)
         if mask is not None:
-            return int(mask.sum())
-        return store._stats["count"].count
+            n = int(mask.sum())
+            if store.multihost:
+                from .parallel.multihost import agreed_int
+                n = agreed_int(n, "sum")
+            return n
+        # multihost: stats_map merges per-process sketches → global count
+        return store.stats_map()["count"].count
 
     def get_bounds(self, name: str):
         store = self._store(name)
-        if store.batch is None or len(store.batch) == 0:
+        n_here = 0 if store.batch is None else len(store.batch)
+        if n_here == 0 and not store.multihost:
             return None
-        bb = store.batch.geom_bbox()
-        mask = self._restricted_mask(store)
-        if mask is not None:
-            if not mask.any():
-                return None
-            bb = bb[mask]
+        if n_here:
+            bb = store.batch.geom_bbox()
+            mask = self._restricted_mask(store)
+            if mask is not None:
+                bb = bb[mask] if mask.any() else bb[:0]
+        else:
+            bb = np.empty((0, 4))
+        if store.multihost:
+            # collective min/max over the per-process local extents
+            from .parallel.multihost import allgather_concat
+            local = (np.array([[bb[:, 0].min(), bb[:, 1].min(),
+                                bb[:, 2].max(), bb[:, 3].max()]])
+                     if len(bb) else np.empty((0, 4)))
+            bb = allgather_concat(local)
+        if not len(bb):
+            return None
         from .geometry.types import Envelope
         return Envelope(float(bb[:, 0].min()), float(bb[:, 1].min()),
                         float(bb[:, 2].max()), float(bb[:, 3].max()))
 
     def _attr_guarded(self, store: _SchemaStore, attr: str) -> bool:
-        """True when this caller cannot see every value of the attribute."""
-        if self._auth_provider is None or attr not in store.attr_visibilities:
-            return False
-        from .security import visibility_mask
-        return not visibility_mask(
-            store.attr_visibilities[attr],
-            self._auth_provider.get_authorizations()).all()
+        """True when this caller cannot see every value of the attribute.
+        Multihost: agreed across processes (any process guarded → all
+        treat it guarded) so downstream collectives never diverge."""
+        guarded = False
+        if self._auth_provider is not None and attr in store.attr_visibilities:
+            from .security import visibility_mask
+            guarded = not visibility_mask(
+                store.attr_visibilities[attr],
+                self._auth_provider.get_authorizations()).all()
+        if store.multihost and self._auth_provider is not None:
+            from .parallel.multihost import agreed_int
+            guarded = bool(agreed_int(int(guarded), "max"))
+        return guarded
 
     def get_attribute_bounds(self, name: str, attr: str):
         store = self._store(name)
@@ -902,10 +1110,18 @@ class TpuDataStore:
         mask = self._restricted_mask(store)
         if mask is not None:
             col = store.batch.column(attr)[mask]
+            if store.multihost:
+                from .parallel.multihost import allgather_concat
+                pairs = (np.array([[col.min(), col.max()]])
+                         if len(col) else np.empty((0, 2)))
+                pairs = allgather_concat(np.asarray(pairs, np.float64))
+                if not len(pairs):
+                    return None
+                return pairs[:, 0].min(), pairs[:, 1].max()
             if not len(col):
                 return None
             return col.min(), col.max()
-        mm = store._stats.get(f"{attr}_minmax")
+        mm = store.stats_map().get(f"{attr}_minmax")
         return None if mm is None or mm.is_empty else mm.bounds
 
     def stat(self, name: str, key: str) -> Stat | None:
@@ -913,17 +1129,19 @@ class TpuDataStore:
         sketches (observed over all rows) are recomputed over the visible
         subset so hidden values cannot leak through TopK/enumeration."""
         store = self._store(name)
-        attr = getattr(store._stats.get(key), "attr", None)
+        stats = store.stats_map()  # multihost: globally merged
+        attr = getattr(stats.get(key), "attr", None)
         if attr and self._attr_guarded(store, attr):
             return None
         mask = self._restricted_mask(store)
-        s = store._stats.get(key)
+        s = stats.get(key)
         if mask is None or s is None:
             return s
-        # rebuild the same stat type over the visible rows only
+        # rebuild the same stat type over the visible rows only;
+        # multihost merges the per-process re-observations globally
         fresh = s.fresh_copy()
         fresh.observe(store.batch.take(np.flatnonzero(mask)))
-        return fresh
+        return store.merge_stat_global(fresh)
 
     # -- metadata catalog persistence -------------------------------------
     def _persist_schema(self, sft: FeatureType) -> None:
@@ -1033,6 +1251,7 @@ class TpuDataStore:
             from .io.export import from_parquet
             store = self._schemas[name]
             store.batch = from_parquet(path, store.sft)
+            store._id_set = None  # rebuilt lazily from the loaded rows
             store.next_fid = _max_numeric_id(store.batch.ids) + 1
             store._dirty = True
             vis_path = os.path.join(self._catalog_dir, f"{name}.vis.json")
@@ -1067,7 +1286,8 @@ class TpuDataStore:
                 except FileNotFoundError:
                     continue  # removed by a concurrent process mid-listing
                 sft = parse_spec(meta["name"], meta["spec"])
-                store = _SchemaStore(sft, mesh=self._mesh)
+                store = _SchemaStore(sft, mesh=self._mesh,
+                                         multihost=self._multihost)
                 # recorded layout versions win over spec defaults; v1
                 # (pre-versioning) catalogs were written with the then-
                 # current layouts, which match today's defaults

@@ -92,7 +92,8 @@ class ShardedAttributeIndex:
     DEFAULT_CAPACITY = 1 << 14
 
     def __init__(self, mesh: Mesh, attr: str, uniques: np.ndarray,
-                 ranks, sec, gid, n_total: int, has_secondary: bool):
+                 ranks, sec, gid, n_total: int, has_secondary: bool,
+                 multihost: bool = False):
         self.mesh = mesh
         self.attr = attr
         self.uniques = uniques      # host dictionary, sorted
@@ -101,6 +102,7 @@ class ShardedAttributeIndex:
         self.gid = gid
         self._n_total = n_total
         self.has_secondary = has_secondary
+        self._multihost = multihost
         self._capacity = self.DEFAULT_CAPACITY
         #: parity with the single-chip AttributeIndex attributes the
         #: planner probes (attribute.py): no z3 tier on the mesh
@@ -124,6 +126,42 @@ class ShardedAttributeIndex:
         rk_s, sec_s, gid_s = _attr_build_program(mesh)(*sharded, valid)
         return cls(mesh, attr, uniques, rk_s, sec_s, gid_s, n,
                    has_secondary=secondary is not None)
+
+    @classmethod
+    def build_multihost(cls, attr: str, column: np.ndarray, secondary=None,
+                        mesh: Mesh | None = None) -> "ShardedAttributeIndex":
+        """Multi-controller build from per-process LOCAL columns.
+
+        The rank dictionary must be GLOBAL (the same value must map to
+        the same rank everywhere), so local unique values allgather and
+        re-unique — bounded by value cardinality, never row count; rows
+        themselves feed only locally (process_local_shard), gids code
+        ``process << GID_PROC_SHIFT | local_row``."""
+        import jax
+        from .multihost import (
+            agreed_int, allgather_concat, allgather_strings,
+            global_device_mesh, process_local_shard,
+        )
+        from .scan import encode_gids
+        mesh = mesh or global_device_mesh()
+        col = np.asarray(column)
+        if col.dtype == object:
+            col = col.astype(str)
+        local_uniques = np.unique(col)
+        gathered = (allgather_strings(local_uniques)
+                    if local_uniques.dtype.kind in ("U", "S")
+                    else allgather_concat(local_uniques))
+        uniques = np.unique(gathered)
+        ranks = np.searchsorted(uniques, col).astype(np.int64)
+        n_local = len(col)
+        sec = (np.asarray(secondary, dtype=np.int64) if secondary is not None
+               else np.zeros(n_local, dtype=np.int64))
+        gids = encode_gids(np.arange(n_local, dtype=np.int64))
+        sharded, valid = process_local_shard(mesh, ranks, sec, gids)
+        rk_s, sec_s, gid_s = _attr_build_program(mesh)(*sharded, valid)
+        return cls(mesh, attr, uniques, rk_s, sec_s, gid_s,
+                   agreed_int(n_local, "sum"),
+                   has_secondary=secondary is not None, multihost=True)
 
     def __len__(self) -> int:
         return self._n_total

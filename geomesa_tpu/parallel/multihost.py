@@ -36,7 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import pad_to_multiple
 
 __all__ = ["initialize_distributed", "global_device_mesh",
-           "process_local_shard"]
+           "process_local_shard", "allgather_concat", "allgather_strings",
+           "global_shard_counts", "agreed_int", "local_device_count"]
 
 
 def initialize_distributed(coordinator_address: str | None = None,
@@ -79,20 +80,135 @@ def _agreed_padded_local(n_local: int, n_local_shards: int) -> int:
     return ((n_local + n_local_shards - 1) // n_local_shards) * n_local_shards
 
 
-def process_local_shard(mesh: Mesh, *arrays, axis: str = "shard"):
+def local_device_count(mesh: Mesh) -> int:
+    """Devices of THIS process in the mesh (its local shard count)."""
+    me = jax.process_index()
+    return max(1, sum(1 for d in mesh.devices.flat if d.process_index == me))
+
+
+def agreed_int(value: int, op: str = "sum") -> int:
+    """Collectively agree an integer across processes (``sum``/``max``/
+    ``min`` of the per-process values).  Single-process: identity.  Used
+    wherever every process must reach the same decision (append slot
+    sizing, capacity growth, totals) from per-process inputs."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+    vals = np.asarray(multihost_utils.process_allgather(np.int64(value)))
+    return int({"sum": vals.sum, "max": vals.max, "min": vals.min}[op]())
+
+
+def allgather_concat(a: np.ndarray) -> np.ndarray:
+    """Concatenate per-process host arrays of UNEQUAL lengths in process
+    order (pad-to-max allgather + strip).  The host-side merge step for
+    per-process partial results — residual-filter survivors, local hit
+    lists — bounded by the result size, never the dataset."""
+    a = np.asarray(a)
+    if jax.process_count() == 1:
+        return a
+    from jax.experimental import multihost_utils
+    lens = np.asarray(multihost_utils.process_allgather(np.int64(len(a))))
+    m = int(lens.max())
+    if m == 0:
+        return a[:0]
+    pad = np.zeros((m,) + a.shape[1:], dtype=a.dtype)
+    pad[: len(a)] = a
+    stacked = np.asarray(multihost_utils.process_allgather(pad))
+    stacked = stacked.reshape((len(lens), m) + a.shape[1:])
+    return np.concatenate([stacked[p, : lens[p]] for p in range(len(lens))])
+
+
+def allgather_strings(arr: np.ndarray) -> np.ndarray:
+    """Concatenate per-process STRING arrays across processes.
+
+    ``process_allgather`` only moves numeric arrays, so strings travel
+    as a NUL-terminated UTF-8 byte blob through :func:`allgather_concat`
+    (dictionary exchange for the attribute index — bounded by value
+    cardinality, not row count)."""
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return arr
+    blob = "".join(s + "\x00" for s in arr.astype(str).tolist())
+    data = np.frombuffer(blob.encode("utf-8"), dtype=np.uint8)
+    merged = allgather_concat(data)
+    text = merged.tobytes().decode("utf-8")
+    parts = text.split("\x00")[:-1] if text else []
+    if not parts:
+        return arr[:0]
+    return (np.asarray(parts, dtype=object) if arr.dtype == object
+            else np.asarray(parts))
+
+
+def global_shard_counts(n_local: int, mesh: Mesh,
+                        m_per: int | None = None) -> np.ndarray:
+    """Per-shard valid row counts for the process-contiguous block layout
+    of :func:`process_local_shard`, identical on every process.
+
+    Each process's ``n_local`` rows fill its local shards front-to-back
+    in blocks of the agreed per-shard length; the global counts vector
+    concatenates the per-process fills in process (= mesh device) order.
+    ``m_per`` overrides the agreed per-shard block length (used by
+    append, which sizes blocks from the append batch)."""
+    local_shards = local_device_count(mesh)
+    if jax.process_count() == 1:
+        per = m_per if m_per is not None else (
+            _agreed_padded_local(n_local, local_shards) // local_shards)
+        per = max(per, 1)
+        return np.clip(n_local - np.arange(local_shards) * per,
+                       0, per).astype(np.int64)
+    from jax.experimental import multihost_utils
+    counts = np.asarray(multihost_utils.process_allgather(np.int64(n_local)))
+    per = m_per if m_per is not None else (
+        _agreed_padded_local(n_local, local_shards) // local_shards)
+    per = max(per, 1)
+    out = [np.clip(int(c) - np.arange(local_shards) * per, 0, per)
+           for c in counts]
+    return np.concatenate(out).astype(np.int64)
+
+
+def agree_append_layout(mesh: Mesh, m_local: int,
+                        minimum: int = 8) -> tuple[int, int, int]:
+    """Collectively agree the per-shard slot count for a multihost
+    append: sized from the LARGEST process load so the append program
+    (and the grow decision derived from it) is identical everywhere.
+    Returns ``(m_per_shard, slots_local, local_shards)``.  Shared by
+    every sharded index's _append_multihost — the slot agreement must
+    never drift between index types."""
+    from ..ops.search import gather_capacity
+    local_shards = local_device_count(mesh)
+    m_per = gather_capacity(
+        agreed_int(-(-max(m_local, 1) // local_shards), "max"),
+        minimum=minimum)
+    return m_per, m_per * local_shards, local_shards
+
+
+def sharded_counts_array(mesh: Mesh, shard_counts: np.ndarray):
+    """Device (n_shards,) int32 array of the agreed per-shard valid
+    counts, each process feeding its own block — the ``r`` operand of
+    the append programs."""
+    local_shards = local_device_count(mesh)
+    proc = jax.process_index()
+    r_local = shard_counts[
+        proc * local_shards:(proc + 1) * local_shards].astype(np.int32)
+    return process_local_shard(mesh, r_local,
+                               padded_local=local_shards)[0][0]
+
+
+def process_local_shard(mesh: Mesh, *arrays, axis: str = "shard",
+                        padded_local: int | None = None):
     """Assemble global sharded arrays from per-process local rows.
 
     Each process passes only ITS rows; the result is a global jax.Array
     laid out along the mesh's shard axis as ``process_count`` blocks of
     one agreed padded length (see module doc for position semantics).
     Returns ``(global_arrays, valid_mask)`` where the mask marks real
-    rows.
+    rows.  ``padded_local`` overrides the agreed per-process block
+    length (callers that already collectively agreed one, e.g. append).
     """
-    n_local_shards = sum(
-        1 for d in mesh.devices.flat if d.process_index == jax.process_index())
-    n_local_shards = max(1, n_local_shards)
+    n_local_shards = local_device_count(mesh)
     n = len(arrays[0])
-    padded_n = _agreed_padded_local(n, n_local_shards)
+    padded_n = (padded_local if padded_local is not None
+                else _agreed_padded_local(n, n_local_shards))
     global_n = padded_n * max(1, jax.process_count())
     sharding = NamedSharding(mesh, P(axis))
 

@@ -95,17 +95,19 @@ def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
     prog = _moments_program(idx.mesh, int(hist_bins), with_values)
     args = [idx.x, idx.y, idx.dtg, idx.gid]
     if with_values:
-        from .scan import GID_PROC_SHIFT
-        table = jnp.asarray(np.asarray(values, np.float64))
-        # per-shard gather from the replicated table by gid
-        mask_bits = (jnp.int64(1) << GID_PROC_SHIFT) - 1
+        # per-shard gather from the replicated table by gid, offset by
+        # per-process row bases under multihost (each process passes its
+        # LOCAL rows' values; see ShardedZ3Index._weight_table)
+        from .scan import gid_weight_lookup
+        table, bases = idx._weight_table(values)
 
         @partial(shard_map, mesh=idx.mesh,
-                 in_specs=(P("shard"), P(None)), out_specs=P("shard"))
-        def gather(gs, tab):
-            return tab[jnp.maximum(gs.astype(jnp.int64) & mask_bits, 0)]
+                 in_specs=(P("shard"), P(None), P(None)),
+                 out_specs=P("shard"))
+        def gather(gs, tab, bs):
+            return gid_weight_lookup(gs, tab, bs)
 
-        args.append(jax.jit(gather)(idx.gid, table))
+        args.append(jax.jit(gather)(idx.gid, table, bases))
     args.append(jnp.asarray(boxes))
     out = prog(*args, jnp.int64(t_lo_ms), jnp.int64(t_hi_ms),
                jnp.float64(h_lo), jnp.float64(h_hi))

@@ -38,9 +38,34 @@ from ..ops.search import (
     expand_ranges, gather_capacity, pad_pow2, pad_ranges, searchsorted2,
 )
 from .mesh import device_mesh, shard_batch
-from .scan import _fetch_global
+from .scan import GID_PROC_SHIFT, _fetch_global
 
 __all__ = ["ShardedXZ2Index", "ShardedXZ3Index"]
+
+
+def _exact_recheck(cand: np.ndarray, geoms: PackedGeometry,
+                   geometry: Geometry, multihost: bool) -> np.ndarray:
+    """Exact geometry predicate over candidate gids.
+
+    Single-controller: ``geoms`` holds every geometry, indexed by gid.
+    Multihost: ``geoms`` holds only THIS process's geometries — each
+    process re-checks its own candidates (the filter runs next to the
+    data, AccumuloIndexAdapter.scala:181-195 role) and the survivors
+    allgather; no process ever touches another's geometry payload."""
+    if not multihost:
+        keep = [p for p in cand
+                if geometry_intersects(geoms.geometry(int(p)), geometry)]
+        return np.asarray(keep, dtype=np.int64)
+    import jax
+    from .multihost import allgather_concat
+    from .scan import decode_gids
+    me = jax.process_index()
+    procs, rows = decode_gids(cand)
+    mine = cand[procs == me]
+    mine_rows = rows[procs == me]
+    keep = [g for g, r in zip(mine, mine_rows)
+            if geometry_intersects(geoms.geometry(int(r)), geometry)]
+    return allgather_concat(np.asarray(keep, dtype=np.int64))
 
 _SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)
 _SENTINEL_CODE = np.int64(np.iinfo(np.int64).max)
@@ -135,14 +160,19 @@ class ShardedXZ2Index:
     DEFAULT_CAPACITY = 1 << 14
 
     def __init__(self, mesh: Mesh, g: int, codes, gid, bbox_cols,
-                 geoms: PackedGeometry | None, n_total: int):
+                 geoms: PackedGeometry | None, n_total: int,
+                 multihost: bool = False):
         self.mesh = mesh
         self.sfc = xz2_sfc(g)
         self.codes = codes
         self.gid = gid
         self.bbox_cols = bbox_cols  # (bx0, by0, bx1, by1) sharded
+        #: exact-predicate payload: ALL geometries (single-controller,
+        #: indexed by gid) or only THIS process's (multihost, indexed by
+        #: the gid's local_row field)
         self.geoms = geoms
         self._n_total = n_total
+        self._multihost = multihost
         self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
@@ -162,6 +192,33 @@ class ShardedXZ2Index:
         out = _xz_build_program(mesh, False)(*sharded, valid)
         cs, gs, bx0, by0, bx1, by1 = out
         return cls(mesh, g, cs, gs, (bx0, by0, bx1, by1), packed, n)
+
+    @classmethod
+    def build_multihost(cls, geoms, g: int = 12,
+                        mesh: Mesh | None = None) -> "ShardedXZ2Index":
+        """Multi-controller build from per-process LOCAL geometries; the
+        exact-predicate payload stays local to each process (see
+        _exact_recheck)."""
+        import jax
+        from .multihost import (
+            agreed_int, global_device_mesh, process_local_shard,
+        )
+        mesh = mesh or global_device_mesh()
+        packed = (geoms if isinstance(geoms, PackedGeometry)
+                  else pack_geometries(geoms))
+        bb = packed.bbox
+        codes = xz2_sfc(g).index(bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3],
+                                 xp=np).astype(np.int64)
+        n_local = len(codes)
+        from .scan import encode_gids
+        gids = encode_gids(np.arange(n_local, dtype=np.int64))
+        sharded, valid = process_local_shard(
+            mesh, codes, gids, bb[:, 0].copy(), bb[:, 1].copy(),
+            bb[:, 2].copy(), bb[:, 3].copy())
+        out = _xz_build_program(mesh, False)(*sharded, valid)
+        cs, gs, bx0, by0, bx1, by1 = out
+        return cls(mesh, g, cs, gs, (bx0, by0, bx1, by1), packed,
+                   agreed_int(n_local, "sum"), multihost=True)
 
     def __len__(self) -> int:
         return self._n_total
@@ -193,10 +250,8 @@ class ShardedXZ2Index:
                 break
             capacity = gather_capacity(int(totals.max()))
         if exact and self.geoms is not None and not _is_envelope(geometry, env):
-            cand = np.asarray(
-                [p for p in cand
-                 if geometry_intersects(self.geoms.geometry(int(p)),
-                                        geometry)], dtype=np.int64)
+            cand = _exact_recheck(cand, self.geoms, geometry,
+                                  self._multihost)
         return np.sort(cand).astype(np.int64)
 
 
@@ -206,7 +261,8 @@ class ShardedXZ3Index:
     DEFAULT_CAPACITY = 1 << 14
 
     def __init__(self, mesh: Mesh, period, g: int, bins, codes, gid,
-                 bbox_cols, dtg, geoms: PackedGeometry | None, n_total: int):
+                 bbox_cols, dtg, geoms: PackedGeometry | None, n_total: int,
+                 multihost: bool = False):
         self.mesh = mesh
         self.period = TimePeriod.parse(period)
         self.sfc = xz3_sfc(self.period, g)
@@ -217,6 +273,7 @@ class ShardedXZ3Index:
         self.dtg = dtg
         self.geoms = geoms
         self._n_total = n_total
+        self._multihost = multihost
         self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
@@ -243,6 +300,40 @@ class ShardedXZ3Index:
         bs, cs, gs, bx0, by0, bx1, by1, td = out
         return cls(mesh, period, g, bs, cs, gs, (bx0, by0, bx1, by1),
                    td, packed, n)
+
+    @classmethod
+    def build_multihost(cls, geoms, dtg_ms,
+                        period: TimePeriod | str = TimePeriod.WEEK,
+                        g: int = 12,
+                        mesh: Mesh | None = None) -> "ShardedXZ3Index":
+        """Multi-controller build from per-process LOCAL geometries (see
+        ShardedXZ2Index.build_multihost)."""
+        import jax
+        from .multihost import (
+            agreed_int, global_device_mesh, process_local_shard,
+        )
+        mesh = mesh or global_device_mesh()
+        packed = (geoms if isinstance(geoms, PackedGeometry)
+                  else pack_geometries(geoms))
+        period = TimePeriod.parse(period)
+        sfc = xz3_sfc(period, g)
+        dtg_ms = np.asarray(dtg_ms, dtype=np.int64)
+        bins, offs = to_binned_time(dtg_ms, period)
+        bb = packed.bbox
+        offs_f = offs.astype(np.float64)
+        codes = sfc.index(bb[:, 0], bb[:, 1], offs_f, bb[:, 2], bb[:, 3],
+                          offs_f, xp=np).astype(np.int64)
+        n_local = len(codes)
+        from .scan import encode_gids
+        gids = encode_gids(np.arange(n_local, dtype=np.int64))
+        sharded, valid = process_local_shard(
+            mesh, bins.astype(np.int32), codes, gids,
+            bb[:, 0].copy(), bb[:, 1].copy(), bb[:, 2].copy(),
+            bb[:, 3].copy(), dtg_ms)
+        out = _xz_build_program(mesh, True)(*sharded, valid)
+        bs, cs, gs, bx0, by0, bx1, by1, td = out
+        return cls(mesh, period, g, bs, cs, gs, (bx0, by0, bx1, by1),
+                   td, packed, agreed_int(n_local, "sum"), multihost=True)
 
     def __len__(self) -> int:
         return self._n_total
@@ -292,8 +383,6 @@ class ShardedXZ3Index:
                 break
             capacity = gather_capacity(int(totals.max()))
         if exact and self.geoms is not None and not _is_envelope(geometry, env):
-            cand = np.asarray(
-                [p for p in cand
-                 if geometry_intersects(self.geoms.geometry(int(p)),
-                                        geometry)], dtype=np.int64)
+            cand = _exact_recheck(cand, self.geoms, geometry,
+                                  self._multihost)
         return np.sort(cand).astype(np.int64)

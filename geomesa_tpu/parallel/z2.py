@@ -161,7 +161,8 @@ class ShardedZ2Index:
 
     def __init__(self, mesh: Mesh, z, gid, x, y, n_total: int,
                  shard_counts: np.ndarray | None,
-                 version: int | None = None):
+                 version: int | None = None,
+                 multihost: bool = False, n_local: int | None = None):
         from ..index.z2 import Z2_INDEX_VERSION, z2_sfc_for_version
         self.mesh = mesh
         self.version = Z2_INDEX_VERSION if version is None else version
@@ -172,6 +173,8 @@ class ShardedZ2Index:
         self.y = y
         self._n_total = n_total
         self._shard_counts = shard_counts
+        self._multihost = multihost
+        self._n_local = n_total if n_local is None else n_local
         self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
@@ -195,6 +198,34 @@ class ShardedZ2Index:
                    shard_counts=shard_counts.astype(np.int64),
                    version=version)
 
+    @classmethod
+    def build_multihost(cls, x, y, mesh: Mesh | None = None,
+                        version: int | None = None) -> "ShardedZ2Index":
+        """Multi-controller build: each process feeds only its LOCAL
+        rows; gids code ``process << GID_PROC_SHIFT | local_row`` (see
+        ShardedZ3Index.build_multihost)."""
+        from ..index.z2 import Z2_INDEX_VERSION, z2_sfc_for_version
+        from .multihost import (
+            agreed_int, global_device_mesh, global_shard_counts,
+            process_local_shard,
+        )
+        from .scan import encode_gids
+
+        mesh = mesh or global_device_mesh()
+        version = Z2_INDEX_VERSION if version is None else version
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        n_local = len(x)
+        gids = encode_gids(np.arange(n_local, dtype=np.int64))
+        sharded, valid = process_local_shard(mesh, x, y, gids)
+        xd, yd, gidd = sharded
+        z_s, gid_s, x_s, y_s = _z2_build_program(
+            mesh, z2_sfc_for_version(version))(xd, yd, gidd, valid)
+        return cls(mesh, z_s, gid_s, x_s, y_s,
+                   n_total=agreed_int(n_local, "sum"),
+                   shard_counts=global_shard_counts(n_local, mesh),
+                   version=version, multihost=True, n_local=n_local)
+
     def total(self) -> int:
         return self._n_total
 
@@ -202,10 +233,10 @@ class ShardedZ2Index:
         return self._n_total
 
     def append(self, x, y) -> "ShardedZ2Index":
-        """Distributed append (see ShardedZ3Index.append)."""
-        if self._shard_counts is None:
-            raise NotImplementedError("append requires a single-controller "
-                                      "build")
+        """Distributed append (see ShardedZ3Index.append).  Collective
+        under multihost: every process passes only its local new rows."""
+        if self._multihost:
+            return self._append_multihost(x, y)
         x = np.asarray(x, dtype=np.float64)
         m = len(x)
         if m == 0:
@@ -234,6 +265,45 @@ class ShardedZ2Index:
         self._shard_counts = self._shard_counts + np.clip(
             m - np.arange(n_shards) * m_per, 0, m_per)
         self._n_total += m
+        self._n_local += m
+        return self
+
+    def _append_multihost(self, x, y) -> "ShardedZ2Index":
+        """Each process feeds only its local new rows (see
+        ShardedZ3Index._append_multihost for the agreed-slot design)."""
+        from .multihost import (
+            agree_append_layout, agreed_int, global_shard_counts,
+            process_local_shard, sharded_counts_array,
+        )
+        from .scan import encode_gids
+        x = np.asarray(x, dtype=np.float64)
+        m_local = len(x)
+        m_global = agreed_int(m_local, "sum")
+        if m_global == 0:
+            return self
+        y = np.asarray(y, dtype=np.float64)
+        n_shards = int(self.mesh.devices.size)
+        m_per, slots_local, _ = agree_append_layout(self.mesh, m_local)
+        gids = np.full(slots_local, -1, dtype=np.int64)
+        gids[:m_local] = encode_gids(
+            self._n_local + np.arange(m_local, dtype=np.int64))
+        cap = int(self.z.shape[0]) // n_shards
+        need = int(self._shard_counts.max()) + m_per
+        if need > cap:
+            grow = _z2_grow_program(self.mesh, gather_capacity(need) - cap)
+            self.z, self.gid, self.x, self.y = grow(
+                self.z, self.gid, self.x, self.y)
+        sharded, _ = process_local_shard(self.mesh, x, y, gids,
+                                         padded_local=slots_local)
+        xd, yd, gidd = sharded
+        rd = sharded_counts_array(self.mesh, self._shard_counts)
+        self.z, self.gid, self.x, self.y = _z2_append_program(
+            self.mesh, self.sfc)(
+            self.z, self.gid, self.x, self.y, xd, yd, gidd, rd)
+        self._shard_counts = self._shard_counts + global_shard_counts(
+            m_local, self.mesh, m_per=m_per)
+        self._n_total += m_global
+        self._n_local += m_local
         return self
 
     def query(self, boxes, max_ranges: int = 2000,
@@ -288,7 +358,9 @@ class ShardedZ2Index:
             np.concatenate(ixy), np.concatenate(bxs),
             pad_pow2(sum(len(b) for b in bxs), minimum=1),
             np.concatenate(bqid))
-        pos_bits = coded_pos_bits(self._n_total, n_q)
+        from .scan import multihost_gid_span
+        pos_bits = coded_pos_bits(
+            multihost_gid_span() if self._multihost else self._n_total, n_q)
         capacity = self._capacity
         while True:
             scan = _z2_many_program(self.mesh, capacity, pos_bits)

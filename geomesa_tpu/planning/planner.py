@@ -95,26 +95,38 @@ class QueryPlanner:
 
         from ..utils.profiling import profile
         with profile("query.plan") as plan_span:
-            decider = StrategyDecider(self.sft, store.stats_map(), len(batch))
+            # multihost: global count + merged stats — every process
+            # must cost strategies identically or the collective
+            # dispatches would diverge (deadlock)
+            stats = store.stats_map()
+            n_plan = (stats["count"].count
+                      if getattr(store, "multihost", False) else len(batch))
+            decider = StrategyDecider(self.sft, stats, n_plan)
             strategy = decider.decide(query.filter, explain,
                                       forced=query.hints.get("QUERY_INDEX"))
         plan_ms = plan_span.ms
         check_deadline("planning")
 
+        mh = getattr(store, "multihost", False)
         t1 = time.perf_counter()
         with profile("query.scan"):
             candidates = self._scan(strategy, query, explain)
         check_deadline("index scan")
-        if candidates is None:  # full scan
+        if candidates is None:  # full scan (of this process's rows)
             mask = evaluate_filter(query.filter, batch)
             positions = np.flatnonzero(mask)
         else:
-            if len(candidates):
-                sub = batch.take(candidates)
+            # multihost: candidates are GLOBAL gids — each process
+            # residual-filters only ITS gid-decoded rows, next to the
+            # data (the server-side filter role; no global batch exists)
+            cand = (store.local_rows_of(candidates) if mh
+                    else candidates)
+            if len(cand):
+                sub = batch.take(cand)
                 mask = evaluate_filter(query.filter, sub)
-                positions = candidates[mask]
+                positions = cand[mask]
             else:
-                positions = candidates
+                positions = np.asarray(cand, dtype=np.int64)
         scan_ms = (time.perf_counter() - t1) * 1000
         check_deadline("filtering")
         explain(lambda: f"Scan: {len(positions)} hits "
@@ -125,7 +137,9 @@ class QueryPlanner:
         if "SAMPLING" in query.hints and len(positions):
             # 1-in-n result thinning, optionally per attribute group —
             # the reference's SAMPLING/SAMPLE_BY query hints
-            # (SamplingIterator + FeatureSampler)
+            # (SamplingIterator + FeatureSampler); multihost thins per
+            # process (the reference samples per scan thread the same
+            # way, utils/FeatureSampler)
             from ..process.sampling import sample_positions
             n_samp = int(query.hints["SAMPLING"])
             by = query.hints.get("SAMPLE_BY")
@@ -133,8 +147,13 @@ class QueryPlanner:
             positions = sample_positions(positions, n_samp, keys)
             explain(lambda: f"Sampled 1-in-{n_samp}"
                             + (f" per {by}" if by else ""))
-        positions = self._sort_limit(positions, batch, query)
-        result_batch = batch.take(positions)
+        if mh:
+            positions, local_rows = self._finalize_multihost(
+                positions, batch, query, store)
+        else:
+            positions = self._sort_limit(positions, batch, query)
+            local_rows = positions
+        result_batch = batch.take(local_rows)
         properties = query.properties
         if properties is None and "COLUMN_GROUP" in query.hints:
             group = query.hints["COLUMN_GROUP"]
@@ -169,7 +188,10 @@ class QueryPlanner:
             return None
         explain(lambda: f"Executing {name} index scan")
         if name == "id":
-            return store.id_index().query(strategy.ids)
+            # id index is host-local; multihost lifts the per-process
+            # rows into the global gid space (encode + allgather)
+            return store.to_global_candidates(
+                store.id_index().query(strategy.ids))
         if name.startswith("attr:"):
             attr = name[5:]
             idx = store.attribute_index(attr)
@@ -306,6 +328,50 @@ class QueryPlanner:
         if plan.num_ranges == 0:
             return None
         return plan.rbin, plan.rzlo, plan.rzhi
+
+    def _finalize_multihost(self, local: np.ndarray, batch: FeatureBatch,
+                            query: Query, store):
+        """Assemble the GLOBAL result gid list from per-process survivor
+        rows (hits-bounded allgather — the client-merge Reducer role),
+        applying sort/limit with global semantics.  Returns
+        ``(global_gids, local_rows_in_global_order)``; each process's
+        result batch is its own slice of the global order."""
+        import jax
+
+        from ..parallel.multihost import allgather_concat, allgather_strings
+        from ..parallel.scan import decode_gids
+
+        local = np.asarray(local, dtype=np.int64)
+        gids = np.asarray(store.gids_of(local), dtype=np.int64)
+        if query.sort_by:
+            keys = batch.column(query.sort_by)[local]
+            if keys.dtype == object:
+                # match _sort_limit's None-last contract: gather a
+                # none-mask alongside the stringified keys (astype(str)
+                # alone would sort None as the literal 'None')
+                none = np.array([k is None for k in keys])
+                safe = np.array(["" if k is None else str(k)
+                                 for k in keys])
+                all_keys = allgather_strings(safe)
+                all_none = allgather_concat(none)
+            else:
+                all_keys = allgather_concat(keys)
+                all_none = np.zeros(len(all_keys), dtype=bool)
+            all_gids = allgather_concat(gids)
+            # stable none-last value sort (the _sort_limit contract)
+            order = np.lexsort((np.arange(len(all_keys)),
+                                all_keys, all_none))
+            if query.sort_desc:
+                # descending values, Nones STILL last
+                nn = ~all_none[order]
+                order = np.concatenate([order[nn][::-1], order[~nn]])
+            positions = all_gids[order]
+        else:
+            positions = np.sort(allgather_concat(gids))
+        if query.max_features is not None:
+            positions = positions[: query.max_features]
+        procs, rows = decode_gids(positions)
+        return positions, rows[procs == jax.process_index()]
 
     def _sort_limit(self, positions: np.ndarray, batch: FeatureBatch,
                     query: Query) -> np.ndarray:

@@ -1,0 +1,133 @@
+"""TpuDataStore multihost mode on the single-process virtual mesh.
+
+With one process, multihost degenerates (gids == rows, allgathers are
+identity) but every multihost code path runs: build_multihost for all
+index types, gid decode/encode residual filtering, merged stats, global
+sort/limit, multihost append through the store.  The REAL two-process
+system test lives in test_multihost_real.py; this file keeps the logic
+under the fast CI loop."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features import FeatureBatch
+from geomesa_tpu.filters import evaluate_filter, parse_ecql
+from geomesa_tpu.parallel import device_mesh
+from geomesa_tpu.planning.planner import Query
+
+MS = 1514764800000
+N = 20_000
+
+
+@pytest.fixture(scope="module")
+def mh_store():
+    rng = np.random.default_rng(77)
+    ds = TpuDataStore(mesh=device_mesh(), multihost=True)
+    ds.create_schema(
+        "mh", "name:String:index=true,score:Double,dtg:Date,*geom:Point")
+    ds.write("mh", {
+        "name": rng.choice(["alpha", "beta", "gamma"], N).astype(object),
+        "score": rng.uniform(0, 100, N),
+        "dtg": rng.integers(MS, MS + 14 * 86_400_000, N),
+        "geom": (rng.uniform(-75, -73, N), rng.uniform(40, 42, N)),
+    })
+    return ds
+
+
+QUERIES = [
+    "BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg DURING "
+    "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z",
+    "BBOX(geom, -74.2, 40.8, -73.9, 41.1)",
+    "name = 'alpha'",
+    "name = 'beta' AND score > 90",
+    "score < 1.5",
+    "IN ('5', '17', '4999')",
+]
+
+
+@pytest.mark.parametrize("ecql", QUERIES)
+def test_multihost_mode_oracle_equal(mh_store, ecql):
+    st = mh_store._store("mh")
+    got = mh_store.query_result("mh", ecql)
+    want = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+    np.testing.assert_array_equal(np.sort(got.positions), want)
+
+
+def test_multihost_mode_sort_limit(mh_store):
+    got = mh_store.query_result(
+        "mh", Query.of("name = 'gamma'", sort_by="score", sort_desc=True,
+                       max_features=10))
+    st = mh_store._store("mh")
+    scores = got.batch.column("score")
+    assert len(scores) == 10
+    want = np.flatnonzero(evaluate_filter(parse_ecql("name = 'gamma'"),
+                                          st.batch))
+    top = np.sort(st.batch.column("score")[want])[::-1][:10]
+    np.testing.assert_allclose(np.sort(scores)[::-1], top)
+
+
+def test_multihost_mode_stats_and_bounds(mh_store):
+    assert mh_store.get_count("mh") == N
+    env = mh_store.get_bounds("mh")
+    assert env.xmin >= -75 and env.xmax <= -73
+    topk = mh_store.stat("mh", "name_topk")
+    assert topk is not None and len(topk.topk(3)) == 3
+
+
+def test_multihost_mode_write_appends_incrementally(mh_store):
+    """A second write goes through the multihost z3 append (collective)
+    and stays oracle-exact."""
+    rng = np.random.default_rng(78)
+    st = mh_store._store("mh")
+    _ = mh_store.query("mh", QUERIES[0])  # builds z3
+    z3_before = st._indexes.get("z3")
+    assert z3_before is not None and z3_before._multihost
+    m = 2_000
+    mh_store.write("mh", {
+        "name": np.array(["delta"] * m, object),
+        "score": rng.uniform(0, 100, m),
+        "dtg": rng.integers(MS, MS + 14 * 86_400_000, m),
+        "geom": (rng.uniform(-75, -73, m), rng.uniform(40, 42, m)),
+    })
+    assert st._indexes.get("z3") is z3_before  # appended, not rebuilt
+    got = mh_store.query_result("mh", QUERIES[0])
+    want = np.flatnonzero(evaluate_filter(parse_ecql(QUERIES[0]), st.batch))
+    np.testing.assert_array_equal(np.sort(got.positions), want)
+    assert mh_store.get_count("mh") == N + m
+
+
+def test_multihost_mode_delete(mh_store):
+    ids = list(mh_store._store("mh").batch.ids[:5])
+    removed = mh_store.delete("mh", ids)
+    assert removed == 5
+    got = mh_store.query_result("mh", "INCLUDE")
+    assert len(got.positions) == mh_store.get_count("mh")
+
+
+def test_multihost_polygon_schema():
+    """XZ2 strategy through the multihost store (exact re-check runs on
+    gid-decoded local candidates)."""
+    from geomesa_tpu.geometry import Polygon
+    rng = np.random.default_rng(9)
+    ds = TpuDataStore(mesh=device_mesh(), multihost=True)
+    ds.create_schema("poly", "v:Int,*geom:Polygon")
+    n = 400
+    cx = rng.uniform(-10, 10, n)
+    cy = rng.uniform(-10, 10, n)
+    r = rng.uniform(0.1, 0.5, n)
+    geoms = [Polygon([[x - d, y - d], [x + d, y - d], [x + d, y + d],
+                      [x - d, y + d], [x - d, y - d]])
+             for x, y, d in zip(cx, cy, r)]
+    ds.write("poly", {"v": np.arange(n), "geom": geoms})
+    ecql = "INTERSECTS(geom, POLYGON((-2 -2, 4 -1, 3 5, -1 3, -2 -2)))"
+    got = ds.query_result("poly", ecql)
+    st = ds._store("poly")
+    want = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+    np.testing.assert_array_equal(np.sort(got.positions), want)
+    assert got.strategy.index == "xz2"
+
+
+def test_multihost_requires_mesh():
+    with pytest.raises(ValueError, match="requires a mesh"):
+        TpuDataStore(multihost=True)

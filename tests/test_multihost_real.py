@@ -58,6 +58,17 @@ assert count >= len(hits)
 grid = idx.density([box], MS, MS + 7 * 86_400_000, box, 16, 16)
 # the density psum spans both processes' rows
 assert grid.sum() == len(hits), (grid.sum(), len(hits))
+# weighted density: per-process LOCAL weight tables, offset by row
+# bases inside the kernel (ADVICE r2: the masked-gid lookup read every
+# process's rows from table offset 0).  Row-distinct weights (the x
+# coordinate) would expose any base-offset error immediately.
+from jax.experimental import multihost_utils as _mhu
+wgrid = idx.density([box], MS, MS + 7 * 86_400_000, box, 16, 16,
+                    weights=np.abs(x))
+my_contrib = np.abs(x[brute]).sum()
+want_w = float(np.asarray(
+    _mhu.process_allgather(np.float64(my_contrib))).sum())
+assert abs(wgrid.sum() - want_w) < 1e-6, (wgrid.sum(), want_w)
 
 # distributed converter ingest: every process parses its file share,
 # the global index assembles collectively (run_distributed_ingest)
@@ -91,8 +102,97 @@ assert ing_idx.total() == 120, ing_idx.total()  # 3 files x 40 rows
 ing_hits = ing_idx.query([(-75.0, 40.0, -73.0, 42.0)], None, None)
 assert len(ing_hits) == 120
 
+# ---- batched multi-window scans decode process bits correctly ----
+# (ADVICE r2 medium: qid<<pos_bits must clear the full multihost gid
+# span; proc>=1 hits used to decode process-stripped into wrong windows)
+win_a = (-74.5, 40.5, -73.5, 41.5)
+win_b = (-74.9, 40.1, -74.0, 41.9)
+parts = idx.query_many([([win_a], None, None), ([win_b], None, None)])
+for w, got_w in zip((win_a, win_b), parts):
+    pr = np.asarray(got_w) >> GID_PROC_SHIFT
+    rw = np.asarray(got_w) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
+    mine_w = np.sort(rw[pr == proc])
+    brute_w = np.flatnonzero((x >= w[0]) & (x <= w[2])
+                             & (y >= w[1]) & (y <= w[3]))
+    assert np.array_equal(mine_w, brute_w), (len(mine_w), len(brute_w))
+
+# ---- multihost append on the raw index ----
+m_new = 60 + proc * 7
+nx2 = rng.uniform(-74.4, -73.6, m_new); ny2 = rng.uniform(40.6, 41.4, m_new)
+nt2 = rng.integers(MS, MS + 7 * 86_400_000, m_new)
+idx.append(nx2, ny2, nt2)
+assert idx.total() == 2017 + 60 + 67, idx.total()
+hits2 = idx.query([box], None, None)
+ax = np.r_[x, nx2]; ay = np.r_[y, ny2]
+procs2 = np.asarray(hits2) >> GID_PROC_SHIFT
+rows2 = np.asarray(hits2) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
+mine2 = np.sort(rows2[procs2 == proc])
+brute2 = np.flatnonzero((ax >= box[0]) & (ax <= box[2])
+                        & (ay >= box[1]) & (ay <= box[3]))
+assert np.array_equal(mine2, brute2), (len(mine2), len(brute2))
+
+# ---- the STORE, multihost mode: create_schema -> write -> append ->
+# query/stats through the full planner with residual filtering on
+# gid-decoded local candidates; NO process holds the full dataset ----
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.filters import evaluate_filter, parse_ecql
+
+ds = TpuDataStore(mesh=mesh, multihost=True)
+ds.create_schema("evt", "name:String:index=true,score:Double,"
+                        "dtg:Date,*geom:Point")
+n_rows = 800 + proc * 13
+sx = rng.uniform(-75, -73, n_rows); sy = rng.uniform(40, 42, n_rows)
+stt = rng.integers(MS, MS + 14 * 86_400_000, n_rows)
+ds.write("evt", {
+    "name": rng.choice(["alpha", "beta", "gamma"], n_rows).astype(object),
+    "score": rng.uniform(0, 100, n_rows),
+    "dtg": stt, "geom": (sx, sy)})
+st = ds._store("evt")
+assert len(st.batch) == n_rows     # data stays distributed
+assert ds.get_count("evt") == 800 + 813, ds.get_count("evt")
+
+for ecql in (
+    "BBOX(geom,-74.5,40.5,-73.5,41.5) AND dtg DURING "
+    "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z",
+    "name = 'alpha' AND score > 50",
+    "BBOX(geom,-74.2,40.8,-73.9,41.1)",
+):
+    got = ds.query_result("evt", ecql)
+    want_local = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+    gp = np.asarray(got.positions) >> GID_PROC_SHIFT
+    gr = np.asarray(got.positions) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
+    assert np.array_equal(np.sort(gr[gp == proc]), want_local), ecql
+    # the local result batch is exactly this process's hit rows
+    assert len(got.batch) == len(want_local), ecql
+
+# append through the store (incremental multihost z3 append)
+z3_obj = st._indexes.get("z3")
+assert z3_obj is not None and z3_obj._multihost
+m2 = 40 + proc * 5
+ds.write("evt", {
+    "name": np.array(["delta"] * m2, dtype=object),
+    "score": rng.uniform(0, 100, m2),
+    "dtg": rng.integers(MS, MS + 14 * 86_400_000, m2),
+    "geom": (rng.uniform(-75, -73, m2), rng.uniform(40, 42, m2))})
+assert st._indexes.get("z3") is z3_obj        # appended, not rebuilt
+ecql = ("BBOX(geom,-74.5,40.5,-73.5,41.5) AND dtg DURING "
+        "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+got = ds.query_result("evt", ecql)
+want_local = np.flatnonzero(evaluate_filter(parse_ecql(ecql), st.batch))
+gp = np.asarray(got.positions) >> GID_PROC_SHIFT
+gr = np.asarray(got.positions) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
+assert np.array_equal(np.sort(gr[gp == proc]), want_local)
+assert ds.get_count("evt") == 800 + 813 + 40 + 45
+
+# merged global stats + bounds
+env = ds.get_bounds("evt")
+assert env is not None and env.xmin >= -75.0 and env.xmax <= -73.0
+topk = ds.stat("evt", "name_topk")
+assert topk is not None and topk.topk(1)[0][0] in ("alpha", "beta", "gamma")
+
 print(f"MULTIHOST-OK proc={proc} total={idx.total()} "
       f"hits={len(hits)} mine={len(mine)} count={count} "
+      f"store_hits={len(got.positions)} "
       f"ingested={result.ingested}", flush=True)
 '''
 
@@ -120,7 +220,7 @@ def test_two_process_multihost(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=420)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
