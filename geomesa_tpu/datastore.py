@@ -306,6 +306,15 @@ class _SchemaStore:
         from .parallel.multihost import allgather_concat
         return np.sort(allgather_concat(self.gids_of(rows)))
 
+    def find_id_clash(self, ids) -> str | None:
+        """First id in ``ids`` that already exists in this store's rows
+        (lazy incrementally-maintained id set — O(ids), not O(store))."""
+        if self.batch is None or not len(self.batch):
+            return None
+        if self._id_set is None:
+            self._id_set = set(self.batch.ids.astype(str).tolist())
+        return next((i for i in ids if i in self._id_set), None)
+
     def merge_stat_global(self, s: Stat) -> Stat:
         """Merge one per-process stat through the monoid across all
         processes (used for restricted-caller re-observations, which are
@@ -759,35 +768,35 @@ class TpuDataStore:
             if (counts > 1).any():
                 err = (f"duplicate feature id {uniq[counts > 1][0]!r} "
                        "within the write batch")
-            elif store.batch is not None and len(store.batch):
-                # incrementally-maintained id set: a small append to a
-                # huge schema must not rescan every stored id
-                if store._id_set is None:
-                    store._id_set = set(store.batch.ids.astype(str)
-                                        .tolist())
-                clash = next((i for i in ids_in if i in store._id_set),
-                             None)
+            else:
+                clash = store.find_id_clash(ids_in)
                 if clash is not None:
                     err = (f"feature id {clash!r} already exists in "
                            f"schema {name!r} (delete it first, or use "
                            "auto-generated ids)")
             if store.multihost:
                 # collective validation: cross-process duplicates within
-                # the write, and an AGREED raise — a one-sided exception
-                # would desync the SPMD store at its next collective
+                # the write, clashes against rows stored on ANY process,
+                # and an AGREED raise — a one-sided exception would
+                # desync the SPMD store at its next collective
                 import jax
                 if jax.process_count() > 1:
                     from .parallel.multihost import allgather_strings
+                    g_ids = allgather_strings(ids_in)
                     if not err:
-                        g_ids = allgather_strings(ids_in)
                         gu, gc = np.unique(g_ids, return_counts=True)
-                        dup_here = np.isin(ids_in, gu[gc > 1])
-                        if dup_here.any() or (gc > 1).any():
-                            bad = gu[gc > 1][0] if (gc > 1).any() else ""
-                            err = (f"duplicate feature id {bad!r} across "
-                                   "processes in the write batch")
-                    else:
-                        allgather_strings(ids_in)  # keep collectives
+                        if (gc > 1).any():
+                            err = (f"duplicate feature id "
+                                   f"{gu[gc > 1][0]!r} across processes "
+                                   "in the write batch")
+                        else:
+                            # every process checks the FULL incoming id
+                            # set against ITS stored rows (ids written
+                            # by peers live only on their process)
+                            clash = store.find_id_clash(g_ids)
+                            if clash is not None:
+                                err = (f"feature id {clash!r} already "
+                                       f"exists in schema {name!r}")
                     errs = [e for e in allgather_strings(
                         np.array([err], dtype=object)) if e]
                     if errs:
@@ -921,20 +930,26 @@ class TpuDataStore:
             encode_record_batch, sft_to_arrow_schema,
         )
 
-        sft = self._store(name).sft
+        store = self._store(name)
+        sft = store.sft
         schema = sft_to_arrow_schema(sft, dictionary_fields)
-        batch = self.query(name, query)
+        result = self.query_result(name, query)
+        batch = result.batch
         if len(batch) == 0:
             return schema.empty_table()
         if self._mesh is not None:
             # distributed reduce: per-shard delta-dictionary streams
-            # k-way merged client-side (ArrowScan.scala:35 reduce step);
-            # dictionary columns decode on merge (per-shard dictionaries
-            # index different accumulations)
+            # k-way merged client-side (ArrowScan.scala:35 reduce step).
+            # Rows group by TRUE device residency (shard_of_gids over
+            # the placement segments), so each stream is exactly what
+            # that data shard would serve — its dictionary accumulates
+            # only ITS values; dictionary columns decode on merge
+            # (per-shard dictionaries index different accumulations).
+            # Multihost: each process reduces its local hit slice.
             from .parallel.stats import merged_arrow
+            shards = self._hit_residency(store, result.positions)
             return merged_arrow(
-                batch, sft, int(self._mesh.devices.size),
-                dictionary_fields, sort_field, reverse)
+                batch, sft, shards, dictionary_fields, sort_field, reverse)
         if sort_field is not None:
             order = np.argsort(np.asarray(batch.columns[sort_field]),
                                kind="stable")
@@ -946,6 +961,31 @@ class TpuDataStore:
                for s in range(0, len(batch), batch_size)]
         return pa.Table.from_batches(rbs)
 
+    def _residency_shards(self, store: _SchemaStore, gids):
+        """Per-row shard ids for the reduce protocols: true residency
+        from a built sharded index's placement segments, else the block
+        split a fresh build would produce (int fallback)."""
+        # a dirty store's cached indexes describe PRE-mutation placement
+        # (e.g. pre-delete row ids) — drop them rather than group new
+        # rows through stale segments
+        store._rebuild_if_dirty()
+        for nm in ("z3", "z2"):
+            idx = store._indexes.get(nm)
+            if idx is not None and getattr(idx, "_segments", None):
+                return idx.shard_of_gids(gids)
+        return int(self._mesh.devices.size)
+
+    def _hit_residency(self, store: _SchemaStore, positions: np.ndarray):
+        """Residency shard ids for this process's slice of the final hit
+        positions (the grouping input of the arrow/stats reducers)."""
+        if store.multihost:
+            import jax
+            from .parallel.scan import decode_gids
+            procs, _ = decode_gids(positions)
+            positions = np.asarray(positions, np.int64)[
+                procs == jax.process_index()]
+        return self._residency_shards(store, positions)
+
     def query_windows(self, name: str, windows) -> list[np.ndarray]:
         """Batched bbox+time window queries: one device dispatch for ALL
         windows (``[(boxes, t_lo_ms, t_hi_ms), …]``), returning a position
@@ -954,7 +994,13 @@ class TpuDataStore:
         Falls back to per-window planner queries for non-point schemas."""
         store = self._store(name)
         if store.batch is None or len(store.batch) == 0:
-            return [np.empty(0, dtype=np.int64) for _ in windows]
+            if store.multihost:
+                # a zero-local-row process must still enter the window
+                # collectives its peers run (see query_result)
+                if store.batch is None:
+                    store.batch = FeatureBatch.empty(store.sft)
+            else:
+                return [np.empty(0, dtype=np.int64) for _ in windows]
         sft = store.sft
         if sft.name not in self._interceptors:
             from .planning.interceptor import load_interceptors
@@ -1000,8 +1046,11 @@ class TpuDataStore:
                 hits[i] = z2_hits[j]
             for j, i in enumerate(timed_idx):
                 hits[i] = z3_hits[j]
-        allowed = (store.vis_mask(self._auth_provider.get_authorizations())
-                   if self._auth_provider is not None else None)
+        # _restricted_mask, not vis_mask: the restricted decision is
+        # AGREED under multihost (per-process vis_mask may be None on
+        # one process and set on another — a divergent gate would
+        # strand peers in the allgather below)
+        allowed = self._restricted_mask(store)
         if allowed is not None:
             if store.multihost:
                 # gids → per-process local rows → mask → allgather back
@@ -1052,7 +1101,9 @@ class TpuDataStore:
     def get_count(self, name: str, query=None) -> int:
         store = self._store(name)
         if query is not None:
-            return len(self.query(name, query))
+            # positions, not the batch: the global hit count under
+            # multihost (the local batch is just this process's slice)
+            return len(self.query_result(name, query).positions)
         mask = self._restricted_mask(store)
         if mask is not None:
             n = int(mask.sum())
@@ -1068,9 +1119,11 @@ class TpuDataStore:
         n_here = 0 if store.batch is None else len(store.batch)
         if n_here == 0 and not store.multihost:
             return None
+        # the restricted-mask decision is collective under multihost —
+        # it must run on EVERY process, zero-local-row ones included
+        mask = self._restricted_mask(store)
         if n_here:
             bb = store.batch.geom_bbox()
-            mask = self._restricted_mask(store)
             if mask is not None:
                 bb = bb[mask] if mask.any() else bb[:0]
         else:

@@ -75,6 +75,60 @@ def decode_gids(gids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return g >> GID_PROC_SHIFT, g & ((np.int64(1) << GID_PROC_SHIFT) - 1)
 
 
+def _block_segments(n: int, per: int, n_shards: int, gid_base: int = 0,
+                    shard_base: int = 0) -> list[tuple[int, int, int]]:
+    """Residency segments for one contiguous block placement: row i of a
+    length-n feed lands on shard ``i // per``."""
+    segs = []
+    for s in range(n_shards):
+        lo, hi = s * per, min(n, (s + 1) * per)
+        if hi > lo:
+            segs.append((gid_base + lo, gid_base + hi, shard_base + s))
+    return segs
+
+
+def segments_shard_of(segments: list, gids: np.ndarray) -> np.ndarray:
+    """Map gids to their holding shard through residency segments
+    (-1 for gids outside every segment, including the no-segments
+    case — unknown residency must never masquerade as shard 0)."""
+    gids = np.asarray(gids, dtype=np.int64)
+    if not segments or not len(gids):
+        return np.full(len(gids), -1, dtype=np.int64)
+    segs = sorted(segments)
+    starts = np.array([s[0] for s in segs], dtype=np.int64)
+    ends = np.array([s[1] for s in segs], dtype=np.int64)
+    shards = np.array([s[2] for s in segs], dtype=np.int64)
+    i = np.clip(np.searchsorted(starts, gids, side="right") - 1,
+                0, len(segs) - 1)
+    out = shards[i]
+    out[(gids < starts[i]) | (gids >= ends[i])] = -1
+    return out
+
+
+def _multihost_segments(mesh: Mesh, n_local: int, gid_start: int,
+                        m_per: int | None = None) -> list:
+    """Residency segments for one multihost feed: every process's block
+    placement, in gid space (``proc << GID_PROC_SHIFT | row``).  Each
+    process's cursor/load allgathers so the map is identical
+    everywhere."""
+    from .multihost import (
+        _agreed_padded_local, allgather_concat, local_device_count,
+    )
+    local_shards = local_device_count(mesh)
+    per = (m_per if m_per is not None
+           else max(1, _agreed_padded_local(n_local, local_shards)
+                    // local_shards))
+    pairs = allgather_concat(
+        np.array([[n_local, gid_start]], dtype=np.int64))
+    segs: list = []
+    for p, (n_p, start_p) in enumerate(pairs):
+        segs.extend(_block_segments(
+            int(n_p), per, local_shards,
+            gid_base=int(encode_gids(np.array([start_p]), p)[0]),
+            shard_base=p * local_shards))
+    return segs
+
+
 def multihost_gid_span() -> int:
     """Value span of multihost gids (``process << GID_PROC_SHIFT |
     row``): what batched-scan wire codings must reserve for the position
@@ -98,6 +152,17 @@ def _fetch_global(a) -> np.ndarray:
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.process_allgather(a, tiled=True))
     return np.asarray(a)
+
+
+def _put_global(mesh: Mesh, arr: np.ndarray):
+    """Place an identical-on-every-process host array sharded over the
+    mesh's shard axis (the write-side dual of :func:`_fetch_global`:
+    plain device_put can't target non-addressable devices)."""
+    sharding = NamedSharding(mesh, P("shard"))
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(arr), sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda i: arr[i])
 
 
 @lru_cache(maxsize=32)
@@ -325,6 +390,12 @@ class ShardedZ3Index:
         self.t_min_ms = t_min_ms
         self.t_max_ms = t_max_ms
         self._capacity = self.DEFAULT_CAPACITY
+        #: gid-residency segments [(gid_lo, gid_hi_excl, shard), ...] —
+        #: which device shard HOLDS each contiguous gid block (builds
+        #: and appends place contiguous blocks).  The per-shard reduce
+        #: protocols (arrow delta streams, stat partials) group result
+        #: rows by TRUE residency through shard_of_gids.
+        self._segments: list[tuple[int, int, int]] = []
 
     # -- builds -----------------------------------------------------------
     @classmethod
@@ -358,6 +429,7 @@ class ShardedZ3Index:
         idx = cls(mesh, period, bins_s, z_s, gid_s, x_s, y_s, t_s,
                   n_total=n, shard_counts=shard_counts.astype(np.int64),
                   version=version)
+        idx._segments = _block_segments(n, per, n_shards)
         if n:
             idx.t_min_ms = int(dtg_ms.min())
             idx.t_max_ms = int(dtg_ms.max())
@@ -401,12 +473,14 @@ class ShardedZ3Index:
         big = np.iinfo(np.int64)
         t_min = agreed_int(dtg_ms.min() if n_local else big.max, "min")
         t_max = agreed_int(dtg_ms.max() if n_local else big.min, "max")
-        return cls(mesh, period, bins_s, z_s, gid_s, x_s, y_s, t_s,
-                   n_total=n_total,
-                   shard_counts=global_shard_counts(n_local, mesh),
-                   t_min_ms=None if n_total == 0 else t_min,
-                   t_max_ms=None if n_total == 0 else t_max,
-                   version=version, multihost=True, n_local=n_local)
+        idx = cls(mesh, period, bins_s, z_s, gid_s, x_s, y_s, t_s,
+                  n_total=n_total,
+                  shard_counts=global_shard_counts(n_local, mesh),
+                  t_min_ms=None if n_total == 0 else t_min,
+                  t_max_ms=None if n_total == 0 else t_max,
+                  version=version, multihost=True, n_local=n_local)
+        idx._segments = _multihost_segments(mesh, n_local, gid_start=0)
+        return idx
 
     # -- bookkeeping ------------------------------------------------------
     def total(self) -> int:
@@ -414,6 +488,13 @@ class ShardedZ3Index:
 
     def __len__(self) -> int:
         return self._n_total
+
+    def shard_of_gids(self, gids: np.ndarray) -> np.ndarray:
+        """Device shard HOLDING each gid (true residency, from the
+        placement segments builds/appends record).  The per-shard reduce
+        protocols group result rows with this — the 'which data node
+        served this row' fact of the reference's distributed scans."""
+        return segments_shard_of(self._segments, gids)
 
     @staticmethod
     def unrank_position(gid: int) -> tuple[int, int]:
@@ -481,6 +562,8 @@ class ShardedZ3Index:
             put(self._shard_counts.astype(np.int32)))
         new_counts = np.clip(m - np.arange(n_shards) * m_per, 0, m_per)
         self._shard_counts = self._shard_counts + new_counts
+        self._segments.extend(
+            _block_segments(m, m_per, n_shards, gid_base=self._n_total))
         self._n_total += m
         self._n_local += m
         t_min, t_max = int(dtg_ms.min()), int(dtg_ms.max())
@@ -538,6 +621,8 @@ class ShardedZ3Index:
             xd, yd, offd, bind, td, gidd, rd)
         self._shard_counts = self._shard_counts + global_shard_counts(
             m_local, self.mesh, m_per=m_per)
+        self._segments.extend(_multihost_segments(
+            self.mesh, m_local, gid_start=self._n_local, m_per=m_per))
         self._n_total += m_global
         self._n_local += m_local
         big = np.iinfo(np.int64)
@@ -729,7 +814,7 @@ class ShardedZ3Index:
 
     def query_ring(self, boxes, t_lo_ms: int, t_hi_ms: int,
                    max_ranges: int = 2000,
-                   capacity: int = 1 << 12) -> np.ndarray:
+                   capacity: int | None = None) -> np.ndarray:
         """Exact query via the RING-PARALLEL scan: the plan shards over
         the mesh and rotates (ppermute) while data stays stationary, so
         no device ever replicates more than 1/N of the ranges — the
@@ -743,39 +828,87 @@ class ShardedZ3Index:
             return np.empty(0, dtype=np.int64)
         return self._query_ring_plan(plan, capacity)
 
+    #: per-hop ring buffer ceiling: each pass holds an
+    #: (n_devices × capacity) travelling buffer per device — plans with
+    #: more candidates than this CHUNK into multiple ring passes instead
+    #: of growing the buffer without bound
+    RING_MAX_CAPACITY = 1 << 15
+
     def _query_ring_plan(self, plan,
-                         capacity: int = 1 << 12) -> np.ndarray:
+                         capacity: int | None = None) -> np.ndarray:
         n = int(self.mesh.devices.size)
-        pad = (-plan.num_ranges) % n
-        r = {
-            "rbin": np.concatenate(
-                [plan.rbin, np.full(pad, -2, plan.rbin.dtype)]),
-            "rzlo": np.concatenate(
-                [plan.rzlo, np.ones(pad, plan.rzlo.dtype)]),
-            "rzhi": np.concatenate(
-                [plan.rzhi, np.zeros(pad, plan.rzhi.dtype)]),
-            "rtlo": np.concatenate(
-                [plan.rtlo, np.ones(pad, plan.rtlo.dtype)]),
-            "rthi": np.concatenate(
-                [plan.rthi, np.zeros(pad, plan.rthi.dtype)]),
-        }
+        spec = NamedSharding(self.mesh, P("shard"))
+        put = lambda a: _put_global(self.mesh, np.asarray(a))
         ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
                              pad_pow2(len(plan.boxes), minimum=1))
-        spec = NamedSharding(self.mesh, P("shard"))
-        put = lambda a: jax.device_put(jnp.asarray(a), spec)
-        while True:
-            ring = _z3_ring_query_program(self.mesh, capacity)
-            packed, totals = ring(
-                self.bins, self.z, self.gid, self.x, self.y, self.dtg,
-                put(r["rbin"]), put(r["rzlo"]), put(r["rzhi"]),
-                put(r["rtlo"]), put(r["rthi"]),
-                jnp.asarray(ixy), jnp.asarray(bxs),
-                jnp.int64(plan.t_lo_ms), jnp.int64(plan.t_hi_ms))
-            tot = _fetch_global(totals)
-            if int(tot.max(initial=0)) <= capacity:
-                flat = _fetch_global(packed).ravel()
-                return np.unique(flat[flat >= 0]).astype(np.int64)
-            capacity = gather_capacity(int(tot.max()))
+
+        def padded(lo: int, hi: int) -> dict:
+            pad = (-(hi - lo)) % n
+            return {
+                "rbin": np.concatenate(
+                    [plan.rbin[lo:hi], np.full(pad, -2, plan.rbin.dtype)]),
+                "rzlo": np.concatenate(
+                    [plan.rzlo[lo:hi], np.ones(pad, plan.rzlo.dtype)]),
+                "rzhi": np.concatenate(
+                    [plan.rzhi[lo:hi], np.zeros(pad, plan.rzhi.dtype)]),
+                "rtlo": np.concatenate(
+                    [plan.rtlo[lo:hi], np.ones(pad, plan.rtlo.dtype)]),
+                "rthi": np.concatenate(
+                    [plan.rthi[lo:hi], np.zeros(pad, plan.rthi.dtype)]),
+            }
+
+        ixy_d, bxs_d = jnp.asarray(ixy), jnp.asarray(bxs)
+        t_lo_d = jnp.int64(plan.t_lo_ms)
+        t_hi_d = jnp.int64(plan.t_hi_ms)
+
+        def ring_pass(r: dict, cap: int) -> np.ndarray:
+            gid_dt = np.dtype(self.gid.dtype)
+            while True:
+                hop = _z3_ring_hop_program(self.mesh, cap)
+                state = (put(r["rbin"]), put(r["rzlo"]), put(r["rzhi"]),
+                         put(r["rtlo"]), put(r["rthi"]),
+                         _put_global(self.mesh,
+                                     np.full((n * n, cap), -1, gid_dt)),
+                         _put_global(self.mesh,
+                                     np.zeros((n * n,), np.int64)))
+                for i in range(n):
+                    state = hop(
+                        self.bins, self.z, self.gid, self.x, self.y,
+                        self.dtg, *state[:5], ixy_d, bxs_d,
+                        t_lo_d, t_hi_d, jnp.int32(i), *state[5:])
+                tot = _fetch_global(state[6])
+                if int(tot.max(initial=0)) <= cap:
+                    flat = _fetch_global(state[5]).ravel()
+                    return flat[flat >= 0]
+                cap = gather_capacity(int(tot.max()))
+
+        if capacity is not None:  # explicit capacity: one pass, retries
+            return np.unique(
+                ring_pass(padded(0, plan.num_ranges), capacity)
+            ).astype(np.int64)
+        # totals-first probe: per-range candidate counts size the buffer
+        # BEFORE running the full ring (no capacity-walk recompiles),
+        # and chunk the plan so every pass's buffer stays bounded
+        r_all = padded(0, plan.num_ranges)
+        counts = ring_range_counts(
+            self.mesh, self.bins, self.z, put(r_all["rbin"]),
+            put(r_all["rzlo"]), put(r_all["rzhi"]))[: plan.num_ranges]
+        budget = self.RING_MAX_CAPACITY
+        bounds = [0]
+        acc = 0
+        for i, c in enumerate(counts):
+            if acc + int(c) > budget and i > bounds[-1]:
+                bounds.append(i)
+                acc = 0
+            acc += int(c)
+        bounds.append(plan.num_ranges)
+        parts = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            chunk_total = int(counts[lo:hi].sum())
+            cap = gather_capacity(max(chunk_total, 1), minimum=1 << 12)
+            parts.append(ring_pass(padded(lo, hi), cap))
+        return np.unique(np.concatenate(parts)).astype(np.int64) \
+            if parts else np.empty(0, dtype=np.int64)
 
     def _weight_table(self, weights):
         """Replicated (table, per-process bases) for weight/value lookups
@@ -883,66 +1016,59 @@ def ring_range_counts(mesh, bins, z, rbin, rzlo, rzhi) -> np.ndarray:
 
 
 @lru_cache(maxsize=32)
-def _z3_ring_query_program(mesh: Mesh, capacity: int):
-    """Ring-parallel FULL query: the covering-range plan is sharded over
-    the mesh and rotates with ``ppermute`` while each device's sorted
-    data shard stays stationary — the ring-attention communication
-    pattern applied to index scanning (SURVEY §5 long-context analog).
+def _z3_ring_hop_program(mesh: Mesh, capacity: int):
+    """ONE hop of the ring-parallel FULL query: the covering-range plan
+    is sharded over the mesh and rotates with ``ppermute`` while each
+    device's sorted data shard stays stationary — the ring-attention
+    communication pattern applied to index scanning (SURVEY §5
+    long-context analog).
 
-    Each of N hops seeks the resident range block against the local
-    segment, packs that hop's hit gids into the block's travelling
-    buffer, and rotates block + buffer to the neighbor; after N hops
-    every block is home carrying hits from ALL shards.  Unlike the
+    Each hop seeks the resident range block against the local segment,
+    packs that hop's hit gids into the block's travelling buffer, and
+    rotates block + buffer to the neighbor; the host loops N hops, after
+    which every block is home carrying hits from ALL shards.  Unlike the
     replicated-plan scan, no device ever holds more than 1/N of the
     ranges — the path for plans too large to replicate (massive
-    multi-window tube/kNN batches, planner cost sweeps)."""
+    multi-window tube/kNN batches, planner cost sweeps).
+
+    Hops are separate dispatches rather than a ``lax.scan`` because the
+    segment gather inside a scan body overflows v5e scoped VMEM (~19MB
+    fused scratch regardless of shapes, measured on chip); the identical
+    body compiles cleanly as a standalone program."""
     n = mesh.devices.size
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     @partial(
         shard_map, mesh=mesh,
         in_specs=(P("shard"),) * 6 + (P("shard"),) * 5 + (P(None),) * 2
-        + (P(), P()),
-        out_specs=(P("shard"), P("shard")),
+        + (P(), P(), P()) + (P("shard"), P("shard")),
+        out_specs=(P("shard"),) * 7,
     )
-    def ring(lb, lz, lg, xs, ys, ts, rb, rlo, rhi, rtl, rth,
-             ixy, bxs, t_lo, t_hi):
-        # anchor the travelling buffers to a sharded operand so the scan
-        # carry is device-varying from step 0 (shard_map requires carried
-        # ppermute values to be varying; see ring_range_counts)
-        anchor = rb[0] * 0
-        out0 = (jnp.full((n, capacity), -1, dtype=lg.dtype)
-                + anchor.astype(lg.dtype))
-        tot0 = jnp.zeros((n,), jnp.int64) + anchor.astype(jnp.int64)
+    def hop(lb, lz, lg, xs, ys, ts, rb, rlo, rhi, rtl, rth,
+            ixy, bxs, t_lo, t_hi, i, out, tot):
+        starts = searchsorted2(lb, lz, rb, rlo, side="left")
+        ends = searchsorted2(lb, lz, rb, rhi, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
+        gc = lg[idx]
+        mask = valid_slot & (gc >= 0) & candidate_mask(
+            lz[idx], rtl[rid], rth[rid], ixy, bxs,
+            xs[idx], ys[idx], ts[idx], t_lo, t_hi)
+        out = jax.lax.dynamic_update_slice(
+            out, jnp.where(mask, gc, gc.dtype.type(-1))[None, :],
+            (i, jnp.int32(0)))
+        tot = jax.lax.dynamic_update_slice(
+            tot, jnp.sum(counts)[None].astype(jnp.int64), (i,))
+        rb = jax.lax.ppermute(rb, "shard", perm)
+        rlo = jax.lax.ppermute(rlo, "shard", perm)
+        rhi = jax.lax.ppermute(rhi, "shard", perm)
+        rtl = jax.lax.ppermute(rtl, "shard", perm)
+        rth = jax.lax.ppermute(rth, "shard", perm)
+        out = jax.lax.ppermute(out, "shard", perm)
+        tot = jax.lax.ppermute(tot, "shard", perm)
+        return rb, rlo, rhi, rtl, rth, out, tot
 
-        def step(carry, i):
-            rb, rlo, rhi, rtl, rth, out, tot = carry
-            starts = searchsorted2(lb, lz, rb, rlo, side="left")
-            ends = searchsorted2(lb, lz, rb, rhi, side="right")
-            counts = jnp.maximum(ends - starts, 0)
-            idx, valid_slot, rid = expand_ranges(starts, counts, capacity)
-            gc = lg[idx]
-            mask = valid_slot & (gc >= 0) & candidate_mask(
-                lz[idx], rtl[rid], rth[rid], ixy, bxs,
-                xs[idx], ys[idx], ts[idx], t_lo, t_hi)
-            out = out.at[i].set(
-                jnp.where(mask, gc, gc.dtype.type(-1)))
-            tot = tot.at[i].set(jnp.sum(counts))
-            rb = jax.lax.ppermute(rb, "shard", perm)
-            rlo = jax.lax.ppermute(rlo, "shard", perm)
-            rhi = jax.lax.ppermute(rhi, "shard", perm)
-            rtl = jax.lax.ppermute(rtl, "shard", perm)
-            rth = jax.lax.ppermute(rth, "shard", perm)
-            out = jax.lax.ppermute(out, "shard", perm)
-            tot = jax.lax.ppermute(tot, "shard", perm)
-            return (rb, rlo, rhi, rtl, rth, out, tot), None
-
-        (rb, rlo, rhi, rtl, rth, out, tot), _ = jax.lax.scan(
-            step, (rb, rlo, rhi, rtl, rth, out0, tot0),
-            jnp.arange(n), length=n)
-        return out.reshape(n * capacity), tot
-
-    return jax.jit(ring)
+    return jax.jit(hop)
 
 
 def gid_weight_lookup(gs, table, bases):

@@ -119,26 +119,37 @@ def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
     return res
 
 
-def _shard_slices(n: int, n_shards: int):
-    """Contiguous per-shard row slices (the per-tablet partial-result
-    partition for the host-merge reducers)."""
-    per = -(-n // n_shards) if n else 0
-    return [slice(s, min(s + per, n))
-            for s in range(0, n, per)] if per else []
+def _shard_groups(n: int, shards) -> list[np.ndarray]:
+    """Per-shard row groups for the host-merge reducers.
+
+    ``shards`` is either an int (contiguous block split — exactly the
+    residency a fresh build would create, used when no sharded index
+    exists yet) or a precomputed per-row shard-id array from
+    ``shard_of_gids`` (TRUE residency, including append placements)."""
+    if isinstance(shards, (int, np.integer)):
+        per = -(-n // int(shards)) if n else 0
+        return [np.arange(s, min(s + per, n))
+                for s in range(0, n, per)] if per else []
+    shards = np.asarray(shards)
+    # unknown-residency rows (-1) form their own group: dropping them
+    # would silently lose rows from the reduce
+    return [np.flatnonzero(shards == s) for s in np.unique(shards)]
 
 
-def merged_stats(batch, stat_spec: str, n_shards: int) -> Stat:
+def merged_stats(batch, stat_spec: str, shards) -> Stat:
     """Per-shard observe + monoid merge (the client-side Reducer): each
-    shard's rows fold into a fresh stat, partials merge pairwise.  For
-    exact stats (count, minmax, histogram, enumeration, descriptive)
-    the merge is exactly the single-pass result; sketches (TopK,
-    Frequency) merge within their approximation guarantees — the same
-    contract as the reference's Stat.+ (Stat.scala:31-90)."""
+    shard's RESIDENT rows fold into a fresh stat, partials merge
+    pairwise.  For exact stats (count, minmax, histogram, enumeration,
+    descriptive) the merge is exactly the single-pass result; sketches
+    (TopK, Frequency) merge within their approximation guarantees — the
+    same contract as the reference's Stat.+ (Stat.scala:31-90).
+    ``shards``: shard-id-per-row array (true residency) or an int block
+    split (see _shard_groups)."""
     proto = parse_stat(stat_spec)
     partials = []
-    for sl in _shard_slices(len(batch), n_shards):
+    for rows in _shard_groups(len(batch), shards):
         part = proto.fresh_copy()
-        part.observe(batch.take(np.arange(sl.start, sl.stop)))
+        part.observe(batch.take(rows))
         partials.append(part)
     if not partials:
         return proto
@@ -148,20 +159,28 @@ def merged_stats(batch, stat_spec: str, n_shards: int) -> Stat:
     return merged
 
 
-def merged_arrow(batch, sft, n_shards: int,
+def merged_arrow(batch, sft, shards,
                  dictionary_fields: tuple[str, ...] = (),
                  sort_field: str | None = None, reverse: bool = False):
     """Per-shard DeltaWriter streams + merge_deltas k-way merge (the
-    ArrowScan reduce): each shard's rows stream through an independent
-    delta-dictionary writer (its dictionary accumulates only ITS values,
-    as on a data node), and the client merge decodes + merges.  Returns
-    a pyarrow Table."""
+    ArrowScan reduce): each shard's RESIDENT rows stream through an
+    independent delta-dictionary writer (its dictionary accumulates only
+    ITS values, as on a data node), and the client merge decodes +
+    merges.  Without a sort field the merged table restores the input
+    row order (single-chip parity) via a host permutation over the
+    per-stream ordinals.  Returns a pyarrow Table."""
     from ..arrow.delta import DeltaWriter
     from ..arrow.reader import merge_deltas
 
+    groups = _shard_groups(len(batch), shards)
     streams = []
-    for sl in _shard_slices(len(batch), n_shards):
+    for rows in groups:
         w = DeltaWriter(sft, dictionary_fields, sort_field, reverse)
-        w.write(batch.take(np.arange(sl.start, sl.stop)))
+        w.write(batch.take(rows))
         streams.append(w.finish())
-    return merge_deltas(streams, sort_field=sort_field, reverse=reverse)
+    merged = merge_deltas(streams, sort_field=sort_field, reverse=reverse)
+    if merged is not None and sort_field is None and len(groups) > 1:
+        # concat order is stream-major; restore global row order
+        ordinals = np.concatenate(groups)
+        merged = merged.take(np.argsort(ordinals, kind="stable"))
+    return merged

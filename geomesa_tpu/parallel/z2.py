@@ -176,6 +176,13 @@ class ShardedZ2Index:
         self._multihost = multihost
         self._n_local = n_total if n_local is None else n_local
         self._capacity = self.DEFAULT_CAPACITY
+        #: gid-residency segments (see ShardedZ3Index)
+        self._segments: list[tuple[int, int, int]] = []
+
+    def shard_of_gids(self, gids: np.ndarray) -> np.ndarray:
+        """Device shard holding each gid (see ShardedZ3Index)."""
+        from .scan import segments_shard_of
+        return segments_shard_of(self._segments, gids)
 
     @classmethod
     def build(cls, x, y, mesh: Mesh | None = None,
@@ -194,9 +201,12 @@ class ShardedZ2Index:
         n_shards = int(mesh.devices.size)
         per = int(z_s.shape[0]) // n_shards
         shard_counts = np.clip(n - np.arange(n_shards) * per, 0, per)
-        return cls(mesh, z_s, gid_s, x_s, y_s, n_total=n,
-                   shard_counts=shard_counts.astype(np.int64),
-                   version=version)
+        idx = cls(mesh, z_s, gid_s, x_s, y_s, n_total=n,
+                  shard_counts=shard_counts.astype(np.int64),
+                  version=version)
+        from .scan import _block_segments
+        idx._segments = _block_segments(n, per, n_shards)
+        return idx
 
     @classmethod
     def build_multihost(cls, x, y, mesh: Mesh | None = None,
@@ -221,10 +231,13 @@ class ShardedZ2Index:
         xd, yd, gidd = sharded
         z_s, gid_s, x_s, y_s = _z2_build_program(
             mesh, z2_sfc_for_version(version))(xd, yd, gidd, valid)
-        return cls(mesh, z_s, gid_s, x_s, y_s,
-                   n_total=agreed_int(n_local, "sum"),
-                   shard_counts=global_shard_counts(n_local, mesh),
-                   version=version, multihost=True, n_local=n_local)
+        idx = cls(mesh, z_s, gid_s, x_s, y_s,
+                  n_total=agreed_int(n_local, "sum"),
+                  shard_counts=global_shard_counts(n_local, mesh),
+                  version=version, multihost=True, n_local=n_local)
+        from .scan import _multihost_segments
+        idx._segments = _multihost_segments(mesh, n_local, gid_start=0)
+        return idx
 
     def total(self) -> int:
         return self._n_total
@@ -264,6 +277,9 @@ class ShardedZ2Index:
             put(self._shard_counts.astype(np.int32)))
         self._shard_counts = self._shard_counts + np.clip(
             m - np.arange(n_shards) * m_per, 0, m_per)
+        from .scan import _block_segments
+        self._segments.extend(
+            _block_segments(m, m_per, n_shards, gid_base=self._n_total))
         self._n_total += m
         self._n_local += m
         return self
@@ -302,6 +318,9 @@ class ShardedZ2Index:
             self.z, self.gid, self.x, self.y, xd, yd, gidd, rd)
         self._shard_counts = self._shard_counts + global_shard_counts(
             m_local, self.mesh, m_per=m_per)
+        from .scan import _multihost_segments
+        self._segments.extend(_multihost_segments(
+            self.mesh, m_local, gid_start=self._n_local, m_per=m_per))
         self._n_total += m_global
         self._n_local += m_local
         return self

@@ -346,25 +346,49 @@ class QueryPlanner:
         if query.sort_by:
             keys = batch.column(query.sort_by)[local]
             if keys.dtype == object:
-                # match _sort_limit's None-last contract: gather a
-                # none-mask alongside the stringified keys (astype(str)
-                # alone would sort None as the literal 'None')
-                none = np.array([k is None for k in keys])
-                safe = np.array(["" if k is None else str(k)
-                                 for k in keys])
-                all_keys = allgather_strings(safe)
+                # match _sort_limit's object contract: (is_none, value)
+                # ascending — numeric comparables gather as floats (str
+                # would order 10 before 9), everything else as strings
+                none = np.array([k is None for k in keys], dtype=bool)
+                vals = [k for k in keys if k is not None]
+                # agreed across processes: numeric only if EVERY
+                # process's keys are numeric (divergent dtypes would
+                # mismatch the gather collectives)
+                from ..parallel.multihost import agreed_int
+                numeric = bool(agreed_int(
+                    int(all(isinstance(v, (int, float)) for v in vals)),
+                    "min"))
+                ints = numeric and bool(agreed_int(
+                    int(all(isinstance(v, int)
+                            and -(2 ** 62) < v < 2 ** 62 for v in vals)),
+                    "min"))
+                if ints:
+                    # exact int64 gather: float64 would collapse values
+                    # past 2^53 (e.g. nanosecond epochs), breaking order
+                    # parity with _sort_limit's exact comparisons
+                    safe = np.array([0 if k is None else int(k)
+                                     for k in keys], dtype=np.int64)
+                    all_keys = allgather_concat(safe)
+                elif numeric:
+                    safe = np.array([0.0 if k is None else float(k)
+                                     for k in keys])
+                    all_keys = allgather_concat(safe)
+                else:
+                    all_keys = allgather_strings(np.array(
+                        ["" if k is None else str(k) for k in keys],
+                        dtype=object))
                 all_none = allgather_concat(none)
             else:
                 all_keys = allgather_concat(keys)
                 all_none = np.zeros(len(all_keys), dtype=bool)
             all_gids = allgather_concat(gids)
-            # stable none-last value sort (the _sort_limit contract)
+            # stable (is_none, value) ascending sort, then a FULL
+            # reverse for descending — exactly _sort_limit's order[::-1]
+            # (which puts Nones first on descending sorts)
             order = np.lexsort((np.arange(len(all_keys)),
                                 all_keys, all_none))
             if query.sort_desc:
-                # descending values, Nones STILL last
-                nn = ~all_none[order]
-                order = np.concatenate([order[nn][::-1], order[~nn]])
+                order = order[::-1]
             positions = all_gids[order]
         else:
             positions = np.sort(allgather_concat(gids))

@@ -26,10 +26,19 @@ def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
         if pushed is not None:
             return pushed
     result = store.query_result(schema, query)
-    if mesh is not None and len(result.batch):
+    # gate on positions, not the batch: under multihost positions is the
+    # GLOBAL gid list (identical everywhere) while the local batch slice
+    # differs per process — a divergent gate would strand peers in the
+    # merge collective
+    if mesh is not None and len(result.positions):
+        # per-shard partials over TRUE residency + monoid merge (the
+        # per-node StatsScan + client Reducer); multihost additionally
+        # merges the per-process partials through the same monoid
         from ..parallel.stats import merged_stats
-        return merged_stats(result.batch, stat_spec,
-                            int(mesh.devices.size))
+        st = store._store(schema)
+        shards = store._hit_residency(st, result.positions)
+        merged = merged_stats(result.batch, stat_spec, shards)
+        return st.merge_stat_global(merged)
     stat = parse_stat(stat_spec)
     if len(result.batch):
         stat.observe(result.batch)
@@ -50,8 +59,16 @@ def _collective_stats(store, schema: str, query, stat_spec: str):
     q = query if isinstance(query, Query) else Query.of(query)
     sft = store.get_schema(schema)
     st = store._store(schema)
-    if not (sft.is_points and sft.dtg_field and st.batch is not None
-            and len(st.batch)):
+    if st.multihost:
+        # agreed gate: a zero-local-row process must still enter the
+        # collective scans its peers run
+        if st.batch is None:
+            from ..features.batch import FeatureBatch
+            st.batch = FeatureBatch.empty(sft)
+        n_gate = st.stats_map()["count"].count
+    else:
+        n_gate = 0 if st.batch is None else len(st.batch)
+    if not (sft.is_points and sft.dtg_field and n_gate):
         return None
     plan = _bbox_time_only(q.filter, sft.geom_field, sft.dtg_field)
     if plan is None:

@@ -184,6 +184,17 @@ gr = np.asarray(got.positions) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
 assert np.array_equal(np.sort(gr[gp == proc]), want_local)
 assert ds.get_count("evt") == 800 + 813 + 40 + 45
 
+# zero-local-hit divergence: an id filter whose hits ALL live on
+# process 0 — process 1 must still enter the collectives (stats_process
+# monoid merge, get_count via positions) instead of short-circuiting
+from geomesa_tpu.process import stats_process
+one = ds.query_result("evt", "IN ('p0.0')")
+assert len(one.positions) == 1
+assert len(one.batch) == (1 if proc == 0 else 0)
+assert ds.get_count("evt", "IN ('p0.0')") == 1
+st_one = stats_process(ds, "evt", "IN ('p0.0')", "Count()")
+assert st_one.count == 1, st_one.count
+
 # merged global stats + bounds
 env = ds.get_bounds("evt")
 assert env is not None and env.xmin >= -75.0 and env.xmax <= -73.0

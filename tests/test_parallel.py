@@ -369,7 +369,7 @@ def test_huge_plan_routes_through_ring(sharded, data, monkeypatch):
     calls = {"ring": 0}
     orig = ShardedZ3Index._query_ring_plan
 
-    def spy(self, plan, capacity=1 << 12):
+    def spy(self, plan, capacity=None):
         calls["ring"] += 1
         return orig(self, plan, capacity)
 
@@ -384,3 +384,25 @@ def test_huge_plan_routes_through_ring(sharded, data, monkeypatch):
         (x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
         & (t >= tlo) & (t <= thi))
     np.testing.assert_array_equal(np.sort(hits), brute)
+
+
+def test_ring_query_probe_avoids_retry(sharded, data, monkeypatch):
+    """With no explicit capacity the ring query probes totals first and
+    sizes the buffer so the full ring program compiles exactly once —
+    no capacity-walk recompiles (VERDICT r2 weak #7)."""
+    from geomesa_tpu.parallel import scan as scan_mod
+    compiles = []
+    orig = scan_mod._z3_ring_hop_program
+
+    def spy(mesh, capacity):
+        compiles.append(capacity)
+        return orig(mesh, capacity)
+
+    monkeypatch.setattr(scan_mod, "_z3_ring_hop_program", spy)
+    x, y, t = data
+    box = (-74.5, 40.5, -73.5, 41.5)
+    tlo, thi = MS_2018 + 86_400_000, MS_2018 + 6 * 86_400_000
+    ring = sharded.query_ring([box], tlo, thi)
+    rep = sharded.query([box], tlo, thi)
+    np.testing.assert_array_equal(ring, np.sort(rep))
+    assert len(compiles) == 1, compiles

@@ -176,3 +176,81 @@ def test_mesh_store_stats_process_distributed():
     a = stats_process(plain, "obs", ecql, "MinMax(score)")
     b = stats_process(mesh, "obs", ecql, "MinMax(score)")
     assert a.to_json() == b.to_json()
+
+
+def test_shard_of_gids_residency_after_append():
+    """Placement segments map every gid (build + append blocks) to the
+    shard that actually holds it; the reduce protocols group by this."""
+    rng = np.random.default_rng(61)
+    n, m = 4_000, 900
+    x = rng.uniform(-75, -73, n); y = rng.uniform(40, 42, n)
+    t = rng.integers(MS, MS + 7 * DAY, n)
+    mesh = device_mesh()
+    idx = ShardedZ3Index.build(x, y, t, period="week", mesh=mesh)
+    idx.append(rng.uniform(-75, -73, m), rng.uniform(40, 42, m),
+               rng.integers(MS, MS + 7 * DAY, m))
+    n_shards = int(mesh.devices.size)
+    sh = idx.shard_of_gids(np.arange(n + m))
+    assert sh.min() >= 0 and sh.max() < n_shards
+    # build rows: contiguous blocks of ceil(n/n_shards)
+    per = -(-n // n_shards)
+    np.testing.assert_array_equal(sh[:n], np.arange(n) // per)
+    # append rows: blocks of the append's per-shard slot count
+    counts = np.bincount(sh[n:], minlength=n_shards)
+    assert counts.sum() == m and counts.max() <= -(-m // n_shards) * 2
+
+
+def test_mesh_arrow_unsorted_row_order_parity():
+    """Without a sort field the merged arrow table restores the exact
+    single-chip row order (positions order), even though streams are
+    residency-grouped."""
+    rng = np.random.default_rng(67)
+    n = 3_511
+    data = {
+        "name": np.array(["a", "b", "c"], dtype=object)[
+            rng.integers(0, 3, n)],
+        "score": rng.uniform(0, 10, n),
+        "dtg": rng.integers(MS, MS + 7 * DAY, n),
+        "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n)),
+    }
+    spec = "name:String:index=true,score:Double,dtg:Date,*geom:Point"
+    plain = TpuDataStore()
+    mesh = TpuDataStore(mesh=device_mesh())
+    for ds in (plain, mesh):
+        ds.create_schema("obs", spec)
+        ds.write("obs", data)
+        ds.write("obs", {k: (v if not isinstance(v, tuple)
+                             else (v[0][:100], v[1][:100]))
+                         if not isinstance(v, np.ndarray) else v[:100]
+                         for k, v in data.items()})  # append block
+    ecql = "BBOX(geom, -74.5, 40.5, -73.5, 41.5)"
+    ta = plain.query_arrow("obs", ecql, dictionary_fields=("name",))
+    tb = mesh.query_arrow("obs", ecql, dictionary_fields=("name",))
+    assert ta.num_rows == tb.num_rows
+    np.testing.assert_allclose(np.asarray(ta.column("score")),
+                               np.asarray(tb.column("score")))
+    assert ta.column("__fid__").to_pylist() == tb.column("__fid__").to_pylist()
+
+
+def test_merged_sketches_under_adversarial_skew():
+    """All heavy hitters on ONE shard (the merge-contract stress from
+    VERDICT r2 weak #8): TopK/Frequency partials must survive the
+    monoid merge with exact counts when capacity exceeds cardinality."""
+    sft = parse_spec("skew", "name:String,score:Double,dtg:Date,*geom:Point")
+    n = 8_000
+    names = np.array(["rare%d" % (i % 50) for i in range(n)], dtype=object)
+    names[:1000] = "heavy_a"   # heavy hitters land entirely in shard 0
+    names[1000:1800] = "heavy_b"
+    rng = np.random.default_rng(71)
+    batch = FeatureBatch.from_dict(sft, {
+        "name": names, "score": rng.uniform(0, 1, n),
+        "dtg": rng.integers(MS, MS + DAY, n),
+        "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n))})
+    merged = merged_stats(batch, "TopK(name)", 8)
+    top = dict(merged.topk(2))
+    assert top["heavy_a"] == 1000 and top["heavy_b"] == 800
+    freq = merged_stats(batch, "Frequency(name)", 8)
+    # count-min never undercounts and is near-exact at this cardinality
+    assert freq.count("heavy_a") >= 1000
+    assert freq.count("heavy_b") >= 800
+    assert freq.count("heavy_a") <= 1000 + n // 50
