@@ -130,6 +130,13 @@ class _SchemaStore:
         self._vis_masks: dict = {}
         self._dirty = True
         self._indexes: dict = {}
+        #: rows covered by each cached index (indexes kept across
+        #: writes serve [0, coverage) from their structure and the
+        #: appended TAIL [coverage, n) as unconditional candidates)
+        self._index_coverage: dict[str, int] = {}
+        #: per-index-type build counter (observability + the
+        #: no-full-rebuild regression tests)
+        self.build_counts: dict[str, int] = {}
         self._stats: dict[str, Stat] = {}
         #: monotonic auto feature-id counter — never decremented on
         #: delete, so ids are never reused (the reference's generators
@@ -182,23 +189,77 @@ class _SchemaStore:
             self._id_set.update(batch.ids.astype(str).tolist())
         self._mutation_version += 1
         self._vis_masks: dict = {}
-        # incremental z3 maintenance: appended rows merge into the
-        # resident sorted columns in one gather pass (BatchWriter-style)
-        # instead of forcing a full device re-sort; every other index
-        # rebuilds lazily as before.  A z3 index cached across a prior
-        # unprocessed mutation (dirty) is stale and must NOT be appended
-        # to.
-        z3 = None if self._dirty else self._indexes.get("z3")
-        self._indexes.clear()
+        # Incremental index maintenance (IndexAdapter.IndexWriter.write
+        # role, api/IndexAdapter.scala:95-106): z3 and z2 APPEND the new
+        # rows into their resident sorted columns (one gather pass, no
+        # full re-sort); xz/attr/id indexes are KEPT — their structure
+        # serves the rows they cover and queries add the appended TAIL
+        # as unconditional candidates (residual filtering keeps results
+        # exact), compacting lazily when the tail grows (datastore.
+        # index() accessors).  Indexes cached across a prior unprocessed
+        # mutation (dirty) are stale and dropped wholesale.
+        if self._dirty:
+            self._indexes.clear()
+            self._index_coverage.clear()
+        z3 = self._indexes.get("z3")
+        z2 = self._indexes.get("z2")
+        # the cached attr-z3-tier keys cover only pre-append rows; a
+        # fresh attribute build must recompute them
+        self._indexes.pop("attr-z3-keys", None)
         self._dev_xy = None
         self._dirty = False
-        if (z3 is not None and self.sft.is_points and self.sft.geom_field
-                and self.sft.dtg_field):
-            x, y = batch.geom_xy(self.sft.geom_field)
-            self._indexes["z3"] = z3.append(
-                x, y, batch.column(self.sft.dtg_field))
-        else:
-            self._dirty = True
+        n_now = len(self.batch)
+        if z3 is not None:
+            if self.sft.is_points and self.sft.geom_field and self.sft.dtg_field:
+                x, y = batch.geom_xy(self.sft.geom_field)
+                self._indexes["z3"] = z3.append(
+                    x, y, batch.column(self.sft.dtg_field))
+                self._index_coverage["z3"] = n_now
+            else:
+                self._indexes.pop("z3", None)
+                self._index_coverage.pop("z3", None)
+        if z2 is not None:
+            if self.sft.is_points and self.sft.geom_field and hasattr(
+                    z2, "append"):
+                x, y = batch.geom_xy(self.sft.geom_field)
+                self._indexes["z2"] = z2.append(x, y)
+                self._index_coverage["z2"] = n_now
+            else:
+                self._indexes.pop("z2", None)
+                self._index_coverage.pop("z2", None)
+
+    #: tail fraction that triggers a compacting rebuild of a kept index
+    TAIL_COMPACT_FRACTION = 8  # tail > coverage/8 (12.5%)
+
+    def _maybe_compact(self, key: str) -> None:
+        """Drop a kept index whose appended tail outgrew the lazy-scan
+        budget — the next accessor call rebuilds over all rows (the
+        compaction role of the reference's periodic major compaction)."""
+        cov = self._index_coverage.get(key)
+        if cov is None or key not in self._indexes or self.batch is None:
+            return
+        tail = len(self.batch) - cov
+        over = tail > max(4096, cov // self.TAIL_COMPACT_FRACTION)
+        if self.multihost:
+            # AGREED: any process over threshold → all compact together
+            # (a one-sided rebuild would enter its collectives alone)
+            from .parallel.multihost import agreed_int
+            over = bool(agreed_int(int(over), "max"))
+        if over:
+            del self._indexes[key]
+            del self._index_coverage[key]
+            if key.startswith("attr:"):
+                self._indexes.pop("attr-z3-keys", None)
+
+    def index_tail(self, key: str) -> np.ndarray | None:
+        """Rows appended after the cached index's build — queries union
+        them into the candidate set (they are not in the index's
+        structure; the residual filter keeps exactness)."""
+        cov = self._index_coverage.get(key)
+        if cov is None or self.batch is None:
+            return None
+        n = len(self.batch)
+        return np.arange(cov, n, dtype=np.int64) if n > cov else None
 
     def masked_batch(self, auths):
         """Batch with attribute-guarded values nulled for these auths —
@@ -357,6 +418,7 @@ class _SchemaStore:
     def _rebuild_if_dirty(self):
         if self._dirty:
             self._indexes.clear()
+            self._index_coverage.clear()
             self._dev_xy = None
             self._dirty = False
 
@@ -385,6 +447,7 @@ class _SchemaStore:
         schema's enabled-index restriction and applicability."""
         from .index.registry import get_index
         self._rebuild_if_dirty()
+        self._maybe_compact(name)
         if name not in self._indexes:
             desc = get_index(name)
             enabled = self.sft.enabled_indices
@@ -399,6 +462,8 @@ class _SchemaStore:
                 self._indexes[name] = desc.build_sharded(self, self.mesh)
             else:
                 self._indexes[name] = desc.build(self)
+            self._index_coverage[name] = len(self.batch)
+            self.build_counts[name] = self.build_counts.get(name, 0) + 1
         return self._indexes[name]
 
     def z3_index(self) -> Z3PointIndex:
@@ -497,22 +562,32 @@ class _SchemaStore:
                 f"index 'attr' is disabled on schema {self.sft.name!r} "
                 "(geomesa.indices.enabled)")
         key = f"attr:{attr}"
+        self._maybe_compact(key)
         if key not in self._indexes:
+            self._index_coverage[key] = len(self.batch)
+            self.build_counts[key] = self.build_counts.get(key, 0) + 1
             if self.mesh is not None:
-                # mesh mode: date-tiered collective scans (the z3 tier's
-                # spatial refinement comes from the planner's residual
-                # filter — see parallel/attribute.py module doc)
+                # mesh mode: tier selection mirrors the single-chip
+                # index — z3 tier (fused rank|bin + z keys) for point
+                # schemas with dtg, date tier when only dtg
                 from .parallel.attribute import ShardedAttributeIndex
-                secondary = (
-                    np.asarray(self.batch.column(self.sft.dtg_field),
-                               np.int64)
-                    if self.sft.dtg_field else None)
                 builder = (ShardedAttributeIndex.build_multihost
                            if self.multihost
                            else ShardedAttributeIndex.build)
-                self._indexes[key] = builder(
-                    attr, self.batch.column(attr), secondary=secondary,
-                    mesh=self.mesh)
+                if (self.sft.dtg_field and self.sft.is_points
+                        and self.sft.geom_field):
+                    bins, z = self._z3_tier_keys()
+                    self._indexes[key] = builder(
+                        attr, self.batch.column(attr), mesh=self.mesh,
+                        sec_bins=bins, sec_z=z)
+                else:
+                    secondary = (
+                        np.asarray(self.batch.column(self.sft.dtg_field),
+                                   np.int64)
+                        if self.sft.dtg_field else None)
+                    self._indexes[key] = builder(
+                        attr, self.batch.column(attr),
+                        secondary=secondary, mesh=self.mesh)
                 return self._indexes[key]
             # secondary tier selection mirrors the reference: Z3 keys
             # when the schema has point geometry + dtg, date keys when

@@ -121,11 +121,16 @@ def _geom_mask_polygonal(batch: FeatureBatch, prop: str, geom, op: str) -> np.nd
     env = geom.envelope
     cand = bbox_intersects(packed.bbox, env.as_tuple())
     out = np.zeros(n, dtype=bool)
+    if op == "intersects":
+        # batched exact predicate over the SoA buffers — the hot residual
+        # re-check runs vectorized, not per-candidate (round-3 next #4)
+        from ..geometry.predicates import packed_intersects
+        idx = np.flatnonzero(cand)
+        out[idx] = packed_intersects(packed, geom, idx)
+        return out
     for i in np.flatnonzero(cand):
         gi = packed.geometry(int(i))
-        if op == "intersects":
-            out[i] = geometry_intersects(gi, geom)
-        elif op == "within":
+        if op == "within":
             out[i] = geometry_within(gi, geom)
         elif op == "contains":
             out[i] = geometry_within(geom, gi)

@@ -23,6 +23,7 @@ __all__ = [
     "points_on_rings",
     "segments_intersect",
     "geometry_intersects",
+    "packed_intersects",
 ]
 
 _EDGE_CHUNK = 4096  # bound the (points × edges) broadcast memory
@@ -95,8 +96,13 @@ def point_in_polygon(px, py, geom: Geometry, include_boundary: bool = True) -> n
     py = np.asarray(py, dtype=np.float64)
     rings = _rings_of(geom)
     inside = _crossing_parity(px, py, rings)
-    if include_boundary:
-        inside |= points_on_rings(px, py, rings)
+    if include_boundary and inside.ndim and not inside.all():
+        # boundary test only for parity-outside points (x|y == x|(y&~x))
+        # — the on-segment broadcast is the costlier half
+        out = np.flatnonzero(~inside)
+        inside[out] = points_on_rings(px[out], py[out], rings)
+    elif include_boundary and not inside.ndim:
+        inside = inside | points_on_rings(px, py, rings)
     return inside
 
 
@@ -362,6 +368,181 @@ def geometry_intersects(a: Geometry, b: Geometry) -> bool:
             if segments_intersect(a1[s:s + _EDGE_CHUNK], a2[s:s + _EDGE_CHUNK], b1, b2).any():
                 return True
     return False
+
+
+#: candidates per block for the packed re-check's broadcast stages
+_CAND_CHUNK = 1 << 16
+
+
+def _packed_edges(sub, pt_kind_of_coord: np.ndarray):
+    """Edge endpoint indices of a PackedGeometry: consecutive coord pairs
+    within each ring, excluding point-kind geometries (their 'rings' are
+    point lists, not polylines)."""
+    ro = sub.ring_offsets
+    C = len(sub.coords)
+    emask = np.ones(C, dtype=bool)
+    emask[np.maximum(ro[1:] - 1, 0)] = False  # last coord of each ring
+    emask &= ~pt_kind_of_coord
+    return np.flatnonzero(emask)
+
+
+def packed_intersects(packed, query: Geometry,
+                      positions=None) -> np.ndarray:
+    """Vectorized JTS-style ``intersects`` of EVERY candidate geometry in
+    a PackedGeometry column against ONE query geometry.
+
+    The batched form of :func:`geometry_intersects` — identical test
+    structure (envelope → vertex containment both ways → point-kind
+    coincidence/on-line → segment crossings) evaluated as dense array
+    ops over the SoA buffers, replacing the per-candidate Python loop of
+    the exact re-check (the server-side filter role,
+    accumulo/data/AccumuloIndexAdapter.scala:181-195).  Returns a bool
+    mask aligned with ``positions`` (or the whole column)."""
+    sub = (packed if positions is None
+           else packed.take(np.asarray(positions, dtype=np.int64)))
+    n = len(sub)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    env = query.envelope
+    alive = bbox_intersects(sub.bbox, env.as_tuple())
+    hit = np.zeros(n, dtype=bool)
+    if not alive.any():
+        return hit
+
+    gp, pr, ro = (sub.geom_part_offsets, sub.part_ring_offsets,
+                  sub.ring_offsets)
+    coords = sub.coords
+    kinds = sub.kinds
+    poly_kind = (kinds == 4) | (kinds == 5)
+    line_kind = (kinds == 2) | (kinds == 3)
+    pt_kind = (kinds == 0) | (kinds == 1)
+    ring_geom = np.repeat(np.arange(n), pr[gp[1:]] - pr[gp[:-1]])
+    coord_ring = np.repeat(np.arange(len(ro) - 1), np.diff(ro))
+    coord_geom = ring_geom[coord_ring]
+    part_of_ring = np.repeat(np.arange(len(pr) - 1), np.diff(pr))
+    ring_rank = np.arange(len(ro) - 1) - pr[part_of_ring]
+
+    b_poly = isinstance(query, (Polygon, MultiPolygon))
+    b_line = isinstance(query, (LineString, MultiLineString))
+    b_pt = isinstance(query, (Point, MultiPoint))
+    b_pts = _points_of(query)
+
+    # --- any A vertex in B (B polygonal); shell-only for polygon
+    # candidates, all coords otherwise (_points_of semantics) ---
+    if b_poly:
+        a_pts_sel = ((~poly_kind[coord_geom])
+                     | (ring_rank[coord_ring] == 0)) & alive[coord_geom]
+        idx = np.flatnonzero(a_pts_sel)
+        if len(idx):
+            inb = point_in_polygon(coords[idx, 0], coords[idx, 1], query)
+            np.logical_or.at(hit, coord_geom[idx], inb)
+
+    # --- edges of line/poly candidates (owner per edge) ---
+    eidx = _packed_edges(sub, pt_kind[coord_geom])
+    e_owner = coord_geom[eidx]
+
+    # --- any B vertex in A (A polygonal): per-candidate crossing parity
+    # + boundary, chunked over candidate blocks ---
+    poly_alive = np.flatnonzero(poly_kind & alive & ~hit)
+    if len(poly_alive) and len(b_pts):
+        pxq, pyq = b_pts[:, 0], b_pts[:, 1]
+        # restrict to edges owned by live polygon candidates
+        want = np.zeros(n, dtype=bool)
+        want[poly_alive] = True
+        esel = np.flatnonzero(want[e_owner])
+        ea, eb = coords[eidx[esel]], coords[eidx[esel] + 1]
+        eg = e_owner[esel]
+        # chunk boundaries MUST align to candidate edge groups: a
+        # candidate's crossing parity is over ALL its edges (splitting
+        # a group across chunks would break the mod-2)
+        group_starts = np.flatnonzero(np.r_[True, eg[1:] != eg[:-1]]) \
+            if len(eg) else np.empty(0, np.int64)
+        group_ends = np.r_[group_starts[1:], len(eg)] \
+            if len(eg) else np.empty(0, np.int64)
+        budget = max(int(_EDGE_CHUNK * 8 // max(len(pxq), 1)), 1)
+        gi = 0
+        while gi < len(group_starts):
+            gj = gi  # extend while the NEXT group still fits the budget
+            while (gj + 1 < len(group_starts)
+                   and group_ends[gj + 1] - group_starts[gi] <= budget):
+                gj += 1
+            sl = slice(int(group_starts[gi]), int(group_ends[gj]))
+            x1, y1 = ea[sl, 0], ea[sl, 1]
+            x2, y2 = eb[sl, 0], eb[sl, 1]
+            g = eg[sl]
+            straddle = ((y1[None, :] > pyq[:, None])
+                        != (y2[None, :] > pyq[:, None]))
+            with np.errstate(divide="ignore", invalid="ignore"):
+                xint = x1[None, :] + (pyq[:, None] - y1[None, :]) / (
+                    y2[None, :] - y1[None, :]) * (x2[None, :] - x1[None, :])
+            cross = straddle & (pxq[:, None] < xint)
+            # boundary: B vertex exactly on the edge
+            dx, dy = x2 - x1, y2 - y1
+            vx = pxq[:, None] - x1[None, :]
+            vy = pyq[:, None] - y1[None, :]
+            crs = vx * dy[None, :] - vy * dx[None, :]
+            dot = vx * dx[None, :] + vy * dy[None, :]
+            sq = (dx * dx + dy * dy)[None, :]
+            on = (crs == 0) & (dot >= 0) & (dot <= sq)
+            # parity per (vertex, candidate): segment-sum crossings into
+            # per-candidate bins (edges are candidate-contiguous)
+            cuts = np.flatnonzero(np.r_[True, g[1:] != g[:-1]])
+            owners = g[cuts]
+            counts = np.add.reduceat(cross.astype(np.int32), cuts, axis=1)
+            inside = (counts % 2).astype(bool)
+            on_any = np.maximum.reduceat(on, cuts, axis=1)
+            np.logical_or.at(hit, owners, (inside | on_any).any(axis=0))
+            gi = gj + 1
+
+    # --- point-kind candidates vs point/line queries ---
+    if (b_pt or b_line):
+        pt_alive = pt_kind & alive & ~hit
+        idx = np.flatnonzero(pt_alive[coord_geom])
+        if len(idx):
+            px, py = coords[idx, 0], coords[idx, 1]
+            if b_pt:
+                same = ((px[:, None] == b_pts[None, :, 0])
+                        & (py[:, None] == b_pts[None, :, 1])).any(axis=1)
+            else:
+                s1, s2 = _segments(query)
+                rings = [np.vstack([p1, p2]) for p1, p2 in zip(s1, s2)]
+                same = points_on_rings(px, py, rings)
+            np.logical_or.at(hit, coord_geom[idx], same)
+
+    # --- B point-kind vs line candidates: B points on A edges ---
+    if b_pt:
+        line_alive = np.zeros(n, dtype=bool)
+        line_alive[np.flatnonzero(line_kind & alive & ~hit)] = True
+        esel = np.flatnonzero(line_alive[e_owner])
+        if len(esel):
+            ea, eb = coords[eidx[esel]], coords[eidx[esel] + 1]
+            eg = e_owner[esel]
+            dx = eb[:, 0] - ea[:, 0]
+            dy = eb[:, 1] - ea[:, 1]
+            vx = b_pts[:, None, 0] - ea[None, :, 0]
+            vy = b_pts[:, None, 1] - ea[None, :, 1]
+            crs = vx * dy[None, :] - vy * dx[None, :]
+            dot = vx * dx[None, :] + vy * dy[None, :]
+            sq = (dx * dx + dy * dy)[None, :]
+            on = ((crs == 0) & (dot >= 0) & (dot <= sq)).any(axis=0)
+            np.logical_or.at(hit, eg, on)
+
+    # --- segment crossings: A edges × B segments ---
+    if not b_pt:
+        q1, q2 = _segments(query)
+        if len(q1):
+            seg_alive = np.zeros(n, dtype=bool)
+            seg_alive[np.flatnonzero((line_kind | poly_kind)
+                                     & alive & ~hit)] = True
+            esel = np.flatnonzero(seg_alive[e_owner])
+            ea, eb = coords[eidx[esel]], coords[eidx[esel] + 1]
+            eg = e_owner[esel]
+            for s in range(0, len(ea), _EDGE_CHUNK):
+                sl = slice(s, s + _EDGE_CHUNK)
+                crossing = segments_intersect(ea[sl], eb[sl], q1, q2)
+                np.logical_or.at(hit, eg[sl], crossing.any(axis=1))
+
+    return hit & alive
 
 
 def _strict_inside(pts: np.ndarray, poly: Geometry) -> np.ndarray:

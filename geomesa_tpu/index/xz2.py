@@ -67,11 +67,8 @@ class XZ2Index:
             return np.empty(0, dtype=np.int64)
         cand = cand[bbox_intersects(self.bbox[cand], env.as_tuple())]
         if exact and self.geoms is not None and not _is_envelope(geometry, env):
-            keep = [
-                p for p in cand
-                if geometry_intersects(self.geoms.geometry(int(p)), geometry)
-            ]
-            cand = np.asarray(keep, dtype=np.int64)
+            from ..geometry.predicates import packed_intersects
+            cand = cand[packed_intersects(self.geoms, geometry, cand)]
         return np.sort(cand).astype(np.int64)
 
 

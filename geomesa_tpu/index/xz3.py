@@ -92,9 +92,6 @@ class XZ3Index:
         if exact and self.geoms is not None:
             from .xz2 import _is_envelope
             if not _is_envelope(geometry, env):
-                cand = np.asarray(
-                    [p for p in cand
-                     if geometry_intersects(self.geoms.geometry(int(p)), geometry)],
-                    dtype=np.int64,
-                )
+                from ..geometry.predicates import packed_intersects
+                cand = cand[packed_intersects(self.geoms, geometry, cand)]
         return np.sort(cand).astype(np.int64)

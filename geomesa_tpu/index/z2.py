@@ -187,18 +187,46 @@ def _encode_sort_z2(sfc, a, b):
         dimension=0, num_keys=1)
 
 
+#: sentinel key for append padding: sorts last, matches no query range
+_SENTINEL_Z2 = np.int64(np.iinfo(np.int64).max)
+
+
+@partial(jax.jit, static_argnames=("sfc",))
+def _z2_append_step(sfc, z, pos, x, y, r, xs, ys, m):
+    """Write a new batch's coords at the capacity tail, encode its z
+    keys into the sentinel slots starting at sorted position ``r``, and
+    re-sort keys+pos in place (see Z3PointIndex._append_step: on TPU the
+    sort network IS the cheapest merge)."""
+    x = jax.lax.dynamic_update_slice(x, xs, (r,))
+    y = jax.lax.dynamic_update_slice(y, ys, (r,))
+    z_new = sfc.index(xs, ys)
+    valid = jnp.arange(xs.shape[0]) < m
+    z_new = jnp.where(valid, z_new, _SENTINEL_Z2)
+    pos_new = jnp.where(
+        valid, r + jnp.arange(xs.shape[0], dtype=pos.dtype),
+        pos.dtype.type(-1))
+    z = jax.lax.dynamic_update_slice(z, z_new, (r,))
+    pos = jax.lax.dynamic_update_slice(pos, pos_new, (r,))
+    z, pos = jax.lax.sort((z, pos), dimension=0, num_keys=1)
+    return z, pos, x, y
+
+
 class Z2PointIndex:
     """Device-resident Z2 index over point features."""
 
     DEFAULT_CAPACITY = 1 << 15
 
-    def __init__(self, z, pos, x, y, version: int = Z2_INDEX_VERSION):
+    def __init__(self, z, pos, x, y, version: int = Z2_INDEX_VERSION,
+                 n_rows: int | None = None):
         self.version = version
         self.sfc = z2_sfc_for_version(version)
         self.z = z
         self.pos = pos
         self.x = x
         self.y = y
+        #: valid rows (the z/pos tail beyond this holds append-padding
+        #: sentinels)
+        self._n_rows = int(z.shape[0]) if n_rows is None else n_rows
         self._capacity = self.DEFAULT_CAPACITY
 
     @classmethod
@@ -210,10 +238,45 @@ class Z2PointIndex:
         xd = jnp.asarray(x) if xd is None else xd
         yd = jnp.asarray(y) if yd is None else yd
         z_s, pos = _encode_sort_z2(sfc, xd, yd)
-        return cls(z=z_s, pos=pos, x=xd, y=yd, version=version)
+        return cls(z=z_s, pos=pos, x=xd, y=yd, version=version,
+                   n_rows=len(x))
 
     def __len__(self) -> int:
-        return int(self.z.shape[0])
+        return self._n_rows
+
+    def _grow_capacity(self, cap: int) -> None:
+        pad = cap - int(self.z.shape[0])
+        if pad <= 0:
+            return
+        self.z = jnp.concatenate(
+            [self.z, jnp.full((pad,), _SENTINEL_Z2, self.z.dtype)])
+        self.pos = jnp.concatenate(
+            [self.pos, jnp.full((pad,), -1, self.pos.dtype)])
+        self.x = jnp.concatenate([self.x, jnp.zeros((pad,), self.x.dtype)])
+        self.y = jnp.concatenate([self.y, jnp.zeros((pad,), self.y.dtype)])
+
+    def append(self, x, y) -> "Z2PointIndex":
+        """Incremental ingest (the single-chip side of round-3 next #5):
+        new rows land in the sentinel padding and the capacity-padded
+        columns re-sort in place; shapes bucket by (capacity, pow2(m))
+        so steady-state appends reuse one compiled program."""
+        from ..ops.search import gather_capacity
+        x = np.asarray(x, dtype=np.float64)
+        m = len(x)
+        if m == 0:
+            return self
+        y = np.asarray(y, dtype=np.float64)
+        m_pad = gather_capacity(m, minimum=8)
+        r = self._n_rows
+        if r + m_pad > int(self.z.shape[0]):
+            self._grow_capacity(gather_capacity(r + m_pad))
+        pad = m_pad - m
+        self.z, self.pos, self.x, self.y = _z2_append_step(
+            self.sfc, self.z, self.pos, self.x, self.y, jnp.int32(r),
+            jnp.asarray(np.pad(x, (0, pad))),
+            jnp.asarray(np.pad(y, (0, pad))), jnp.int32(m))
+        self._n_rows = r + m
+        return self
 
     def query(self, boxes, max_ranges: int = DEFAULT_MAX_RANGES) -> np.ndarray:
         """Original-order positions matching any of the bboxes, exactly."""

@@ -10,15 +10,23 @@ order IS key order.  Per-shard state: sorted ``(rank, secondary)`` key
 columns + the gid payload; queries map value predicates to rank ranges on
 the host and run one collective seek+gather scan.
 
-The **date tier** mirrors the single-chip index
-(:class:`geomesa_tpu.index.attribute.AttributeIndex`): rows sort by
-``(rank, dtg)``, so equality lookups refine by a time window inside the
-value run via the lexicographic 2-key seek.  As in the reference, tiers
-apply only to point lookups (equality / IN); range and prefix scans span
-many value runs and rely on the planner's residual filter.  The z3 tier
-is not materialized on the mesh — spatial refinement of attribute hits
-comes from the planner's residual filter (exactness is unaffected; only
-candidate-set size differs).
+**Tiers** mirror the single-chip index
+(:class:`geomesa_tpu.index.attribute.AttributeIndex`):
+
+* **date tier** — rows sort by ``(rank, dtg)``; equality lookups refine
+  by a time window inside the value run via the lexicographic 2-key
+  seek.
+* **z3 tier** — rows sort by ``((rank << 16) | time_bin, z)``: the rank
+  and the Z3 time bin FUSE into the first key (bins are small ints), so
+  the same 2-key collective scan serves per-``(value, bin)`` z-range
+  seeks — the tiered-range assembly of
+  GeoMesaFeatureIndex.getQueryStrategy (:248-338) with no third sort
+  key needed.  Restores single-chip candidate-set parity on the mesh
+  (round-3 next #6).
+
+As in the reference, tiers apply only to point lookups (equality / IN);
+range and prefix scans span many value runs and rely on the planner's
+residual filter.
 """
 
 from __future__ import annotations
@@ -86,32 +94,54 @@ def _attr_scan_program(mesh: Mesh, capacity: int):
     return jax.jit(scan)
 
 
+#: bits of the first sort key reserved for the Z3 time bin (z3 tier:
+#: key1 = rank << _BIN_BITS | bin); week bins stay far below 2^16
+_BIN_BITS = 16
+
+
+def _tier_keys(ranks: np.ndarray, secondary, sec_bins, sec_z, n: int):
+    """(key1, key2, tier) for the build: z3 tier fuses rank+bin into
+    key1 with z as key2; date tier is (rank, dtg); untired (rank, 0)."""
+    if sec_z is not None:
+        bins = np.asarray(sec_bins, dtype=np.int64)
+        if bins.size and (bins.min() < 0 or bins.max() >= 1 << _BIN_BITS):
+            raise ValueError("time bin exceeds the fused-key budget")
+        return ((ranks << _BIN_BITS) | bins,
+                np.asarray(sec_z, dtype=np.int64), "z3")
+    if secondary is not None:
+        return ranks, np.asarray(secondary, dtype=np.int64), "date"
+    return ranks, np.zeros(n, dtype=np.int64), "none"
+
+
 class ShardedAttributeIndex:
     """Rank-encoded attribute index sharded over a device mesh."""
 
     DEFAULT_CAPACITY = 1 << 14
 
     def __init__(self, mesh: Mesh, attr: str, uniques: np.ndarray,
-                 ranks, sec, gid, n_total: int, has_secondary: bool,
+                 ranks, sec, gid, n_total: int, tier: str = "none",
                  multihost: bool = False):
         self.mesh = mesh
         self.attr = attr
         self.uniques = uniques      # host dictionary, sorted
-        self.ranks = ranks          # sharded sorted int64 rank keys
-        self.sec = sec              # sharded int64 secondary (dtg or 0)
+        self.ranks = ranks          # sharded sorted int64 key1
+        self.sec = sec              # sharded int64 key2 (dtg / z / 0)
         self.gid = gid
         self._n_total = n_total
-        self.has_secondary = has_secondary
+        self.tier = tier
         self._multihost = multihost
         self._capacity = self.DEFAULT_CAPACITY
-        #: parity with the single-chip AttributeIndex attributes the
-        #: planner probes (attribute.py): no z3 tier on the mesh
-        self.secondary = sec if has_secondary else None
-        self.sec_z = None
+        #: the single-chip AttributeIndex attributes the planner probes
+        self.has_secondary = tier == "date"
+        self.secondary = sec if tier == "date" else None
+        self.sec_z = True if tier == "z3" else None
 
     @classmethod
     def build(cls, attr: str, column: np.ndarray, secondary=None,
-              mesh: Mesh | None = None) -> "ShardedAttributeIndex":
+              mesh: Mesh | None = None, sec_bins=None,
+              sec_z=None) -> "ShardedAttributeIndex":
+        """``secondary`` (dtg) selects the date tier; ``sec_bins`` +
+        ``sec_z`` (host-computed Z3 key parts) select the z3 tier."""
         mesh = mesh or device_mesh()
         col = np.asarray(column)
         if col.dtype == object:
@@ -119,17 +149,16 @@ class ShardedAttributeIndex:
         uniques, inv = np.unique(col, return_inverse=True)
         ranks = inv.astype(np.int64)
         n = len(col)
-        sec = (np.asarray(secondary, dtype=np.int64) if secondary is not None
-               else np.zeros(n, dtype=np.int64))
+        k1, k2, tier = _tier_keys(ranks, secondary, sec_bins, sec_z, n)
         gids = np.arange(n, dtype=np.int32)
-        sharded, valid = shard_batch(mesh, ranks, sec, gids)
+        sharded, valid = shard_batch(mesh, k1, k2, gids)
         rk_s, sec_s, gid_s = _attr_build_program(mesh)(*sharded, valid)
-        return cls(mesh, attr, uniques, rk_s, sec_s, gid_s, n,
-                   has_secondary=secondary is not None)
+        return cls(mesh, attr, uniques, rk_s, sec_s, gid_s, n, tier=tier)
 
     @classmethod
     def build_multihost(cls, attr: str, column: np.ndarray, secondary=None,
-                        mesh: Mesh | None = None) -> "ShardedAttributeIndex":
+                        mesh: Mesh | None = None, sec_bins=None,
+                        sec_z=None) -> "ShardedAttributeIndex":
         """Multi-controller build from per-process LOCAL columns.
 
         The rank dictionary must be GLOBAL (the same value must map to
@@ -137,7 +166,6 @@ class ShardedAttributeIndex:
         re-unique — bounded by value cardinality, never row count; rows
         themselves feed only locally (process_local_shard), gids code
         ``process << GID_PROC_SHIFT | local_row``."""
-        import jax
         from .multihost import (
             agreed_int, allgather_concat, allgather_strings,
             global_device_mesh, process_local_shard,
@@ -154,14 +182,13 @@ class ShardedAttributeIndex:
         uniques = np.unique(gathered)
         ranks = np.searchsorted(uniques, col).astype(np.int64)
         n_local = len(col)
-        sec = (np.asarray(secondary, dtype=np.int64) if secondary is not None
-               else np.zeros(n_local, dtype=np.int64))
+        k1, k2, tier = _tier_keys(ranks, secondary, sec_bins, sec_z,
+                                  n_local)
         gids = encode_gids(np.arange(n_local, dtype=np.int64))
-        sharded, valid = process_local_shard(mesh, ranks, sec, gids)
+        sharded, valid = process_local_shard(mesh, k1, k2, gids)
         rk_s, sec_s, gid_s = _attr_build_program(mesh)(*sharded, valid)
         return cls(mesh, attr, uniques, rk_s, sec_s, gid_s,
-                   agreed_int(n_local, "sum"),
-                   has_secondary=secondary is not None, multihost=True)
+                   agreed_int(n_local, "sum"), tier=tier, multihost=True)
 
     def __len__(self) -> int:
         return self._n_total
@@ -202,17 +229,42 @@ class ShardedAttributeIndex:
         return (int(_SEC_LO) if lo is None else int(lo),
                 int(_SEC_HI) if hi is None else int(hi))
 
+    def _k1(self, rank: int, bin_: int | None = None,
+            hi: bool = False) -> int:
+        """First sort key for a rank: plain rank for date/untired; the
+        fused ``rank << 16 | bin`` for the z3 tier (bin None spans every
+        bin of the rank's run — lo/hi chosen by ``hi``)."""
+        if self.tier != "z3":
+            return int(rank)
+        if bin_ is not None:
+            return (int(rank) << _BIN_BITS) | int(bin_)
+        return ((int(rank) << _BIN_BITS)
+                | ((1 << _BIN_BITS) - 1 if hi else 0))
+
+    def _value_ranges(self, rank: int, s_lo: int, s_hi: int,
+                      z3_ranges) -> list[tuple[int, int, int, int]]:
+        """Lex ranges for one value's run: z3-tiered point lookups seek
+        per-(bin, z-range) sub-runs (tiered-range assembly,
+        GeoMesaFeatureIndex.scala:248-338); otherwise one run-wide range
+        refined by the date window."""
+        if self.tier == "z3" and z3_ranges is not None:
+            rbin, rzlo, rzhi = z3_ranges
+            return [(self._k1(rank, int(b)), int(zl),
+                     self._k1(rank, int(b)), int(zh))
+                    for b, zl, zh in zip(rbin, rzlo, rzhi)]
+        return [(self._k1(rank), s_lo, self._k1(rank, hi=True), s_hi)]
+
     def query_equals(self, value, sec_window=None,
                      z3_ranges=None) -> np.ndarray:
-        """Gids where attr == value, optionally date-tier refined.
-        ``z3_ranges`` is accepted for API parity but unused (see module
-        doc: spatial refinement is the planner's residual filter)."""
+        """Gids where attr == value, tier-refined: by a dtg window (date
+        tier) or a covering ``(rbin, rzlo, rzhi)`` plan (z3 tier)."""
         value = self._cast(value)
         i = np.searchsorted(self.uniques, value)
         if i >= len(self.uniques) or self.uniques[i] != value:
             return np.empty(0, dtype=np.int64)
         s_lo, s_hi = self._sec_bounds(sec_window)
-        return self._scan([(int(i), s_lo, int(i), s_hi)])
+        return self._scan(self._value_ranges(int(i), s_lo, s_hi,
+                                             z3_ranges))
 
     def query_in(self, values, sec_window=None,
                  z3_ranges=None) -> np.ndarray:
@@ -223,7 +275,8 @@ class ShardedAttributeIndex:
             v = self._cast(v)
             i = np.searchsorted(self.uniques, v)
             if i < len(self.uniques) and self.uniques[i] == v:
-                ranges.append((int(i), s_lo, int(i), s_hi))
+                ranges.extend(self._value_ranges(int(i), s_lo, s_hi,
+                                                 z3_ranges))
         return self._scan(ranges)
 
     def query_range(self, lo=None, hi=None, lo_inclusive=True,
@@ -240,7 +293,8 @@ class ShardedAttributeIndex:
                 side="right" if hi_inclusive else "left")) - 1
         if i1 < i0:
             return np.empty(0, dtype=np.int64)
-        return self._scan([(i0, int(_SEC_LO), i1, int(_SEC_HI))])
+        return self._scan([(self._k1(i0), int(_SEC_LO),
+                            self._k1(i1, hi=True), int(_SEC_HI))])
 
     def query_prefix(self, prefix: str) -> np.ndarray:
         """String prefix scan — serves LIKE 'abc%'."""
@@ -251,4 +305,5 @@ class ShardedAttributeIndex:
                                  side="right")) - 1
         if i1 < i0:
             return np.empty(0, dtype=np.int64)
-        return self._scan([(i0, int(_SEC_LO), i1, int(_SEC_HI))])
+        return self._scan([(self._k1(i0), int(_SEC_LO),
+                            self._k1(i1, hi=True), int(_SEC_HI))])

@@ -819,7 +819,7 @@ class ShardedZ3Index:
         the mesh and rotates (ppermute) while data stays stationary, so
         no device ever replicates more than 1/N of the ranges — the
         long-context path for plans too large to broadcast (see
-        :func:`_z3_ring_query_program`).  Returns sorted global gids,
+        :func:`_z3_ring_hop_program`).  Returns sorted global gids,
         identical to :meth:`query`."""
         t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
         plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period,

@@ -179,8 +179,10 @@ def merged_arrow(batch, sft, shards,
         w.write(batch.take(rows))
         streams.append(w.finish())
     merged = merge_deltas(streams, sort_field=sort_field, reverse=reverse)
-    if merged is not None and sort_field is None and len(groups) > 1:
-        # concat order is stream-major; restore global row order
+    if (merged is not None and sort_field is None and len(groups) > 1
+            and not isinstance(shards, (int, np.integer))):
+        # concat order is stream-major; restore global row order (int
+        # block splits are already contiguous-in-order — no reorder)
         ordinals = np.concatenate(groups)
         merged = merged.take(np.argsort(ordinals, kind="stable"))
     return merged

@@ -52,19 +52,17 @@ def _exact_recheck(cand: np.ndarray, geoms: PackedGeometry,
     process re-checks its own candidates (the filter runs next to the
     data, AccumuloIndexAdapter.scala:181-195 role) and the survivors
     allgather; no process ever touches another's geometry payload."""
+    from ..geometry.predicates import packed_intersects
     if not multihost:
-        keep = [p for p in cand
-                if geometry_intersects(geoms.geometry(int(p)), geometry)]
-        return np.asarray(keep, dtype=np.int64)
+        return np.asarray(cand, dtype=np.int64)[
+            packed_intersects(geoms, geometry, cand)]
     import jax
     from .multihost import allgather_concat
     from .scan import decode_gids
     me = jax.process_index()
     procs, rows = decode_gids(cand)
     mine = cand[procs == me]
-    mine_rows = rows[procs == me]
-    keep = [g for g, r in zip(mine, mine_rows)
-            if geometry_intersects(geoms.geometry(int(r)), geometry)]
+    keep = mine[packed_intersects(geoms, geometry, rows[procs == me])]
     return allgather_concat(np.asarray(keep, dtype=np.int64))
 
 _SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)

@@ -189,9 +189,13 @@ class QueryPlanner:
         explain(lambda: f"Executing {name} index scan")
         if name == "id":
             # id index is host-local; multihost lifts the per-process
-            # rows into the global gid space (encode + allgather)
-            return store.to_global_candidates(
-                store.id_index().query(strategy.ids))
+            # rows into the global gid space (encode + allgather); the
+            # appended tail joins BEFORE the lift (tail rows are local)
+            cand = store.id_index().query(strategy.ids)
+            tail = store.index_tail("id")
+            if tail is not None and len(tail):
+                cand = _union([cand, tail])
+            return store.to_global_candidates(cand)
         if name.startswith("attr:"):
             attr = name[5:]
             idx = store.attribute_index(attr)
@@ -209,14 +213,17 @@ class QueryPlanner:
                     and (strategy.geometries or strategy.intervals)):
                 z3_ranges = self._attr_z3_ranges(strategy)
             if kind == "equals":
-                return idx.query_equals(payload, sec_window, z3_ranges)
-            if kind == "in":
-                return idx.query_in(payload, sec_window, z3_ranges)
-            if kind == "range":
+                cand = idx.query_equals(payload, sec_window, z3_ranges)
+            elif kind == "in":
+                cand = idx.query_in(payload, sec_window, z3_ranges)
+            elif kind == "range":
                 lo, hi, lo_inc, hi_inc = payload
-                return idx.query_range(lo, hi, lo_inc, hi_inc)
-            if kind == "prefix":
-                return idx.query_prefix(payload)
+                cand = idx.query_range(lo, hi, lo_inc, hi_inc)
+            elif kind == "prefix":
+                cand = idx.query_prefix(payload)
+            else:
+                raise ValueError(f"unknown attribute query {kind!r}")
+            return self._add_tail(cand, name)
         boxes = [g.envelope.as_tuple() for g in strategy.geometries] or [
             (-180.0, -90.0, 180.0, 90.0)
         ]
@@ -242,12 +249,35 @@ class QueryPlanner:
             for g in strategy.geometries or ():
                 for lo, hi in strategy.intervals:
                     parts.append(idx.query(g, lo, hi, exact=False))
-            return _union(parts)
+            return self._add_tail(_union(parts), "xz3")
         if name == "xz2":
             idx = store.xz2_index()
             parts = [idx.query(g, exact=False) for g in strategy.geometries or ()]
-            return _union(parts)
+            return self._add_tail(_union(parts), "xz2")
         raise ValueError(f"unknown strategy {name!r}")
+
+    def _add_tail(self, cand: np.ndarray, key: str) -> np.ndarray:
+        """Union rows appended after a kept index's build into its
+        candidate set (write-path incremental maintenance: kept indexes
+        serve their covered rows; the tail rides as unconditional
+        candidates and the residual filter keeps results exact).
+        Multihost: tails are per-process local rows; the presence
+        decision is AGREED so every process enters the lift
+        collective."""
+        store = self.store
+        tail = store.index_tail(key) if hasattr(store, "index_tail") \
+            else None
+        n_tail = 0 if tail is None else len(tail)
+        if getattr(store, "multihost", False):
+            from ..parallel.multihost import agreed_int
+            if agreed_int(n_tail, "max") == 0:
+                return cand
+            tail = (tail if tail is not None
+                    else np.empty(0, dtype=np.int64))
+            return _union([cand, store.to_global_candidates(tail)])
+        if n_tail == 0:
+            return cand
+        return _union([cand, tail])
 
     def _scan_or_split(self, strategy: FilterStrategy, query: Query,
                        explain: Explainer) -> np.ndarray | None:
@@ -354,13 +384,16 @@ class QueryPlanner:
                 # agreed across processes: numeric only if EVERY
                 # process's keys are numeric (divergent dtypes would
                 # mismatch the gather collectives)
+                import numbers
+
                 from ..parallel.multihost import agreed_int
                 numeric = bool(agreed_int(
-                    int(all(isinstance(v, (int, float)) for v in vals)),
+                    int(all(isinstance(v, numbers.Real) for v in vals)),
                     "min"))
                 ints = numeric and bool(agreed_int(
-                    int(all(isinstance(v, int)
-                            and -(2 ** 62) < v < 2 ** 62 for v in vals)),
+                    int(all(isinstance(v, numbers.Integral)
+                            and -(2 ** 62) < int(v) < 2 ** 62
+                            for v in vals)),
                     "min"))
                 if ints:
                     # exact int64 gather: float64 would collapse values

@@ -310,6 +310,56 @@ def test_incremental_write_appends_z3_index():
     np.testing.assert_array_equal(np.sort(res2.positions), oracle2)
 
 
+def test_interleaved_writes_no_full_rebuild(rng_mod):
+    """Interleaved write/query keeps every index incremental: z3 and z2
+    append in place, xz/attr/id serve their covered rows plus the
+    appended tail as candidates — no full rebuild per write (round-3
+    next #5; build counters prove it)."""
+    rng = rng_mod
+    ds = TpuDataStore()
+    ds.create_schema("iw", "name:String:index=true,dtg:Date,*geom:Point")
+    n0 = 30_000
+
+    def rows(k, tag):
+        return {"name": np.array([tag] * k, object),
+                "dtg": rng.integers(MS_2018, MS_2018 + 14 * 86_400_000, k),
+                "geom": (rng.uniform(-75, -73, k),
+                         rng.uniform(40, 42, k))}
+
+    ds.write("iw", rows(n0, "a"))
+    st = ds._store("iw")
+    queries = [
+        "BBOX(geom,-74.5,40.5,-73.5,41.5) AND dtg DURING "
+        "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z",   # z3
+        "BBOX(geom,-74.2,40.8,-73.9,41.1)",              # z2
+        "name = 'a'",                                    # attr
+    ]
+    for q in queries:
+        ds.query("iw", q)
+    base_counts = dict(st.build_counts)
+    # 6 interleaved small writes + every query flavor each round
+    for i in range(6):
+        ds.write("iw", rows(500, f"t{i}"))
+        for q in queries + [f"name = 't{i}'", "IN ('3', '77')"]:
+            res = ds.query_result("iw", q)
+            want = np.flatnonzero(
+                evaluate_filter(parse_ecql(q), st.batch))
+            np.testing.assert_array_equal(np.sort(res.positions), want)
+    # z3/z2 appended in place; attr/id kept with tails (500*6 = 3000
+    # rows < the compaction threshold of 4096) — no rebuilds at all
+    assert st.build_counts.get("z3") == base_counts.get("z3") == 1
+    assert st.build_counts.get("z2") == base_counts.get("z2") == 1
+    assert st.build_counts.get("attr:name", 0) <= 1
+    # tails exist and cover exactly the appended rows
+    assert len(st.index_tail("attr:name")) == 3000
+    # a large write crosses the threshold: the next attr query compacts
+    ds.write("iw", rows(6000, "big"))
+    _ = ds.query("iw", "name = 'big'")
+    assert st.index_tail("attr:name") is None or \
+        len(st.index_tail("attr:name")) == 0
+    assert st.build_counts["attr:name"] == 2
+
+
 def test_auto_ids_never_reused_after_delete(tmp_path):
     """Auto feature-ids come from a monotonic counter, not len(batch):
     delete+write must mint FRESH ids (the reference's id generators never
