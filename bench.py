@@ -252,9 +252,63 @@ def main():
         pallas["density_xla_1m_ms"] = round(_median_time(
             lambda: np.asarray(density_grid(
                 xs, ys, ws, ms, env, 256, 128)[:1, :1])) * 1e3, 1)
+
+        # z2 int-space mask: fused Pallas decode+box kernel vs the XLA
+        # deinterleave + (N × R) broadcast (round-3 next #8 kernel #1)
+        from geomesa_tpu.curve.zorder import deinterleave2
+        from geomesa_tpu.ops.pallas_kernels import z2_mask_pallas
+        from geomesa_tpu.curve.sfc import z2_sfc
+        z2v = z2_sfc().index(xs, ys)
+        ixy8 = np.stack([np.array([i << 27, i << 26, (i + 8) << 27,
+                                   (i + 8) << 26], dtype=np.int32)
+                         for i in range(8)])
+
+        @jax.jit
+        def _z2_mask_xla(zz, bx):
+            ix, iy = deinterleave2(zz.astype(jnp.uint64))
+            ix = ix.astype(jnp.int64)
+            iy = iy.astype(jnp.int64)
+            return ((ix[:, None] >= bx[None, :, 0])
+                    & (iy[:, None] >= bx[None, :, 1])
+                    & (ix[:, None] <= bx[None, :, 2])
+                    & (iy[:, None] <= bx[None, :, 3])).any(axis=1)
+
+        try:
+            _ = np.asarray(z2_mask_pallas(z2v, ixy8)[:1])
+            pallas["z2_mask_pallas_1m_ms"] = round(_median_time(
+                lambda: np.asarray(z2_mask_pallas(z2v, ixy8)[:1])) * 1e3, 1)
+        except Exception as e:
+            pallas["z2_mask_pallas_error"] = repr(e)
+        _ = np.asarray(_z2_mask_xla(z2v, jnp.asarray(ixy8))[:1])
+        pallas["z2_mask_xla_1m_ms"] = round(_median_time(
+            lambda: np.asarray(_z2_mask_xla(
+                z2v, jnp.asarray(ixy8))[:1])) * 1e3, 1)
+
+        # 1-D histogram: MXU one-hot kernel vs XLA scatter-add (kernel #2)
+        from geomesa_tpu.ops.pallas_kernels import hist1d_pallas
+        hb = jnp.clip(((xs + 180.0) / 360.0 * 256).astype(jnp.int32),
+                      0, 255)
+
+        @jax.jit
+        def _hist_xla(b, m):
+            return jnp.zeros((256,), jnp.int64).at[b].add(
+                jnp.where(m, 1, 0).astype(jnp.int64))
+
+        try:
+            _ = np.asarray(hist1d_pallas(hb, ws, ms, 256)[:1])
+            pallas["hist1d_pallas_1m_ms"] = round(_median_time(
+                lambda: np.asarray(hist1d_pallas(hb, ws, ms,
+                                                 256)[:1])) * 1e3, 1)
+        except Exception as e:
+            pallas["hist1d_pallas_error"] = repr(e)
+        _ = np.asarray(_hist_xla(hb, ms)[:1])
+        pallas["hist1d_xla_1m_ms"] = round(_median_time(
+            lambda: np.asarray(_hist_xla(hb, ms)[:1])) * 1e3, 1)
         # refresh health after the compiled runs above
         pallas.update(pallas_health())
     pallas["active"] = bool(pallas.get("z3_scan_ok") is not False
+                            and pallas.get("z2_scan_ok") is not False
+                            and pallas.get("hist1d_ok") is not False
                             and pallas["on_tpu"])
 
     print(json.dumps({
@@ -283,9 +337,36 @@ def main():
             "knn25_4m_ms": round(knn_dt * 1e3, 1),
             "tube40_4m_ms": round(tube_dt * 1e3, 1),
             "pallas": pallas,
+            "scale": _scale_stanza(),
             "device": str(jax.devices()[0]),
         },
     }))
+
+
+def _scale_stanza() -> dict:
+    """Scale-proof evidence (round-3 next #7): the RECORDED 500M
+    single-chip run (SCALE_r03.json, produced by scale_proof.py — too
+    long to rerun inside every bench) plus a LIVE smaller streaming
+    build each round so the lean generational path has a recurring
+    regression number.  ``SCALE_LIVE_N=0`` skips the live run."""
+    out: dict = {}
+    rec = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "SCALE_r03.json")
+    if os.path.exists(rec):
+        try:
+            with open(rec) as f:
+                out["recorded_500m"] = json.load(f)
+        except Exception as e:
+            out["recorded_500m_error"] = repr(e)
+    n_live = int(os.environ.get("SCALE_LIVE_N", 64_000_000))
+    if n_live:
+        try:
+            import scale_proof
+            out["live"] = scale_proof.run(n_live, progress=lambda *_: None,
+                                          record=False)
+        except Exception as e:  # never kill the bench over the stanza
+            out["live_error"] = repr(e)
+    return out
 
 
 if __name__ == "__main__":

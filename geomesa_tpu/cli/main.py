@@ -181,6 +181,39 @@ def cmd_explain(args):
     print(_store(args).explain(args.feature_name, args.cql))
 
 
+def cmd_sql(args):
+    """Run a SELECT statement (the geomesa-spark-sql user surface)."""
+    from ..sql import sql_query
+    out = sql_query(_store(args), args.statement)
+    if isinstance(out, int):
+        print(out)
+        return
+    if isinstance(out, dict):  # GROUP BY aggregation
+        keys = list(out)
+        print(",".join(keys))
+        for row in zip(*(out[k] for k in keys)):
+            print(",".join(str(v) for v in row))
+        return
+    names = [a.name for a in out.sft.attributes
+             if not a.is_geometry and a.name in out.columns]
+    gname = out.sft.default_geom
+    packed = out.geoms is not None
+    points = (gname and not packed and f"{gname}_x" in out.columns)
+    print(",".join(["fid"] + names + ([gname] if packed or points else [])))
+    from ..geometry.wkt import geometry_to_wkt
+    xs = ys = None
+    if points:
+        xs, ys = out.geom_xy(gname)
+    for i in range(len(out)):
+        row = [str(out.ids[i])]
+        row += [str(out.column(n)[i]) for n in names]
+        if packed:
+            row.append(geometry_to_wkt(out.geoms.geometry(i)))
+        elif points:
+            row.append(f"POINT ({float(xs[i])} {float(ys[i])})")
+        print(",".join(row))
+
+
 def cmd_stats_count(args):
     ds = _store(args)
     q = args.cql if args.cql else None
@@ -292,6 +325,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("index-versions", cmd_index_versions,
              help="show a schema's index-layout versions")
     catalog(sp)
+
+    sp = add("sql", cmd_sql, help="run a SELECT statement")
+    catalog(sp, feature=False)
+    sp.add_argument("statement", help="SELECT ... FROM <schema> ...")
 
     sp = add("ingest", cmd_ingest, help="ingest files")
     catalog(sp)

@@ -109,11 +109,15 @@ def _query_many_packed(z, pos, x, y, rzlo, rzhi, rqid, ixy, boxes, bqid,
     return pack_coded(total, cqid, posc, mask, pos_bits)
 
 
-@partial(jax.jit, static_argnames=("capacity",))
-def _query_packed(z, pos, x, y, rzlo, rzhi, ixy, boxes, capacity: int):
+@partial(jax.jit, static_argnames=("capacity", "use_pallas"))
+def _query_packed(z, pos, x, y, rzlo, rzhi, ixy, boxes, capacity: int,
+                  use_pallas: bool = False):
     """One-dispatch scan (seeks + gather + fused mask) returning the packed
     ``[total, pos|-1, …]`` vector — one device round trip per query (see
-    z3._query_packed for the protocol rationale)."""
+    z3._query_packed for the protocol rationale).  ``use_pallas`` routes
+    the decode + R-box int test through the fused Pallas kernel (the
+    Z2Filter.inBounds role); the exact float re-check stays XLA (it
+    fuses)."""
     starts = jnp.searchsorted(z, rzlo, side="left")
     ends = jnp.searchsorted(z, rzhi, side="right")
     counts = jnp.maximum(ends - starts, 0)
@@ -121,15 +125,19 @@ def _query_packed(z, pos, x, y, rzlo, rzhi, ixy, boxes, capacity: int):
     idx, valid, _ = expand_ranges(starts, counts, capacity)
     zc = z[idx]
     posc = pos[idx]
-    ix, iy = deinterleave2(zc.astype(jnp.uint64))
-    ix = ix.astype(jnp.int64)
-    iy = iy.astype(jnp.int64)
-    in_box_int = (
-        (ix[:, None] >= ixy[None, :, 0])
-        & (iy[:, None] >= ixy[None, :, 1])
-        & (ix[:, None] <= ixy[None, :, 2])
-        & (iy[:, None] <= ixy[None, :, 3])
-    ).any(axis=1)
+    if use_pallas:
+        from ..ops.pallas_kernels import z2_mask_pallas
+        in_box_int = z2_mask_pallas(zc, ixy)
+    else:
+        ix, iy = deinterleave2(zc.astype(jnp.uint64))
+        ix = ix.astype(jnp.int64)
+        iy = iy.astype(jnp.int64)
+        in_box_int = (
+            (ix[:, None] >= ixy[None, :, 0])
+            & (iy[:, None] >= ixy[None, :, 1])
+            & (ix[:, None] <= ixy[None, :, 2])
+            & (iy[:, None] <= ixy[None, :, 3])
+        ).any(axis=1)
     xc = x[posc]
     yc = y[posc]
     in_box_exact = (
@@ -211,6 +219,8 @@ def _z2_append_step(sfc, z, pos, x, y, r, xs, ys, m):
     return z, pos, x, y
 
 
+
+
 class Z2PointIndex:
     """Device-resident Z2 index over point features."""
 
@@ -287,14 +297,19 @@ class Z2PointIndex:
                        pad_pow2(plan.num_ranges))
         ixy, bxs = pad_boxes(plan.ixy, plan.boxes,
                              pad_pow2(len(plan.boxes), minimum=1))
+        args = (self.z, self.pos, self.x, self.y,
+                jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
+                jnp.asarray(ixy), jnp.asarray(bxs))
 
         def dispatch(capacity):
-            return _query_packed(
-                self.z, self.pos, self.x, self.y,
-                jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
-                jnp.asarray(ixy), jnp.asarray(bxs),
-                capacity=capacity,
-            )
+            from ..ops.pallas_kernels import GATES
+            from .z3 import _use_pallas_scan
+            return GATES["z2_scan"].run(
+                lambda: np.asarray(_query_packed(
+                    *args, capacity=capacity, use_pallas=True)),
+                lambda: _query_packed(*args, capacity=capacity,
+                                      use_pallas=False),
+                enabled=_use_pallas_scan())
 
         hits, self._capacity = run_packed_query(dispatch, self._capacity)
         return hits

@@ -334,9 +334,6 @@ def _query_many_packed(
     return pack_coded(total, cqid, posc, mask, pos_bits)
 
 
-#: tri-state: None = untried, True = pallas scan works on this backend,
-#: False = failed once (e.g. Mosaic lowering) — stay on the XLA path
-_pallas_scan_ok: bool | None = None
 
 
 #: sentinel keys for capacity-padding slots: sort after every real key
@@ -554,29 +551,13 @@ class Z3PointIndex:
             plan.t_lo_ms, plan.t_hi_ms,
         )
         def dispatch(capacity):
-            global _pallas_scan_ok
-            if _pallas_scan_ok is not False and _use_pallas_scan():
-                try:
-                    # materialize INSIDE the try: dispatch is async, so
-                    # kernel failures surface when results reach the host
-                    out = np.asarray(_query_packed(
-                        *args, capacity=capacity, use_pallas=True))
-                    _pallas_scan_ok = True
-                    return out
-                except Exception as e:  # Mosaic failure → XLA path
-                    # LOUD fallback (VERDICT r1 weak #1): a silent switch
-                    # would quietly cost the Pallas speedup forever after
-                    _pallas_scan_ok = False
-                    import logging
-                    logging.getLogger("geomesa_tpu.pallas").warning(
-                        "pallas z3 scan failed (%s: %s); falling back to "
-                        "the XLA path for the rest of this process — "
-                        "check bench 'pallas_active' and the "
-                        "pallas.z3_scan.fallback metric",
-                        type(e).__name__, e)
-                    from ..metrics import registry as _metrics
-                    _metrics.counter("pallas.z3_scan.fallback").inc()
-            return _query_packed(*args, capacity=capacity, use_pallas=False)
+            from ..ops.pallas_kernels import GATES
+            return GATES["z3_scan"].run(
+                lambda: np.asarray(_query_packed(
+                    *args, capacity=capacity, use_pallas=True)),
+                lambda: _query_packed(*args, capacity=capacity,
+                                      use_pallas=False),
+                enabled=_use_pallas_scan())
 
         if self._capacity >= TWO_PHASE_MIN_CAPACITY:
             return self._query_two_phase(args)

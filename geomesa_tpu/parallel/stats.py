@@ -35,9 +35,12 @@ __all__ = ["sharded_stats_scan", "merged_stats", "merged_arrow"]
 
 
 @lru_cache(maxsize=32)
-def _moments_program(mesh: Mesh, hist_bins: int, with_values: bool):
+def _moments_program(mesh: Mesh, hist_bins: int, with_values: bool,
+                     pallas_hist: bool = False):
     """Per-shard masked moments (+ optional fixed-bin histogram) reduced
-    with psum/pmin/pmax — the StatsScan iterator as one collective."""
+    with psum/pmin/pmax — the StatsScan iterator as one collective.
+    ``pallas_hist`` routes the histogram through the MXU one-hot kernel
+    (XLA lowers the scatter-add to a serialized per-element loop)."""
 
     n_sharded = 5 if with_values else 4
     specs = (P("shard"),) * n_sharded + (P(None),) + (P(),) * 4
@@ -70,8 +73,14 @@ def _moments_program(mesh: Mesh, hist_bins: int, with_values: bool):
             w = (h_hi - h_lo) / hist_bins
             b = jnp.clip(((vals - h_lo) / w).astype(jnp.int32),
                          0, hist_bins - 1)
-            hist = jnp.zeros((hist_bins,), jnp.int64).at[b].add(
-                jnp.where(mask, 1, 0).astype(jnp.int64))
+            if pallas_hist:
+                from ..ops.pallas_kernels import hist1d_pallas
+                hist = hist1d_pallas(
+                    b, jnp.ones_like(b, jnp.float32), mask,
+                    hist_bins).astype(jnp.int64)
+            else:
+                hist = jnp.zeros((hist_bins,), jnp.int64).at[b].add(
+                    jnp.where(mask, 1, 0).astype(jnp.int64))
             hist = jax.lax.psum(hist, "shard")
         else:
             hist = jax.lax.psum(jnp.zeros((1,), jnp.int64), "shard")
@@ -92,7 +101,13 @@ def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
     with_values = values is not None
     h_lo, h_hi = (float(hist_range[0]), float(hist_range[1])) \
         if hist_range else (0.0, 1.0)
-    prog = _moments_program(idx.mesh, int(hist_bins), with_values)
+    from ..ops.pallas_kernels import GATES
+    # f32 one-hot accumulation is exact only while every bin count fits
+    # float32's integer range — per-shard rows bound the per-bin count,
+    # so gate on 2^24 rows/shard (the XLA scatter path stays int64)
+    rows_per_shard = int(idx.x.shape[0]) // max(int(idx.mesh.devices.size), 1)
+    gate = GATES["hist1d"]
+    use_pallas = (bool(hist_bins) and rows_per_shard < (1 << 24))
     args = [idx.x, idx.y, idx.dtg, idx.gid]
     if with_values:
         # per-shard gather from the replicated table by gid, offset by
@@ -109,9 +124,16 @@ def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
 
         args.append(jax.jit(gather)(idx.gid, table, bases))
     args.append(jnp.asarray(boxes))
-    out = prog(*args, jnp.int64(t_lo_ms), jnp.int64(t_hi_ms),
-               jnp.float64(h_lo), jnp.float64(h_hi))
-    cnt, s, s2, vmin, vmax, hist = (np.asarray(v) for v in out)
+    tail = (jnp.int64(t_lo_ms), jnp.int64(t_hi_ms),
+            jnp.float64(h_lo), jnp.float64(h_hi))
+
+    def _run(pallas_hist: bool):
+        prog = _moments_program(idx.mesh, int(hist_bins), with_values,
+                                pallas_hist=pallas_hist)
+        return tuple(np.asarray(v) for v in prog(*args, *tail))
+
+    cnt, s, s2, vmin, vmax, hist = gate.run(
+        lambda: _run(True), lambda: _run(False), enabled=use_pallas)
     res = {"count": int(cnt[0]), "sum": float(s[0]), "sumsq": float(s2[0]),
            "min": float(vmin[0]), "max": float(vmax[0])}
     if hist_bins:

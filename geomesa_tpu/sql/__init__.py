@@ -10,5 +10,6 @@ whose ``where`` pushes ECQL predicates into the query planner.
 
 from . import functions as st
 from .frame import SpatialFrame
+from .parser import parse_sql, sql_query
 
-__all__ = ["st", "SpatialFrame"]
+__all__ = ["st", "SpatialFrame", "sql_query", "parse_sql"]

@@ -632,23 +632,70 @@ def st_aggregateDistanceSphere(col) -> float:
     return float(haversine_m(x[:-1], y[:-1], x[1:], y[1:]).sum())
 
 
+def _clip_ring_x(ring: np.ndarray, x0: float, keep_leq: bool):
+    """Sutherland–Hodgman half-plane clip of a closed ring against the
+    vertical line ``x == x0`` (keep x<=x0 or x>=x0).  Returns the clipped
+    closed ring or None when nothing survives."""
+    pts = np.asarray(ring, dtype=np.float64)
+    if len(pts) > 1 and np.array_equal(pts[0], pts[-1]):
+        pts = pts[:-1]
+    out: list = []
+    n = len(pts)
+    for i in range(n):
+        a, b = pts[i], pts[(i + 1) % n]
+        ina = a[0] <= x0 if keep_leq else a[0] >= x0
+        inb = b[0] <= x0 if keep_leq else b[0] >= x0
+        if ina:
+            out.append((a[0], a[1]))
+        if ina != inb:
+            f = (x0 - a[0]) / (b[0] - a[0])
+            out.append((x0, a[1] + f * (b[1] - a[1])))
+    if len(out) < 3:
+        return None
+    out.append(out[0])
+    return np.asarray(out)
+
+
 def st_antimeridianSafeGeom(col) -> np.ndarray:
     """Split polygons that cross the ±180 antimeridian into a
-    MultiPolygon of in-range halves (ST_antimeridianSafeGeom)."""
+    MultiPolygon of in-range halves (ST_antimeridianSafeGeom) — the
+    ACTUAL ring clipped at lon=180, not its envelope (the reference
+    splits the true geometry, SQLFunctions' antimeridian handling)."""
     def fix(g):
         if not isinstance(g, Polygon):
             return g
         xs = g.shell[:, 0]
         if xs.max() - xs.min() <= 180.0:
             return g
-        # treat west-positive wrap: shift negative lons +360, split at 180
-        sx = np.where(xs < 0, xs + 360.0, xs)
-        lo, hi = g.shell[:, 1].min(), g.shell[:, 1].max()
-        east = Polygon([(sx.min(), lo), (180.0, lo), (180.0, hi),
-                        (sx.min(), hi)])
-        west = Polygon([(-180.0, lo), (sx.max() - 360.0, lo),
-                        (sx.max() - 360.0, hi), (-180.0, hi)])
-        return MultiPolygon((east, west))
+        # west-positive wrap: shift negative lons +360 so the ring is
+        # contiguous in [0, 360], then clip the SHIFTED ring at 180
+        def shift(ring):
+            r = np.asarray(ring, dtype=np.float64).copy()
+            r[:, 0] = np.where(r[:, 0] < 0, r[:, 0] + 360.0, r[:, 0])
+            return r
+        shell = shift(g.shell)
+        parts = []
+        east = _clip_ring_x(shell, 180.0, keep_leq=True)
+        if east is not None:
+            holes = tuple(h for h in (
+                _clip_ring_x(shift(hh), 180.0, True) for hh in g.holes)
+                if h is not None)
+            parts.append(Polygon(east, holes))
+        west = _clip_ring_x(shell, 180.0, keep_leq=False)
+        if west is not None:
+            west = west.copy()
+            west[:, 0] -= 360.0
+            holes = []
+            for hh in g.holes:
+                c = _clip_ring_x(shift(hh), 180.0, False)
+                if c is not None:
+                    c = c.copy()
+                    c[:, 0] -= 360.0
+                    holes.append(c)
+            parts.append(Polygon(west, tuple(holes)))
+        if not parts:
+            return g
+        return parts[0] if len(parts) == 1 else MultiPolygon(tuple(parts))
     return np.array([fix(g) for g in _geoms(col)], dtype=object)
 
 

@@ -1,0 +1,177 @@
+"""SQL text front-end: SELECT statements lowered onto SpatialFrame.
+
+The user surface of the reference's Spark SQL integration
+(geomesa-spark/geomesa-spark-sql/.../GeoMesaSparkSQL.scala +
+SQLRules.scala: SQL text → catalyst plan → spatial predicates pushed
+into the datastore query).  Here the planner IS the datastore's, so the
+"catalyst" stage reduces to: parse the statement, rewrite ``st_*``
+spatial calls into ECQL predicates (the push-down rule), and lower
+projection / WHERE / ORDER BY / LIMIT onto a :class:`SpatialFrame`;
+GROUP BY aggregations run vectorized on the scan result.
+
+Supported grammar (single table, no joins — the reference's pushed
+fragment; anything beyond it belongs in the caller's dataframe code)::
+
+    SELECT <*|cols|aggs> FROM <schema>
+      [WHERE <predicate>] [GROUP BY <col>]
+      [ORDER BY <col> [ASC|DESC]] [LIMIT <n>]
+
+Aggregates: count(*), count(col), sum/min/max/avg(col) with optional
+``AS alias`` (GROUP BY required except for a bare global count(*)).
+WHERE accepts ECQL predicates directly plus the Spark-style spatial
+calls ``st_intersects/st_contains/st_within/st_dwithin(geom,
+st_geomFromWKT('...'))`` which rewrite to their ECQL forms.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .frame import SpatialFrame
+
+__all__ = ["sql_query", "parse_sql"]
+
+_CLAUSE = re.compile(
+    r"^\s*SELECT\s+(?P<select>.+?)\s+FROM\s+(?P<table>\w+)"
+    r"(?:\s+WHERE\s+(?P<where>.+?))?"
+    r"(?:\s+GROUP\s+BY\s+(?P<group>\w+))?"
+    r"(?:\s+ORDER\s+BY\s+(?P<order>\w+)(?:\s+(?P<dir>ASC|DESC))?)?"
+    r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL)
+
+_AGG = re.compile(r"^(count|sum|min|max|avg|mean)\s*\(\s*(\*|\w+)\s*\)"
+                  r"(?:\s+AS\s+(\w+))?$", re.IGNORECASE)
+
+#: Spark-SQL spatial call → ECQL predicate rewrites (the SQLRules
+#: push-down step).  ``st_geomFromWKT('WKT')`` unwraps to the bare WKT.
+#: Both argument orders are accepted; with the LITERAL first, contains/
+#: within invert (st_contains(lit, col) ⇔ col WITHIN lit) and the
+#: symmetric predicates keep their name.
+_ST_CALL = re.compile(
+    r"st_(intersects|contains|within|crosses|touches|overlaps)\s*\(\s*"
+    r"(\w+)\s*,\s*st_geomFromWKT\s*\(\s*'([^']+)'\s*\)\s*\)",
+    re.IGNORECASE)
+_ST_CALL_GEOM_FIRST = re.compile(
+    r"st_(intersects|contains|within|crosses|touches|overlaps)\s*\(\s*"
+    r"st_geomFromWKT\s*\(\s*'([^']+)'\s*\)\s*,\s*(\w+)\s*\)",
+    re.IGNORECASE)
+_ST_DWITHIN = re.compile(
+    r"st_dwithin\s*\(\s*(\w+)\s*,\s*st_geomFromWKT\s*\(\s*'([^']+)'\s*\)"
+    r"\s*,\s*([0-9.eE+-]+)\s*\)", re.IGNORECASE)
+_SWAP = {"CONTAINS": "WITHIN", "WITHIN": "CONTAINS"}
+
+
+def _rewrite_where(text: str) -> str:
+    """st_* spatial calls → ECQL predicates (push-down rewrite)."""
+    def sub(m):
+        return f"{m.group(1).upper()}({m.group(2)}, {m.group(3)})"
+
+    def sub_geom_first(m):
+        op = m.group(1).upper()
+        return f"{_SWAP.get(op, op)}({m.group(3)}, {m.group(2)})"
+
+    text = _ST_CALL.sub(sub, text)
+    text = _ST_CALL_GEOM_FIRST.sub(sub_geom_first, text)
+    text = _ST_DWITHIN.sub(
+        lambda m: f"DWITHIN({m.group(1)}, {m.group(2)}, {m.group(3)}, "
+                  "meters)", text)
+    return text
+
+
+class ParsedSQL:
+    def __init__(self, table, columns, aggs, where, group, order,
+                 descending, limit):
+        self.table = table
+        self.columns = columns      # projection names, or None for *
+        self.aggs = aggs            # [(fn, col, alias)] when aggregating
+        self.where = where          # ECQL string or None
+        self.group = group
+        self.order = order
+        self.descending = descending
+        self.limit = limit
+
+
+def parse_sql(text: str) -> ParsedSQL:
+    m = _CLAUSE.match(text)
+    if not m:
+        raise ValueError(f"unsupported SQL statement: {text!r} (expected "
+                         "SELECT ... FROM <schema> [WHERE ...] "
+                         "[GROUP BY ...] [ORDER BY ...] [LIMIT n])")
+    select = m.group("select").strip()
+    columns = None
+    aggs = []
+    if select != "*":
+        parts = [p.strip() for p in select.split(",")]
+        plain = []
+        for p in parts:
+            am = _AGG.match(p)
+            if am:
+                fn = am.group(1).lower()
+                fn = "mean" if fn == "avg" else fn
+                col = am.group(2)
+                alias = am.group(3) or f"{fn}_{col}".replace("*", "rows")
+                aggs.append((fn, col, alias))
+            else:
+                if not re.match(r"^\w+$", p):
+                    raise ValueError(f"unsupported projection {p!r}")
+                plain.append(p)
+        columns = plain or None
+        if aggs and plain and m.group("group") is None:
+            raise ValueError("mixing columns and aggregates needs GROUP BY")
+    where = m.group("where")
+    if where is not None:
+        where = _rewrite_where(where.strip())
+    return ParsedSQL(
+        table=m.group("table"), columns=columns, aggs=aggs, where=where,
+        group=m.group("group"),
+        order=m.group("order"),
+        descending=(m.group("dir") or "").upper() == "DESC",
+        limit=int(m.group("limit")) if m.group("limit") else None)
+
+
+def sql_query(store, text: str):
+    """Execute a SELECT against a TpuDataStore.
+
+    Returns a :class:`FeatureBatch` for row queries, a dict of columns
+    for GROUP BY aggregations, or a scalar for a bare global count(*).
+    """
+    q = parse_sql(text)
+    frame = SpatialFrame(store, q.table)
+    if q.where:
+        frame = frame.where(q.where)
+    if q.aggs and q.group is None:
+        if len(q.aggs) == 1 and q.aggs[0][:2] == ("count", "*"):
+            return frame.count()
+        raise ValueError("aggregates without GROUP BY are limited to "
+                         "count(*)")
+    if q.group is not None:
+        if not q.aggs:
+            raise ValueError("GROUP BY needs aggregate projections")
+        stray = [c for c in (q.columns or []) if c != q.group]
+        if stray:
+            raise ValueError(
+                f"column {stray[0]!r} must appear in the GROUP BY "
+                "clause or be used in an aggregate function")
+        spec = {alias: (q.group if col == "*" else col,
+                        "count" if fn == "count" else fn)
+                for fn, col, alias in q.aggs}
+        out = frame.group_by(q.group, spec)
+        if q.order is not None:
+            key = out[q.order]
+            idx = np.argsort(key, kind="stable")
+            if q.descending:
+                idx = idx[::-1]
+            if q.limit is not None:
+                idx = idx[: q.limit]
+            out = {k: np.asarray(v)[idx] for k, v in out.items()}
+        elif q.limit is not None:
+            out = {k: np.asarray(v)[: q.limit] for k, v in out.items()}
+        return out
+    # row query: projection / sort / limit push into the planner Query
+    from ..planning.planner import Query
+    query = Query(filter=frame._filter, properties=q.columns,
+                  sort_by=q.order, sort_desc=q.descending,
+                  max_features=q.limit)
+    return store.query(q.table, query)

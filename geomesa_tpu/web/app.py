@@ -44,7 +44,8 @@ __all__ = ["WebApp", "serve"]
 class WebApp:
     """WSGI application exposing a TpuDataStore over HTTP."""
 
-    def __init__(self, store, audit_writer=None, geojson=None, blob=None):
+    def __init__(self, store, audit_writer=None, geojson=None, blob=None,
+                 raster=None):
         self.store = store
         # prefer an explicitly-passed audit writer, else the store's
         self.audit = audit_writer or getattr(store, "_audit_writer", None)
@@ -56,6 +57,11 @@ class WebApp:
                                 else GeoJsonApp(geojson))
         #: optional GeoIndexedBlobStore (BlobstoreServlet analog)
         self.blob = blob
+        #: optional raster coverages for the WCS-shaped endpoint
+        #: (geomesa-accumulo-raster's WCS role): name → RasterStore
+        if raster is not None and not isinstance(raster, dict):
+            raster = {getattr(raster, "name", "default"): raster}
+        self.raster = raster
         self._router = Router([
             (r"^/api/version$", self._version),
             (r"^/api/schemas$", self._schemas),
@@ -66,6 +72,7 @@ class WebApp:
             (r"^/api/metrics$", self._metrics_dump),
             (r"^/api/blob$", self._blob_index),
             (r"^/api/blob/([^/]+)$", self._blob_item),
+            (r"^/wcs$", self._wcs),
         ])
 
     # -- WSGI entry point --------------------------------------------------
@@ -241,6 +248,61 @@ class WebApp:
     def _metrics_dump(self, method, params, environ):
         return 200, _metrics.snapshot()
 
+    # -- WCS-shaped raster serving (geomesa-accumulo-raster WCS role) -----
+    def _wcs(self, method, params, environ):
+        """Minimal WCS 1.0-shaped surface: GetCapabilities /
+        DescribeCoverage list the configured RasterStores,
+        GetCoverage mosaics a bbox at a target resolution into PNG
+        (8-bit grayscale) or npy (raw float grid) — the coverage-store
+        serving role of ``geomesa-accumulo/geomesa-accumulo-raster``."""
+        if not self.raster:
+            raise HttpError(404, "no raster coverages configured")
+        req = (params.get("request") or "GetCapabilities").lower()
+        if req == "getcapabilities":
+            items = "".join(
+                f"<CoverageOfferingBrief><name>{n}</name>"
+                f"</CoverageOfferingBrief>" for n in sorted(self.raster))
+            return (200, f"<WCS_Capabilities><ContentMetadata>{items}"
+                         "</ContentMetadata></WCS_Capabilities>",
+                    "text/xml")
+        name = params.get("coverage") or next(iter(sorted(self.raster)))
+        rs = self.raster.get(name)
+        if rs is None:
+            raise HttpError(404, f"no coverage {name!r}")
+        if req == "describecoverage":
+            b = rs.bounds()
+            res = ",".join(str(r) for r in rs.available_resolutions)
+            env = ("" if b is None else
+                   f"<lonLatEnvelope>{b[0]} {b[1]} {b[2]} {b[3]}"
+                   "</lonLatEnvelope>")
+            return (200, f"<CoverageDescription><CoverageOffering>"
+                         f"<name>{name}</name>{env}"
+                         f"<resolutions>{res}</resolutions>"
+                         "</CoverageOffering></CoverageDescription>",
+                    "text/xml")
+        if req != "getcoverage":
+            raise HttpError(400, f"unsupported WCS request {req!r}")
+        bbox = params.get("bbox")
+        if bbox:
+            box = tuple(float(v) for v in bbox.split(","))
+        else:
+            box = rs.bounds()
+            if box is None:
+                raise HttpError(404, f"coverage {name!r} is empty")
+        width = int_param(params, "width", 256)
+        height = int_param(params, "height", 256)
+        res = float_param(params, "resolution", None)
+        grid = rs.mosaic(box, width, height, resolution=res)
+        fmt = (params.get("format") or "png").lower()
+        if fmt in ("npy", "arraybuffer"):
+            import io as _io
+            buf = _io.BytesIO()
+            np.save(buf, np.asarray(grid))
+            return 200, buf.getvalue(), "application/octet-stream"
+        if fmt != "png":
+            raise HttpError(400, f"unsupported format {fmt!r}")
+        return 200, _png_gray(np.asarray(grid)), "image/png"
+
     # -- blob store (geomesa-blobstore-web BlobstoreServlet analog) -------
     def _require_blob(self):
         if self.blob is None:
@@ -279,6 +341,28 @@ class WebApp:
             bs.delete_blob(bid)
             return 204, None
         raise HttpError(405, method)
+
+
+def _png_gray(grid: np.ndarray) -> bytes:
+    """Encode a 2-D float grid as an 8-bit grayscale PNG (stdlib only:
+    zlib deflate + crc32 chunks) — min/max-normalized."""
+    import struct
+    import zlib
+
+    g = np.asarray(grid, dtype=np.float64)
+    lo, hi = float(np.nanmin(g)), float(np.nanmax(g))
+    scale = (g - lo) / (hi - lo) if hi > lo else np.zeros_like(g)
+    img = np.nan_to_num(scale * 255.0).astype(np.uint8)
+    h, w = img.shape
+    raw = b"".join(b"\x00" + img[r].tobytes() for r in range(h))
+
+    def chunk(tag: bytes, payload: bytes) -> bytes:
+        return (struct.pack(">I", len(payload)) + tag + payload
+                + struct.pack(">I", zlib.crc32(tag + payload)))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 0, 0, 0, 0)  # 8-bit gray
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw)) + chunk(b"IEND", b""))
 
 
 def _jsonable(v):

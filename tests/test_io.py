@@ -380,3 +380,16 @@ def test_expression_functions_round2():
     x, y = pe("projectFrom('EPSG:3857', point($x, $y))").evaluate({
         "x": np.array([0.0]), "y": np.array([0.0])})
     assert abs(x[0]) < 1e-9 and abs(y[0]) < 1e-9
+
+
+def test_date_to_string_millis_with_trailing_literal():
+    """SSS followed by a literal ('Z') renders 3-digit millis — the old
+    endswith('000') fixup left 6-digit microseconds (ADVICE r2)."""
+    from geomesa_tpu.io.expressions import parse_expression as pe
+
+    from geomesa_tpu.io.expressions import _fn_date_to_string, _Lit
+
+    cols = {"t": np.array([1515110400123, 1515110400000], dtype=np.int64)}
+    got = list(_fn_date_to_string(
+        cols, _Lit("yyyy-MM-dd'T'HH:mm:ss.SSS'Z'"), pe("$t")))
+    assert got == ["2018-01-05T00:00:00.123Z", "2018-01-05T00:00:00.000Z"]

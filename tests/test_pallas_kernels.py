@@ -93,3 +93,50 @@ def test_density_sorted_matches_scatter():
     b0 = np.asarray(density_grid_sorted(
         x, y, w, jnp.zeros(n, bool), env, 64, 32))
     assert b0.sum() == 0
+
+
+def test_z2_mask_pallas_oracle():
+    """Fused z2 decode + R-box mask == the XLA int-space test (round-3
+    next #8 kernel #1)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from geomesa_tpu.curve.zorder import interleave2
+    from geomesa_tpu.ops.pallas_kernels import z2_mask_pallas
+
+    rng = np.random.default_rng(12)
+    n = 50_000
+    ix = rng.integers(0, 1 << 31, n).astype(np.int64)
+    iy = rng.integers(0, 1 << 31, n).astype(np.int64)
+    z = np.asarray(interleave2(ix, iy, xp=np)).astype(np.int64)
+    boxes = np.array([[1 << 29, 1 << 28, 3 << 29, 3 << 29],
+                      [0, 0, 1 << 27, 1 << 27]], dtype=np.int32)
+    got = np.asarray(z2_mask_pallas(jnp.asarray(z), boxes))
+    want = np.zeros(n, bool)
+    for b in boxes:
+        want |= (ix >= b[0]) & (iy >= b[1]) & (ix <= b[2]) & (iy <= b[3])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hist1d_pallas_oracle():
+    """MXU one-hot 1-D histogram == bincount (exact for unit weights;
+    round-3 next #8 kernel #2)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from geomesa_tpu.ops.pallas_kernels import hist1d_pallas
+
+    rng = np.random.default_rng(13)
+    n = 40_000
+    vals = rng.integers(0, 100, n)
+    mask = rng.random(n) > 0.25
+    got = np.asarray(hist1d_pallas(
+        jnp.asarray(vals), jnp.ones(n, dtype=jnp.float32),
+        jnp.asarray(mask), 100))
+    want = np.bincount(vals[mask], minlength=100).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+    # weighted: f32 accumulation order differs — tolerance-bounded
+    w = rng.uniform(0, 3, n)
+    got = np.asarray(hist1d_pallas(
+        jnp.asarray(vals), jnp.asarray(w, dtype=jnp.float32),
+        jnp.asarray(mask), 100))
+    want = np.bincount(vals[mask], weights=w[mask], minlength=100)
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=3e-4)

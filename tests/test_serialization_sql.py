@@ -271,3 +271,23 @@ def test_st_antimeridian_safe():
     for p in out[0].polygons:
         assert -180.0 <= p.shell[:, 0].min() <= p.shell[:, 0].max() <= 180.0
     assert out[1] is plain
+
+
+def test_st_antimeridian_safe_clips_actual_ring():
+    """The split halves are the ACTUAL ring clipped at lon=180, not its
+    envelope (ADVICE r2): a triangular crossing polygon must produce
+    triangular halves, strictly smaller than the bbox rectangles."""
+    from geomesa_tpu.geometry.types import MultiPolygon, Polygon
+    from geomesa_tpu.sql import functions as F
+    tri = Polygon([(170, 10), (-170, 10), (175, 20), (170, 10)])
+    out = F.st_antimeridianSafeGeom(np.array([tri], dtype=object))
+    mp = out[0]
+    assert isinstance(mp, MultiPolygon) and len(mp.polygons) == 2
+    total_area = 0.0
+    for p in mp.polygons:
+        xs, ys = p.shell[:, 0], p.shell[:, 1]
+        assert -180.0 <= xs.min() <= xs.max() <= 180.0
+        total_area += 0.5 * abs(np.dot(xs[:-1], ys[1:])
+                                - np.dot(ys[:-1], xs[1:]))
+    # shifted-space shoelace area of the true triangle: base 20 x h 10 / 2
+    assert total_area == pytest.approx(100.0, rel=1e-9)

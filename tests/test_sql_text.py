@@ -1,0 +1,124 @@
+"""SQL text front-end: SELECT statements lowered onto the store planner
+(the reference's GeoMesaSparkSQL + SQLRules user surface — round-3
+next #10)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.filters import evaluate_filter, parse_ecql
+from geomesa_tpu.sql import parse_sql, sql_query
+
+MS = 1514764800000
+DAY = 86_400_000
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(3)
+    n = 20_000
+    store = TpuDataStore()
+    store.create_schema(
+        "evt", "name:String:index=true,score:Double,dtg:Date,*geom:Point")
+    store.write("evt", {
+        "name": rng.choice(["a", "b", "c"], n).astype(object),
+        "score": rng.uniform(0, 100, n),
+        "dtg": rng.integers(MS, MS + 14 * DAY, n),
+        "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n))})
+    return store
+
+
+def test_select_star_where_ecql(ds):
+    got = sql_query(ds, "SELECT * FROM evt WHERE "
+                        "BBOX(geom, -74.5, 40.5, -73.5, 41.5)")
+    st = ds._store("evt")
+    want = np.flatnonzero(evaluate_filter(
+        parse_ecql("BBOX(geom, -74.5, 40.5, -73.5, 41.5)"), st.batch))
+    assert len(got) == len(want)
+
+
+def test_spatial_st_call_rewrites_to_ecql(ds):
+    sql = ("SELECT name, score FROM evt WHERE st_intersects(geom, "
+           "st_geomFromWKT('POLYGON((-74.5 40.5, -73.5 40.5, -73.5 41.5,"
+           " -74.5 41.5, -74.5 40.5))')) AND name = 'a'")
+    got = sql_query(ds, sql)
+    st = ds._store("evt")
+    want = np.flatnonzero(evaluate_filter(parse_ecql(
+        "INTERSECTS(geom, POLYGON((-74.5 40.5, -73.5 40.5, -73.5 41.5, "
+        "-74.5 41.5, -74.5 40.5))) AND name = 'a'"), st.batch))
+    assert len(got) == len(want)
+    assert set(got.columns) == {"name", "score"}
+
+
+def test_order_by_limit(ds):
+    got = sql_query(ds, "SELECT name, score FROM evt WHERE name = 'b' "
+                        "ORDER BY score DESC LIMIT 5")
+    scores = got.column("score")
+    assert len(scores) == 5
+    st = ds._store("evt")
+    b_scores = st.batch.column("score")[st.batch.column("name") == "b"]
+    np.testing.assert_allclose(scores, np.sort(b_scores)[::-1][:5])
+
+
+def test_group_by_aggregates(ds):
+    out = sql_query(ds, "SELECT count(*) AS n, avg(score) AS avg_s, "
+                        "max(score) AS mx FROM evt GROUP BY name "
+                        "ORDER BY n DESC")
+    st = ds._store("evt")
+    names = st.batch.column("name")
+    assert list(out["name"]) == sorted(
+        set(names), key=lambda v: -int((names == v).sum()))
+    for i, v in enumerate(out["name"]):
+        m = names == v
+        assert out["n"][i] == m.sum()
+        assert out["avg_s"][i] == pytest.approx(
+            st.batch.column("score")[m].mean())
+        assert out["mx"][i] == pytest.approx(
+            st.batch.column("score")[m].max())
+
+
+def test_global_count(ds):
+    n = sql_query(ds, "SELECT count(*) FROM evt WHERE name = 'c'")
+    st = ds._store("evt")
+    assert n == int((st.batch.column("name") == "c").sum())
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError, match="unsupported SQL"):
+        parse_sql("DELETE FROM evt")
+    with pytest.raises(ValueError, match="GROUP BY"):
+        parse_sql("SELECT name, sum(score) FROM evt")
+    p = parse_sql("SELECT * FROM evt WHERE st_dwithin(geom, "
+                  "st_geomFromWKT('POINT(0 0)'), 1000) LIMIT 3;")
+    assert p.where == "DWITHIN(geom, POINT(0 0), 1000, meters)"
+    assert p.limit == 3
+
+
+def test_cli_sql_command(tmp_path):
+    import io
+    from contextlib import redirect_stdout
+
+    from geomesa_tpu.cli.main import build_parser
+
+    ds = TpuDataStore(str(tmp_path / "cat"))
+    ds.create_schema("pts", "v:Int,dtg:Date,*geom:Point")
+    ds.write("pts", {"v": np.arange(4), "dtg": np.full(4, MS),
+                     "geom": (np.array([-74.0, -73.5, 10.0, 11.0]),
+                              np.array([40.7, 41.0, 5.0, 6.0]))})
+    ds.flush("pts")
+    parser = build_parser()
+    args = parser.parse_args([
+        "sql", "-c", str(tmp_path / "cat"),
+        "SELECT * FROM pts WHERE BBOX(geom, -75, 40, -73, 42)"])
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        args.fn(args)
+    lines = buf.getvalue().strip().splitlines()
+    assert lines[0].startswith("fid,v,dtg")
+    assert len(lines) == 3  # header + 2 hits
+    assert "POINT" in lines[1]
+
+
+def test_group_by_rejects_stray_columns(ds):
+    with pytest.raises(ValueError, match="GROUP BY"):
+        sql_query(ds, "SELECT score, count(*) AS n FROM evt GROUP BY name")

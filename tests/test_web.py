@@ -235,3 +235,50 @@ def test_attribute_level_visibility():
     ds._auth_provider = StaticAuthorizationsProvider(["admin"])
     got = ds.query("av")
     assert list(got.column("ssn")) == ["111", "222"]
+
+
+def test_wcs_endpoints():
+    """WCS-shaped raster serving (the geomesa-accumulo-raster WCS role):
+    capabilities, coverage description, and a GetCoverage mosaic in
+    PNG and npy formats."""
+    from geomesa_tpu.raster import RasterStore
+    from geomesa_tpu.web.app import WebApp
+
+    rs = RasterStore("dem")
+    rs.put(np.arange(64, dtype=np.float64).reshape(8, 8), (0, 0, 8, 8))
+    rs.put(np.ones((8, 8)) * 5.0, (8, 0, 16, 8))
+    wapp = WebApp(TpuDataStore(), raster={"dem": rs})
+
+    def raw(path):
+        captured = {}
+
+        def sr(status, headers):
+            captured["status"] = int(status.split()[0])
+            captured["headers"] = dict(headers)
+
+        qs = ""
+        if "?" in path:
+            path, qs = path.split("?", 1)
+        env = {"REQUEST_METHOD": "GET", "PATH_INFO": path,
+               "QUERY_STRING": qs, "CONTENT_LENGTH": "0",
+               "wsgi.input": io.BytesIO(b"")}
+        body = b"".join(wapp(env, sr))
+        return captured["status"], captured["headers"], body
+
+    s, h, b = raw("/wcs?request=GetCapabilities")
+    assert s == 200 and b"<name>dem</name>" in b
+    s, h, b = raw("/wcs?request=DescribeCoverage&coverage=dem")
+    assert s == 200 and b"lonLatEnvelope" in b and b"resolutions" in b
+    s, h, b = raw("/wcs?request=GetCoverage&coverage=dem&"
+                  "bbox=0,0,16,8&width=16&height=8&format=png")
+    assert s == 200 and h["Content-Type"] == "image/png"
+    assert b.startswith(b"\x89PNG")
+    s, h, b = raw("/wcs?request=GetCoverage&coverage=dem&"
+                  "bbox=0,0,16,8&width=16&height=8&format=npy")
+    assert s == 200
+    grid = np.load(io.BytesIO(b))
+    assert grid.shape == (8, 16)
+    # right half is the constant-5 tile
+    np.testing.assert_allclose(grid[:, 8:], 5.0)
+    s, _, _ = raw("/wcs?request=GetCoverage&coverage=nope")
+    assert s == 404
