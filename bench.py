@@ -299,6 +299,10 @@ def main():
             pallas["hist1d_pallas_1m_ms"] = round(_median_time(
                 lambda: np.asarray(hist1d_pallas(hb, ws, ms,
                                                  256)[:1])) * 1e3, 1)
+            # the kernel just ran successfully — record it on the gate
+            # (its integrations would otherwise report 'untried' here)
+            from geomesa_tpu.ops.pallas_kernels import GATES
+            GATES["hist1d"].ok = True
         except Exception as e:
             pallas["hist1d_pallas_error"] = repr(e)
         _ = np.asarray(_hist_xla(hb, ms)[:1])

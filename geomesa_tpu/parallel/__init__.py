@@ -26,7 +26,9 @@ from .attribute import ShardedAttributeIndex
 from .scan import (
     ShardedZ3Index, ring_range_counts, sharded_density, sharded_range_count,
 )
-from .stats import merged_arrow, merged_stats, sharded_stats_scan
+from .stats import (
+    merged_arrow, merged_stats, sharded_frequency_scan, sharded_stats_scan,
+)
 from .xz import ShardedXZ2Index, ShardedXZ3Index
 from .z2 import ShardedZ2Index
 
@@ -38,5 +40,6 @@ __all__ = [
     "SpatialRDDProvider", "TpuStoreRDDProvider", "ConverterRDDProvider",
     "FileSystemRDDProvider", "spatial_rdd", "save_rdd",
     "initialize_distributed", "global_device_mesh", "process_local_shard",
-    "sharded_stats_scan", "merged_stats", "merged_arrow",
+    "sharded_stats_scan", "sharded_frequency_scan", "merged_stats",
+    "merged_arrow",
 ]

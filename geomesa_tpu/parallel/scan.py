@@ -910,15 +910,17 @@ class ShardedZ3Index:
         return np.unique(np.concatenate(parts)).astype(np.int64) \
             if parts else np.empty(0, dtype=np.int64)
 
-    def _weight_table(self, weights):
+    def _weight_table(self, weights, dtype=np.float64):
         """Replicated (table, per-process bases) for weight/value lookups
         by gid.  Single controller: the table is indexed by gid directly
         (base 0).  Multihost: each process passes weights for ITS local
         rows; the tables allgather in process order and the kernel looks
         up ``bases[gid >> GID_PROC_SHIFT] + (gid & row_mask)`` — the
         masked-gid lookup alone would read every process's table[row]
-        from the wrong offset (ADVICE r2)."""
-        w = np.asarray(weights, np.float64)
+        from the wrong offset (ADVICE r2).  ``dtype`` preserves integer
+        columns exactly where float64 would lose bits past 2^53 (the
+        frequency sketch hashes exact int64)."""
+        w = np.asarray(weights, dtype)
         if not self._multihost:
             return jnp.asarray(w), jnp.zeros((1,), jnp.int64)
         from .multihost import allgather_concat

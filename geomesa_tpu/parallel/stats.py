@@ -31,7 +31,23 @@ except ImportError:  # pragma: no cover — older jax
 
 from ..stats.stat import Stat, parse_stat
 
-__all__ = ["sharded_stats_scan", "merged_stats", "merged_arrow"]
+__all__ = ["sharded_stats_scan", "sharded_frequency_scan",
+           "merged_stats", "merged_arrow"]
+
+
+@lru_cache(maxsize=8)
+def _gather_program(mesh: Mesh):
+    """Cached per-shard gather of a replicated value table by gid —
+    shared by the stats and frequency scans (a per-call closure would
+    retrace/recompile on every invocation)."""
+    from .scan import gid_weight_lookup
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard"), P(None), P(None)), out_specs=P("shard"))
+    def gather(gs, tab, bs):
+        return gid_weight_lookup(gs, tab, bs)
+
+    return jax.jit(gather)
 
 
 @lru_cache(maxsize=32)
@@ -44,9 +60,13 @@ def _moments_program(mesh: Mesh, hist_bins: int, with_values: bool,
 
     n_sharded = 5 if with_values else 4
     specs = (P("shard"),) * n_sharded + (P(None),) + (P(),) * 4
+    # pallas_call outputs carry no varying-mesh-axes annotation, which
+    # shard_map's vma checker rejects — disable the check on the pallas
+    # variant (semantics unchanged; the XLA variant keeps it)
+    extra = {"check_vma": False} if pallas_hist else {}
 
     @partial(shard_map, mesh=mesh, in_specs=specs,
-             out_specs=(P(None),) * 6)
+             out_specs=(P("shard"),) * 5 + (P(None),), **extra)
     def moments(*args):
         if with_values:
             xs, ys, ts, gs, vals, bx, t_lo, t_hi, h_lo, h_hi = args
@@ -60,15 +80,14 @@ def _moments_program(mesh: Mesh, hist_bins: int, with_values: bool,
             & (ys[:, None] <= bx[None, :, 3])
         ).any(axis=1)
         mask = (gs >= 0) & in_box & (ts >= t_lo) & (ts <= t_hi)
-        cnt = jax.lax.psum(jnp.sum(mask)[None].astype(jnp.int64), "shard")
-        s = jax.lax.psum(
-            jnp.sum(jnp.where(mask, vals, 0.0))[None], "shard")
-        s2 = jax.lax.psum(
-            jnp.sum(jnp.where(mask, vals * vals, 0.0))[None], "shard")
-        vmin = jax.lax.pmin(
-            jnp.min(jnp.where(mask, vals, jnp.inf))[None], "shard")
-        vmax = jax.lax.pmax(
-            jnp.max(jnp.where(mask, vals, -jnp.inf))[None], "shard")
+        # per-shard scalar partials, reduced on host (one tiny vector
+        # per stat): the chip backend lowers only SUM all-reduces, so
+        # pmin/pmax collectives never compiled on real hardware
+        cnt = jnp.sum(mask)[None].astype(jnp.int64)
+        s = jnp.sum(jnp.where(mask, vals, 0.0))[None]
+        s2 = jnp.sum(jnp.where(mask, vals * vals, 0.0))[None]
+        vmin = jnp.min(jnp.where(mask, vals, jnp.inf))[None]
+        vmax = jnp.max(jnp.where(mask, vals, -jnp.inf))[None]
         if hist_bins:
             w = (h_hi - h_lo) / hist_bins
             b = jnp.clip(((vals - h_lo) / w).astype(jnp.int32),
@@ -113,16 +132,8 @@ def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
         # per-shard gather from the replicated table by gid, offset by
         # per-process row bases under multihost (each process passes its
         # LOCAL rows' values; see ShardedZ3Index._weight_table)
-        from .scan import gid_weight_lookup
         table, bases = idx._weight_table(values)
-
-        @partial(shard_map, mesh=idx.mesh,
-                 in_specs=(P("shard"), P(None), P(None)),
-                 out_specs=P("shard"))
-        def gather(gs, tab, bs):
-            return gid_weight_lookup(gs, tab, bs)
-
-        args.append(jax.jit(gather)(idx.gid, table, bases))
+        args.append(_gather_program(idx.mesh)(idx.gid, table, bases))
     args.append(jnp.asarray(boxes))
     tail = (jnp.int64(t_lo_ms), jnp.int64(t_hi_ms),
             jnp.float64(h_lo), jnp.float64(h_hi))
@@ -130,15 +141,108 @@ def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
     def _run(pallas_hist: bool):
         prog = _moments_program(idx.mesh, int(hist_bins), with_values,
                                 pallas_hist=pallas_hist)
-        return tuple(np.asarray(v) for v in prog(*args, *tail))
+        out = prog(*args, *tail)
+        # per-shard partials span processes under multihost; the
+        # replicated histogram is host-addressable everywhere
+        from .scan import _fetch_global
+        return tuple(_fetch_global(v) for v in out[:5]) + (
+            np.asarray(out[5]),)
 
     cnt, s, s2, vmin, vmax, hist = gate.run(
         lambda: _run(True), lambda: _run(False), enabled=use_pallas)
-    res = {"count": int(cnt[0]), "sum": float(s[0]), "sumsq": float(s2[0]),
-           "min": float(vmin[0]), "max": float(vmax[0])}
+    # host reduce of the per-shard partials (n_shards scalars each)
+    res = {"count": int(cnt.sum()), "sum": float(s.sum()),
+           "sumsq": float(s2.sum()),
+           "min": float(vmin.min()), "max": float(vmax.max())}
     if hist_bins:
         res["histogram"] = hist
     return res
+
+
+@lru_cache(maxsize=32)
+def _frequency_program(mesh: Mesh, depth: int, width: int,
+                       pallas_hist: bool):
+    """Per-shard count-min sketch + psum: each shard hashes its masked
+    values with the SAME splitmix64 family as the host sketch
+    (stats/stat._hash_col numeric path) and histograms each hash row —
+    the reference's per-node StatsScan computing Frequency partials
+    merged by the Reducer (utils/stats/Frequency + StatsScan.scala:125),
+    fully device-resident."""
+
+    specs = (P("shard"),) * 5 + (P(None),) + (P(), P())
+    extra = {"check_vma": False} if pallas_hist else {}  # see _moments
+
+    def splitmix(h):
+        h = (h ^ (h >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        return h ^ (h >> jnp.uint64(31))
+
+    @partial(shard_map, mesh=mesh, in_specs=specs, out_specs=P(None),
+             **extra)
+    def freq(xs, ys, ts, gs, vals, bx, t_lo, t_hi):
+        in_box = (
+            (xs[:, None] >= bx[None, :, 0])
+            & (ys[:, None] >= bx[None, :, 1])
+            & (xs[:, None] <= bx[None, :, 2])
+            & (ys[:, None] <= bx[None, :, 3])
+        ).any(axis=1)
+        mask = (gs >= 0) & in_box & (ts >= t_lo) & (ts <= t_hi)
+        # match _hash_col's numeric path bit-for-bit: truncate to int64,
+        # reinterpret as uint64, xor the seeded constant, splitmix64
+        v64 = vals.astype(jnp.int64).astype(jnp.uint64)
+        rows = []
+        for d in range(depth):
+            seed = jnp.uint64((d + 1) * 0x9E3779B97F4A7C15
+                              & 0xFFFFFFFFFFFFFFFF)
+            h = splitmix(v64 ^ seed)
+            bins = (h % jnp.uint64(width)).astype(jnp.int32)
+            if pallas_hist:
+                from ..ops.pallas_kernels import hist1d_pallas
+                rows.append(hist1d_pallas(
+                    bins, jnp.ones_like(bins, jnp.float32), mask,
+                    width).astype(jnp.int64))
+            else:
+                rows.append(jnp.zeros((width,), jnp.int64).at[bins].add(
+                    jnp.where(mask, 1, 0).astype(jnp.int64)))
+        return jax.lax.psum(jnp.stack(rows), "shard")
+
+    return jax.jit(freq)
+
+
+def sharded_frequency_scan(idx, boxes, t_lo_ms, t_hi_ms, values,
+                           depth: int = 4, width: int = 1024):
+    """Device-resident Frequency (count-min) sketch over a bbox+time
+    window of a ShardedZ3Index: per-shard hash+histogram partials merged
+    with psum over ICI; only the (depth × width) table reaches the host.
+    ``values`` follow the _weight_table contract (per-process local rows
+    under multihost).  Returns a ``stats.stat.Frequency`` whose counts
+    equal a host observe() over the matching rows."""
+    from ..ops.pallas_kernels import GATES
+    from ..stats.stat import Frequency
+
+    t_lo_ms, t_hi_ms = idx._clamp_time(t_lo_ms, t_hi_ms)
+    boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+    # integer columns travel as EXACT int64: the float64 weight path
+    # would lose bits past 2^53 and diverge from the host sketch's hash
+    col = np.asarray(values)
+    table, bases = idx._weight_table(
+        col, dtype=np.int64 if col.dtype.kind in "iu" else np.float64)
+    vals = _gather_program(idx.mesh)(idx.gid, table, bases)
+    rows_per_shard = (int(idx.x.shape[0])
+                      // max(int(idx.mesh.devices.size), 1))
+    args = (idx.x, idx.y, idx.dtg, idx.gid, vals, jnp.asarray(boxes),
+            jnp.int64(t_lo_ms), jnp.int64(t_hi_ms))
+
+    def _run(pallas_hist: bool):
+        prog = _frequency_program(idx.mesh, int(depth), int(width),
+                                  pallas_hist)
+        return np.asarray(prog(*args))
+
+    out = GATES["hist1d"].run(
+        lambda: _run(True), lambda: _run(False),
+        enabled=rows_per_shard < (1 << 24))
+    return Frequency("", int(depth), int(width),
+                     out.astype(np.int64))
 
 
 def _shard_groups(n: int, shards) -> list[np.ndarray]:

@@ -53,7 +53,7 @@ def _collective_stats(store, schema: str, query, stat_spec: str):
     import numpy as np
 
     from ..planning.planner import Query
-    from ..stats.stat import CountStat, Histogram, MinMax, SeqStat
+    from ..stats.stat import CountStat, Frequency, Histogram, MinMax, SeqStat
     from .density import _bbox_time_only
 
     q = query if isinstance(query, Query) else Query.of(query)
@@ -77,13 +77,22 @@ def _collective_stats(store, schema: str, query, stat_spec: str):
     stat = parse_stat(stat_spec)
     stats = stat.stats if isinstance(stat, SeqStat) else [stat]
     per_attr: dict[str, list] = {}
+    freqs: list = []
     for s in stats:
         if isinstance(s, CountStat):
             continue
         if isinstance(s, (MinMax, Histogram)):
             per_attr.setdefault(s.attr, []).append(s)
+        elif isinstance(s, Frequency):
+            # device count-min sketch — numeric attrs only (string CMS
+            # hashes host-side); check BEFORE any collective runs so an
+            # ineligible spec never wastes completed device scans
+            col = st.batch.columns.get(s.attr)
+            if col is None or col.dtype.kind not in "if":
+                return None
+            freqs.append(s)
         else:
-            return None  # sketch kinds fold via the monoid path instead
+            return None  # other sketch kinds fold via the monoid path
     if any(len([s for s in ss if isinstance(s, Histogram)]) > 1
            for ss in per_attr.values()):
         return None
@@ -110,6 +119,12 @@ def _collective_stats(store, schema: str, query, stat_spec: str):
                     s.min, s.max = res["min"], res["max"]
             elif isinstance(s, Histogram):
                 s.counts = np.asarray(res["histogram"], dtype=np.int64)
+    for s in freqs:
+        from ..parallel.stats import sharded_frequency_scan
+        got = sharded_frequency_scan(idx, boxes, lo, hi,
+                                     st.batch.column(s.attr),
+                                     depth=s.depth, width=s.width)
+        s.table = got.table
     if count is None and any(isinstance(s, CountStat) for s in stats):
         count = sharded_stats_scan(idx, boxes, lo, hi)["count"]
     for s in stats:

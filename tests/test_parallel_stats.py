@@ -254,3 +254,81 @@ def test_merged_sketches_under_adversarial_skew():
     assert freq.count("heavy_a") >= 1000
     assert freq.count("heavy_b") >= 800
     assert freq.count("heavy_a") <= 1000 + n // 50
+
+
+def test_sharded_frequency_scan_matches_host_sketch():
+    """Device count-min sketch (per-shard hash+hist partials + psum)
+    produces the SAME table as the host Frequency observe over the
+    matching rows — bit-identical hashes, exact counts."""
+    from geomesa_tpu.parallel import sharded_frequency_scan
+    from geomesa_tpu.stats.stat import Frequency
+
+    rng = np.random.default_rng(77)
+    n = 30_000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(MS, MS + 7 * DAY, n)
+    vals = rng.integers(0, 50, n).astype(np.float64)
+    idx = ShardedZ3Index.build(x, y, t, period="week", mesh=device_mesh())
+    box = (-74.5, 40.5, -73.5, 41.5)
+    lo, hi = MS + DAY, MS + 5 * DAY
+    got = sharded_frequency_scan(idx, [box], lo, hi, vals)
+    sel = ((x >= box[0]) & (x <= box[2]) & (y >= box[1]) & (y <= box[3])
+           & (t >= lo) & (t <= hi))
+    host = Frequency("v")
+    sft = parse_spec("f", "v:Double,dtg:Date,*geom:Point")
+    host.observe(FeatureBatch.from_dict(sft, {
+        "v": vals[sel], "dtg": t[sel], "geom": (x[sel], y[sel])}))
+    np.testing.assert_array_equal(got.table, host.table)
+    # point estimates agree too
+    for v in (0.0, 7.0, 23.0):
+        assert got.count(v) == host.count(v)
+
+
+def test_stats_process_pushes_down_frequency():
+    """Frequency(numeric) over a bbox+time filter takes the device CMS
+    push-down on a mesh store and matches the host observe."""
+    from geomesa_tpu.process import stats_process
+    from geomesa_tpu.stats.stat import Frequency
+
+    rng = np.random.default_rng(79)
+    n = 8_000
+    data = {
+        "score": rng.integers(0, 30, n).astype(np.float64),
+        "dtg": rng.integers(MS, MS + 7 * DAY, n),
+        "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n)),
+    }
+    spec = "score:Double,dtg:Date,*geom:Point"
+    plain = TpuDataStore()
+    mesh = TpuDataStore(mesh=device_mesh())
+    for ds in (plain, mesh):
+        ds.create_schema("obs", spec)
+        ds.write("obs", data)
+    ecql = ("BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND dtg DURING "
+            "2018-01-02T00:00:00Z/2018-01-05T00:00:00Z")
+    a = stats_process(plain, "obs", ecql, "Frequency(score)")
+    b = stats_process(mesh, "obs", ecql, "Frequency(score)")
+    np.testing.assert_array_equal(a.table, b.table)
+    assert a.count(7.0) == b.count(7.0)
+
+
+def test_sharded_frequency_exact_for_big_int64():
+    """Integer columns travel as exact int64 (float64 would collapse
+    values past 2^53 and diverge from the host hash)."""
+    from geomesa_tpu.parallel import sharded_frequency_scan
+    from geomesa_tpu.stats.stat import Frequency
+
+    rng = np.random.default_rng(81)
+    n = 4_000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(MS, MS + DAY, n)
+    vals = (1 << 60) + rng.integers(0, 4, n)   # adjacent big ints
+    idx = ShardedZ3Index.build(x, y, t, period="week", mesh=device_mesh())
+    got = sharded_frequency_scan(idx, [(-75, 40, -73, 42)], None, None,
+                                 vals)
+    host = Frequency("v")
+    sft = parse_spec("f", "v:Long,dtg:Date,*geom:Point")
+    host.observe(FeatureBatch.from_dict(sft, {
+        "v": vals, "dtg": t, "geom": (x, y)}))
+    np.testing.assert_array_equal(got.table, host.table)
