@@ -119,6 +119,21 @@ def main():
         print(f"mesh store ({len(jax.devices())} devices): "
               f"{len(hits_mesh):,} hits (single-chip store found "
               f"{len(ds.query('gdelt', q)):,})")
+
+        # 8. SQL text front-end (the Spark-SQL user surface): st_* calls
+        # rewrite to ECQL push-down predicates, aggregates vectorize
+        from geomesa_tpu.sql import sql_query
+        agg = sql_query(dsm, "SELECT actor, count(*) AS n, avg(score) "
+                             "AS avg_s FROM gdelt GROUP BY actor "
+                             "ORDER BY n DESC LIMIT 3")
+        print("sql top actors:", list(zip(agg["actor"], agg["n"])))
+
+        # 9. device-resident sketches: count-min Frequency over a
+        # bbox+time window (per-shard partials psum-merged)
+        from geomesa_tpu.process import stats_process
+        f = stats_process(dsm, "gdelt", q, "Frequency(score)")
+        print("frequency sketch non-zero cells:",
+              int((f.table > 0).sum()))
     else:
         print("mesh store: single device visible — run under "
               "XLA_FLAGS=--xla_force_host_platform_device_count=8 "

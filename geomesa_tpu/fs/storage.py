@@ -23,6 +23,7 @@ import fnmatch
 import json
 import os
 import threading
+from functools import lru_cache
 import uuid
 
 import numpy as np
@@ -35,6 +36,15 @@ from ..planning.planner import Query
 from .partitions import PartitionScheme, scheme_from_config
 
 __all__ = ["FileSystemDataStore"]
+
+
+@lru_cache(maxsize=1)
+def _scan_pool():
+    """Shared scan thread pool (spawning a fresh executor per query
+    would rival the IO it overlaps on small partition sets)."""
+    from concurrent.futures import ThreadPoolExecutor
+    return ThreadPoolExecutor(_TypeStorage.SCAN_THREADS,
+                              thread_name_prefix="fsds-scan")
 
 
 class _TypeStorage:
@@ -151,17 +161,28 @@ class _TypeStorage:
             out = out.concat(p)
         return out
 
+    #: parallel partition-file readers (the AbstractBatchScan pipelined
+    #: multi-threaded scan role, index/utils/AbstractBatchScan.scala —
+    #: file IO + decode overlap across partitions)
+    SCAN_THREADS = 8
+
     def query(self, query) -> FeatureBatch:
         q = query if isinstance(query, Query) else Query.of(query)
         meta = self._load_meta()
-        parts = []
-        for part in self._select_partitions(q.filter):
-            for entry in meta["partitions"][part]:
-                path = os.path.join(self.root, part, entry["file"])
-                batch = self._read_file(path)
-                mask = evaluate_filter(q.filter, batch)
-                if mask.any():
-                    parts.append(batch.take(np.flatnonzero(mask)))
+        paths = [os.path.join(self.root, part, entry["file"])
+                 for part in self._select_partitions(q.filter)
+                 for entry in meta["partitions"][part]]
+
+        def scan_one(path: str):
+            batch = self._read_file(path)
+            mask = evaluate_filter(q.filter, batch)
+            return batch.take(np.flatnonzero(mask)) if mask.any() else None
+
+        if len(paths) > 1:
+            results = list(_scan_pool().map(scan_one, paths))
+        else:
+            results = [scan_one(p) for p in paths]
+        parts = [r for r in results if r is not None]
         if not parts:
             return FeatureBatch.empty(self.sft)
         out = parts[0]

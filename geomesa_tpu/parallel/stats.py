@@ -35,6 +35,28 @@ __all__ = ["sharded_stats_scan", "sharded_frequency_scan",
            "merged_stats", "merged_arrow"]
 
 
+def _bbox_time_mask(xs, ys, ts, gs, bx, t_lo, t_hi):
+    """Shared per-shard row mask: gid validity + any-box membership
+    (inclusive edges) + inclusive time interval — the ONE definition the
+    moments, frequency and density bodies must agree on."""
+    in_box = (
+        (xs[:, None] >= bx[None, :, 0])
+        & (ys[:, None] >= bx[None, :, 1])
+        & (xs[:, None] <= bx[None, :, 2])
+        & (ys[:, None] <= bx[None, :, 3])
+    ).any(axis=1)
+    return (gs >= 0) & in_box & (ts >= t_lo) & (ts <= t_hi)
+
+
+def _hist_pallas_ok(idx) -> bool:
+    """Whether the f32 one-hot histogram kernel is EXACT for this index:
+    per-shard rows bound any bin count, which must stay inside float32's
+    integer range (the XLA scatter path is int64-exact)."""
+    rows_per_shard = (int(idx.x.shape[0])
+                      // max(int(idx.mesh.devices.size), 1))
+    return rows_per_shard < (1 << 24)
+
+
 @lru_cache(maxsize=8)
 def _gather_program(mesh: Mesh):
     """Cached per-shard gather of a replicated value table by gid —
@@ -73,13 +95,7 @@ def _moments_program(mesh: Mesh, hist_bins: int, with_values: bool,
         else:
             xs, ys, ts, gs, bx, t_lo, t_hi, h_lo, h_hi = args
             vals = xs
-        in_box = (
-            (xs[:, None] >= bx[None, :, 0])
-            & (ys[:, None] >= bx[None, :, 1])
-            & (xs[:, None] <= bx[None, :, 2])
-            & (ys[:, None] <= bx[None, :, 3])
-        ).any(axis=1)
-        mask = (gs >= 0) & in_box & (ts >= t_lo) & (ts <= t_hi)
+        mask = _bbox_time_mask(xs, ys, ts, gs, bx, t_lo, t_hi)
         # per-shard scalar partials, reduced on host (one tiny vector
         # per stat): the chip backend lowers only SUM all-reduces, so
         # pmin/pmax collectives never compiled on real hardware
@@ -121,12 +137,8 @@ def sharded_stats_scan(idx, boxes, t_lo_ms, t_hi_ms, values=None,
     h_lo, h_hi = (float(hist_range[0]), float(hist_range[1])) \
         if hist_range else (0.0, 1.0)
     from ..ops.pallas_kernels import GATES
-    # f32 one-hot accumulation is exact only while every bin count fits
-    # float32's integer range — per-shard rows bound the per-bin count,
-    # so gate on 2^24 rows/shard (the XLA scatter path stays int64)
-    rows_per_shard = int(idx.x.shape[0]) // max(int(idx.mesh.devices.size), 1)
     gate = GATES["hist1d"]
-    use_pallas = (bool(hist_bins) and rows_per_shard < (1 << 24))
+    use_pallas = bool(hist_bins) and _hist_pallas_ok(idx)
     args = [idx.x, idx.y, idx.dtg, idx.gid]
     if with_values:
         # per-shard gather from the replicated table by gid, offset by
@@ -180,15 +192,17 @@ def _frequency_program(mesh: Mesh, depth: int, width: int,
     @partial(shard_map, mesh=mesh, in_specs=specs, out_specs=P(None),
              **extra)
     def freq(xs, ys, ts, gs, vals, bx, t_lo, t_hi):
-        in_box = (
-            (xs[:, None] >= bx[None, :, 0])
-            & (ys[:, None] >= bx[None, :, 1])
-            & (xs[:, None] <= bx[None, :, 2])
-            & (ys[:, None] <= bx[None, :, 3])
-        ).any(axis=1)
-        mask = (gs >= 0) & in_box & (ts >= t_lo) & (ts <= t_hi)
+        mask = _bbox_time_mask(xs, ys, ts, gs, bx, t_lo, t_hi)
         # match _hash_col's numeric path bit-for-bit: truncate to int64,
-        # reinterpret as uint64, xor the seeded constant, splitmix64
+        # reinterpret as uint64, xor the seeded constant, splitmix64.
+        # XLA's float->int64 convert differs from numpy's for NaN/inf/
+        # out-of-range values — canonicalize those to numpy's INT64_MIN
+        # result first (int64 inputs pass through untouched)
+        if jnp.issubdtype(vals.dtype, jnp.floating):
+            lo = jnp.float64(np.iinfo(np.int64).min)
+            ok = (jnp.isfinite(vals) & (vals >= lo)
+                  & (vals < jnp.float64(2.0 ** 63)))
+            vals = jnp.where(ok, vals, lo)
         v64 = vals.astype(jnp.int64).astype(jnp.uint64)
         rows = []
         for d in range(depth):
@@ -228,8 +242,6 @@ def sharded_frequency_scan(idx, boxes, t_lo_ms, t_hi_ms, values,
     table, bases = idx._weight_table(
         col, dtype=np.int64 if col.dtype.kind in "iu" else np.float64)
     vals = _gather_program(idx.mesh)(idx.gid, table, bases)
-    rows_per_shard = (int(idx.x.shape[0])
-                      // max(int(idx.mesh.devices.size), 1))
     args = (idx.x, idx.y, idx.dtg, idx.gid, vals, jnp.asarray(boxes),
             jnp.int64(t_lo_ms), jnp.int64(t_hi_ms))
 
@@ -240,7 +252,7 @@ def sharded_frequency_scan(idx, boxes, t_lo_ms, t_hi_ms, values,
 
     out = GATES["hist1d"].run(
         lambda: _run(True), lambda: _run(False),
-        enabled=rows_per_shard < (1 << 24))
+        enabled=_hist_pallas_ok(idx))
     return Frequency("", int(depth), int(width),
                      out.astype(np.int64))
 

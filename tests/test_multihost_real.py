@@ -116,6 +116,30 @@ for w, got_w in zip((win_a, win_b), parts):
                              & (y >= w[1]) & (y <= w[3]))
     assert np.array_equal(mine_w, brute_w), (len(mine_w), len(brute_w))
 
+# ---- device stats + count-min over multihost (per-process values) ----
+from geomesa_tpu.parallel import sharded_frequency_scan, sharded_stats_scan
+vals_local = np.arange(n_local, dtype=np.float64) % 50
+stats_r = sharded_stats_scan(idx, [box], MS, MS + 7 * 86_400_000,
+                             values=vals_local, hist_bins=10,
+                             hist_range=(0, 50))
+my_sel = brute  # box covers the full time range
+# count matches the density total (both processes' hits)
+assert stats_r["count"] == int(grid.sum()), (stats_r["count"], grid.sum())
+freq = sharded_frequency_scan(idx, [box], MS, MS + 7 * 86_400_000,
+                              vals_local)
+# oracle: host sketch over BOTH processes' selected values (allgather)
+from geomesa_tpu.stats.stat import Frequency
+from geomesa_tpu.parallel.multihost import allgather_concat
+all_vals = allgather_concat(vals_local[my_sel])
+host_f = Frequency("v")
+from geomesa_tpu.features.feature_type import parse_spec as _ps
+from geomesa_tpu.features.batch import FeatureBatch as _FB
+sft_f = _ps("f", "v:Double,dtg:Date,*geom:Point")
+host_f.observe(_FB.from_dict(sft_f, {
+    "v": all_vals, "dtg": np.full(len(all_vals), MS),
+    "geom": (np.zeros(len(all_vals)), np.zeros(len(all_vals)))}))
+assert np.array_equal(freq.table, host_f.table), "multihost CMS mismatch"
+
 # ---- multihost append on the raw index ----
 m_new = 60 + proc * 7
 nx2 = rng.uniform(-74.4, -73.6, m_new); ny2 = rng.uniform(40.6, 41.4, m_new)

@@ -332,3 +332,33 @@ def test_sharded_frequency_exact_for_big_int64():
     host.observe(FeatureBatch.from_dict(sft, {
         "v": vals, "dtg": t, "geom": (x, y)}))
     np.testing.assert_array_equal(got.table, host.table)
+
+
+def test_sharded_frequency_nan_inf_matches_host():
+    """Non-finite / out-of-range floats canonicalize to numpy's
+    float64->int64 result before hashing, keeping the device table
+    bit-identical to the host sketch even with NaN/inf values."""
+    from geomesa_tpu.parallel import sharded_frequency_scan
+    from geomesa_tpu.stats.stat import Frequency
+
+    rng = np.random.default_rng(83)
+    n = 2_000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(MS, MS + DAY, n)
+    vals = rng.uniform(0, 10, n)
+    vals[::7] = np.nan
+    vals[1::11] = np.inf
+    vals[2::13] = -np.inf
+    vals[3::17] = 1e300
+    idx = ShardedZ3Index.build(x, y, t, period="week", mesh=device_mesh())
+    got = sharded_frequency_scan(idx, [(-75, 40, -73, 42)], None, None,
+                                 vals)
+    host = Frequency("v")
+    sft = parse_spec("f", "v:Double,dtg:Date,*geom:Point")
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        host.observe(FeatureBatch.from_dict(sft, {
+            "v": vals, "dtg": t, "geom": (x, y)}))
+    np.testing.assert_array_equal(got.table, host.table)
