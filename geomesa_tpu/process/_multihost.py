@@ -1,0 +1,38 @@
+"""Multihost helpers for the analytics processes.
+
+Store-level candidates under multihost are GLOBAL gids
+(``process << GID_PROC_SHIFT | local_row``) while feature columns are
+per-process LOCAL — a process module indexing its batch by gid would
+read the wrong rows for every process but 0.  Exact passes over
+candidates are per-candidate decomposable, so each process evaluates
+ITS share and survivors allgather (the same pattern as the planner's
+residual filter)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["split_local"]
+
+
+def split_local(store_st, cand: np.ndarray):
+    """``(local_rows, local_gids, finish)`` for a per-candidate exact
+    pass: identity on single-controller stores; under multihost
+    ``local_rows`` are THIS process's decoded rows, ``local_gids`` their
+    global ids, and ``finish(kept_gids)`` allgathers the survivors into
+    the (identical-everywhere) sorted global result."""
+    cand = np.asarray(cand, dtype=np.int64)
+    if not getattr(store_st, "multihost", False):
+        return cand, cand, (lambda kept: kept)
+    import jax
+
+    from ..parallel.multihost import allgather_concat
+    from ..parallel.scan import decode_gids
+
+    procs, rows = decode_gids(cand)
+    mine = procs == jax.process_index()
+
+    def finish(kept: np.ndarray) -> np.ndarray:
+        return np.sort(allgather_concat(np.asarray(kept, np.int64)))
+
+    return rows[mine], cand[mine], finish

@@ -50,20 +50,42 @@ def knn_process(store, schema: str, x: float, y: float, k: int,
     sft = store.get_schema(schema)
     geom = sft.geom_field
     radius = float(initial_radius_m)
-    batch = store._store(schema).batch
-    if batch is None or len(batch) == 0:
+    st = store._store(schema)
+    batch = st.batch
+    mh = getattr(st, "multihost", False)
+    if (batch is None or len(batch) == 0) and not mh:
+        # multihost: a locally-empty process must still enter the
+        # collective window scans its peers run
         return np.empty(0, dtype=np.int64), np.empty(0)
     # None bounds mean "no time constraint" — query_windows plans these
     # over the data's extent instead of a sentinel interval
     lo = int(t_lo_ms) if t_lo_ms is not None and sft.dtg_field else None
     hi = int(t_hi_ms) if t_hi_ms is not None and sft.dtg_field else None
+    if batch is None:
+        from ..features.batch import FeatureBatch
+        st.batch = batch = FeatureBatch.empty(sft)
     all_xy = batch.geom_xy(geom)
 
     def rank(positions):
-        bx, by = all_xy[0][positions], all_xy[1][positions]
-        d = haversine_m(x, y, bx, by)
+        """(effective_positions, distances, ascending order) — under
+        multihost each process measures ITS rows and the (gid, dist)
+        pairs allgather as ONE packed collective, so every process
+        ranks the same global list."""
+        if mh:
+            from ..parallel.multihost import allgather_concat
+            from ._multihost import split_local
+            rows_l, gids_l, _ = split_local(st, positions)
+            d_loc = haversine_m(x, y, all_xy[0][rows_l],
+                                all_xy[1][rows_l])
+            packed = np.stack([gids_l, d_loc.view(np.int64)], axis=1)
+            out = allgather_concat(packed)
+            positions = out[:, 0].copy()
+            d = out[:, 1].copy().view(np.float64)
+        else:
+            d = haversine_m(x, y, all_xy[0][positions],
+                            all_xy[1][positions])
         order = np.argsort(d, kind="stable")
-        return d, order
+        return positions, d, order
 
     # batched expanding rings: each dispatch scans THREE radii at once
     # (r, 2r, 4r) so the remote round trip amortizes across rounds — the
@@ -76,17 +98,17 @@ def knn_process(store, schema: str, x: float, y: float, k: int,
         for r, positions in zip(radii, ring_hits):
             if not len(positions):
                 continue
-            d, order = rank(positions)
+            pos, d, order = rank(positions)
             # secure condition: the k-th distance fits inside the scanned
             # window (no closer feature can hide outside it)
             if len(order) >= k and d[order[k - 1]] <= r:
                 sel = order[:k]
-                return positions[sel], d[sel]
+                return pos[sel], d[sel]
         if radii[-1] >= max_radius_m:
             positions = ring_hits[-1]
             if len(positions) == 0:
                 return np.empty(0, dtype=np.int64), np.empty(0)
-            d, order = rank(positions)
+            pos, d, order = rank(positions)
             sel = order[:k]
-            return positions[sel], d[sel]
+            return pos[sel], d[sel]
         radius *= 8.0

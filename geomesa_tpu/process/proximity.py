@@ -18,9 +18,15 @@ def proximity_process(store, schema: str, geometries, distance_m: float):
     (store.query_windows), then exact meter distances rank candidates."""
     sft = store.get_schema(schema)
     geom = sft.geom_field
-    batch = store._store(schema).batch
-    if batch is None or len(batch) == 0:
+    st = store._store(schema)
+    batch = st.batch
+    mh = getattr(st, "multihost", False)
+    if (batch is None or len(batch) == 0) and not mh:
+        # multihost: locally-empty processes still enter the collectives
         return np.empty(0, dtype=np.int64)
+    if batch is None:
+        from ..features.batch import FeatureBatch
+        st.batch = batch = FeatureBatch.empty(sft)
     geometries = list(geometries)
     windows = []
     for g in geometries:
@@ -33,11 +39,15 @@ def proximity_process(store, schema: str, geometries, distance_m: float):
         windows.append(([box], None, None))
     per_geom = store.query_windows(schema, windows)
     all_xy = batch.geom_xy(geom)
+    from ._multihost import split_local
     parts = []
     for g, positions in zip(geometries, per_geom):
         if not len(positions):
             continue
-        bx, by = all_xy[0][positions], all_xy[1][positions]
+        # multihost: exact distances run on THIS process's decoded rows,
+        # survivors allgather once at the end
+        rows_l, positions, _ = split_local(st, positions)
+        bx, by = all_xy[0][rows_l], all_xy[1][rows_l]
         if isinstance(g, Point):
             d = haversine_m(g.x, g.y, bx, by)
             parts.append(positions[d <= distance_m])
@@ -68,6 +78,11 @@ def proximity_process(store, schema: str, geometries, distance_m: float):
             if isinstance(g, (Polygon, MultiPolygon)):
                 keep |= point_in_polygon(bx, by, g)
             parts.append(positions[keep])
+    if mh:
+        from ..parallel.multihost import allgather_concat
+        local = (np.unique(np.concatenate(parts)) if parts
+                 else np.empty(0, dtype=np.int64))
+        return np.sort(allgather_concat(local.astype(np.int64)))
     if not parts:
         return np.empty(0, dtype=np.int64)
     return np.unique(np.concatenate(parts))

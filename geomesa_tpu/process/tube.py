@@ -88,9 +88,12 @@ def tube_select(store, schema: str, track_xy, track_t_ms,
         return np.empty(0, dtype=np.int64)
     cand = np.unique(np.concatenate(parts))
 
-    batch = store._store(schema).batch
+    st = store._store(schema)
+    from ._multihost import split_local
+    rows_l, gids_l, finish = split_local(st, cand)
+    batch = st.batch
     px, py = batch.geom_xy(geom)
-    px, py = px[cand], py[cand]
+    px, py = px[rows_l], py[rows_l]
     ax, ay = track[:-1, 0], track[:-1, 1]
     bx, by = track[1:, 0], track[1:, 1]
     dist_deg, t_along = _point_segment_dist_deg(px, py, ax, ay, bx, by)
@@ -98,7 +101,7 @@ def tube_select(store, schema: str, track_xy, track_t_ms,
     # nearest segment per candidate, then exact meter distance to the
     # closest point on that segment
     seg_idx = np.argmin(dist_deg, axis=1)
-    rows = np.arange(len(cand))
+    rows = np.arange(len(rows_l))
     t_best = t_along[rows, seg_idx]
     cx = ax[seg_idx] + t_best * (bx[seg_idx] - ax[seg_idx])
     cy = ay[seg_idx] + t_best * (by[seg_idx] - ay[seg_idx])
@@ -106,12 +109,12 @@ def tube_select(store, schema: str, track_xy, track_t_ms,
     keep = dist_m <= buffer_m
 
     if dtg:
-        ft = batch.column(dtg)[cand].astype(np.float64)
+        ft = batch.column(dtg)[rows_l].astype(np.float64)
         t0 = times[:-1].astype(np.float64)
         t1 = times[1:].astype(np.float64)
         t_interp = t0[seg_idx] + t_best * (t1[seg_idx] - t0[seg_idx])
         keep &= np.abs(ft - t_interp) <= time_buffer_ms
-    return cand[keep]
+    return finish(gids_l[keep])
 
 
 def _tube_nofill(store, schema, geom, dtg, track, times,
@@ -133,16 +136,19 @@ def _tube_nofill(store, schema, geom, dtg, track, times,
     if not parts:
         return np.empty(0, dtype=np.int64)
     cand = np.unique(np.concatenate(parts))
-    batch = store._store(schema).batch
+    st = store._store(schema)
+    from ._multihost import split_local
+    rows_l, gids_l, finish = split_local(st, cand)
+    batch = st.batch
     px, py = batch.geom_xy(geom)
-    px, py = px[cand], py[cand]
+    px, py = px[rows_l], py[rows_l]
     # (candidates × vertices) haversine distances; match against the
     # vertex's OWN time — no interpolation across gaps
     d = haversine_m(px[:, None], py[:, None],
                     track[None, :, 0], track[None, :, 1])
     near = d <= buffer_m
     if dtg:
-        ft = batch.column(dtg)[cand].astype(np.float64)
+        ft = batch.column(dtg)[rows_l].astype(np.float64)
         near &= np.abs(ft[:, None] - times[None, :].astype(np.float64)) \
             <= time_buffer_ms
-    return cand[near.any(axis=1)]
+    return finish(gids_l[near.any(axis=1)])

@@ -246,7 +246,17 @@ def _hash_col(col: np.ndarray, seed: int) -> np.ndarray:
             (zlib.crc32(str(v).encode(), seed) for v in col),
             dtype=np.uint64, count=len(col))
     else:
-        out = col.astype(np.int64).view(np.uint64).copy()
+        arr = col
+        if np.issubdtype(arr.dtype, np.floating):
+            # canonicalize non-finite / out-of-range floats BEFORE the
+            # int64 cast: the raw C cast is platform-dependent (x86
+            # gives INT64_MIN, aarch64 gives 0 / INT64_MAX) and the
+            # device sketch must hash identically everywhere
+            lo = float(np.iinfo(np.int64).min)
+            ok = np.isfinite(arr) & (arr >= lo) & (arr < 2.0 ** 63)
+            with np.errstate(invalid="ignore"):
+                arr = np.where(ok, arr, lo)
+        out = arr.astype(np.int64).view(np.uint64).copy()
         out ^= np.uint64(seed * 0x9E3779B97F4A7C15 & 0xFFFFFFFFFFFFFFFF)
     # splitmix64 finalize
     out = (out ^ (out >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)

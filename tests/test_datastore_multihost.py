@@ -131,3 +131,32 @@ def test_multihost_polygon_schema():
 def test_multihost_requires_mesh():
     with pytest.raises(ValueError, match="requires a mesh"):
         TpuDataStore(multihost=True)
+
+
+def test_multihost_mode_processes(mh_store):
+    """kNN / tube-select / proximity run through the multihost store
+    (positions are gids; exact passes decode to local rows)."""
+    from geomesa_tpu.geometry import Point
+    from geomesa_tpu.process import knn_process, proximity_process
+    from geomesa_tpu.process.tube import tube_select
+
+    st = mh_store._store("mh")
+    x0, y0 = -74.0, 41.0
+    pos, dist = knn_process(mh_store, "mh", x0, y0, 10)
+    assert len(pos) == 10 and np.all(np.diff(dist) >= 0)
+    # oracle: brute-force nearest over the (single-process) batch
+    from geomesa_tpu.process.knn import haversine_m
+    bx, by = st.batch.geom_xy()
+    want = np.argsort(haversine_m(x0, y0, bx, by), kind="stable")[:10]
+    np.testing.assert_array_equal(np.sort(pos), np.sort(want))
+
+    prox = proximity_process(mh_store, "mh", [Point(x0, y0)], 20_000.0)
+    want_p = np.flatnonzero(haversine_m(x0, y0, bx, by) <= 20_000.0)
+    np.testing.assert_array_equal(prox, want_p)
+
+    track = np.array([[-74.5, 40.5], [-74.0, 41.0], [-73.5, 41.5]])
+    dtg = st.batch.column("dtg")
+    times = np.array([dtg.min(), (dtg.min() + dtg.max()) // 2, dtg.max()])
+    tube = tube_select(mh_store, "mh", track, times, buffer_m=30_000,
+                       time_buffer_ms=10 * 86_400_000)
+    assert len(tube) > 0

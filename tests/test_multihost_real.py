@@ -219,6 +219,19 @@ assert ds.get_count("evt", "IN ('p0.0')") == 1
 st_one = stats_process(ds, "evt", "IN ('p0.0')", "Count()")
 assert st_one.count == 1, st_one.count
 
+# analytics across processes: kNN's exact distances measure on each
+# process's own rows and (gid, dist) pairs allgather — the 10 nearest
+# must match a brute-force over BOTH processes' coordinates
+from geomesa_tpu.process import knn_process
+from geomesa_tpu.process.knn import haversine_m
+qx, qy = -74.0, 41.0
+kpos, kdist = knn_process(ds, "evt", qx, qy, 10)
+assert len(kpos) == 10 and np.all(np.diff(kdist) >= 0)
+bx, by = st.batch.geom_xy()
+my_d = haversine_m(qx, qy, bx, by)
+all_d = np.sort(allgather_concat(my_d))
+np.testing.assert_allclose(np.sort(kdist), all_d[:10], rtol=1e-12)
+
 # merged global stats + bounds
 env = ds.get_bounds("evt")
 assert env is not None and env.xmin >= -75.0 and env.xmax <= -73.0
