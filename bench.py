@@ -362,7 +362,7 @@ def _scale_stanza() -> dict:
                 out["recorded_500m"] = json.load(f)
         except Exception as e:
             out["recorded_500m_error"] = repr(e)
-    n_live = int(os.environ.get("SCALE_LIVE_N", 64_000_000))
+    n_live = int(os.environ.get("SCALE_LIVE_N", 32_000_000))
     if n_live:
         try:
             import scale_proof

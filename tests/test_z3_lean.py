@@ -1,0 +1,64 @@
+"""LeanZ3Index: keys-on-device / payload-on-host generational index
+(the 500M+ single-chip scale path — scale_proof.py runs it on the real
+chip; this file keeps the logic under the fast CI loop)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.index.z3 import Z3PointIndex
+from geomesa_tpu.index.z3_lean import LeanZ3Index
+
+MS = 1514764800000
+DAY = 86_400_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    n = 60_000
+    return (rng.uniform(-75, -73, n), rng.uniform(40, 42, n),
+            rng.integers(MS, MS + 14 * DAY, n))
+
+
+def test_generational_build_query_oracle(data):
+    x, y, t = data
+    idx = LeanZ3Index(period="week", generation_slots=1 << 14)
+    for s in range(0, len(x), 25_000):  # slices straddle generations
+        sl = slice(s, s + 25_000)
+        idx.append(x[sl], y[sl], t[sl])
+    assert len(idx) == len(x)
+    assert len(idx.generations) == -(-len(x) // (1 << 14))
+    box = (-74.5, 40.5, -73.5, 41.5)
+    lo, hi = MS + 2 * DAY, MS + 9 * DAY
+    got = idx.query([box], lo, hi)
+    want = np.flatnonzero((x >= box[0]) & (x <= box[2]) & (y >= box[1])
+                          & (y <= box[3]) & (t >= lo) & (t <= hi))
+    np.testing.assert_array_equal(got, want)
+    # parity with the full-fat index
+    full = Z3PointIndex.build(x, y, t, period="week")
+    np.testing.assert_array_equal(got, np.sort(full.query([box], lo, hi)))
+
+
+def test_open_time_bounds_and_multi_box(data):
+    x, y, t = data
+    idx = LeanZ3Index(period="week", generation_slots=1 << 15)
+    idx.append(x, y, t)
+    boxes = [(-74.9, 40.1, -74.6, 40.4), (-73.4, 41.6, -73.1, 41.9)]
+    got = idx.query(boxes, None, None)
+    m = np.zeros(len(x), dtype=bool)
+    for b in boxes:
+        m |= ((x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3]))
+    np.testing.assert_array_equal(got, np.flatnonzero(m))
+
+
+def test_empty_and_budget_bookkeeping():
+    idx = LeanZ3Index(period="week")
+    # open bounds on an empty index must not crash in planning
+    assert len(idx.query([(-75, 40, -73, 42)], None, None)) == 0
+    assert idx.device_bytes() == 0
+    idx2 = LeanZ3Index(period="week", generation_slots=1 << 14)
+    rng = np.random.default_rng(4)
+    idx2.append(rng.uniform(-75, -73, 100), rng.uniform(40, 42, 100),
+                rng.integers(MS, MS + DAY, 100))
+    assert idx2.device_bytes() == (1 << 14) * 16
+    idx2.block()
