@@ -80,14 +80,36 @@ def _lean_scan(bins, z, pos, rb, rlo, rhi, capacity: int):
 
 
 @jax.jit
-def _lean_count(bins, z, rb, rlo, rhi):
-    """Candidate totals probe: size the gather capacity BEFORE compiling
-    the scan (one cheap compile instead of a capacity-walk of scan
-    compiles — each costs tens of seconds at 2^28-slot columns over a
-    remote tunnel)."""
-    starts = searchsorted2(bins, z, rb, rlo, side="left")
-    ends = searchsorted2(bins, z, rb, rhi, side="right")
-    return jnp.sum(jnp.maximum(ends - starts, 0))
+def _lean_count_multi(rb, rlo, rhi, *cols):
+    """Totals probe over EVERY generation in ONE dispatch: a 30-run
+    store otherwise pays 30 tunnel round trips per probe (the dispatch
+    RTT, ~100ms each, dominates the microseconds of seek work)."""
+    outs = []
+    for g in range(len(cols) // 2):
+        b, z = cols[2 * g], cols[2 * g + 1]
+        starts = searchsorted2(b, z, rb, rlo, side="left")
+        ends = searchsorted2(b, z, rb, rhi, side="right")
+        outs.append(jnp.sum(jnp.maximum(ends - starts, 0)))
+    return jnp.stack(outs)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _lean_scan_multi(rb, rlo, rhi, capacity: int, *cols):
+    """Candidate gather over every generation in ONE dispatch (the scan
+    sibling of :func:`_lean_count_multi`); returns (G, capacity)."""
+    outs = []
+    for g in range(len(cols) // 3):
+        b, z, pos = cols[3 * g], cols[3 * g + 1], cols[3 * g + 2]
+        starts = searchsorted2(b, z, rb, rlo, side="left")
+        ends = searchsorted2(b, z, rb, rhi, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        idx, valid_slot, _ = expand_ranges(starts, counts, capacity)
+        outs.append(jnp.where(valid_slot, pos[idx], jnp.int32(-1)))
+    return jnp.stack(outs)
+
+
+#: generation-count compile bucket for the multi-generation programs
+_GEN_BUCKET = 4
 
 
 class _Generation:
@@ -120,6 +142,10 @@ class LeanZ3Index:
     #: once).
     GENERATION_SLOTS = 1 << 24
     DEFAULT_CAPACITY = 1 << 15
+    #: slot budget for the batched (G × capacity) candidate buffer;
+    #: beyond it queries fall back to per-generation buffers sized by
+    #: each generation's own total
+    BATCH_SCAN_BUDGET = 1 << 26
 
     def __init__(self, period: TimePeriod | str = TimePeriod.WEEK,
                  version: int = Z3_INDEX_VERSION,
@@ -234,23 +260,50 @@ class LeanZ3Index:
         rb = jnp.asarray(r["rbin"])
         rlo = jnp.asarray(r["rzlo"])
         rhi = jnp.asarray(r["rzhi"])
-        parts = []
-        for gi, gen in enumerate(self.generations):
-            if progress is not None:
-                progress(f"    gen {gi}/{len(self.generations)}")
-            # totals probe first: one scan compile at the right size
-            total = int(_lean_count(gen.bins, gen.z, rb, rlo, rhi))
-            if total == 0:
-                continue
-            capacity = gather_capacity(total,
-                                       minimum=self.DEFAULT_CAPACITY)
-            cand, _ = _lean_scan(gen.bins, gen.z, gen.pos,
-                                 rb, rlo, rhi, capacity)
-            arr = np.asarray(cand)
-            parts.append(arr[arr >= 0])
-        if not parts:
+        # probe totals and gather candidates for ALL generations in one
+        # dispatch each — per-generation dispatches cost a tunnel RTT
+        # apiece, which dominated 500M-store queries (30 runs × 2 ×
+        # ~120ms).  The list pads to a compile bucket with the LAST
+        # generation repeated (no extra HBM; duplicate hits dedup below)
+        gens = list(self.generations)
+        n_pad = (-len(gens)) % _GEN_BUCKET
+        padded = gens + [gens[-1]] * n_pad
+        count_cols: list = []
+        for gen in padded:
+            count_cols += [gen.bins, gen.z]
+        if progress is not None:
+            progress(f"    probing {len(gens)} generations")
+        totals = np.asarray(_lean_count_multi(rb, rlo, rhi, *count_cols))
+        if int(totals[:len(gens)].sum()) == 0:
             return np.empty(0, dtype=np.int64)
-        cand = np.concatenate(parts).astype(np.int64)
+        capacity = gather_capacity(int(totals.max()),
+                                   minimum=self.DEFAULT_CAPACITY)
+        if len(padded) * capacity <= self.BATCH_SCAN_BUDGET:
+            scan_cols: list = []
+            for gen in padded:
+                scan_cols += [gen.bins, gen.z, gen.pos]
+            packed = np.asarray(_lean_scan_multi(rb, rlo, rhi, capacity,
+                                                 *scan_cols))
+            flat = packed.ravel()
+        else:
+            # huge candidate sets: the shared-capacity batched buffer
+            # would cost G × max-total slots of HBM — fall back to
+            # per-generation scans sized by each generation's OWN total
+            parts = []
+            for gen, tot in zip(gens, totals[:len(gens)]):
+                if int(tot) == 0:
+                    continue
+                cap_g = gather_capacity(int(tot),
+                                        minimum=self.DEFAULT_CAPACITY)
+                cand_g, _ = _lean_scan(gen.bins, gen.z, gen.pos,
+                                       rb, rlo, rhi, cap_g)
+                parts.append(np.asarray(cand_g))
+            flat = np.concatenate(parts) if parts else np.empty(0,
+                                                                np.int32)
+        # unique: bucket padding repeats the last generation's hits
+        cand = np.unique(flat[flat >= 0]).astype(np.int64)
+        if not len(cand):
+            return np.empty(0, dtype=np.int64)
         # exact host re-check on the payload (the client-side filter)
         x, y, t = self._payload_flat()
         boxes = np.atleast_2d(np.asarray(boxes, dtype=np.float64))

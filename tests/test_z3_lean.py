@@ -62,3 +62,28 @@ def test_empty_and_budget_bookkeeping():
                 rng.integers(MS, MS + DAY, 100))
     assert idx2.device_bytes() == (1 << 14) * 16
     idx2.block()
+
+
+def test_big_capacity_falls_back_per_generation(monkeypatch, data):
+    """Huge candidate sets route through per-generation buffers sized by
+    each generation's own total (the batched shared-capacity buffer
+    would cost G × max-total slots of HBM)."""
+    from geomesa_tpu.index import z3_lean as mod
+
+    x, y, t = data
+    idx = LeanZ3Index(period="week", generation_slots=1 << 14)
+    idx.append(x, y, t)
+    calls = {"single": 0}
+    orig = mod._lean_scan
+
+    def spy(*a, **k):
+        calls["single"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(mod, "_lean_scan", spy)
+    monkeypatch.setattr(LeanZ3Index, "BATCH_SCAN_BUDGET", 1 << 14)
+    # whole-world query: totals ~= all rows → capacity blows the
+    # (shrunken) batched budget → per-generation path
+    got = idx.query([(-180, -90, 180, 90)], None, None)
+    np.testing.assert_array_equal(got, np.arange(len(x)))
+    assert calls["single"] == len(idx.generations)
