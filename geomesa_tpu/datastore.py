@@ -1010,7 +1010,11 @@ class TpuDataStore:
         schema = sft_to_arrow_schema(sft, dictionary_fields)
         result = self.query_result(name, query)
         batch = result.batch
-        if len(batch) == 0:
+        # gate on the GLOBAL hit list, not the local batch: under
+        # multihost a process may hold zero of the hits while peers hold
+        # some — it must still enter the mesh reduce below with its
+        # empty local group, like stats_process does (ADVICE r3)
+        if len(result.positions) == 0:
             return schema.empty_table()
         if self._mesh is not None:
             # distributed reduce: per-shard delta-dictionary streams
@@ -1023,8 +1027,11 @@ class TpuDataStore:
             # Multihost: each process reduces its local hit slice.
             from .parallel.stats import merged_arrow
             shards = self._hit_residency(store, result.positions)
-            return merged_arrow(
+            merged = merged_arrow(
                 batch, sft, shards, dictionary_fields, sort_field, reverse)
+            # zero LOCAL rows (all hits live on peers) → empty table of
+            # the right schema rather than None
+            return merged if merged is not None else schema.empty_table()
         if sort_field is not None:
             order = np.argsort(np.asarray(batch.columns[sort_field]),
                                kind="stable")
@@ -1239,13 +1246,30 @@ class TpuDataStore:
         if mask is not None:
             col = store.batch.column(attr)[mask]
             if store.multihost:
-                from .parallel.multihost import allgather_concat
-                pairs = (np.array([[col.min(), col.max()]])
-                         if len(col) else np.empty((0, 2)))
-                pairs = allgather_concat(np.asarray(pairs, np.float64))
-                if not len(pairs):
+                # dtype gate decided from the SCHEMA type (identical on
+                # every process): string/object columns cannot ride the
+                # float64 allgather — their bounds travel as strings
+                # (ADVICE r3)
+                numeric = store.sft.attribute(attr).type in (
+                    "int", "long", "float", "double", "date", "bool")
+                if numeric:
+                    from .parallel.multihost import allgather_concat
+                    pairs = (np.array([[col.min(), col.max()]])
+                             if len(col) else np.empty((0, 2)))
+                    pairs = allgather_concat(np.asarray(pairs, np.float64))
+                    if not len(pairs):
+                        return None
+                    return pairs[:, 0].min(), pairs[:, 1].max()
+                # each process contributes its [min, max] (or nothing);
+                # the global bounds are min/max over the flat gather —
+                # pairing doesn't matter since both ends are present
+                from .parallel.multihost import allgather_strings
+                vals = [v for v in col if v is not None]
+                local = ([str(min(vals)), str(max(vals))] if vals else [])
+                flat = allgather_strings(np.array(local, dtype=object))
+                if not len(flat):
                     return None
-                return pairs[:, 0].min(), pairs[:, 1].max()
+                return min(flat), max(flat)
             if not len(col):
                 return None
             return col.min(), col.max()

@@ -54,8 +54,49 @@ class PallasGate:
         self.kind = kind
         self.ok: bool | None = None
 
-    def run(self, pallas_thunk, xla_thunk, enabled: bool = True):
-        if enabled and self.ok is not False and on_tpu():
+    def _agree_multihost(self, probe) -> bool:
+        """Multihost: the pallas/XLA choice must be identical on every
+        process — the two variants are different compiled programs
+        entering the same mesh collectives, so a one-sided fallback
+        (e.g. a Mosaic failure on a subset of processes) would desync
+        or deadlock them (ADVICE r3).  Two agreed decisions:
+
+        1. the recorded gate state (a failure anywhere moves everyone
+           to XLA at the next call);
+        2. when a ``probe`` is given, each process first runs it — a
+           tiny STANDALONE kernel call with no collectives — so a
+           divergent Mosaic lowering failure is discovered *before*
+           any process enters the collective program (entering it
+           one-sided would strand the peers mid-psum).
+        """
+        from ..parallel.multihost import agreed_int
+        ok = self.ok is not False
+        if ok and probe is not None and self.ok is None:
+            try:
+                probe()
+            except Exception:
+                ok = False
+        agreed = bool(agreed_int(int(ok), "min"))
+        if not agreed:
+            # record on EVERY process so the fleet stays symmetric (a
+            # one-sided False would skip future agreements one-sided)
+            self.ok = False
+        return agreed
+
+    def run(self, pallas_thunk, xla_thunk, enabled: bool = True,
+            probe=None):
+        """``enabled`` must be computed from process-invariant inputs
+        (global shapes, mesh size): under multihost the agreement
+        collective below is entered iff ``enabled and on_tpu()``, so a
+        process-varying ``enabled`` would strand peers in the
+        allgather.  Only the gate state may diverge across processes,
+        and the agreement reconciles exactly that."""
+        attempt = enabled and on_tpu()
+        if attempt and jax.process_count() > 1:
+            attempt = self._agree_multihost(probe)
+        else:
+            attempt = attempt and self.ok is not False
+        if attempt:
             try:
                 out = pallas_thunk()  # materialize inside the try —
                 self.ok = True        # kernel failures surface on fetch

@@ -158,6 +158,11 @@ def sql_query(store, text: str):
                         "count" if fn == "count" else fn)
                 for fn, col, alias in q.aggs}
         out = frame.group_by(q.group, spec)
+        if q.order is not None and q.order not in out:
+            raise ValueError(
+                f"ORDER BY column {q.order!r} is not in the aggregation "
+                f"output (have: {sorted(out)}); order by the GROUP BY "
+                "column or an aggregate alias")
         if q.order is not None:
             key = out[q.order]
             idx = np.argsort(key, kind="stable")

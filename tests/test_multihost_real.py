@@ -232,6 +232,30 @@ my_d = haversine_m(qx, qy, bx, by)
 all_d = np.sort(allgather_concat(my_d))
 np.testing.assert_allclose(np.sort(kdist), all_d[:10], rtol=1e-12)
 
+# query_arrow with zero LOCAL hits (ADVICE r4): proc 1 holds none of
+# the 'p0.0' hits but must still enter the mesh reduce with its empty
+# local group and return the schema'd empty table, not None
+tbl = ds.query_arrow("evt", "IN ('p0.0')")
+assert tbl is not None and tbl.num_rows == (1 if proc == 0 else 0), tbl
+assert "name" in tbl.schema.names
+
+# string attribute bounds for a restricted caller (ADVICE r4): the
+# per-process (min,max) pairs must ride the string collective — the
+# float64 allgather raised ValueError on object columns
+class _Auth:
+    def get_authorizations(self):
+        return frozenset(["u"])
+
+ds_r = TpuDataStore(mesh=mesh, multihost=True, auth_provider=_Auth())
+ds_r.create_schema("sec", "name:String,dtg:Date,*geom:Point")
+sec_names = ["bb", "cc"] if proc == 0 else ["aa", "zz"]
+ds_r.write("sec", {"name": np.array(sec_names, dtype=object),
+                   "dtg": np.full(2, MS),
+                   "geom": (np.zeros(2), np.zeros(2))},
+           visibility=("u" if proc == 0 else "admin"))
+nb = ds_r.get_attribute_bounds("sec", "name")
+assert nb == ("bb", "cc"), nb   # proc 1's rows are hidden from this caller
+
 # merged global stats + bounds
 env = ds.get_bounds("evt")
 assert env is not None and env.xmin >= -75.0 and env.xmax <= -73.0

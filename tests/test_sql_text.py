@@ -77,6 +77,15 @@ def test_group_by_aggregates(ds):
             st.batch.column("score")[m].max())
 
 
+def test_group_by_order_by_unknown_column(ds):
+    # ADVICE r4: ordering by a column outside the aggregation output is
+    # a validation error with the supported-grammar message, not a bare
+    # KeyError
+    with pytest.raises(ValueError, match="ORDER BY column 'score'"):
+        sql_query(ds, "SELECT count(*) AS n FROM evt GROUP BY name "
+                      "ORDER BY score")
+
+
 def test_global_count(ds):
     n = sql_query(ds, "SELECT count(*) FROM evt WHERE name = 'c'")
     st = ds._store("evt")
