@@ -1250,9 +1250,9 @@ class TpuDataStore:
                 # every process): string/object columns cannot ride the
                 # float64 allgather — their bounds travel as strings
                 # (ADVICE r3)
-                numeric = store.sft.attribute(attr).type in (
-                    "int", "long", "float", "double", "date", "bool")
-                if numeric:
+                a_type = store.sft.attribute(attr).type
+                if a_type in ("int", "long", "float", "double", "date",
+                              "bool"):
                     from .parallel.multihost import allgather_concat
                     pairs = (np.array([[col.min(), col.max()]])
                              if len(col) else np.empty((0, 2)))
@@ -1260,6 +1260,11 @@ class TpuDataStore:
                     if not len(pairs):
                         return None
                     return pairs[:, 0].min(), pairs[:, 1].max()
+                if a_type != "string":
+                    # bytes/json have no collective ordering protocol —
+                    # str() coercion would return repr-mangled bounds
+                    # inconsistent with the single-host path
+                    return None
                 # each process contributes its [min, max] (or nothing);
                 # the global bounds are min/max over the flat gather —
                 # pairing doesn't matter since both ends are present
