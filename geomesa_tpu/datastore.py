@@ -42,8 +42,11 @@ from .stats.stat import (
 __all__ = ["TpuDataStore", "CatalogVersionError", "CURRENT_INDEX_VERSIONS"]
 
 #: on-disk catalog format version; bumped on incompatible layout changes
-#: (v2 added per-index layout versions; v1 catalogs read as all-current)
-CATALOG_VERSION = 2
+#: (v2 added per-index layout versions; v1 catalogs read as all-current;
+#: v3 changed the Frequency sketch's string hashing — pre-v3 persisted
+#: frequency tables are dropped on load and rebuild on the next
+#: stats_analyze rather than silently answering from the wrong buckets)
+CATALOG_VERSION = 3
 
 #: current per-index key-layout versions (the reference's Z3IndexV7-style
 #: version registry, index/api/GeoMesaFeatureIndexFactory); v1 of z3/z2
@@ -123,6 +126,19 @@ class _SchemaStore:
         #: old catalogs keep their recorded layout; see migrate_schema)
         self.index_versions: dict = _parse_index_versions(sft.user_data)
         self.batch: FeatureBatch | None = None
+        #: lean profile (``geomesa.index.profile=lean`` user data, or
+        #: auto-enabled by a first write past the row threshold): chunked
+        #: columnar storage (features/lean.LeanBatch), implicit feature
+        #: ids, deletes as tombstones, and the tiered LeanZ3Index as the
+        #: only spatial index — the "tens of billions of points through
+        #: one DataStore" regime (introduction.rst:24,
+        #: GeoMesaDataStore.scala:48) on a single chip's terms
+        self.lean = ((sft.user_data or {}).get(
+            "geomesa.index.profile") == "lean")
+        #: deleted-row mask (lean profile: rows are never removed, ids
+        #: never reused — the delete path of IndexAdapter writers
+        #: re-expressed as a mask the planner applies to every result)
+        self.tombstone: np.ndarray | None = None
         self.visibilities: np.ndarray | None = None  # per-feature vis strings
         #: attr name → per-feature vis strings (attribute-level visibility,
         #: the reference's KryoVisibilityRowEncoder / vis-level=attribute)
@@ -146,6 +162,138 @@ class _SchemaStore:
         #: (built on the first explicit-id write, maintained after)
         self._id_set: set | None = None
         self._init_stats()
+        if self.lean:
+            self._init_lean()
+
+    # -- lean profile ------------------------------------------------------
+    @property
+    def query_indices(self) -> set | None:
+        """Indices the planner may choose for this schema (None = all
+        registered): the lean profile serves z3 (the scale index) and
+        id (implicit-id decode) only."""
+        return {"z3", "id"} if self.lean else None
+
+    def _init_lean(self) -> None:
+        sft = self.sft
+        if not (sft.is_points and sft.geom_field and sft.dtg_field):
+            raise ValueError(
+                "geomesa.index.profile=lean requires a point geometry "
+                "and a dtg attribute (the lean Z3 index is the only "
+                "scale index)")
+        if self.mesh is not None:
+            raise ValueError(
+                "the lean profile is single-controller for now — "
+                "drop mesh= or use the full-fat sharded indexes")
+        from .features.lean import LeanBatch
+        self.lean = True
+        self.batch = LeanBatch(sft)
+        self._dirty = False
+
+    def _lean_payload(self):
+        """(x, y, t) for the lean index's exact re-check — the store's
+        own finalized columns (ONE host copy, shared by reference)."""
+        x, y = self.batch.geom_xy()
+        t = np.asarray(self.batch.column(self.sft.dtg_field), np.int64)
+        return x, y, t
+
+    def _lean_index(self):
+        """The live LeanZ3Index — maintained incrementally by writes;
+        (re)built here by streaming the column store in bounded slices
+        only after a layout migration or reload."""
+        idx = self._indexes.get("z3")
+        if idx is None:
+            from .index.z3_lean import LeanZ3Index
+            idx = LeanZ3Index(period=self.sft.z3_interval,
+                              version=self.index_versions["z3"])
+            idx.payload_provider = self._lean_payload
+            n = len(self.batch)
+            if n:
+                x, y = self.batch.geom_xy()
+                t = self.batch.column(self.sft.dtg_field)
+                step = 1 << 22
+                for lo in range(0, n, step):
+                    idx.append(x[lo:lo + step], y[lo:lo + step],
+                               t[lo:lo + step])
+            self._indexes["z3"] = idx
+            self._index_coverage["z3"] = n
+            self.build_counts["z3"] = self.build_counts.get("z3", 0) + 1
+        return idx
+
+    def _lean_write(self, chunk, visibility: str = "") -> None:
+        """Streaming ingest: observe stats on the chunk, append its
+        columns by reference, and push its keys into the live index —
+        O(chunk) per write (a FeatureBatch.concat store is O(n²) over a
+        streamed build)."""
+        n_new = len(chunk)
+        prior = len(self.batch)
+        if visibility or self.visibilities is not None:
+            # visibility labels materialize only once someone uses them
+            # (an object-array per row is real memory at lean scale)
+            if self.visibilities is None:
+                self.visibilities = np.full(prior, "", dtype=object)
+            self.visibilities = np.concatenate(
+                [self.visibilities,
+                 np.full(n_new, visibility, dtype=object)])
+        for s in self._stats.values():
+            s.observe(chunk)
+        self._mutation_version += 1
+        self._vis_masks = {}
+        # index BEFORE the batch grows: _lean_index streams the batch's
+        # CURRENT rows when (re)building, so appending the chunk first
+        # would double-index it
+        idx = self._lean_index()
+        self.batch.append_batch(chunk)
+        if self.tombstone is not None:
+            self.tombstone = np.concatenate(
+                [self.tombstone, np.zeros(n_new, dtype=bool)])
+        x, y = chunk.geom_xy(self.sft.geom_field)
+        idx.append(np.asarray(x, np.float64), np.asarray(y, np.float64),
+                   np.asarray(chunk.column(self.sft.dtg_field), np.int64))
+        self._index_coverage["z3"] = len(self.batch)
+
+    def _lean_observe_masked(self, proto, mask: np.ndarray | None):
+        """Fold the (masked) rows into a fresh copy of ``proto`` in
+        bounded slices — never materializing the full row set (the
+        chunked re-observe for restricted callers / post-delete stats)."""
+        fresh = proto.fresh_copy() if hasattr(proto, "fresh_copy") else proto
+        n = len(self.batch)
+        step = 1 << 22
+        for lo in range(0, n, step):
+            hi = min(lo + step, n)
+            view = self.batch.slice_view(lo, hi)
+            if mask is not None:
+                sub = mask[lo:hi]
+                if not sub.all():
+                    if not sub.any():
+                        continue
+                    view = view.take(np.flatnonzero(sub))
+            fresh.observe(view)
+        return fresh
+
+    def _lean_recompute_stats(self) -> None:
+        """Chunked recompute over the LIVE rows (deletes tombstone rows
+        but sketches are not invertible — the same re-observe contract
+        as recompute_stats, sliced to bound host memory)."""
+        self._stats = {}
+        self._init_stats()
+        n = len(self.batch)
+        if not n:
+            return
+        live = None if self.tombstone is None else ~self.tombstone
+        from .stats.stat import Histogram
+        for a in self.sft.attributes:
+            if (a.indexed and a.type in ("int", "long", "float", "double")
+                    and a.name in self.batch.columns):
+                col = self.batch.column(a.name)
+                if len(col) and col.dtype != object:
+                    sel = col if live is None else col[live]
+                    if len(sel):
+                        lo, hi = float(sel.min()), float(sel.max())
+                        if hi > lo:
+                            self._stats[f"{a.name}_histogram"] = \
+                                Histogram(a.name, 32, lo, hi)
+        for key, s in list(self._stats.items()):
+            self._stats[key] = self._lean_observe_masked(s, live)
 
     def _init_stats(self):
         sft = self.sft
@@ -163,6 +311,13 @@ class _SchemaStore:
 
     def write(self, batch: FeatureBatch, visibility: str = "",
               attribute_visibilities: dict | None = None):
+        if self.lean:
+            if attribute_visibilities:
+                raise ValueError(
+                    "attribute-level visibility is not supported on "
+                    "lean-profile schemas (row visibility is)")
+            self._lean_write(batch, visibility)
+            return
         vis = np.full(len(batch), visibility, dtype=object)
         prior = 0 if self.batch is None else len(self.batch)
         if self.batch is None:
@@ -398,6 +553,9 @@ class _SchemaStore:
         StatsRunner/stats-analyze products the cost estimator consumes,
         stats/StatsBasedEstimator spirit) — bounds come from the data, so
         these only exist after an analyze/recompute pass."""
+        if self.lean:
+            self._lean_recompute_stats()
+            return
         self._stats = {}
         self._init_stats()
         if self.batch is not None and len(self.batch):
@@ -446,6 +604,16 @@ class _SchemaStore:
         GeoMesaFeatureIndexFactory lookup): builds lazily, honors the
         schema's enabled-index restriction and applicability."""
         from .index.registry import get_index
+        if self.lean:
+            self._rebuild_if_dirty()
+            if name == "z3":
+                return self._lean_index()
+            if name == "id":
+                from .index.id import LeanIdIndex
+                return LeanIdIndex(len(self.batch))
+            raise ValueError(
+                f"index {name!r} is not available on lean-profile "
+                f"schema {self.sft.name!r} (z3/id only)")
         self._rebuild_if_dirty()
         self._maybe_compact(name)
         if name not in self._indexes:
@@ -555,6 +723,11 @@ class _SchemaStore:
         return self._indexes["attr-z3-keys"]
 
     def attribute_index(self, attr: str) -> AttributeIndex:
+        if self.lean:
+            raise ValueError(
+                "attribute indexes are not available on lean-profile "
+                "schemas — attribute predicates run as residual filters "
+                "over the candidate rows")
         self._rebuild_if_dirty()
         enabled = self.sft.enabled_indices
         if enabled is not None and "attr" not in enabled:
@@ -619,6 +792,10 @@ class _MaskedStoreView:
 class TpuDataStore:
     """In-process spatio-temporal datastore over columnar TPU indexes."""
 
+    #: first-write row count at which a qualifying schema auto-enables
+    #: the lean profile (chunked columns + tiered LeanZ3Index)
+    LEAN_AUTO_ROWS = 32_000_000
+
     def __init__(self, catalog_dir: str | None = None, *,
                  mesh=None, multihost: bool = False, auth_provider=None,
                  audit_writer=None, user: str = "unknown"):
@@ -665,9 +842,11 @@ class TpuDataStore:
                     f"catalog {self._catalog_dir!r} has version {found}, "
                     f"newer than this framework's {CATALOG_VERSION}; "
                     "upgrade before opening it")
+            self._catalog_found_version = found
         else:
             with open(path, "w") as f:
                 f.write(str(CATALOG_VERSION))
+            self._catalog_found_version = CATALOG_VERSION
 
     @contextmanager
     def _catalog_lock(self):
@@ -788,6 +967,49 @@ class TpuDataStore:
         if visibility:
             parse_visibility(visibility)  # validate eagerly
         store = self._store(name)
+        if (not store.lean and store.batch is None
+                and store.mesh is None
+                and store.sft.is_points and store.sft.geom_field
+                and store.sft.dtg_field
+                and not isinstance(data, FeatureBatch)
+                and ids is None and not attribute_visibilities):
+            # auto-profile: a first write past the threshold flips the
+            # schema to the lean profile BEFORE any full-fat state
+            # exists (the reference serves every scale through one
+            # facade; the threshold is where full-fat HBM residency
+            # stops making sense)
+            first = next(iter(data.values()), ())
+            n_first = (len(first[0]) if isinstance(first, tuple)
+                       else len(first))
+            if n_first >= self.LEAN_AUTO_ROWS:
+                store.sft.user_data["geomesa.index.profile"] = "lean"
+                store._init_lean()
+                self._persist_schema(store.sft)
+        if store.lean:
+            from .features.batch import build_columns
+            from .features.lean import ChunkView
+            if attribute_visibilities:
+                raise ValueError(
+                    "attribute-level visibility is not supported on "
+                    "lean-profile schemas (row visibility is)")
+            if ids is not None or (isinstance(data, FeatureBatch)
+                                   and data.ids_explicit):
+                raise ValueError(
+                    "lean-profile schemas use implicit feature ids "
+                    "(row number); explicit ids are not supported")
+            if isinstance(data, FeatureBatch):
+                chunk = ChunkView(store.sft, dict(data.columns),
+                                  len(data))
+            else:
+                cols, geoms = build_columns(store.sft, data)
+                assert geoms is None  # lean schemas are points-only
+                n_chunk = len(next(iter(cols.values()))) if cols else 0
+                chunk = ChunkView(store.sft, cols, n_chunk)
+            store.write(chunk, visibility=visibility)
+            store.next_fid = len(store.batch)
+            from .metrics import registry as _metrics
+            _metrics.counter(f"write.{name}.features").inc(len(chunk))
+            return len(chunk)
         for attr, expr in (attribute_visibilities or {}).items():
             spec = store.sft.attribute(attr)   # KeyError on typos
             if spec.is_geometry or attr == store.sft.dtg_field:
@@ -894,6 +1116,25 @@ class TpuDataStore:
         removeFeatures path).  Stats are recomputed from the surviving
         rows — sketches are not invertible."""
         store = self._store(name)
+        if store.lean:
+            # tombstone, don't remove: positions stay stable (the live
+            # index and payload never shuffle) and implicit ids are
+            # never reused — the modifying-writer delete as a mask
+            from .index.id import LeanIdIndex
+            rows = LeanIdIndex(len(store.batch)).query(
+                np.atleast_1d(np.asarray(ids, dtype=object)))
+            if not len(rows):
+                return 0
+            if store.tombstone is None:
+                store.tombstone = np.zeros(len(store.batch), dtype=bool)
+            newly = rows[~store.tombstone[rows]]
+            if not len(newly):
+                return 0
+            store.tombstone[rows] = True
+            store._mutation_version += 1
+            store._vis_masks = {}
+            store._lean_recompute_stats()
+            return int(len(newly))
         n_here = 0 if store.batch is None else len(store.batch)
         if n_here == 0 and not store.multihost:
             return 0
@@ -963,6 +1204,11 @@ class TpuDataStore:
                 # guarded values must be invisible to FILTERS too, not
                 # just results — evaluate over the masked view
                 eval_store = _MaskedStoreView(store, masked)
+        if store.tombstone is not None:
+            # deleted rows (lean tombstones) are invisible to every
+            # query, like any other row the caller cannot see
+            live = ~store.tombstone
+            allowed = live if allowed is None else (allowed & live)
         result = QueryPlanner(store.sft, eval_store).run(
             q, explain, allowed=allowed)
         self._audit(name, q, result)
@@ -1091,9 +1337,30 @@ class TpuDataStore:
         # take the (slower) per-window planner path, which applies them;
         # schemas restricting their index set also take the planner path
         # (it honors the restriction)
+        if store.lean and not self._interceptors[sft.name]:
+            # lean fast path: ALL windows (timed or not — the index
+            # clamps open bounds to the data extent) through the lean
+            # index's single batched multi-window program
+            t0 = time.time()
+            hits = store.index("z3").query_many(
+                [(boxes, lo, hi) for boxes, lo, hi in windows])
+            allowed = self._effective_mask(store)
+            if allowed is not None:
+                hits = [h[allowed[h]] for h in hits]
+            from .metrics import registry as _metrics
+            _metrics.counter(f"query.{name}.windows").inc(len(windows))
+            if self._audit_writer is not None:
+                from .audit import QueryEvent
+                self._audit_writer.write_event(QueryEvent(
+                    store="tpu", type_name=name, user=self._user,
+                    filter=f"batched windows[{len(windows)}]",
+                    scan_time_ms=(time.time() - t0) * 1e3,
+                    hits=int(sum(len(h) for h in hits))))
+            return hits
         enabled = sft.enabled_indices
         use_fast = (sft.is_points and sft.dtg_field
                     and not self._interceptors[sft.name]
+                    and not store.lean
                     and (enabled is None
                          or {"z2", "z3"} <= set(enabled)))
         if not use_fast:
@@ -1128,11 +1395,11 @@ class TpuDataStore:
                 hits[i] = z2_hits[j]
             for j, i in enumerate(timed_idx):
                 hits[i] = z3_hits[j]
-        # _restricted_mask, not vis_mask: the restricted decision is
-        # AGREED under multihost (per-process vis_mask may be None on
-        # one process and set on another — a divergent gate would
-        # strand peers in the allgather below)
-        allowed = self._restricted_mask(store)
+        # _effective_mask (restricted + tombstones), not vis_mask: the
+        # restricted decision is AGREED under multihost (per-process
+        # vis_mask may be None on one process and set on another — a
+        # divergent gate would strand peers in the allgather below)
+        allowed = self._effective_mask(store)
         if allowed is not None:
             if store.multihost:
                 # gids → per-process local rows → mask → allgather back
@@ -1180,13 +1447,29 @@ class TpuDataStore:
                                else len(store.batch), dtype=bool)
         return mask
 
+    def _effective_mask(self, store: _SchemaStore,
+                        only_if_restricted: bool = False) -> np.ndarray | None:
+        """Restricted-visibility mask combined with lean tombstones —
+        what the stats/bounds/window paths must treat as 'the rows this
+        caller can see'.  With ``only_if_restricted`` the tombstones ride
+        along only when a visibility restriction exists: the global
+        sketches already exclude deleted rows (delete-time recompute),
+        so an unrestricted caller must NOT trigger the O(n) re-observe
+        path just because tombstones exist."""
+        mask = self._restricted_mask(store)
+        tomb = store.tombstone
+        if tomb is None or (only_if_restricted and mask is None):
+            return mask
+        live = ~tomb
+        return live if mask is None else (mask & live)
+
     def get_count(self, name: str, query=None) -> int:
         store = self._store(name)
         if query is not None:
             # positions, not the batch: the global hit count under
             # multihost (the local batch is just this process's slice)
             return len(self.query_result(name, query).positions)
-        mask = self._restricted_mask(store)
+        mask = self._effective_mask(store)
         if mask is not None:
             n = int(mask.sum())
             if store.multihost:
@@ -1201,9 +1484,22 @@ class TpuDataStore:
         n_here = 0 if store.batch is None else len(store.batch)
         if n_here == 0 and not store.multihost:
             return None
+        if store.lean:
+            from .geometry.types import Envelope
+            mask = self._effective_mask(store)
+            if mask is None:
+                env = store.batch.envelope
+                return None if env is None else Envelope(*env)
+            if not mask.any():
+                return None
+            # masked extent straight from the x/y columns — never the
+            # O(n·4) per-feature bbox materialization
+            x, y = store.batch.geom_xy()
+            return Envelope(float(x[mask].min()), float(y[mask].min()),
+                            float(x[mask].max()), float(y[mask].max()))
         # the restricted-mask decision is collective under multihost —
         # it must run on EVERY process, zero-local-row ones included
-        mask = self._restricted_mask(store)
+        mask = self._effective_mask(store)
         if n_here:
             bb = store.batch.geom_bbox()
             if mask is not None:
@@ -1242,7 +1538,7 @@ class TpuDataStore:
         store = self._store(name)
         if self._attr_guarded(store, attr):
             return None
-        mask = self._restricted_mask(store)
+        mask = self._effective_mask(store, only_if_restricted=True)
         if mask is not None:
             col = store.batch.column(attr)[mask]
             if store.multihost:
@@ -1290,12 +1586,15 @@ class TpuDataStore:
         attr = getattr(stats.get(key), "attr", None)
         if attr and self._attr_guarded(store, attr):
             return None
-        mask = self._restricted_mask(store)
+        mask = self._effective_mask(store, only_if_restricted=True)
         s = stats.get(key)
         if mask is None or s is None:
             return s
         # rebuild the same stat type over the visible rows only;
         # multihost merges the per-process re-observations globally
+        if store.lean:
+            # chunked: never materialize the full visible row set
+            return store._lean_observe_masked(s, mask)
         fresh = s.fresh_copy()
         fresh.observe(store.batch.take(np.flatnonzero(mask)))
         return store.merge_stat_global(fresh)
@@ -1369,6 +1668,14 @@ class TpuDataStore:
             if meta is not None:
                 store.next_fid = max(store.next_fid,
                                      int(meta.get("next_fid", 0)))
+            if getattr(self, "_catalog_found_version",
+                       CATALOG_VERSION) < 3:
+                # pre-v3 Frequency tables used the old string hashing —
+                # reading them with the current hash would answer from
+                # the wrong buckets; drop them (rebuilt by the next
+                # stats_analyze)
+                raw = {k: v for k, v in raw.items()
+                       if v.get("kind") != "frequency"}
             store._stats = {k: stat_from_json(v) for k, v in raw.items()}
 
     # -- data persistence (FSDS-analog: parquet files under the catalog) --
@@ -1380,6 +1687,13 @@ class TpuDataStore:
         store = self._store(name)
         if store.batch is None:
             return
+        if store.lean:
+            raise ValueError(
+                "lean-profile schemas do not flush to the parquet "
+                "catalog (a 100M+-row snapshot belongs in a durable "
+                "store, not the metadata directory); stats persist via "
+                "persist_stats, and the data's source of truth is the "
+                "ingest stream")
         from .io.export import to_parquet
         to_parquet(store.batch, os.path.join(self._catalog_dir, f"{name}.parquet"))
         if store.visibilities is not None or store.attr_visibilities:
@@ -1403,6 +1717,11 @@ class TpuDataStore:
         self.persist_stats(name)
 
     def _load_data(self, name: str) -> None:
+        if self._schemas[name].lean:
+            # lean schemas never flushed row data (see flush); sketches
+            # and the fid counter still reload
+            self.load_stats(name)
+            return
         path = os.path.join(self._catalog_dir, f"{name}.parquet")
         if os.path.exists(path):
             from .io.export import from_parquet

@@ -24,7 +24,7 @@ import numpy as np
 from ..geometry.packed import PackedGeometry, pack_geometries
 from .feature_type import FeatureType
 
-__all__ = ["FeatureBatch"]
+__all__ = ["FeatureBatch", "build_columns"]
 
 _DTYPES = {
     "int": np.int32,
@@ -70,66 +70,7 @@ class FeatureBatch:
         the point default-geometry fast path accepts ``(x, y)`` tuples of
         arrays under the geometry attribute name.
         """
-        columns: dict = {}
-        geoms = None
-        for attr in sft.attributes:
-            if attr.name not in data:
-                continue
-            vals = data[attr.name]
-            if attr.is_geometry:
-                if attr.type == "point":
-                    # canonical point layout is the x/y fast path — whether
-                    # given as (x, y) arrays or Point objects — so batches
-                    # concat regardless of construction style
-                    if isinstance(vals, tuple):
-                        x, y = vals
-                    elif (isinstance(vals, list) and vals
-                          and isinstance(vals[0], (tuple, list))
-                          and len(vals[0]) == 2
-                          and not isinstance(vals[0][0], (tuple, list))):
-                        # list of (x, y) coordinate pairs
-                        arr = np.asarray(vals, dtype=np.float64)
-                        x, y = arr[:, 0], arr[:, 1]
-                    else:
-                        pts = (vals if isinstance(vals, PackedGeometry)
-                               else pack_geometries(vals))
-                        if pts.kinds.size and not (pts.kinds == 0).all():
-                            raise ValueError(
-                                f"attribute {attr.name!r} is typed Point but "
-                                "got non-point geometries")
-                        xy = pts.coords[pts.ring_offsets[:-1]] if pts.kinds.size \
-                            else np.empty((0, 2))
-                        x, y = xy[:, 0], xy[:, 1]
-                    columns[f"{attr.name}_x"] = np.asarray(x, dtype=np.float64)
-                    columns[f"{attr.name}_y"] = np.asarray(y, dtype=np.float64)
-                else:
-                    packed = vals if isinstance(vals, PackedGeometry) else pack_geometries(vals)
-                    if attr.name == sft.default_geom:
-                        geoms = packed
-                    columns[f"{attr.name}_bbox"] = packed.bbox
-                    if packed.kinds.size and (packed.kinds == 0).all():
-                        # pure point column: also expose x/y fast path
-                        pts = packed.coords[packed.ring_offsets[:-1]]
-                        columns[f"{attr.name}_x"] = pts[:, 0]
-                        columns[f"{attr.name}_y"] = pts[:, 1]
-            elif attr.type == "date":
-                vals = np.asarray(vals)
-                if vals.dtype.kind == "M":
-                    vals = vals.astype("M8[ms]").astype(np.int64)
-                if vals.dtype == object and any(v is None for v in vals):
-                    # sparse values (live-cache partial attrs): stay object;
-                    # filter evaluation treats None as non-matching
-                    columns[attr.name] = vals
-                else:
-                    columns[attr.name] = vals.astype(np.int64)
-            elif attr.type in ("string", "bytes", "json"):
-                columns[attr.name] = np.asarray(vals, dtype=object)
-            else:
-                arr = np.asarray(vals)
-                if arr.dtype == object and any(v is None for v in arr):
-                    columns[attr.name] = arr
-                else:
-                    columns[attr.name] = arr.astype(_DTYPES[attr.type])
+        columns, geoms = build_columns(sft, data)
         ids_arr = None if ids is None else np.asarray(ids, dtype=object)
         return cls(sft, columns, ids_arr, geoms, ids_explicit=ids is not None)
 
@@ -189,3 +130,71 @@ class FeatureBatch:
             geoms = self.geoms.concat(other.geoms)
         return FeatureBatch(
             self.sft, cols, np.concatenate([self.ids, other.ids]), geoms)
+
+
+def build_columns(sft: FeatureType, data: dict):
+    """Normalize a dict of attribute values into the canonical column
+    layout (module doc) — the shared ingest step of FeatureBatch.from_dict
+    and the lean profile's chunked writes (which skip FeatureBatch id
+    materialization entirely).  Returns ``(columns, packed_geoms)``."""
+    columns: dict = {}
+    geoms = None
+    for attr in sft.attributes:
+        if attr.name not in data:
+            continue
+        vals = data[attr.name]
+        if attr.is_geometry:
+            if attr.type == "point":
+                # canonical point layout is the x/y fast path — whether
+                # given as (x, y) arrays or Point objects — so batches
+                # concat regardless of construction style
+                if isinstance(vals, tuple):
+                    x, y = vals
+                elif (isinstance(vals, list) and vals
+                      and isinstance(vals[0], (tuple, list))
+                      and len(vals[0]) == 2
+                      and not isinstance(vals[0][0], (tuple, list))):
+                    # list of (x, y) coordinate pairs
+                    arr = np.asarray(vals, dtype=np.float64)
+                    x, y = arr[:, 0], arr[:, 1]
+                else:
+                    pts = (vals if isinstance(vals, PackedGeometry)
+                           else pack_geometries(vals))
+                    if pts.kinds.size and not (pts.kinds == 0).all():
+                        raise ValueError(
+                            f"attribute {attr.name!r} is typed Point but "
+                            "got non-point geometries")
+                    xy = pts.coords[pts.ring_offsets[:-1]] if pts.kinds.size \
+                        else np.empty((0, 2))
+                    x, y = xy[:, 0], xy[:, 1]
+                columns[f"{attr.name}_x"] = np.asarray(x, dtype=np.float64)
+                columns[f"{attr.name}_y"] = np.asarray(y, dtype=np.float64)
+            else:
+                packed = vals if isinstance(vals, PackedGeometry) else pack_geometries(vals)
+                if attr.name == sft.default_geom:
+                    geoms = packed
+                columns[f"{attr.name}_bbox"] = packed.bbox
+                if packed.kinds.size and (packed.kinds == 0).all():
+                    # pure point column: also expose x/y fast path
+                    pts = packed.coords[packed.ring_offsets[:-1]]
+                    columns[f"{attr.name}_x"] = pts[:, 0]
+                    columns[f"{attr.name}_y"] = pts[:, 1]
+        elif attr.type == "date":
+            vals = np.asarray(vals)
+            if vals.dtype.kind == "M":
+                vals = vals.astype("M8[ms]").astype(np.int64)
+            if vals.dtype == object and any(v is None for v in vals):
+                # sparse values (live-cache partial attrs): stay object;
+                # filter evaluation treats None as non-matching
+                columns[attr.name] = vals
+            else:
+                columns[attr.name] = vals.astype(np.int64)
+        elif attr.type in ("string", "bytes", "json"):
+            columns[attr.name] = np.asarray(vals, dtype=object)
+        else:
+            arr = np.asarray(vals)
+            if arr.dtype == object and any(v is None for v in arr):
+                columns[attr.name] = arr
+            else:
+                columns[attr.name] = arr.astype(_DTYPES[attr.type])
+    return columns, geoms

@@ -1,0 +1,164 @@
+"""LeanBatch: chunked columnar storage for the store's lean profile.
+
+The reference serves "tens of billions of points" through one DataStore
+facade (docs/user/introduction.rst:24, GeoMesaDataStore.scala:48)
+because rows live on the cluster, not the client.  The TPU-native
+analog at single-host scale: the schema's columns accumulate as CHUNK
+LISTS of numpy arrays (one per write, concatenated lazily per column),
+feature ids are IMPLICIT (the id of row ``r`` is ``str(r)`` — minted
+monotonically by append order, never reused), and query results
+materialize real :class:`FeatureBatch` objects only for the HIT rows.
+
+This keeps the per-write cost O(chunk) — a FeatureBatch.concat per
+write would be O(n) each, O(n²) for a streaming build — and avoids the
+two O(n)-objects killers at 100M+ rows: an object-dtype id array
+(~60 B/row of pointer+string overhead) and per-write visibility
+relabeling.
+
+Only point schemas with a time attribute qualify (the lean Z3 index is
+the only scale index); the store enforces that.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .batch import FeatureBatch
+from .feature_type import FeatureType
+
+__all__ = ["LeanBatch", "ChunkView"]
+
+
+class ChunkView:
+    """Minimal column-view 'batch' for streaming paths that never need
+    feature ids (stats observe, lean index appends): ``len``,
+    ``column``, ``columns``, ``geom_xy``, ``take``.  Avoids the O(chunk)
+    id-string materialization a real FeatureBatch would pay."""
+
+    geoms = None
+
+    def __init__(self, sft: FeatureType, columns: dict, n: int):
+        for name, col in columns.items():
+            if len(col) != n:
+                # the invariant FeatureBatch.__post_init__ enforces —
+                # a ragged chunk would silently misalign the store
+                raise ValueError(f"column {name!r} has length "
+                                 f"{len(col)}, expected {n}")
+        self.sft = sft
+        self.columns = columns
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def geom_xy(self, name: str | None = None):
+        name = name or self.sft.default_geom
+        return self.columns[f"{name}_x"], self.columns[f"{name}_y"]
+
+    def take(self, positions) -> "ChunkView":
+        positions = np.asarray(positions)
+        return ChunkView(self.sft,
+                         {k: v[positions] for k, v in self.columns.items()},
+                         len(positions))
+
+
+class LeanBatch:
+    """FeatureBatch-compatible chunked column store (module doc).
+
+    Supports the planner surface: ``len``, ``column``, ``columns``,
+    ``geom_xy``, ``geom_bbox`` (running envelope), ``take`` (→ real
+    FeatureBatch of the requested rows).  ``ids`` raises — any code
+    path touching the full id array would silently materialize
+    O(n) Python strings; the planner materializes ids per-result via
+    ``take`` instead."""
+
+    #: packed (non-point) geometry store — lean schemas are points-only
+    geoms = None
+
+    def __init__(self, sft: FeatureType):
+        self.sft = sft
+        self._chunks: dict[str, list] = {}
+        self._flat: dict[str, np.ndarray] = {}
+        self._n = 0
+        #: running dataset envelope (xmin, ymin, xmax, ymax)
+        self.envelope: tuple | None = None
+
+    def __len__(self) -> int:
+        return self._n
+
+    # -- ingest -----------------------------------------------------------
+    def append_batch(self, fb: FeatureBatch) -> None:
+        """Append one write's columns by reference (no copy)."""
+        if self._chunks and set(fb.columns) != set(self._chunks):
+            raise ValueError(
+                "lean writes must provide the same columns every time "
+                f"(have {sorted(self._chunks)}, got {sorted(fb.columns)})")
+        for k, v in fb.columns.items():
+            self._chunks.setdefault(k, []).append(np.asarray(v))
+            self._flat.pop(k, None)
+        self._n += len(fb)
+        gx, gy = fb.geom_xy(self.sft.geom_field)
+        if len(gx):
+            lo_x, lo_y = float(np.min(gx)), float(np.min(gy))
+            hi_x, hi_y = float(np.max(gx)), float(np.max(gy))
+            if self.envelope is None:
+                self.envelope = (lo_x, lo_y, hi_x, hi_y)
+            else:
+                e = self.envelope
+                self.envelope = (min(e[0], lo_x), min(e[1], lo_y),
+                                 max(e[2], hi_x), max(e[3], hi_y))
+
+    # -- column access ----------------------------------------------------
+    def column(self, name: str) -> np.ndarray:
+        """Finalized (flat) column; concatenates chunks once and keeps
+        the single flat array (chunk refs dropped → one host copy)."""
+        if name not in self._flat:
+            parts = self._chunks[name]
+            flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            self._flat[name] = flat
+            self._chunks[name] = [flat]
+        return self._flat[name]
+
+    @property
+    def columns(self) -> dict:
+        return {k: self.column(k) for k in self._chunks}
+
+    def geom_xy(self, name: str | None = None):
+        name = name or self.sft.default_geom
+        return self.column(f"{name}_x"), self.column(f"{name}_y")
+
+    def geom_bbox(self, name: str | None = None) -> np.ndarray:
+        """Per-feature bboxes — points only, so synthesized from x/y.
+        O(n·4) floats: callers at lean scale should prefer
+        ``envelope`` (the store's get_bounds does)."""
+        x, y = self.geom_xy(name)
+        return np.stack([x, y, x, y], axis=1)
+
+    @property
+    def ids(self):
+        raise AttributeError(
+            "LeanBatch has implicit ids (row r ⇔ str(r)); materializing "
+            "the full id array is O(n) strings — use take(rows) for "
+            "result ids, or row_ids(rows)")
+
+    @staticmethod
+    def row_ids(rows: np.ndarray) -> np.ndarray:
+        """Feature ids of the given rows (hits-sized)."""
+        return np.array([str(int(r)) for r in rows], dtype=object)
+
+    def take(self, positions: np.ndarray) -> FeatureBatch:
+        """Materialize a real FeatureBatch for the requested rows (the
+        only place full feature rows come into existence)."""
+        positions = np.asarray(positions, dtype=np.int64)
+        cols = {k: self.column(k)[positions] for k in self._chunks}
+        return FeatureBatch(self.sft, cols, self.row_ids(positions),
+                            None)
+
+    def slice_view(self, lo: int, hi: int) -> "ChunkView":
+        """Zero-copy row-range view (chunked stats recompute / export
+        iterate these; no ids materialized)."""
+        cols = {k: self.column(k)[lo:hi] for k in self._chunks}
+        return ChunkView(self.sft, cols, hi - lo)

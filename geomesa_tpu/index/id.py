@@ -9,7 +9,29 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["IdIndex"]
+__all__ = ["IdIndex", "LeanIdIndex"]
+
+
+class LeanIdIndex:
+    """Id lookups for the lean profile's IMPLICIT ids (row ``r`` ⇔
+    ``str(r)`` — features/lean.py): no index structure at all, an id
+    lookup is an integer parse + range check.  The O(1)-per-id analog
+    of IdIndexKeySpace's direct row seek."""
+
+    def __init__(self, n_rows: int):
+        self.n_rows = int(n_rows)
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    def query(self, ids) -> np.ndarray:
+        out = []
+        for fid in ids:
+            s = str(fid)
+            # canonical decimal form only: '007' is NOT row 7's id
+            if s.isdecimal() and str(int(s)) == s and int(s) < self.n_rows:
+                out.append(int(s))
+        return np.unique(np.asarray(sorted(out), dtype=np.int64))
 
 
 class IdIndex:

@@ -101,7 +101,9 @@ class QueryPlanner:
             stats = store.stats_map()
             n_plan = (stats["count"].count
                       if getattr(store, "multihost", False) else len(batch))
-            decider = StrategyDecider(self.sft, stats, n_plan)
+            decider = StrategyDecider(
+                self.sft, stats, n_plan,
+                allowed_indices=getattr(store, "query_indices", None))
             strategy = decider.decide(query.filter, explain,
                                       forced=query.hints.get("QUERY_INDEX"))
         plan_ms = plan_span.ms

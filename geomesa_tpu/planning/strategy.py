@@ -87,10 +87,15 @@ class StrategyDecider:
     """Enumerate viable strategies for a filter and pick the cheapest."""
 
     def __init__(self, sft: FeatureType, stats: dict | None = None,
-                 total_count: int = 0):
+                 total_count: int = 0,
+                 allowed_indices: set[str] | None = None):
+        """``allowed_indices`` further restricts the offered strategies
+        beyond the schema's ``geomesa.indices.enabled`` user data — the
+        store's lean profile serves only {z3, id} (plus full scans)."""
         self.sft = sft
         self.stats = stats or {}
         self.total = max(1, total_count)
+        self.allowed_indices = allowed_indices
 
     # -- cost estimates (StatsBasedEstimator spirit) ----------------------
     def _spatial_fraction(self, geometries) -> float:
@@ -138,6 +143,9 @@ class StrategyDecider:
         user data — the reference's per-schema index configuration,
         RichSimpleFeatureType.getIndices): a disabled index is never
         offered as a strategy."""
+        if (self.allowed_indices is not None
+                and index not in self.allowed_indices):
+            return False
         enabled = self.sft.enabled_indices
         return enabled is None or index in enabled
 
@@ -188,6 +196,17 @@ class StrategyDecider:
                 out.append(FilterStrategy(
                     idx, max(1.0, cost), geometries=tuple(geoms.values),
                     intervals=tuple(intervals.values) if intervals else ()))
+            elif (not temporal and dtg and sft.is_points
+                  and self._enabled("z3")):
+                # no z2 available (e.g. the lean profile serves only the
+                # z3 scale index): a pure-spatial query runs on z3 with
+                # an OPEN interval, which the point index clamps to the
+                # data's time extent — same trick that admits half-open
+                # intervals above
+                out.append(FilterStrategy(
+                    "z3", max(1.0, self.total * sp_frac),
+                    geometries=tuple(geoms.values),
+                    intervals=((None, None),)))
 
         indexed = ({a.name for a in sft.attributes if a.indexed}
                    if self._enabled("attr") else set())
