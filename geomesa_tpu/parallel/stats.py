@@ -251,6 +251,13 @@ def sharded_frequency_scan(idx, boxes, t_lo_ms, t_hi_ms, values,
     # integer columns travel as EXACT int64: the float64 weight path
     # would lose bits past 2^53 and diverge from the host sketch's hash
     col = np.asarray(values)
+    if col.dtype == object:
+        # string columns: seed-independent host digest of the UTF-8
+        # bytes, then the device's numeric seeded-splitmix path is
+        # bit-identical to the host sketch (VERDICT r4 #8; Frequency's
+        # primary use is strings, utils/stats/Frequency.scala)
+        from ..stats.stat import _string_digest
+        col = _string_digest(col).view(np.int64)
     table, bases = idx._weight_table(
         col, dtype=np.int64 if col.dtype.kind in "iu" else np.float64)
     vals = _gather_program(idx.mesh)(idx.gid, table, bases)

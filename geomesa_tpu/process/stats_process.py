@@ -84,11 +84,13 @@ def _collective_stats(store, schema: str, query, stat_spec: str):
         if isinstance(s, (MinMax, Histogram)):
             per_attr.setdefault(s.attr, []).append(s)
         elif isinstance(s, Frequency):
-            # device count-min sketch — numeric attrs only (string CMS
-            # hashes host-side); check BEFORE any collective runs so an
-            # ineligible spec never wastes completed device scans
+            # device count-min sketch — numerics travel exact, strings
+            # as a host-side UTF-8 digest (bit-identical either way);
+            # check BEFORE any collective runs so an ineligible spec
+            # never wastes completed device scans
             col = st.batch.columns.get(s.attr)
-            if col is None or col.dtype.kind not in "if":
+            if col is None or (col.dtype.kind not in "if"
+                               and col.dtype != object):
                 return None
             freqs.append(s)
         else:

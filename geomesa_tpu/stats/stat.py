@@ -239,12 +239,27 @@ class Z3HistogramStat(Stat):
                 "counts": [[k[0], k[1], v] for k, v in sorted(self.counts.items())]}
 
 
+def _string_digest(col: np.ndarray) -> np.ndarray:
+    """Seed-INDEPENDENT 64-bit digest of a string column's UTF-8 bytes
+    (two crc32 lanes).  Computed once per column; every per-depth sketch
+    hash then derives via the seeded splitmix finalize — which is what
+    lets the DEVICE count-min sketch serve string columns bit-identically
+    (round-4 VERDICT #8): the digest column ships to the device as plain
+    int64 and the device's numeric hash path takes over unchanged."""
+    return np.fromiter(
+        ((zlib.crc32(b) | (zlib.crc32(b, 0x9E3779B9) << 32))
+         for b in (str(v).encode() for v in col)),
+        dtype=np.uint64, count=len(col))
+
+
 def _hash_col(col: np.ndarray, seed: int) -> np.ndarray:
     """Stable vectorized 64-bit hash of a column (numeric or object)."""
     if col.dtype == object:
-        out = np.fromiter(
-            (zlib.crc32(str(v).encode(), seed) for v in col),
-            dtype=np.uint64, count=len(col))
+        # digest once, then the SAME seeded path as numerics — one
+        # Python-loop pass per column instead of one per sketch depth,
+        # and exactly what the device sketch computes from the digest
+        out = _string_digest(col)
+        out ^= np.uint64(seed * 0x9E3779B97F4A7C15 & 0xFFFFFFFFFFFFFFFF)
     else:
         arr = col
         if np.issubdtype(arr.dtype, np.floating):
