@@ -185,29 +185,31 @@ def _lean_scan_exact_coded(rb, rlo, rhi, rqid, boxes, bqid, qtlo, qthi,
 #: generation-count compile bucket for the multi-generation programs
 _GEN_BUCKET = 4
 
-#: slot count of the shared empty sentinel generations that pad a
-#: bucket: zero matches by construction (all-sentinel keys), so padding
-#: does no seek/expand work (round-3 VERDICT weak #5)
-_SENTINEL_SLOTS = 8
-
 _sentinel_cache: dict = {}
 
 
-def _sentinel_cols(tier: str):
-    """Shared empty generation columns for bucket padding (device
-    arrays, built once per process)."""
-    if tier not in _sentinel_cache:
-        bins = jnp.full((_SENTINEL_SLOTS,), _SENTINEL_BIN, jnp.int32)
-        z = jnp.full((_SENTINEL_SLOTS,), _SENTINEL_Z, jnp.int64)
-        pos = jnp.full((_SENTINEL_SLOTS,), -1, jnp.int32)
+def _sentinel_cols(tier: str, slots: int):
+    """Shared empty generation columns for bucket padding: FULL-SIZE
+    (same slot count as the real generations, all-sentinel keys), so
+    every padded program has the uniform shape ``(slots,) × G_pad`` and
+    compiles once per BUCKET, not once per real generation count — at
+    60 sorted runs over a remote-compile tunnel the difference is
+    minutes of compile per checkpoint.  All-sentinel keys match zero
+    seeks, so padding still does no real expand work (round-3 VERDICT
+    weak #5); the one shared buffer is passed for every padded slot."""
+    key = (tier, slots)
+    if key not in _sentinel_cache:
+        bins = jnp.full((slots,), _SENTINEL_BIN, jnp.int32)
+        z = jnp.full((slots,), _SENTINEL_Z, jnp.int64)
+        pos = jnp.full((slots,), -1, jnp.int32)
         if tier == "full":
-            zero = jnp.zeros((_SENTINEL_SLOTS,), jnp.float64)
-            t0 = jnp.zeros((_SENTINEL_SLOTS,), jnp.int64)
-            _sentinel_cache[tier] = (bins, z, pos, zero, zero, t0,
-                                     jnp.int32(0))
+            zero = jnp.zeros((slots,), jnp.float64)
+            t0 = jnp.zeros((slots,), jnp.int64)
+            _sentinel_cache[key] = (bins, z, pos, zero, zero, t0,
+                                    jnp.int32(0))
         else:
-            _sentinel_cache[tier] = (bins, z, pos)
-    return _sentinel_cache[tier]
+            _sentinel_cache[key] = (bins, z, pos)
+    return _sentinel_cache[key]
 
 
 class _Generation:
@@ -370,29 +372,41 @@ class LeanZ3Index:
         self._rebalance()
         return self.generations[-1]
 
+    def _budget_after_sentinels(self) -> int:
+        """Effective budget: hbm_budget_bytes minus the shared full-size
+        sentinel padding buffers queries will lazily allocate — a keys
+        sentinel always, a full one only while full-tier generations
+        exist (recomputed as tiers demote)."""
+        per = self.generation_slots * 16
+        if any(g.tier == "full" for g in self.generations):
+            per += self.generation_slots * 40
+        return self.hbm_budget_bytes - per
+
     def _rebalance(self) -> None:
-        """Demote oldest-first until the device residency fits the HBM
-        budget: payload drops first (full → keys), then key runs spill
-        to host RAM (keys → host).  The ACTIVE generation's keys never
-        spill — appends sort there."""
-        if self.device_bytes() <= self.hbm_budget_bytes:
+        """Demote oldest-first until the device residency (key/payload
+        columns PLUS the shared sentinel padding buffers queries will
+        allocate) fits the HBM budget: payload drops first (full →
+        keys), then key runs spill to host RAM (keys → host).  The
+        ACTIVE generation's keys never spill — appends sort there."""
+        if self.device_bytes() <= self._budget_after_sentinels():
             return
         for gen in self.generations:
             if gen.tier == "full":
                 # the active generation's payload may drop too: its
                 # appends continue through the keys-tier program
                 gen.drop_payload()
-                if self.device_bytes() <= self.hbm_budget_bytes:
+                if self.device_bytes() <= self._budget_after_sentinels():
                     return
         for gen in self.generations[:-1]:
             if gen.tier == "keys":
                 gen.spill_to_host()
-                if self.device_bytes() <= self.hbm_budget_bytes:
+                if self.device_bytes() <= self._budget_after_sentinels():
                     return
-        if self.device_bytes() > self.hbm_budget_bytes:
+        if self.device_bytes() > self._budget_after_sentinels():
             raise MemoryError(
                 f"active generation ({self.generation_slots} slots) "
-                f"exceeds hbm_budget_bytes={self.hbm_budget_bytes}")
+                f"exceeds hbm_budget_bytes={self.hbm_budget_bytes} "
+                "minus the sentinel-padding overhead")
 
     def append(self, x, y, dtg_ms) -> "LeanZ3Index":
         """Stream one slice in: host payload retained by reference, keys
@@ -539,8 +553,8 @@ class LeanZ3Index:
             padded = self._pad_bucket(dev_gens)
             count_cols: list = []
             for gen in padded:
-                cols = (_sentinel_cols("keys") if gen is None
-                        else (gen.bins, gen.z))
+                cols = (_sentinel_cols("keys", self.generation_slots)
+                        if gen is None else (gen.bins, gen.z))
                 count_cols += [cols[0], cols[1]]
             if progress is not None:
                 progress(f"    probing {len(dev_gens)} generations")
@@ -658,7 +672,8 @@ class LeanZ3Index:
             cols: list = []
             for gen in group:
                 if gen is None:
-                    cols += list(_sentinel_cols(tier))
+                    cols += list(_sentinel_cols(tier,
+                                                self.generation_slots))
                 elif tier == "full":
                     cols += [gen.bins, gen.z, gen.pos, gen.x, gen.y,
                              gen.t, jnp.int32(gen.base)]

@@ -181,8 +181,14 @@ def run(n: int = 100_000_000, slice_rows: int = 8_388_608,
                 with open(record_path + ".tmp", "w") as f:
                     json.dump(out, f, indent=1)
                 os.replace(record_path + ".tmp", record_path)
-    # kNN process against the full store (round-4 VERDICT #5)
+    # kNN process against the full store (round-4 VERDICT #5).  Cold
+    # includes the first-time compiles of the generation-count-shaped
+    # scan programs (cached on disk afterwards); warm is the steady
+    # state an interactive workload sees.
     from geomesa_tpu.process import knn_process
+    t0 = time.perf_counter()
+    kpos, kdist = knn_process(ds, "gdelt", -74.0, 40.7, 25)
+    knn_cold_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     kpos, kdist = knn_process(ds, "gdelt", -74.0, 40.7, 25)
     knn_s = time.perf_counter() - t0
@@ -190,10 +196,12 @@ def run(n: int = 100_000_000, slice_rows: int = 8_388_608,
     x, yv = st.batch.geom_xy()
     want = np.sort(haversine_m(-74.0, 40.7, x, yv))[:25]
     assert np.allclose(np.sort(kdist), want, rtol=1e-12)
-    out["knn25_ms"] = round(knn_s * 1e3, 1)
+    out["knn25_cold_ms"] = round(knn_cold_s * 1e3, 1)
+    out["knn25_warm_ms"] = round(knn_s * 1e3, 1)
     out["knn_oracle_exact"] = True
     progress(f"  store-scale: kNN k=25 over {len(st.batch) / 1e6:.0f}M "
-             f"rows {knn_s * 1e3:.0f}ms, exact vs brute force")
+             f"rows cold {knn_cold_s * 1e3:.0f}ms / warm "
+             f"{knn_s * 1e3:.0f}ms, exact vs brute force")
     if record and _improves(record_path, out["rows"]):
         with open(record_path + ".tmp", "w") as f:
             json.dump(out, f, indent=1)
