@@ -180,13 +180,14 @@ class _SchemaStore:
                 "geomesa.index.profile=lean requires a point geometry "
                 "and a dtg attribute (the lean Z3 index is the only "
                 "scale index)")
-        if self.mesh is not None:
-            raise ValueError(
-                "the lean profile is single-controller for now — "
-                "drop mesh= or use the full-fat sharded indexes")
         from .features.lean import LeanBatch
+        prefix = ""
+        if self.multihost:
+            import jax
+            if jax.process_count() > 1:
+                prefix = f"p{jax.process_index()}."
         self.lean = True
-        self.batch = LeanBatch(sft)
+        self.batch = LeanBatch(sft, id_prefix=prefix)
         self._dirty = False
 
     def _lean_payload(self):
@@ -202,16 +203,31 @@ class _SchemaStore:
         only after a layout migration or reload."""
         idx = self._indexes.get("z3")
         if idx is None:
-            from .index.z3_lean import LeanZ3Index
-            idx = LeanZ3Index(period=self.sft.z3_interval,
-                              version=self.index_versions["z3"])
+            if self.mesh is not None:
+                from .parallel.lean import ShardedLeanZ3Index
+                idx = ShardedLeanZ3Index(
+                    period=self.sft.z3_interval, mesh=self.mesh,
+                    version=self.index_versions["z3"],
+                    multihost=self.multihost)
+            else:
+                from .index.z3_lean import LeanZ3Index
+                idx = LeanZ3Index(period=self.sft.z3_interval,
+                                  version=self.index_versions["z3"])
             idx.payload_provider = self._lean_payload
             n = len(self.batch)
-            if n:
+            # multihost: stream in an AGREED number of equal steps —
+            # per-process row counts differ and each append is a
+            # collective (trailing steps feed empty slices)
+            step = 1 << 22
+            n_steps = -(-n // step)
+            if self.multihost:
+                from .parallel.multihost import agreed_int
+                n_steps = agreed_int(n_steps, "max")
+            if n_steps:
                 x, y = self.batch.geom_xy()
                 t = self.batch.column(self.sft.dtg_field)
-                step = 1 << 22
-                for lo in range(0, n, step):
+                for i in range(n_steps):
+                    lo = i * step
                     idx.append(x[lo:lo + step], y[lo:lo + step],
                                t[lo:lo + step])
             self._indexes["z3"] = idx
@@ -610,7 +626,8 @@ class _SchemaStore:
                 return self._lean_index()
             if name == "id":
                 from .index.id import LeanIdIndex
-                return LeanIdIndex(len(self.batch))
+                return LeanIdIndex(len(self.batch),
+                                   prefix=self.batch.id_prefix)
             raise ValueError(
                 f"index {name!r} is not available on lean-profile "
                 f"schema {self.sft.name!r} (z3/id only)")
@@ -1119,22 +1136,38 @@ class TpuDataStore:
         if store.lean:
             # tombstone, don't remove: positions stay stable (the live
             # index and payload never shuffle) and implicit ids are
-            # never reused — the modifying-writer delete as a mask
+            # never reused — the modifying-writer delete as a mask.
+            # Multihost: each process resolves ITS prefixed ids; the
+            # count and the mutation decision are agreed.
             from .index.id import LeanIdIndex
-            rows = LeanIdIndex(len(store.batch)).query(
+            rows = LeanIdIndex(len(store.batch),
+                               prefix=store.batch.id_prefix).query(
                 np.atleast_1d(np.asarray(ids, dtype=object)))
-            if not len(rows):
-                return 0
-            if store.tombstone is None:
-                store.tombstone = np.zeros(len(store.batch), dtype=bool)
-            newly = rows[~store.tombstone[rows]]
-            if not len(newly):
-                return 0
-            store.tombstone[rows] = True
-            store._mutation_version += 1
-            store._vis_masks = {}
-            store._lean_recompute_stats()
-            return int(len(newly))
+            newly = rows
+            if len(rows):
+                if store.tombstone is None:
+                    store.tombstone = np.zeros(len(store.batch),
+                                               dtype=bool)
+                newly = rows[~store.tombstone[rows]]
+                store.tombstone[rows] = True
+            n_new = int(len(newly))
+            if store.multihost:
+                from .parallel.multihost import agreed_int
+                n_global = agreed_int(n_new, "sum")
+            else:
+                n_global = n_new
+            if n_global:
+                if store.multihost and store.tombstone is None:
+                    # SPMD symmetry: the tombstone must exist on EVERY
+                    # process once any process has one, or downstream
+                    # mask-presence branches (get_count, query allowed)
+                    # diverge into mismatched collectives
+                    store.tombstone = np.zeros(len(store.batch),
+                                               dtype=bool)
+                store._mutation_version += 1
+                store._vis_masks = {}
+                store._lean_recompute_stats()
+            return n_global
         n_here = 0 if store.batch is None else len(store.batch)
         if n_here == 0 and not store.multihost:
             return 0
@@ -1346,7 +1379,16 @@ class TpuDataStore:
                 [(boxes, lo, hi) for boxes, lo, hi in windows])
             allowed = self._effective_mask(store)
             if allowed is not None:
-                hits = [h[allowed[h]] for h in hits]
+                if store.multihost:
+                    # gids → local rows → mask → allgather back (the
+                    # full-fat fast path's discipline)
+                    from .parallel.multihost import allgather_concat
+                    hits = [np.sort(allgather_concat(store.gids_of(
+                                r[allowed[r]])))
+                            for r in (store.local_rows_of(h)
+                                      for h in hits)]
+                else:
+                    hits = [h[allowed[h]] for h in hits]
             from .metrics import registry as _metrics
             _metrics.counter(f"query.{name}.windows").inc(len(windows))
             if self._audit_writer is not None:
@@ -1489,14 +1531,24 @@ class TpuDataStore:
             mask = self._effective_mask(store)
             if mask is None:
                 env = store.batch.envelope
-                return None if env is None else Envelope(*env)
-            if not mask.any():
+                pairs = (np.array([env]) if env is not None
+                         else np.empty((0, 4)))
+            else:
+                # masked extent straight from the x/y columns — never
+                # the O(n·4) per-feature bbox materialization
+                x, y = store.batch.geom_xy()
+                pairs = (np.array([[x[mask].min(), y[mask].min(),
+                                    x[mask].max(), y[mask].max()]])
+                         if mask.any() else np.empty((0, 4)))
+            if store.multihost:
+                from .parallel.multihost import allgather_concat
+                pairs = allgather_concat(np.asarray(pairs, np.float64))
+            if not len(pairs):
                 return None
-            # masked extent straight from the x/y columns — never the
-            # O(n·4) per-feature bbox materialization
-            x, y = store.batch.geom_xy()
-            return Envelope(float(x[mask].min()), float(y[mask].min()),
-                            float(x[mask].max()), float(y[mask].max()))
+            return Envelope(float(pairs[:, 0].min()),
+                            float(pairs[:, 1].min()),
+                            float(pairs[:, 2].max()),
+                            float(pairs[:, 3].max()))
         # the restricted-mask decision is collective under multihost —
         # it must run on EVERY process, zero-local-row ones included
         mask = self._effective_mask(store)
@@ -1593,8 +1645,10 @@ class TpuDataStore:
         # rebuild the same stat type over the visible rows only;
         # multihost merges the per-process re-observations globally
         if store.lean:
-            # chunked: never materialize the full visible row set
-            return store._lean_observe_masked(s, mask)
+            # chunked: never materialize the full visible row set;
+            # multihost re-merges per-process re-observations
+            return store.merge_stat_global(
+                store._lean_observe_masked(s, mask))
         fresh = s.fresh_copy()
         fresh.observe(store.batch.take(np.flatnonzero(mask)))
         return store.merge_stat_global(fresh)

@@ -78,11 +78,14 @@ class LeanBatch:
     #: packed (non-point) geometry store — lean schemas are points-only
     geoms = None
 
-    def __init__(self, sft: FeatureType):
+    def __init__(self, sft: FeatureType, id_prefix: str = ""):
         self.sft = sft
         self._chunks: dict[str, list] = {}
         self._flat: dict[str, np.ndarray] = {}
         self._n = 0
+        #: implicit-id prefix — multihost stores prefix per process
+        #: (``p{proc}.``) so local row ids stay globally unique
+        self.id_prefix = id_prefix
         #: running dataset envelope (xmin, ymin, xmax, ymax)
         self.envelope: tuple | None = None
 
@@ -144,10 +147,10 @@ class LeanBatch:
             "the full id array is O(n) strings — use take(rows) for "
             "result ids, or row_ids(rows)")
 
-    @staticmethod
-    def row_ids(rows: np.ndarray) -> np.ndarray:
+    def row_ids(self, rows: np.ndarray) -> np.ndarray:
         """Feature ids of the given rows (hits-sized)."""
-        return np.array([str(int(r)) for r in rows], dtype=object)
+        p = self.id_prefix
+        return np.array([f"{p}{int(r)}" for r in rows], dtype=object)
 
     def take(self, positions: np.ndarray) -> FeatureBatch:
         """Materialize a real FeatureBatch for the requested rows (the

@@ -14,12 +14,14 @@ __all__ = ["IdIndex", "LeanIdIndex"]
 
 class LeanIdIndex:
     """Id lookups for the lean profile's IMPLICIT ids (row ``r`` ⇔
-    ``str(r)`` — features/lean.py): no index structure at all, an id
-    lookup is an integer parse + range check.  The O(1)-per-id analog
-    of IdIndexKeySpace's direct row seek."""
+    ``f"{prefix}{r}"`` — features/lean.py; multihost stores prefix per
+    process): no index structure at all, an id lookup is a prefix strip
+    + integer parse + range check.  The O(1)-per-id analog of
+    IdIndexKeySpace's direct row seek."""
 
-    def __init__(self, n_rows: int):
+    def __init__(self, n_rows: int, prefix: str = ""):
         self.n_rows = int(n_rows)
+        self.prefix = prefix
 
     def __len__(self) -> int:
         return self.n_rows
@@ -28,6 +30,10 @@ class LeanIdIndex:
         out = []
         for fid in ids:
             s = str(fid)
+            if self.prefix:
+                if not s.startswith(self.prefix):
+                    continue
+                s = s[len(self.prefix):]
             # canonical decimal form only: '007' is NOT row 7's id
             if s.isdecimal() and str(int(s)) == s and int(s) < self.n_rows:
                 out.append(int(s))

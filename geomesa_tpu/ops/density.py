@@ -88,6 +88,8 @@ def density_grid_auto(x, y, weights, mask, env, width: int, height: int):
         if GATES["density"].choose():
             return density_grid_pallas(x, y, weights, mask, env,
                                        width, height)
-        return density_grid_sorted(x, y, weights, mask, env,
-                                   width, height)
+        # disabled route = the XLA scatter path the tuning measurement
+        # actually compared against (pairing the decision with an
+        # unmeasured variant would let the measurement govern blind)
+        return density_grid(x, y, weights, mask, env, width, height)
     return density_grid(x, y, weights, mask, env, width, height)

@@ -65,6 +65,10 @@ class PallasGate:
         #: True when the measurement says XLA is faster — the gate then
         #: routes every call to the XLA path
         self.disabled = False
+        #: multihost probe outcome (None = not yet probed); recorded so
+        #: a failing probe runs once per process, not once per call —
+        #: kept separate from ``ok`` (probe failure ≠ tuning-disabled)
+        self.probe_failed: bool | None = None
 
     def choose(self, enabled: bool = True) -> bool:
         """LOCAL routing decision for call sites that cannot materialize
@@ -102,16 +106,21 @@ class PallasGate:
         # loads from a per-host tuning file, so gating entry on it would
         # strand peers in this very allgather (the entry condition must
         # stay process-invariant)
-        ok = self.ok is not False and not self.disabled
-        if ok and probe is not None and self.ok is None:
+        ok = (self.ok is not False and not self.disabled
+              and self.probe_failed is not True)
+        if (ok and probe is not None and self.ok is None
+                and self.probe_failed is None):
             try:
                 probe()
+                self.probe_failed = False
             except Exception:
+                self.probe_failed = True
                 ok = False
         # the vote is NOT recorded on self.ok: entry into this agreement
         # is process-invariant (enabled and on_tpu()), so every process
         # re-agrees each call — and a tuning-disabled gate must stay
-        # distinguishable from a failed kernel (ok records failures only)
+        # distinguishable from a failed kernel (ok records failures
+        # only; probe outcomes cache locally on probe_failed)
         return bool(agreed_int(int(ok), "min"))
 
     def run(self, pallas_thunk, xla_thunk, enabled: bool = True,

@@ -1,0 +1,452 @@
+"""ShardedLeanZ3Index: the lean generational index over a device mesh.
+
+Round-4 VERDICT #4: the cluster IS the reference's scale story
+(AccumuloQueryPlan.scala:87-157 — scan plans fan out over tablet
+servers), so the keys-on-device generational index must shard too.
+Layout: every generation's key columns are STACKED per shard —
+``(n_shards, slots)`` arrays with ``P("shard", None)`` sharding — and
+the probe/scan programs run under ``shard_map``: each device seeks its
+own sorted runs, all generations in one dispatch, with per-shard
+fixed-capacity coded outputs.
+
+Positions are GLOBAL gids (``process << GID_PROC_SHIFT | local_row``
+under multihost, plain row ids single-controller), minted host-side at
+append time and carried as an int64 sort payload.  The exact bbox+time
+re-check runs on each process's host payload (the client-side filter of
+the keys-only tier); survivors allgather so every process returns the
+same global hit list — the same SPMD discipline as ShardedZ3Index.
+
+Per-shard generations keep the append sort's working set at ONE
+``(slots,)`` run per device — the per-chip scale ceiling becomes
+HBM/20 B ≈ 670M rows/chip of keys instead of the full-fat 40 B/pt
+~150M (round-4 VERDICT #4's ">150M/chip-equivalent"); host spill (the
+single-chip 1B path) composes per process and is left to the
+single-controller tiers for now.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..curve.binnedtime import TimePeriod, to_binned_time
+from ..index.z3 import Z3_INDEX_VERSION, plan_z3_query, z3_sfc_for_version
+from ..ops.search import (
+    expand_ranges, gather_capacity, pad_pow2, pad_ranges, searchsorted2,
+)
+from .scan import _fetch_global, encode_gids
+
+__all__ = ["ShardedLeanZ3Index"]
+
+_SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)
+_SENTINEL_Z = np.int64(np.iinfo(np.int64).max)
+
+#: generation-count compile bucket (one compile per bucket: sentinel
+#: padding is full-size, as in index/z3_lean)
+_GEN_BUCKET = 4
+
+
+@lru_cache(maxsize=8)
+def _append_program(mesh: Mesh, sfc):
+    """Per-shard generation append under shard_map: encode the shard's
+    slice, write into its sentinel padding at slot offset ``r`` and
+    re-sort — the z3_lean append body, one run per device."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard", None),) * 3 + (P(),)
+             + (P("shard", None),) * 6,
+             out_specs=(P("shard", None),) * 3)
+    def app(bins, z, pos, r, xs, ys, offs, bs, ps, m):
+        b0, z0, p0 = bins[0], z[0], pos[0]
+        m_pad = xs.shape[1]
+        z_new = sfc.index(xs[0], ys[0], offs[0])
+        valid = jnp.arange(m_pad) < m[0, 0]
+        b_new = jnp.where(valid, bs[0], _SENTINEL_BIN)
+        z_new = jnp.where(valid, z_new, _SENTINEL_Z)
+        p_new = jnp.where(valid, ps[0], jnp.int64(-1))
+        b0 = jax.lax.dynamic_update_slice(b0, b_new, (r,))
+        z0 = jax.lax.dynamic_update_slice(z0, z_new, (r,))
+        p0 = jax.lax.dynamic_update_slice(p0, p_new, (r,))
+        b0, z0, p0 = jax.lax.sort((b0, z0, p0), dimension=0, num_keys=2)
+        return b0[None], z0[None], p0[None]
+
+    return jax.jit(app, donate_argnums=(0, 1, 2))
+
+
+@lru_cache(maxsize=8)
+def _count_program(mesh: Mesh, n_gens: int):
+    """Totals probe: per (shard, generation) candidate counts in ONE
+    dispatch — out ``(n_shards, n_gens)``."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None),) * 3 + (P("shard", None),) * (2 * n_gens),
+             out_specs=P("shard", None))
+    def count(rb, rlo, rhi, *cols):
+        outs = []
+        for g in range(n_gens):
+            b, z = cols[2 * g][0], cols[2 * g + 1][0]
+            starts = searchsorted2(b, z, rb, rlo, side="left")
+            ends = searchsorted2(b, z, rb, rhi, side="right")
+            outs.append(jnp.sum(jnp.maximum(ends - starts, 0)))
+        return jnp.stack(outs)[None]
+
+    return jax.jit(count)
+
+
+@lru_cache(maxsize=8)
+def _scan_program(mesh: Mesh, n_gens: int, capacity: int, pos_bits: int):
+    """Candidate gather: per-shard coded ``qid << pos_bits | gid``
+    buffers over every generation — out ``(n_shards, capacity)``
+    int64 (gids span the multihost process field)."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None),) * 4 + (P("shard", None),) * (3 * n_gens),
+             out_specs=P("shard", None))
+    def scan(rb, rlo, rhi, rqid, *cols):
+        per_gen = capacity // max(1, n_gens)
+        outs = []
+        for g in range(n_gens):
+            b, z, pos = (cols[3 * g][0], cols[3 * g + 1][0],
+                         cols[3 * g + 2][0])
+            starts = searchsorted2(b, z, rb, rlo, side="left")
+            ends = searchsorted2(b, z, rb, rhi, side="right")
+            counts = jnp.maximum(ends - starts, 0)
+            idx, valid, rid = expand_ranges(starts, counts, per_gen)
+            coded = ((rqid[rid].astype(jnp.int64) << pos_bits)
+                     | pos[idx])
+            outs.append(jnp.where(valid, coded, jnp.int64(-1)))
+        return jnp.concatenate(outs)[None]
+
+    return jax.jit(scan)
+
+
+class _ShardedGen:
+    """One generation: stacked per-shard sorted key runs."""
+
+    __slots__ = ("bins", "z", "pos", "n_slots")
+
+    def __init__(self, mesh: Mesh, slots: int):
+        shards = int(mesh.devices.size)
+        sh = NamedSharding(mesh, P("shard", None))
+        self.bins = jax.device_put(
+            np.full((shards, slots), _SENTINEL_BIN, np.int32), sh)
+        self.z = jax.device_put(
+            np.full((shards, slots), _SENTINEL_Z, np.int64), sh)
+        self.pos = jax.device_put(
+            np.full((shards, slots), -1, np.int64), sh)
+        #: slot offset consumed so far (identical on every shard — each
+        #: append writes the same agreed m_pad per shard)
+        self.n_slots = 0
+
+    @property
+    def slots(self) -> int:
+        return int(self.z.shape[1])
+
+    def device_bytes(self) -> int:
+        return int(self.z.shape[0]) * self.slots * (4 + 8 + 8)
+
+
+@lru_cache(maxsize=8)
+def _sentinel_gen(mesh: Mesh, slots: int):
+    """Shared empty full-size generation for bucket padding (uniform
+    program shapes → one compile per bucket; zero seeks match)."""
+    return _ShardedGen(mesh, slots)
+
+
+class ShardedLeanZ3Index:
+    """Lean generational Z3 index over a mesh (module doc)."""
+
+    #: slots per generation PER SHARD
+    GENERATION_SLOTS = 1 << 22
+    DEFAULT_CAPACITY = 1 << 15
+    #: per-shard slot budget for one batched scan output
+    BATCH_SCAN_BUDGET = 1 << 26
+
+    def __init__(self, period: TimePeriod | str = TimePeriod.WEEK,
+                 mesh: Mesh | None = None,
+                 version: int = Z3_INDEX_VERSION,
+                 generation_slots: int | None = None,
+                 multihost: bool = False):
+        assert mesh is not None
+        self.period = TimePeriod.parse(period)
+        self.version = version
+        self.sfc = z3_sfc_for_version(self.period, version)
+        self.mesh = mesh
+        self.generation_slots = generation_slots or self.GENERATION_SLOTS
+        self._multihost = bool(multihost)
+        self.generations: list[_ShardedGen] = []
+        #: host payload provider: () -> (x, y, t) of THIS process's
+        #: local rows (the store's columns)
+        self.payload_provider = None
+        self._payload: list = []
+        self._flat = None
+        self._n_local = 0      # this process's rows
+        self._n_total = 0      # agreed global rows
+        self.t_min_ms: int | None = None
+        self.t_max_ms: int | None = None
+        self.dispatch_count = 0
+
+    def __len__(self) -> int:
+        return self._n_total
+
+    def total(self) -> int:
+        return self._n_total
+
+    def device_bytes(self) -> int:
+        return sum(g.device_bytes() for g in self.generations)
+
+    def block(self) -> None:
+        if self.generations:
+            jax.block_until_ready(self.generations[-1].pos)
+
+    # -- write path -------------------------------------------------------
+    def _agreed(self, value: int, op: str) -> int:
+        if not self._multihost:
+            return int(value)
+        from .multihost import agreed_int
+        return agreed_int(int(value), op)
+
+    def append(self, x, y, dtg_ms) -> "ShardedLeanZ3Index":
+        """Distribute this process's rows across its local shards and
+        merge into the current generation (rolling when full).  Under
+        multihost every process enters with its LOCAL rows; the slot
+        layout (m_pad) is agreed so the generation stays rectangular."""
+        x = np.ascontiguousarray(x, dtype=np.float64)
+        y = np.ascontiguousarray(y, dtype=np.float64)
+        dtg_ms = np.ascontiguousarray(dtg_ms, dtype=np.int64)
+        m_local = len(x)
+        # ONE agreement for the whole append (each _agreed call is a
+        # fleet-wide host allgather under multihost)
+        m_max = self._agreed(m_local, "max")
+        if m_max == 0:
+            return self
+        if self.payload_provider is None:
+            self._payload.append((x, y, dtg_ms))
+            self._flat = None
+        n_shards = int(self.mesh.devices.size)
+        from .multihost import local_device_count
+        local_shards = (local_device_count(self.mesh)
+                        if self._multihost else n_shards)
+        # rows → this process's local shards, block-split; m_pad agreed
+        # via m_max and clamped to the generation size (oversized
+        # appends loop — the single-chip append's take=min(room,…))
+        per = -(-max(1, m_max) // local_shards)
+        m_pad = min(gather_capacity(per, minimum=8),
+                    self.generation_slots)
+        done = 0
+        while done < m_max:
+            gen = self.generations[-1] if self.generations else None
+            if gen is None or gen.n_slots + m_pad > gen.slots:
+                gen = _ShardedGen(self.mesh, self.generation_slots)
+                self.generations.append(gen)
+            take_all = min(m_pad * local_shards, max(0, m_local - done))
+            xs = np.zeros((local_shards, m_pad))
+            ys = np.zeros((local_shards, m_pad))
+            offs = np.zeros((local_shards, m_pad))
+            bs = np.zeros((local_shards, m_pad), np.int32)
+            ps = np.full((local_shards, m_pad), -1, np.int64)
+            ms = np.zeros((local_shards, 1), np.int32)
+            if take_all > 0:
+                sl = slice(done, done + take_all)
+                hb, ho = to_binned_time(dtg_ms[sl], self.period)
+                rows = np.arange(done, done + take_all, dtype=np.int64)
+                gids = (encode_gids(self._n_local + rows)
+                        if self._multihost else self._n_local + rows)
+                for s in range(local_shards):
+                    lo, hi = s * m_pad, min(take_all, (s + 1) * m_pad)
+                    if hi <= lo:
+                        break
+                    k = hi - lo
+                    xs[s, :k] = x[sl][lo:hi]
+                    ys[s, :k] = y[sl][lo:hi]
+                    offs[s, :k] = ho[lo:hi].astype(np.float64)
+                    bs[s, :k] = hb[lo:hi].astype(np.int32)
+                    ps[s, :k] = gids[lo:hi]
+                    ms[s, 0] = k
+            arrs = self._shard_put([xs, ys, offs, bs, ps, ms])
+            prog = _append_program(self.mesh, self.sfc)
+            self.dispatch_count += 1
+            gen.bins, gen.z, gen.pos = prog(
+                gen.bins, gen.z, gen.pos, jnp.int32(gen.n_slots), *arrs)
+            gen.n_slots += m_pad
+            done += m_pad * local_shards
+        self._n_local += m_local
+        self._n_total += self._agreed(m_local, "sum")
+        t_min = int(dtg_ms.min()) if m_local else np.iinfo(np.int64).max
+        t_max = int(dtg_ms.max()) if m_local else np.iinfo(np.int64).min
+        t_min = self._agreed(t_min, "min")
+        t_max = self._agreed(t_max, "max")
+        self.t_min_ms = (t_min if self.t_min_ms is None
+                         else min(self.t_min_ms, t_min))
+        self.t_max_ms = (t_max if self.t_max_ms is None
+                         else max(self.t_max_ms, t_max))
+        return self
+
+    def _shard_put(self, arrs: list):
+        """Host (local_shards, …) arrays → global sharded arrays."""
+        sh = NamedSharding(self.mesh, P("shard", None))
+        if not self._multihost:
+            return [jax.device_put(a, sh) for a in arrs]
+        return [jax.make_array_from_process_local_data(sh, a)
+                for a in arrs]
+
+    # -- payload ----------------------------------------------------------
+    def _payload_flat(self):
+        if self.payload_provider is not None:
+            return self.payload_provider()
+        if self._flat is None:
+            xs, ys, ts = (zip(*self._payload) if self._payload
+                          else ((), (), ()))
+            self._flat = (
+                np.concatenate(xs) if xs else np.empty(0),
+                np.concatenate(ys) if ys else np.empty(0),
+                np.concatenate(ts) if ts else np.empty(0, np.int64))
+            self._payload = [tuple(self._flat)]
+        return self._flat
+
+    def _clamp_time(self, t_lo_ms, t_hi_ms):
+        t_lo_ms = self.t_min_ms if t_lo_ms is None else int(t_lo_ms)
+        t_hi_ms = self.t_max_ms if t_hi_ms is None else int(t_hi_ms)
+        if self.t_min_ms is not None:
+            t_lo_ms = max(t_lo_ms, self.t_min_ms)
+        if self.t_max_ms is not None:
+            t_hi_ms = min(t_hi_ms, self.t_max_ms)
+        return t_lo_ms, t_hi_ms
+
+    # -- query path -------------------------------------------------------
+    def query(self, boxes, t_lo_ms, t_hi_ms,
+              max_ranges: int = 2000) -> np.ndarray:
+        return self.query_many([(boxes, t_lo_ms, t_hi_ms)],
+                               max_ranges=max_ranges)[0]
+
+    def query_many(self, windows,
+                   max_ranges: int = 2000) -> list[np.ndarray]:
+        """Batched multi-window scan over every shard × generation:
+        probe + scan dispatches, host exact mask on each process's
+        payload, survivors allgathered — every process returns the same
+        sorted GLOBAL gid list per window."""
+        n_q = len(windows)
+        if n_q == 0 or self._n_total == 0:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        rbin, rzlo, rzhi, rqid = [], [], [], []
+        w_boxes: list = []
+        qtlo = np.empty(n_q, dtype=np.int64)
+        qthi = np.empty(n_q, dtype=np.int64)
+        for q, (bxs, lo, hi) in enumerate(windows):
+            lo, hi = self._clamp_time(lo, hi)
+            qtlo[q], qthi[q] = lo, hi
+            bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
+            w_boxes.append(bxs)
+            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges,
+                                 sfc=self.sfc)
+            if plan.num_ranges == 0:
+                continue
+            rbin.append(plan.rbin)
+            rzlo.append(plan.rzlo)
+            rzhi.append(plan.rzhi)
+            rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+        if not rbin:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        ra = pad_ranges(
+            {"rbin": np.concatenate(rbin), "rzlo": np.concatenate(rzlo),
+             "rzhi": np.concatenate(rzhi), "rqid": np.concatenate(rqid)},
+            pad_pow2(sum(len(r) for r in rbin)))
+        rb = jnp.asarray(ra["rbin"])
+        rlo = jnp.asarray(ra["rzlo"])
+        rhi = jnp.asarray(ra["rzhi"])
+        rq = jnp.asarray(ra["rqid"])
+        from .scan import multihost_gid_span
+        span = (multihost_gid_span() if self._multihost
+                else max(2, self._n_total))
+        pos_bits = max(1, int(np.ceil(np.log2(span))))
+
+        gens = list(self.generations)
+        n_pad = (-len(gens)) % _GEN_BUCKET
+        padded = gens + [_sentinel_gen(self.mesh,
+                                       self.generation_slots)] * n_pad
+        count_cols: list = []
+        for gen in padded:
+            count_cols += [gen.bins, gen.z]
+        self.dispatch_count += 1
+        totals = _fetch_global(_count_program(self.mesh, len(padded))(
+            rb, rlo, rhi, *count_cols))            # (n_shards, G_pad)
+        per_shard = totals.sum(axis=1)
+        if int(per_shard.max()) == 0:
+            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
+        # per-generation outputs share one capacity slab (the program
+        # concatenates G per-gen buffers of capacity // G each); when
+        # the shared slab would exceed the per-shard budget, fall back
+        # to per-generation dispatches sized by each generation's OWN
+        # max-shard total — matching rows must never silently truncate
+        # (expand_ranges masks out everything past capacity)
+        per_gen_cap = gather_capacity(
+            int(totals.max()), minimum=self.DEFAULT_CAPACITY)
+        if per_gen_cap * len(padded) <= self.BATCH_SCAN_BUDGET:
+            groups = [list(range(len(padded)))]
+            caps = [per_gen_cap * len(padded)]
+        else:
+            gen_tot = totals.max(axis=0)        # per-gen max over shards
+            groups = [[g] for g in range(len(gens)) if int(gen_tot[g])]
+            caps = [gather_capacity(int(gen_tot[g]),
+                                    minimum=self.DEFAULT_CAPACITY)
+                    for g in range(len(gens)) if int(gen_tot[g])]
+        parts = []
+        for group, cap in zip(groups, caps):
+            scan_cols: list = []
+            for gi in group:
+                gen = padded[gi]
+                scan_cols += [gen.bins, gen.z, gen.pos]
+            self.dispatch_count += 1
+            packed = _fetch_global(_scan_program(
+                self.mesh, len(group), cap, pos_bits)(
+                rb, rlo, rhi, rq, *scan_cols))
+            part = packed.ravel()
+            parts.append(part[part >= 0])
+        flat = np.concatenate(parts)
+        mask_bits = (np.int64(1) << pos_bits) - 1
+        qids = (flat >> pos_bits).astype(np.int64)
+        gids = (flat & mask_bits).astype(np.int64)
+        # exact host mask on THIS process's rows, survivors allgathered
+        from ..parallel.scan import decode_gids
+        if self._multihost:
+            procs, rows = decode_gids(gids)
+            mine = procs == jax.process_index()
+        else:
+            rows = gids
+            mine = np.ones(len(gids), dtype=bool)
+        x, yv, t = self._payload_flat()
+        keep = np.zeros(len(gids), dtype=bool)
+        lrows = rows[mine]
+        cx, cy, ct = x[lrows], yv[lrows], t[lrows]
+        lq = qids[mine]
+        k_local = np.zeros(len(lrows), dtype=bool)
+        for q in range(n_q):
+            sel = lq == q
+            if not sel.any():
+                continue
+            in_box = np.zeros(int(sel.sum()), dtype=bool)
+            for b in w_boxes[q]:
+                in_box |= ((cx[sel] >= b[0]) & (cy[sel] >= b[1])
+                           & (cx[sel] <= b[2]) & (cy[sel] <= b[3]))
+            k_local[sel] = (in_box & (ct[sel] >= qtlo[q])
+                            & (ct[sel] <= qthi[q]))
+        keep[mine] = k_local
+        coded_hits = flat[keep]
+        if self._multihost:
+            from .multihost import allgather_concat
+            coded_hits = allgather_concat(coded_hits)
+        out = []
+        hq = (coded_hits >> pos_bits).astype(np.int64)
+        hg = (coded_hits & mask_bits).astype(np.int64)
+        for q in range(n_q):
+            out.append(np.unique(hg[hq == q]))
+        return out
