@@ -794,6 +794,20 @@ class _SchemaStore:
         return self._indexes[key]
 
 
+def _apply_mask_global(store: "_SchemaStore", hits: list,
+                       allowed: np.ndarray) -> list:
+    """Apply a per-process row mask to per-window hit lists with global
+    semantics: single-controller indexes directly; multihost decodes
+    gids → local rows, masks next to the data, and allgathers the
+    survivors back into the global gid list (every process must enter
+    the collective — call this from all processes or none)."""
+    if store.multihost:
+        from .parallel.multihost import allgather_concat
+        return [np.sort(allgather_concat(store.gids_of(r[allowed[r]])))
+                for r in (store.local_rows_of(h) for h in hits)]
+    return [h[allowed[h]] for h in hits]
+
+
 class _MaskedStoreView:
     """Delegates to a _SchemaStore but substitutes the attribute-masked
     batch (attribute-level visibility for restricted callers)."""
@@ -1140,6 +1154,8 @@ class TpuDataStore:
             # Multihost: each process resolves ITS prefixed ids; the
             # count and the mutation decision are agreed.
             from .index.id import LeanIdIndex
+            # duplicate ids in the request cannot double-count:
+            # LeanIdIndex.query returns a np.unique'd row array
             rows = LeanIdIndex(len(store.batch),
                                prefix=store.batch.id_prefix).query(
                 np.atleast_1d(np.asarray(ids, dtype=object)))
@@ -1379,16 +1395,7 @@ class TpuDataStore:
                 [(boxes, lo, hi) for boxes, lo, hi in windows])
             allowed = self._effective_mask(store)
             if allowed is not None:
-                if store.multihost:
-                    # gids → local rows → mask → allgather back (the
-                    # full-fat fast path's discipline)
-                    from .parallel.multihost import allgather_concat
-                    hits = [np.sort(allgather_concat(store.gids_of(
-                                r[allowed[r]])))
-                            for r in (store.local_rows_of(h)
-                                      for h in hits)]
-                else:
-                    hits = [h[allowed[h]] for h in hits]
+                hits = _apply_mask_global(store, hits, allowed)
             from .metrics import registry as _metrics
             _metrics.counter(f"query.{name}.windows").inc(len(windows))
             if self._audit_writer is not None:
@@ -1443,14 +1450,7 @@ class TpuDataStore:
         # divergent gate would strand peers in the allgather below)
         allowed = self._effective_mask(store)
         if allowed is not None:
-            if store.multihost:
-                # gids → per-process local rows → mask → allgather back
-                from .parallel.multihost import allgather_concat
-                hits = [np.sort(allgather_concat(store.gids_of(
-                            r[allowed[r]])))
-                        for r in (store.local_rows_of(h) for h in hits)]
-            else:
-                hits = [h[allowed[h]] for h in hits]
+            hits = _apply_mask_global(store, hits, allowed)
         from .metrics import registry as _metrics
         _metrics.counter(f"query.{name}.windows").inc(len(windows))
         if self._audit_writer is not None:

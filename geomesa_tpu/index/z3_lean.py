@@ -145,6 +145,52 @@ def _lean_scan_coded(rb, rlo, rhi, rqid, *cols,
 
 
 @partial(jax.jit, static_argnames=("capacity", "pos_bits"))
+def _lean_scan_exact_keep(rb, rlo, rhi, rqid, boxes, bqid, qtlo, qthi,
+                          *cols, capacity: int, pos_bits: int):
+    """Two-phase sibling of :func:`_lean_scan_exact_coded`: the coded
+    buffer STAYS ON DEVICE and only the hit count crosses; the host
+    then dispatches :func:`_compact_coded` for a survivors-sized
+    transfer.  The winning trade for candidate-heavy queries — the
+    device already knows the exact survivors (full tier), so shipping
+    a capacity-sized buffer at ~125ms/MB to keep 0.1%% of it is pure
+    waste (the full-fat index's _scan_keep_device trade, index/z3.py)."""
+    packed = _lean_scan_exact_coded(
+        rb, rlo, rhi, rqid, boxes, bqid, qtlo, qthi, *cols,
+        capacity=capacity, pos_bits=pos_bits)
+    return packed, jnp.sum(packed >= 0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _compact_coded(packed, k: int):
+    """Descending sort floats the valid (>= 0) coded hits to the front;
+    the first ``k`` slots cover all survivors (k = pow2 >= hits)."""
+    return -jnp.sort(-packed.ravel())[:k]
+
+
+#: combined (G_pad × capacity) slot count at which the exact tier's
+#: two-phase read (device compaction + survivors-sized transfer) beats
+#: shipping the full coded buffer: an extra ~100ms round trip vs
+#: ~125ms/MB of padded int buffer
+_TWO_PHASE_MIN_SLOTS = 1 << 18
+
+
+def _bins_spanned(t_lo_ms: int, t_hi_ms: int, period) -> int:
+    """Time bins a clamped interval covers (per-window range budgets
+    scale by it: a tiny box over 27 open-bounds bins would otherwise
+    get 2000/27 ranges per bin — overcovering hundreds of thousands of
+    candidates for a handful of hits)."""
+    b_lo, _ = to_binned_time(np.int64(max(0, t_lo_ms)), period)
+    b_hi, _ = to_binned_time(np.int64(max(0, t_hi_ms)), period)
+    return max(1, int(b_hi) - int(b_lo) + 1)
+
+
+#: hard per-window range cap after per-bin scaling (device seeks are
+#: cheap — a 32k-range searchsorted batch is microseconds — but plan
+#: assembly and upload are host work)
+_MAX_RANGES_PER_WINDOW = 1 << 14
+
+
+@partial(jax.jit, static_argnames=("capacity", "pos_bits"))
 def _lean_scan_exact_coded(rb, rlo, rhi, rqid, boxes, bqid, qtlo, qthi,
                            *cols, capacity: int, pos_bits: int):
     """EXACT scan over ``full``-tier generations in ONE dispatch: seek +
@@ -522,7 +568,14 @@ class LeanZ3Index:
             qtlo[q], qthi[q] = lo, hi
             bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
             w_boxes.append(bxs)
-            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges,
+            # per-BIN range budget: plan_z3_query splits its target
+            # across the interval's bins, so open/long intervals would
+            # starve each bin into hugely overcovering ranges (895k
+            # candidates for 23 hits measured) — scale by the bin count
+            # and let the hard cap bound plan cost
+            budget = min(max_ranges * _bins_spanned(lo, hi, self.period),
+                         _MAX_RANGES_PER_WINDOW)
+            plan = plan_z3_query(bxs, lo, hi, self.period, budget,
                                  sfc=self.sfc)
             if plan.num_ranges == 0:
                 continue
@@ -681,6 +734,18 @@ class LeanZ3Index:
                     cols += [gen.bins, gen.z, gen.pos]
             self.dispatch_count += 1
             if tier == "full":
+                if len(group) * cap >= _TWO_PHASE_MIN_SLOTS:
+                    # survivors-only transfer: keep the coded buffer on
+                    # device, read the hit count, compact (full tier
+                    # already masked exactly on device)
+                    packed, nhits = _lean_scan_exact_keep(
+                        rb, rlo, rhi, rq, *exact_args, *cols,
+                        capacity=cap, pos_bits=pos_bits)
+                    k = gather_capacity(max(int(nhits), 1), minimum=8)
+                    self.dispatch_count += 1
+                    flat = np.asarray(_compact_coded(packed, k=k))
+                    parts.append(flat[flat >= 0].astype(np.int64))
+                    continue
                 packed = _lean_scan_exact_coded(
                     rb, rlo, rhi, rq, *exact_args, *cols,
                     capacity=cap, pos_bits=pos_bits)

@@ -31,6 +31,19 @@ class LambdaDataStore:
         self.expiry_ms = expiry_ms
         self._clock = clock
         self._write_ms: dict[tuple, float] = {}   # (type, fid) → write time
+        #: lean persistent layer: stream fid → implicit row id of its
+        #: persisted row (the upsert mapping — lean stores mint row ids,
+        #: so replacement = tombstone the old row + append the new one)
+        self._persisted_row: dict[tuple, str] = {}
+
+    def _lean_store(self, name: str):
+        """The persistent layer's lean _SchemaStore, or None (duck-typed:
+        any store without the lean profile flushes by explicit id)."""
+        st = getattr(self.persistent, "_store", None)
+        if st is None:
+            return None
+        st = st(name)
+        return st if getattr(st, "lean", False) else None
 
     # -- schema -----------------------------------------------------------
     def create_schema(self, name: str, spec: str):
@@ -61,10 +74,37 @@ class LambdaDataStore:
         expired = [fid for fid in cache.all_feature_ids()
                    if now - self._write_ms.get((name, fid), 0.0)
                    >= self.expiry_ms]
-        if not expired:
+        lean = self._lean_store(name)
+        if lean is not None and lean.multihost:
+            # SPMD: the flush's delete/write are collectives — a
+            # process with nothing expired must still enter them when
+            # any peer flushes (agreed gate, empty local batch)
+            from .parallel.multihost import agreed_int
+            if agreed_int(len(expired), "max") == 0:
+                return 0
+        elif not expired:
             return 0
-        batch = cache.snapshot(expired)
-        if len(batch):
+        batch = (cache.snapshot(expired) if expired
+                 else FeatureBatch.empty(self.get_schema(name)))
+        if lean is not None:
+            # lean persistence (round-4 VERDICT #10): the generational
+            # store mints implicit row ids, so the flusher owns the
+            # fid→row upsert mapping — re-persisted fids tombstone
+            # their old row, the batch appends with fresh row ids (the
+            # DataStorePersistence role over the LSM-shaped store)
+            old = [self._persisted_row.pop((name, str(f)), None)
+                   for f in batch.ids]
+            self.persistent.delete(
+                name, [r for r in old if r is not None])
+            base = len(lean.batch)
+            prefix = lean.batch.id_prefix
+            self.persistent.write(
+                name, FeatureBatch(batch.sft, dict(batch.columns),
+                                   ids=None, geoms=batch.geoms))
+            for i, fid in enumerate(batch.ids):
+                self._persisted_row[(name, str(fid))] = \
+                    f"{prefix}{base + i}"
+        elif len(batch):
             # upsert: a feature persisted earlier and then re-written
             # transiently must replace, not duplicate, its stored row
             if hasattr(self.persistent, "delete"):
@@ -86,8 +126,19 @@ class LambdaDataStore:
             return persistent
         if len(persistent) == 0:
             return transient
-        t_ids = set(str(i) for i in transient.ids)
-        keep = np.array([str(i) not in t_ids for i in persistent.ids])
+        if self._lean_store(name) is not None:
+            # transient-wins by the persisted-row MAPPING: lean row ids
+            # are store-minted, so the shadowed rows are the ones a
+            # currently-transient fid previously persisted (a stream
+            # fid that happens to look like a row id shadows nothing)
+            mapped = {self._persisted_row.get((name, str(i)))
+                      for i in transient.ids}
+            keep = np.array([str(i) not in mapped
+                             for i in persistent.ids])
+        else:
+            t_ids = set(str(i) for i in transient.ids)
+            keep = np.array([str(i) not in t_ids
+                             for i in persistent.ids])
         merged = transient.concat(persistent.take(np.flatnonzero(keep)))
         if q.max_features is not None:
             merged = merged.take(np.arange(min(q.max_features, len(merged))))

@@ -280,11 +280,21 @@ class ShardedLeanZ3Index:
             gen.n_slots += m_pad
             done += m_pad * local_shards
         self._n_local += m_local
-        self._n_total += self._agreed(m_local, "sum")
+        # one vector allgather agrees sum/extent together (each agreed
+        # call is a fleet-wide host barrier — the ingest path pays it
+        # once per append, not three times)
         t_min = int(dtg_ms.min()) if m_local else np.iinfo(np.int64).max
         t_max = int(dtg_ms.max()) if m_local else np.iinfo(np.int64).min
-        t_min = self._agreed(t_min, "min")
-        t_max = self._agreed(t_max, "max")
+        if self._multihost:
+            from .multihost import allgather_concat
+            trip = allgather_concat(np.array(
+                [[m_local, t_min, t_max]], dtype=np.int64))
+            m_sum = int(trip[:, 0].sum())
+            t_min = int(trip[:, 1].min())
+            t_max = int(trip[:, 2].max())
+        else:
+            m_sum = m_local
+        self._n_total += m_sum
         self.t_min_ms = (t_min if self.t_min_ms is None
                          else min(self.t_min_ms, t_min))
         self.t_max_ms = (t_max if self.t_max_ms is None
@@ -341,12 +351,18 @@ class ShardedLeanZ3Index:
         w_boxes: list = []
         qtlo = np.empty(n_q, dtype=np.int64)
         qthi = np.empty(n_q, dtype=np.int64)
+        from ..index.z3_lean import _MAX_RANGES_PER_WINDOW, _bins_spanned
         for q, (bxs, lo, hi) in enumerate(windows):
             lo, hi = self._clamp_time(lo, hi)
             qtlo[q], qthi[q] = lo, hi
             bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
             w_boxes.append(bxs)
-            plan = plan_z3_query(bxs, lo, hi, self.period, max_ranges,
+            # per-BIN range budget (see index/z3_lean.query_many):
+            # open/long intervals must not starve each bin into
+            # overcovering ranges
+            budget = min(max_ranges * _bins_spanned(lo, hi, self.period),
+                         _MAX_RANGES_PER_WINDOW)
+            plan = plan_z3_query(bxs, lo, hi, self.period, budget,
                                  sfc=self.sfc)
             if plan.num_ranges == 0:
                 continue
