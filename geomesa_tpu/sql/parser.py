@@ -17,7 +17,9 @@ fragment; anything beyond it belongs in the caller's dataframe code)::
       [ORDER BY <col> [ASC|DESC]] [LIMIT <n>]
 
 Aggregates: count(*), count(col), sum/min/max/avg(col) with optional
-``AS alias`` (GROUP BY required except for a bare global count(*)).
+``AS alias`` — grouped (GROUP BY) or GLOBAL (no GROUP BY: one scan,
+vectorized reductions; a bare count(*) short-circuits to the planner's
+count path).
 WHERE accepts ECQL predicates directly plus the Spark-style spatial
 calls ``st_intersects/st_contains/st_within/st_dwithin(geom,
 st_geomFromWKT('...'))`` which rewrite to their ECQL forms.
@@ -135,7 +137,9 @@ def sql_query(store, text: str):
     """Execute a SELECT against a TpuDataStore.
 
     Returns a :class:`FeatureBatch` for row queries, a dict of columns
-    for GROUP BY aggregations, or a scalar for a bare global count(*).
+    for GROUP BY aggregations, a dict of scalars for global aggregates
+    (``SELECT sum(x), avg(y) FROM t WHERE …``), or a scalar for a bare
+    global count(*).
     """
     q = parse_sql(text)
     frame = SpatialFrame(store, q.table)
@@ -144,8 +148,38 @@ def sql_query(store, text: str):
     if q.aggs and q.group is None:
         if len(q.aggs) == 1 and q.aggs[0][:2] == ("count", "*"):
             return frame.count()
-        raise ValueError("aggregates without GROUP BY are limited to "
-                         "count(*)")
+        # global aggregates: one scan, vectorized reductions over the
+        # hit columns (SELECT sum(x), avg(y), min(z) FROM t WHERE ...)
+        if q.order is not None or q.limit is not None:
+            raise ValueError(
+                "ORDER BY / LIMIT do not apply to a global aggregate "
+                "(the result is a single row)")
+        # project ONLY the aggregated columns — a sum(score) over a
+        # 100M-row store must not materialize the geometry columns
+        cols = sorted({col for _, col, _ in q.aggs if col != "*"})
+        if cols:
+            frame = frame.select(*cols)
+        batch = frame.collect()
+        out: dict = {}
+        for fn, col, alias in q.aggs:
+            if col == "*":
+                if fn != "count":
+                    raise ValueError(f"{fn}(*) is not defined — "
+                                     "aggregate a column")
+                out[alias] = len(batch)
+                continue
+            vals = np.asarray(batch.column(col))
+            if len(vals) == 0:
+                out[alias] = 0 if fn == "count" else None
+                continue
+            out[alias] = {
+                "count": lambda v: int(len(v)),
+                "sum": lambda v: v.sum(),
+                "min": lambda v: v.min(),
+                "max": lambda v: v.max(),
+                "mean": lambda v: v.mean(),
+            }[fn](vals)
+        return out
     if q.group is not None:
         if not q.aggs:
             raise ValueError("GROUP BY needs aggregate projections")
