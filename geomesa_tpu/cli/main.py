@@ -183,16 +183,22 @@ def cmd_explain(args):
 
 def cmd_sql(args):
     """Run a SELECT statement (the geomesa-spark-sql user surface)."""
+    import numpy as np
+
     from ..sql import sql_query
     out = sql_query(_store(args), args.statement)
     if isinstance(out, int):
         print(out)
         return
-    if isinstance(out, dict):  # GROUP BY aggregation
+    if isinstance(out, dict):
         keys = list(out)
         print(",".join(keys))
-        for row in zip(*(out[k] for k in keys)):
-            print(",".join(str(v) for v in row))
+        if any(np.ndim(out[k]) for k in keys):  # GROUP BY arrays
+            for row in zip(*(out[k] for k in keys)):
+                print(",".join(str(v) for v in row))
+        else:                                   # global aggregates
+            print(",".join("" if out[k] is None else str(out[k])
+                           for k in keys))
         return
     names = [a.name for a in out.sft.attributes
              if not a.is_geometry and a.name in out.columns]

@@ -943,6 +943,13 @@ class TpuDataStore:
             # update (the reference's index-migration path)
             self.migrate_schema(name)
         with self._catalog_lock():
+            # validate BEFORE mutating: a raise below this point would
+            # leave store.sft renamed in memory while the catalog (and
+            # the old name's registration) still say otherwise
+            if sft.name != name and sft.name in self._schemas:
+                raise ValueError(
+                    f"cannot rename schema {name!r} to {sft.name!r}"
+                    ": that schema already exists")
             store.sft = sft
             self._interceptors.pop(name, None)
             if sft.name != name:
@@ -958,6 +965,18 @@ class TpuDataStore:
                         if os.path.exists(old):
                             os.replace(old, os.path.join(
                                 self._catalog_dir, f"{sft.name}{suffix}"))
+                    import shutil
+                    for d in self._lean_snapshot_dirs(name):
+                        target = os.path.join(
+                            self._catalog_dir,
+                            f"{sft.name}.lean"
+                            + os.path.basename(d)[len(f"{name}.lean"):])
+                        # a stale non-empty target dir (crashed remove
+                        # of an old schema) would make rename(2) fail
+                        # ENOTEMPTY mid-rename; the live-schema
+                        # collision is already rejected above
+                        shutil.rmtree(target, ignore_errors=True)
+                        os.replace(d, target)
             self._persist_schema(sft)
 
     def remove_schema(self, name: str) -> None:
@@ -970,6 +989,25 @@ class TpuDataStore:
                     path = os.path.join(self._catalog_dir, f"{name}{suffix}")
                     if os.path.exists(path):
                         os.remove(path)
+                # lean snapshot dirs too: a stale snapshot would
+                # resurrect the removed schema's rows into a later
+                # schema of the same name
+                import shutil
+                for d in self._lean_snapshot_dirs(name):
+                    shutil.rmtree(d, ignore_errors=True)
+
+    def _lean_snapshot_dirs(self, name: str) -> list[str]:
+        """Every lean snapshot dir for ``name`` (``{name}.lean`` plus
+        the per-process ``{name}.lean.pN`` multihost variants)."""
+        if not self._catalog_dir or not os.path.isdir(self._catalog_dir):
+            return []
+        out = []
+        for f in os.listdir(self._catalog_dir):
+            if f == f"{name}.lean" or f.startswith(f"{name}.lean."):
+                p = os.path.join(self._catalog_dir, f)
+                if os.path.isdir(p):
+                    out.append(p)
+        return out
 
     @property
     def type_names(self) -> list[str]:
@@ -1742,12 +1780,8 @@ class TpuDataStore:
         if store.batch is None:
             return
         if store.lean:
-            raise ValueError(
-                "lean-profile schemas do not flush to the parquet "
-                "catalog (a 100M+-row snapshot belongs in a durable "
-                "store, not the metadata directory); stats persist via "
-                "persist_stats, and the data's source of truth is the "
-                "ingest stream")
+            self._flush_lean(name, store)
+            return
         from .io.export import to_parquet
         to_parquet(store.batch, os.path.join(self._catalog_dir, f"{name}.parquet"))
         if store.visibilities is not None or store.attr_visibilities:
@@ -1770,11 +1804,145 @@ class TpuDataStore:
                 json.dump(payload, f)
         self.persist_stats(name)
 
+    #: rows per lean snapshot part — bounds the host working set of a
+    #: flush/reload to one part's columns, never the dataset
+    LEAN_PART_ROWS = 1 << 22
+
+    def _lean_dir(self, name: str, store) -> str:
+        """Snapshot directory for a lean schema.  Multihost: each
+        process snapshots its LOCAL rows under its id prefix (``p0``,
+        ``p1``, …) so a shared catalog dir composes."""
+        # `is not None`, NOT truthiness: at reload time the batch exists
+        # but is EMPTY, and dropping the multihost suffix there would
+        # silently miss every flushed row
+        suffix = (store.batch.id_prefix.rstrip(".")
+                  if store.batch is not None else "")
+        return os.path.join(self._catalog_dir,
+                            f"{name}.lean" + (f".{suffix}" if suffix
+                                              else ""))
+
+    def _flush_lean(self, name: str, store) -> None:
+        """Chunked parquet snapshot of a lean schema: bounded column
+        parts (no id materialization — lean ids are implicit row
+        numbers) plus a manifest.  The durable-store role of the
+        reference's FileSystemDataStore (fs/storage) at lean scale:
+        flushing 100M+ rows streams ``LEAN_PART_ROWS`` slices, so peak
+        host memory is one part.  Per-ROW state (tombstones,
+        visibility codes) rides inside the parts as reserved columns —
+        a JSON list of 100M codes would be gigabytes of host string.
+
+        Crash-safe: parts carry a per-flush stamp, the manifest is
+        swapped in atomically (tmp + ``os.replace``) LAST, and only
+        then are prior-flush parts deleted — a crash at any point
+        leaves the previous manifest referencing its intact parts."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        d = self._lean_dir(name, store)
+        os.makedirs(d, exist_ok=True)
+        mpath = os.path.join(d, "manifest.json")
+        stamp = 0
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                stamp = int(json.load(f).get("stamp", 0)) + 1
+        n = len(store.batch)
+        vis_labels = None
+        if store.visibilities is not None:
+            # label set built per slice: an astype(str) of the WHOLE
+            # column would copy gigabytes at 100M rows, breaking the
+            # one-part memory bound
+            slice_labels = [
+                np.unique(store.visibilities[lo:min(
+                    lo + self.LEAN_PART_ROWS, n)].astype(str))
+                for lo in range(0, n, self.LEAN_PART_ROWS)]
+            vis_labels = (np.unique(np.concatenate(slice_labels))
+                          if slice_labels else np.empty(0, dtype=str))
+        parts = []
+        for i, lo in enumerate(range(0, n, self.LEAN_PART_ROWS)):
+            hi = min(lo + self.LEAN_PART_ROWS, n)
+            view = store.batch.slice_view(lo, hi)
+            cols = {k: pa.array(np.asarray(v))
+                    for k, v in view.columns.items()}
+            if store.tombstone is not None:
+                cols["__tombstone__"] = pa.array(store.tombstone[lo:hi])
+            if vis_labels is not None:
+                cols["__vis__"] = pa.array(np.searchsorted(
+                    vis_labels,
+                    store.visibilities[lo:hi].astype(str)).astype(
+                    np.int32))
+            fname = f"part-{stamp:06d}-{i:05d}.parquet"
+            pq.write_table(pa.table(cols), os.path.join(d, fname))
+            parts.append(fname)
+        manifest: dict = {
+            "n": n, "parts": parts, "stamp": stamp,
+            "envelope": list(store.batch.envelope)
+            if store.batch.envelope else None,
+            "id_prefix": store.batch.id_prefix,
+            "has_tombstones": store.tombstone is not None,
+        }
+        if vis_labels is not None:
+            manifest["vis_labels"] = vis_labels.tolist()
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, mpath)        # the commit point
+        live = set(parts)
+        for f in os.listdir(d):       # prior-flush parts, now orphaned
+            if f.startswith("part-") and f not in live:
+                os.remove(os.path.join(d, f))
+        self.persist_stats(name)
+
+    def _load_lean(self, name: str) -> None:
+        """Restore a lean snapshot: append each part's columns by
+        reference (O(part) per step), restore tombstones/visibilities,
+        and leave the index to the lazy streaming rebuild in
+        ``_lean_index`` (bounded slices through the same append path
+        the live store uses)."""
+        import pyarrow.parquet as pq
+        store = self._schemas[name]
+        d = self._lean_dir(name, store)
+        mpath = os.path.join(d, "manifest.json")
+        if not os.path.exists(mpath):
+            return
+        with open(mpath) as f:
+            manifest = json.load(f)
+        from .features.lean import ChunkView
+        tomb_parts: list = []
+        vis_parts: list = []
+        vis_labels = (np.asarray(manifest["vis_labels"], dtype=object)
+                      if manifest.get("vis_labels") is not None else None)
+        for fname in manifest["parts"]:
+            table = pq.read_table(os.path.join(d, fname))
+            cols = {c: table.column(c).to_numpy(zero_copy_only=False)
+                    for c in table.column_names}
+            if manifest.get("has_tombstones"):
+                tomb_parts.append(
+                    cols.pop("__tombstone__").astype(bool))
+            if vis_labels is not None:
+                vis_parts.append(
+                    vis_labels[cols.pop("__vis__").astype(np.int64)])
+            n_part = table.num_rows
+            if n_part:
+                store.batch.append_batch(
+                    ChunkView(store.sft, cols, n_part))
+        if len(store.batch) != manifest["n"]:
+            raise CatalogVersionError(
+                f"lean snapshot {d} is inconsistent: manifest says "
+                f"{manifest['n']} rows, parts hold {len(store.batch)}")
+        if manifest.get("envelope"):
+            store.batch.envelope = tuple(manifest["envelope"])
+        if tomb_parts:
+            store.tombstone = np.concatenate(tomb_parts)
+        if vis_parts:
+            store.visibilities = np.concatenate(vis_parts)
+        store._dirty = True
+        store._mutation_version += 1
+
     def _load_data(self, name: str) -> None:
         if self._schemas[name].lean:
-            # lean schemas never flushed row data (see flush); sketches
-            # and the fid counter still reload
+            # sketches + fid counter from stats.json; row data from the
+            # chunked parquet snapshot when one was flushed
             self.load_stats(name)
+            self._load_lean(name)
             return
         path = os.path.join(self._catalog_dir, f"{name}.parquet")
         if os.path.exists(path):

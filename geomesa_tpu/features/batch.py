@@ -108,9 +108,13 @@ class FeatureBatch:
         x, y = self.geom_xy(name)
         return np.stack([x, y, x, y], axis=1)
 
-    def take(self, positions: np.ndarray) -> "FeatureBatch":
-        """Row subset (gather) — used to materialize query results."""
-        cols = {k: v[positions] for k, v in self.columns.items()}
+    def take(self, positions: np.ndarray,
+             columns=None) -> "FeatureBatch":
+        """Row subset (gather) — used to materialize query results.
+        ``columns`` restricts which columns are gathered (projection
+        push-down; ids and packed geometries still gather)."""
+        cols = {k: v[positions] for k, v in self.columns.items()
+                if columns is None or k in columns}
         geoms = None
         if self.geoms is not None:
             geoms = self.geoms.take(positions)

@@ -58,10 +58,11 @@ class ChunkView:
         name = name or self.sft.default_geom
         return self.columns[f"{name}_x"], self.columns[f"{name}_y"]
 
-    def take(self, positions) -> "ChunkView":
+    def take(self, positions, columns=None) -> "ChunkView":
         positions = np.asarray(positions)
         return ChunkView(self.sft,
-                         {k: v[positions] for k, v in self.columns.items()},
+                         {k: v[positions] for k, v in self.columns.items()
+                          if columns is None or k in columns},
                          len(positions))
 
 
@@ -152,11 +153,17 @@ class LeanBatch:
         p = self.id_prefix
         return np.array([f"{p}{int(r)}" for r in rows], dtype=object)
 
-    def take(self, positions: np.ndarray) -> FeatureBatch:
+    def take(self, positions: np.ndarray,
+             columns=None) -> FeatureBatch:
         """Materialize a real FeatureBatch for the requested rows (the
-        only place full feature rows come into existence)."""
+        only place full feature rows come into existence).  ``columns``
+        restricts which physical columns materialize — the planner's
+        projection push-down: ``sum(score)`` over 100M hit rows copies
+        ONE float64 column, not the geometry columns too."""
         positions = np.asarray(positions, dtype=np.int64)
-        cols = {k: self.column(k)[positions] for k in self._chunks}
+        names = (self._chunks if columns is None
+                 else [k for k in self._chunks if k in columns])
+        cols = {k: self.column(k)[positions] for k in names}
         return FeatureBatch(self.sft, cols, self.row_ids(positions),
                             None)
 

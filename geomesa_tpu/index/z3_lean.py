@@ -64,6 +64,15 @@ __all__ = ["LeanZ3Index"]
 _SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)
 _SENTINEL_Z = np.int64(np.iinfo(np.int64).max)
 
+#: per-slot byte widths, derived ONCE from the column dtypes (bins
+#: int32 + z int64 + pos int32 — positions are generation-local int32
+#: here, unlike the sharded index's int64 gids — and the full tier
+#: adds x/y f64 + t int64).  Every budget computation uses these, so a
+#: dtype change cannot silently skew the HBM accounting.
+KEYS_BYTES = 4 + 8 + 4
+PAYLOAD_BYTES = 8 + 8 + 8
+FULL_BYTES = KEYS_BYTES + PAYLOAD_BYTES
+
 
 def _append_keys_body(sfc, bins, z, pos, r, base, xs, ys, offs, bs, m):
     """Shared append body (traced inline by both jitted wrappers so the
@@ -231,31 +240,76 @@ def _lean_scan_exact_coded(rb, rlo, rhi, rqid, boxes, bqid, qtlo, qthi,
 #: generation-count compile bucket for the multi-generation programs
 _GEN_BUCKET = 4
 
-_sentinel_cache: dict = {}
-
-
-def _sentinel_cols(tier: str, slots: int):
-    """Shared empty generation columns for bucket padding: FULL-SIZE
-    (same slot count as the real generations, all-sentinel keys), so
-    every padded program has the uniform shape ``(slots,) × G_pad`` and
+def _make_sentinel_cols(tier: str, slots: int):
+    """Empty generation columns for bucket padding: FULL-SIZE (same
+    slot count as the real generations, all-sentinel keys), so every
+    padded program has the uniform shape ``(slots,) × G_pad`` and
     compiles once per BUCKET, not once per real generation count — at
     60 sorted runs over a remote-compile tunnel the difference is
     minutes of compile per checkpoint.  All-sentinel keys match zero
     seeks, so padding still does no real expand work (round-3 VERDICT
-    weak #5); the one shared buffer is passed for every padded slot."""
-    key = (tier, slots)
-    if key not in _sentinel_cache:
-        bins = jnp.full((slots,), _SENTINEL_BIN, jnp.int32)
-        z = jnp.full((slots,), _SENTINEL_Z, jnp.int64)
-        pos = jnp.full((slots,), -1, jnp.int32)
-        if tier == "full":
-            zero = jnp.zeros((slots,), jnp.float64)
-            t0 = jnp.zeros((slots,), jnp.int64)
-            _sentinel_cache[key] = (bins, z, pos, zero, zero, t0,
-                                    jnp.int32(0))
-        else:
-            _sentinel_cache[key] = (bins, z, pos)
-    return _sentinel_cache[key]
+    weak #5); one shared buffer per index is passed for every padded
+    slot (cached per-INSTANCE so its device arrays die with the index
+    and eviction cannot steal another live index's padding)."""
+    bins = jnp.full((slots,), _SENTINEL_BIN, jnp.int32)
+    z = jnp.full((slots,), _SENTINEL_Z, jnp.int64)
+    pos = jnp.full((slots,), -1, jnp.int32)
+    if tier == "full":
+        zero = jnp.zeros((slots,), jnp.float64)
+        t0 = jnp.zeros((slots,), jnp.int64)
+        return (bins, z, pos, zero, zero, t0, jnp.int32(0))
+    return (bins, z, pos)
+
+
+class HostRun:
+    """One sorted key run spilled to host RAM (the ``host`` residency
+    tier, single-chip AND per-shard on the mesh): numpy segmented
+    searchsorted seeks — per distinct query bin, two vectorized
+    z-searchsorted calls within the bin's segment (bins are few: the
+    time-period bins of the data extent)."""
+
+    __slots__ = ("bins", "z", "pos", "_bin_vals", "_bin_starts")
+
+    def __init__(self, bins: np.ndarray, z: np.ndarray, pos: np.ndarray):
+        self.bins, self.z, self.pos = bins, z, pos
+        self._bin_vals, starts = np.unique(bins, return_index=True)
+        self._bin_starts = np.append(starts, len(bins))
+
+    def __len__(self) -> int:
+        return len(self.z)
+
+    def seek(self, rb, rlo, rhi):
+        """Per-range [start, end) offsets into the run."""
+        starts = np.zeros(len(rb), np.int64)
+        ends = np.zeros(len(rb), np.int64)
+        if len(self.z) == 0:
+            return starts, ends
+        for b in np.unique(rb):
+            bi = np.searchsorted(self._bin_vals, b)
+            if bi >= len(self._bin_vals) or self._bin_vals[bi] != b:
+                continue
+            s0, s1 = self._bin_starts[bi], self._bin_starts[bi + 1]
+            seg = self.z[s0:s1]
+            sel = rb == b
+            starts[sel] = s0 + np.searchsorted(seg, rlo[sel], side="left")
+            ends[sel] = s0 + np.searchsorted(seg, rhi[sel], side="right")
+        return starts, ends
+
+    def candidates(self, rb, rlo, rhi, rqid, pos_bits: int) -> np.ndarray:
+        """Coded candidate positions ``qid << pos_bits | pos`` for a
+        padded range batch (the numpy twin of the device expand)."""
+        starts, ends = self.seek(rb, rlo, rhi)
+        counts = np.maximum(ends - starts, 0)
+        cum = np.cumsum(counts)
+        total = int(cum[-1]) if len(cum) else 0
+        if total == 0:
+            return np.empty(0, np.int64)
+        j = np.arange(total)
+        rid = np.searchsorted(cum, j, side="right")
+        prev = np.where(rid > 0, cum[rid - 1], 0)
+        idx = starts[rid] + (j - prev)
+        return ((rqid[rid].astype(np.int64) << pos_bits)
+                | self.pos[idx].astype(np.int64))
 
 
 class _Generation:
@@ -265,7 +319,7 @@ class _Generation:
     payload is indexed by ``pos - base`` (append order)."""
 
     __slots__ = ("bins", "z", "pos", "x", "y", "t", "n", "base", "tier",
-                 "_bin_vals", "_bin_starts")
+                 "run")
 
     def __init__(self, capacity: int, base: int, tier: str):
         self.bins = jnp.full((capacity,), _SENTINEL_BIN, jnp.int32)
@@ -280,8 +334,7 @@ class _Generation:
         self.n = 0
         self.base = base
         self.tier = tier
-        self._bin_vals = None
-        self._bin_starts = None
+        self.run: HostRun | None = None
 
     @property
     def capacity(self) -> int:
@@ -290,7 +343,7 @@ class _Generation:
     def device_bytes(self) -> int:
         if self.tier == "host":
             return 0
-        per = 16 + (24 if self.tier == "full" else 0)
+        per = FULL_BYTES if self.tier == "full" else KEYS_BYTES
         return self.capacity * per
 
     def drop_payload(self) -> None:
@@ -301,9 +354,8 @@ class _Generation:
             self.tier = "keys"
 
     def spill_to_host(self) -> None:
-        """keys → host: fetch the sorted key run into host RAM, free
-        HBM, and precompute the per-bin segment offsets the numpy seeks
-        use (bins are few — the time period bins of the data extent)."""
+        """keys → host: fetch the sorted key run into host RAM as a
+        :class:`HostRun`, freeing the HBM."""
         self.drop_payload()
         if self.tier != "keys":
             return
@@ -311,30 +363,9 @@ class _Generation:
         z = np.asarray(self.z)
         pos = np.asarray(self.pos)
         # valid rows only: the sentinel padding sorts to the tail
-        bins, z, pos = bins[:self.n], z[:self.n], pos[:self.n]
-        self.bins, self.z, self.pos = bins, z, pos
-        self._bin_vals, starts = np.unique(bins, return_index=True)
-        self._bin_starts = np.append(starts, len(bins))
+        self.run = HostRun(bins[:self.n], z[:self.n], pos[:self.n])
+        self.bins = self.z = self.pos = None
         self.tier = "host"
-
-    def host_seek(self, rb, rlo, rhi):
-        """Numpy segmented searchsorted over the spilled run: per
-        distinct query bin, two vectorized z-searchsorted calls within
-        the bin's segment.  Returns candidate global positions."""
-        if self.n == 0:
-            return np.empty(0, np.int64), np.empty(0, np.int64)
-        starts = np.zeros(len(rb), np.int64)
-        ends = np.zeros(len(rb), np.int64)
-        for b in np.unique(rb):
-            bi = np.searchsorted(self._bin_vals, b)
-            if bi >= len(self._bin_vals) or self._bin_vals[bi] != b:
-                continue
-            s0, s1 = self._bin_starts[bi], self._bin_starts[bi + 1]
-            seg = self.z[s0:s1]
-            sel = rb == b
-            starts[sel] = s0 + np.searchsorted(seg, rlo[sel], side="left")
-            ends[sel] = s0 + np.searchsorted(seg, rhi[sel], side="right")
-        return starts, ends
 
 
 class LeanZ3Index:
@@ -382,6 +413,15 @@ class LeanZ3Index:
         #: device program dispatches issued (tests pin dispatch counts;
         #: the tunnel RTT makes every dispatch ~100ms)
         self.dispatch_count = 0
+        #: per-instance bucket-padding sentinel columns, keyed tier
+        #: (see _make_sentinel_cols)
+        self._sentinels: dict = {}
+
+    def _sentinel_cols(self, tier: str):
+        if tier not in self._sentinels:
+            self._sentinels[tier] = _make_sentinel_cols(
+                tier, self.generation_slots)
+        return self._sentinels[tier]
 
     def __len__(self) -> int:
         return self._n_rows
@@ -401,7 +441,7 @@ class LeanZ3Index:
 
     def host_key_bytes(self) -> int:
         """Host RAM held by spilled (``host``-tier) key runs."""
-        return sum(g.n * 16 for g in self.generations
+        return sum(g.n * KEYS_BYTES for g in self.generations
                    if g.tier == "host")
 
     def tier_counts(self) -> dict:
@@ -413,6 +453,20 @@ class LeanZ3Index:
     # -- write path -------------------------------------------------------
     def _new_generation(self, base: int) -> _Generation:
         tier = "full" if self.payload_on_device else "keys"
+        if tier == "full":
+            # would the payload survive rebalance?  Payload drops run
+            # oldest→newest BEFORE any spill, so if demoting every
+            # existing payload still busts the budget this generation's
+            # payload is doomed — don't allocate slots × 24 B of HBM
+            # (and a transient spike) that _rebalance frees moments
+            # later.
+            floor = (sum(min(g.device_bytes(),
+                             g.capacity * KEYS_BYTES)
+                         for g in self.generations if g.tier != "host")
+                     + self.generation_slots
+                     * (FULL_BYTES + KEYS_BYTES + FULL_BYTES))
+            if floor > self.hbm_budget_bytes:
+                tier = "keys"
         gen = _Generation(self.generation_slots, base=base, tier=tier)
         self.generations.append(gen)
         self._rebalance()
@@ -423,9 +477,9 @@ class LeanZ3Index:
         sentinel padding buffers queries will lazily allocate — a keys
         sentinel always, a full one only while full-tier generations
         exist (recomputed as tiers demote)."""
-        per = self.generation_slots * 16
+        per = self.generation_slots * KEYS_BYTES
         if any(g.tier == "full" for g in self.generations):
-            per += self.generation_slots * 40
+            per += self.generation_slots * FULL_BYTES
         return self.hbm_budget_bytes - per
 
     def _rebalance(self) -> None:
@@ -441,6 +495,11 @@ class LeanZ3Index:
                 # the active generation's payload may drop too: its
                 # appends continue through the keys-tier program
                 gen.drop_payload()
+                if not any(g.tier == "full" for g in self.generations):
+                    # the budget stops charging the full-tier sentinel
+                    # once no full generation exists — free the cached
+                    # one so the charge matches resident HBM
+                    self._sentinels.pop("full", None)
                 if self.device_bytes() <= self._budget_after_sentinels():
                     return
         for gen in self.generations[:-1]:
@@ -476,7 +535,7 @@ class LeanZ3Index:
         done = 0
         while done < m_total:
             gen = (self.generations[-1] if self.generations else None)
-            if gen is None or gen.n >= gen.capacity or gen.tier == "host":
+            if gen is None or gen.tier == "host" or gen.n >= gen.capacity:
                 # base = global row id of the generation's first row —
                 # mid-append rollovers account for rows already consumed
                 gen = self._new_generation(self._n_rows + done)
@@ -606,7 +665,7 @@ class LeanZ3Index:
             padded = self._pad_bucket(dev_gens)
             count_cols: list = []
             for gen in padded:
-                cols = (_sentinel_cols("keys", self.generation_slots)
+                cols = (self._sentinel_cols("keys")
                         if gen is None else (gen.bins, gen.z))
                 count_cols += [cols[0], cols[1]]
             if progress is not None:
@@ -634,20 +693,10 @@ class LeanZ3Index:
                     exact_args=None)
         # host tier: numpy seeks (no dispatch at all)
         for gen in host_gens:
-            starts, ends = gen.host_seek(ra["rbin"], ra["rzlo"],
-                                         ra["rzhi"])
-            counts = np.maximum(ends - starts, 0)
-            cum = np.cumsum(counts)
-            total = int(cum[-1]) if len(cum) else 0
-            if total == 0:
-                continue
-            j = np.arange(total)
-            rid = np.searchsorted(cum, j, side="right")
-            prev = np.where(rid > 0, cum[rid - 1], 0)
-            idx = starts[rid] + (j - prev)
-            coded = ((ra["rqid"][rid].astype(np.int64) << pos_bits)
-                     | gen.pos[idx].astype(np.int64))
-            keys_cand.append(coded)
+            coded = gen.run.candidates(ra["rbin"], ra["rzlo"],
+                                       ra["rzhi"], ra["rqid"], pos_bits)
+            if len(coded):
+                keys_cand.append(coded)
 
         mask_bits = (np.int64(1) << pos_bits) - 1
         out = [np.empty(0, dtype=np.int64) for _ in range(n_q)]
@@ -725,8 +774,7 @@ class LeanZ3Index:
             cols: list = []
             for gen in group:
                 if gen is None:
-                    cols += list(_sentinel_cols(tier,
-                                                self.generation_slots))
+                    cols += list(self._sentinel_cols(tier))
                 elif tier == "full":
                     cols += [gen.bins, gen.z, gen.pos, gen.x, gen.y,
                              gen.t, jnp.int32(gen.base)]

@@ -1,27 +1,44 @@
-"""ShardedLeanZ3Index: the lean generational index over a device mesh.
+"""ShardedLeanZ3Index: the tiered lean generational index over a mesh.
 
 Round-4 VERDICT #4: the cluster IS the reference's scale story
 (AccumuloQueryPlan.scala:87-157 — scan plans fan out over tablet
-servers), so the keys-on-device generational index must shard too.
-Layout: every generation's key columns are STACKED per shard —
-``(n_shards, slots)`` arrays with ``P("shard", None)`` sharding — and
-the probe/scan programs run under ``shard_map``: each device seeks its
-own sorted runs, all generations in one dispatch, with per-shard
-fixed-capacity coded outputs.
+servers), so the lean generational index must shard too.  Layout: every
+generation's columns are STACKED per shard — ``(n_shards, slots)``
+arrays with ``P("shard", None)`` sharding — and the probe/scan programs
+run under ``shard_map``: each device seeks its own sorted runs, all
+generations in one dispatch, with per-shard fixed-capacity coded
+outputs.
 
 Positions are GLOBAL gids (``process << GID_PROC_SHIFT | local_row``
 under multihost, plain row ids single-controller), minted host-side at
-append time and carried as an int64 sort payload.  The exact bbox+time
-re-check runs on each process's host payload (the client-side filter of
-the keys-only tier); survivors allgather so every process returns the
-same global hit list — the same SPMD discipline as ShardedZ3Index.
+append time and carried as an int64 sort payload.
 
-Per-shard generations keep the append sort's working set at ONE
-``(slots,)`` run per device — the per-chip scale ceiling becomes
-HBM/20 B ≈ 670M rows/chip of keys instead of the full-fat 40 B/pt
-~150M (round-4 VERDICT #4's ">150M/chip-equivalent"); host spill (the
-single-chip 1B path) composes per process and is left to the
-single-controller tiers for now.
+**Residency tiers** (the single-chip ``index/z3_lean`` design composed
+with the mesh — each generation demotes oldest-first under a PER-SHARD
+HBM budget):
+
+* ``full`` — keys AND an (x, y, t) payload per shard: the exact
+  bbox+time mask runs fused INSIDE the shard_map scan and only true
+  hits leave the device.  Unlike the single-chip full tier (payload in
+  append order, gathered by ``pos - base``), the sharded payload is
+  carried THROUGH the per-shard sort as extra ``lax.sort`` operands:
+  a shard's rows are block-split slices of many appends, so gids are
+  not generation-contiguous per shard and a ``pos - base`` gather
+  cannot work — sorted payload lets the expand index it directly.
+* ``keys`` — 20 B/pt per shard (bins int32 + z int64 + gid int64):
+  device seeks + candidate gather; the exact mask runs on each
+  process's host payload (the client-side re-check) and survivors
+  allgather.
+* ``host`` — the per-shard sorted runs spilled to the OWNING process's
+  host RAM (each process materializes only its addressable shards —
+  which hold exactly its local rows) and seeked with the shared numpy
+  :class:`~geomesa_tpu.index.z3_lean.HostRun`.  This is the 1B
+  single-chip spill story composed with the mesh: per-chip reach is no
+  longer bounded by HBM at all.
+
+Demotion decisions are process-invariant (agreed byte counts over
+identical global metadata), so multihost processes always pick the
+same tiers — the agreed-gating discipline of the store.
 """
 
 from __future__ import annotations
@@ -40,8 +57,10 @@ except ImportError:  # pragma: no cover — older jax
 
 from ..curve.binnedtime import TimePeriod, to_binned_time
 from ..index.z3 import Z3_INDEX_VERSION, plan_z3_query, z3_sfc_for_version
+from ..index.z3_lean import HostRun
 from ..ops.search import (
-    expand_ranges, gather_capacity, pad_pow2, pad_ranges, searchsorted2,
+    expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
+    searchsorted2,
 )
 from .scan import _fetch_global, encode_gids
 
@@ -50,6 +69,15 @@ __all__ = ["ShardedLeanZ3Index"]
 _SENTINEL_BIN = np.int32(np.iinfo(np.int32).max)
 _SENTINEL_Z = np.int64(np.iinfo(np.int64).max)
 
+#: per-slot byte widths, derived ONCE from the column dtypes (bins
+#: int32 + z int64 + pos int64 — pos is an int64 gid here, unlike the
+#: single-chip index's int32 — and the full tier adds x/y f64 + t
+#: int64).  Every budget computation uses these, so a dtype change
+#: cannot silently skew the HBM accounting.
+KEYS_BYTES = 4 + 8 + 8
+PAYLOAD_BYTES = 8 + 8 + 8
+FULL_BYTES = KEYS_BYTES + PAYLOAD_BYTES
+
 #: generation-count compile bucket (one compile per bucket: sentinel
 #: padding is full-size, as in index/z3_lean)
 _GEN_BUCKET = 4
@@ -57,9 +85,9 @@ _GEN_BUCKET = 4
 
 @lru_cache(maxsize=8)
 def _append_program(mesh: Mesh, sfc):
-    """Per-shard generation append under shard_map: encode the shard's
-    slice, write into its sentinel padding at slot offset ``r`` and
-    re-sort — the z3_lean append body, one run per device."""
+    """Per-shard ``keys``-tier append under shard_map: encode the
+    shard's slice, write into its sentinel padding at slot offset ``r``
+    and re-sort — the z3_lean append body, one run per device."""
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P("shard", None),) * 3 + (P(),)
@@ -83,9 +111,43 @@ def _append_program(mesh: Mesh, sfc):
 
 
 @lru_cache(maxsize=8)
+def _append_program_full(mesh: Mesh, sfc):
+    """``full``-tier append: the keys body plus the (x, y, t) payload
+    columns carried THROUGH the sort (module doc — sorted payload is
+    what makes the fused exact mask possible per shard)."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard", None),) * 6 + (P(),)
+             + (P("shard", None),) * 7,
+             out_specs=(P("shard", None),) * 6)
+    def app(bins, z, pos, xp, yp, tp, r, xs, ys, offs, bs, ps, ts, m):
+        b0, z0, p0 = bins[0], z[0], pos[0]
+        x0, y0, t0 = xp[0], yp[0], tp[0]
+        m_pad = xs.shape[1]
+        z_new = sfc.index(xs[0], ys[0], offs[0])
+        valid = jnp.arange(m_pad) < m[0, 0]
+        b_new = jnp.where(valid, bs[0], _SENTINEL_BIN)
+        z_new = jnp.where(valid, z_new, _SENTINEL_Z)
+        p_new = jnp.where(valid, ps[0], jnp.int64(-1))
+        b0 = jax.lax.dynamic_update_slice(b0, b_new, (r,))
+        z0 = jax.lax.dynamic_update_slice(z0, z_new, (r,))
+        p0 = jax.lax.dynamic_update_slice(p0, p_new, (r,))
+        x0 = jax.lax.dynamic_update_slice(x0, xs[0], (r,))
+        y0 = jax.lax.dynamic_update_slice(y0, ys[0], (r,))
+        t0 = jax.lax.dynamic_update_slice(t0, ts[0], (r,))
+        b0, z0, p0, x0, y0, t0 = jax.lax.sort(
+            (b0, z0, p0, x0, y0, t0), dimension=0, num_keys=2)
+        return (b0[None], z0[None], p0[None], x0[None], y0[None],
+                t0[None])
+
+    return jax.jit(app, donate_argnums=(0, 1, 2, 3, 4, 5))
+
+
+@lru_cache(maxsize=8)
 def _count_program(mesh: Mesh, n_gens: int):
     """Totals probe: per (shard, generation) candidate counts in ONE
-    dispatch — out ``(n_shards, n_gens)``."""
+    dispatch — out ``(n_shards, n_gens)``.  Tier-agnostic: both device
+    tiers probe on (bins, z)."""
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(None),) * 3 + (P("shard", None),) * (2 * n_gens),
@@ -104,9 +166,10 @@ def _count_program(mesh: Mesh, n_gens: int):
 
 @lru_cache(maxsize=8)
 def _scan_program(mesh: Mesh, n_gens: int, capacity: int, pos_bits: int):
-    """Candidate gather: per-shard coded ``qid << pos_bits | gid``
-    buffers over every generation — out ``(n_shards, capacity)``
-    int64 (gids span the multihost process field)."""
+    """``keys``-tier candidate gather: per-shard coded
+    ``qid << pos_bits | gid`` buffers over every generation — out
+    ``(n_shards, capacity)`` int64 (gids span the multihost process
+    field)."""
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(None),) * 4 + (P("shard", None),) * (3 * n_gens),
@@ -129,12 +192,58 @@ def _scan_program(mesh: Mesh, n_gens: int, capacity: int, pos_bits: int):
     return jax.jit(scan)
 
 
+@lru_cache(maxsize=8)
+def _scan_program_exact(mesh: Mesh, n_gens: int, capacity: int,
+                        pos_bits: int):
+    """``full``-tier EXACT scan: seek + expand + the fused f64
+    bbox+time mask over the shard's SORTED payload columns — every
+    non-negative output slot is a TRUE hit; no host re-check, no
+    survivors allgather (the output is already a global array).  A
+    candidate only matches boxes/time of its own window (bqid/qtlo/
+    qthi, the _query_many_packed discipline of index/z3)."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None),) * 4 + (P(None, None), P(None), P(None),
+                                        P(None))
+             + (P("shard", None),) * (6 * n_gens),
+             out_specs=P("shard", None))
+    def scan(rb, rlo, rhi, rqid, boxes, bqid, qtlo, qthi, *cols):
+        per_gen = capacity // max(1, n_gens)
+        outs = []
+        for g in range(n_gens):
+            b, z, pos, xp, yp, tp = (c[0] for c in
+                                     cols[6 * g: 6 * g + 6])
+            starts = searchsorted2(b, z, rb, rlo, side="left")
+            ends = searchsorted2(b, z, rb, rhi, side="right")
+            counts = jnp.maximum(ends - starts, 0)
+            idx, valid, rid = expand_ranges(starts, counts, per_gen)
+            xc, yc, tc = xp[idx], yp[idx], tp[idx]
+            cqid = rqid[rid]
+            same_q = cqid[:, None] == bqid[None, :]
+            in_box = (
+                (xc[:, None] >= boxes[None, :, 0])
+                & (yc[:, None] >= boxes[None, :, 1])
+                & (xc[:, None] <= boxes[None, :, 2])
+                & (yc[:, None] <= boxes[None, :, 3])
+                & same_q
+            ).any(axis=1)
+            ok = (valid & in_box
+                  & (tc >= qtlo[cqid]) & (tc <= qthi[cqid]))
+            coded = ((cqid.astype(jnp.int64) << pos_bits) | pos[idx])
+            outs.append(jnp.where(ok, coded, jnp.int64(-1)))
+        return jnp.concatenate(outs)[None]
+
+    return jax.jit(scan)
+
+
 class _ShardedGen:
-    """One generation: stacked per-shard sorted key runs."""
+    """One generation: stacked per-shard sorted runs.  ``tier`` ∈
+    {"full", "keys", "host"} (module doc)."""
 
-    __slots__ = ("bins", "z", "pos", "n_slots")
+    __slots__ = ("bins", "z", "pos", "x", "y", "t", "n_slots", "tier",
+                 "runs")
 
-    def __init__(self, mesh: Mesh, slots: int):
+    def __init__(self, mesh: Mesh, slots: int, tier: str = "keys"):
         shards = int(mesh.devices.size)
         sh = NamedSharding(mesh, P("shard", None))
         self.bins = jax.device_put(
@@ -143,39 +252,94 @@ class _ShardedGen:
             np.full((shards, slots), _SENTINEL_Z, np.int64), sh)
         self.pos = jax.device_put(
             np.full((shards, slots), -1, np.int64), sh)
+        if tier == "full":
+            self.x = jax.device_put(np.zeros((shards, slots)), sh)
+            self.y = jax.device_put(np.zeros((shards, slots)), sh)
+            self.t = jax.device_put(
+                np.zeros((shards, slots), np.int64), sh)
+        else:
+            self.x = self.y = self.t = None
         #: slot offset consumed so far (identical on every shard — each
         #: append writes the same agreed m_pad per shard)
         self.n_slots = 0
+        self.tier = tier
+        #: host-tier: this process's spilled per-shard runs
+        self.runs: list[HostRun] | None = None
 
     @property
     def slots(self) -> int:
-        return int(self.z.shape[1])
+        return 0 if self.tier == "host" else int(self.z.shape[1])
+
+    def per_shard_bytes(self) -> int:
+        """Device bytes ONE shard holds for this generation (the unit
+        the per-chip HBM budget governs)."""
+        if self.tier == "host":
+            return 0
+        per = FULL_BYTES if self.tier == "full" else KEYS_BYTES
+        return int(self.z.shape[1]) * per
 
     def device_bytes(self) -> int:
-        return int(self.z.shape[0]) * self.slots * (4 + 8 + 8)
+        if self.tier == "host":
+            return 0
+        return int(self.z.shape[0]) * self.per_shard_bytes()
+
+    def drop_payload(self) -> None:
+        """full → keys: free the per-shard device payload (each
+        process's host payload remains the re-check truth)."""
+        if self.tier == "full":
+            self.x = self.y = self.t = None
+            self.tier = "keys"
+
+    def spill_to_host(self) -> None:
+        """keys → host: each process fetches its ADDRESSABLE shards'
+        sorted runs into host RAM (those shards hold exactly its local
+        rows) and frees the HBM on all of them."""
+        self.drop_payload()
+        if self.tier != "keys":
+            return
+        local = {}
+        for name, arr in (("bins", self.bins), ("z", self.z),
+                          ("pos", self.pos)):
+            for s in arr.addressable_shards:
+                row = s.index[0].start or 0
+                local.setdefault(row, {})[name] = np.asarray(s.data)[0]
+        self.runs = []
+        for row in sorted(local):
+            cols = local[row]
+            valid = cols["pos"] >= 0
+            self.runs.append(HostRun(cols["bins"][valid],
+                                     cols["z"][valid],
+                                     cols["pos"][valid]))
+        self.bins = self.z = self.pos = None
+        self.tier = "host"
+
+    def host_key_bytes(self) -> int:
+        if self.tier != "host":
+            return 0
+        return sum(len(r) * KEYS_BYTES for r in self.runs)
 
 
-@lru_cache(maxsize=8)
-def _sentinel_gen(mesh: Mesh, slots: int):
-    """Shared empty full-size generation for bucket padding (uniform
-    program shapes → one compile per bucket; zero seeks match)."""
-    return _ShardedGen(mesh, slots)
 
 
 class ShardedLeanZ3Index:
-    """Lean generational Z3 index over a mesh (module doc)."""
+    """Tiered lean generational Z3 index over a mesh (module doc)."""
 
     #: slots per generation PER SHARD
     GENERATION_SLOTS = 1 << 22
     DEFAULT_CAPACITY = 1 << 15
     #: per-shard slot budget for one batched scan output
     BATCH_SCAN_BUDGET = 1 << 26
+    #: default PER-SHARD HBM budget for key/payload residency (the
+    #: single-chip default: v5e usable minus scan slack, docs/scale.md)
+    HBM_BUDGET_BYTES = int(13.5 * 2**30)
 
     def __init__(self, period: TimePeriod | str = TimePeriod.WEEK,
                  mesh: Mesh | None = None,
                  version: int = Z3_INDEX_VERSION,
                  generation_slots: int | None = None,
-                 multihost: bool = False):
+                 multihost: bool = False,
+                 hbm_budget_bytes: int | None = None,
+                 payload_on_device: bool = True):
         assert mesh is not None
         self.period = TimePeriod.parse(period)
         self.version = version
@@ -183,6 +347,10 @@ class ShardedLeanZ3Index:
         self.mesh = mesh
         self.generation_slots = generation_slots or self.GENERATION_SLOTS
         self._multihost = bool(multihost)
+        self.hbm_budget_bytes = hbm_budget_bytes or self.HBM_BUDGET_BYTES
+        #: whether NEW generations carry per-shard payload for the
+        #: fused exact mask (they demote under budget pressure)
+        self.payload_on_device = payload_on_device
         self.generations: list[_ShardedGen] = []
         #: host payload provider: () -> (x, y, t) of THIS process's
         #: local rows (the store's columns)
@@ -194,6 +362,21 @@ class ShardedLeanZ3Index:
         self.t_min_ms: int | None = None
         self.t_max_ms: int | None = None
         self.dispatch_count = 0
+        #: per-INSTANCE bucket-padding sentinels, keyed tier — instance
+        #: scope (not a module cache) ties their device arrays to this
+        #: index's lifetime, keeps eviction from stealing a sentinel
+        #: another live index is padding with, and lets the budget
+        #: accounting free the full-tier one when its charge ends
+        self._sentinels: dict = {}
+
+    def _sentinel(self, tier: str) -> _ShardedGen:
+        """Shared empty full-size generation for bucket padding
+        (uniform program shapes → one compile per bucket; all-sentinel
+        keys match zero seeks)."""
+        if tier not in self._sentinels:
+            self._sentinels[tier] = _ShardedGen(
+                self.mesh, self.generation_slots, tier=tier)
+        return self._sentinels[tier]
 
     def __len__(self) -> int:
         return self._n_total
@@ -204,9 +387,21 @@ class ShardedLeanZ3Index:
     def device_bytes(self) -> int:
         return sum(g.device_bytes() for g in self.generations)
 
+    def host_key_bytes(self) -> int:
+        """Host RAM this process holds in spilled per-shard runs."""
+        return sum(g.host_key_bytes() for g in self.generations)
+
+    def tier_counts(self) -> dict:
+        out = {"full": 0, "keys": 0, "host": 0}
+        for g in self.generations:
+            out[g.tier] += 1
+        return out
+
     def block(self) -> None:
-        if self.generations:
-            jax.block_until_ready(self.generations[-1].pos)
+        for gen in reversed(self.generations):
+            if gen.tier != "host":
+                jax.block_until_ready(gen.pos)
+                break
 
     # -- write path -------------------------------------------------------
     def _agreed(self, value: int, op: str) -> int:
@@ -214,6 +409,67 @@ class ShardedLeanZ3Index:
             return int(value)
         from .multihost import agreed_int
         return agreed_int(int(value), op)
+
+    def _per_shard_resident(self) -> int:
+        """Per-shard device bytes incl. the full-size sentinel padding
+        buffers queries will lazily allocate (a keys sentinel always, a
+        full one only while full-tier generations exist)."""
+        per = sum(g.per_shard_bytes() for g in self.generations)
+        per += self.generation_slots * KEYS_BYTES
+        if any(g.tier == "full" for g in self.generations):
+            per += self.generation_slots * FULL_BYTES
+        return per
+
+    def _rebalance(self) -> None:
+        """Demote oldest-first until each shard's residency fits the
+        per-shard HBM budget: payload drops first (full → keys), then
+        runs spill to the owning processes (keys → host).  The ACTIVE
+        generation's keys never spill — appends sort there.  All
+        decisions derive from process-invariant global metadata, so
+        multihost processes demote identically."""
+        if self._per_shard_resident() <= self.hbm_budget_bytes:
+            return
+        for gen in self.generations:
+            if gen.tier == "full":
+                gen.drop_payload()
+                if not any(g.tier == "full" for g in self.generations):
+                    # the budget stops charging the full-tier sentinel
+                    # the moment no full generation exists — free the
+                    # cached one so the charge matches resident HBM
+                    self._sentinels.pop("full", None)
+                if self._per_shard_resident() <= self.hbm_budget_bytes:
+                    return
+        for gen in self.generations[:-1]:
+            if gen.tier == "keys":
+                gen.spill_to_host()
+                if self._per_shard_resident() <= self.hbm_budget_bytes:
+                    return
+        if self._per_shard_resident() > self.hbm_budget_bytes:
+            raise MemoryError(
+                f"active generation ({self.generation_slots} slots/"
+                f"shard) exceeds hbm_budget_bytes="
+                f"{self.hbm_budget_bytes} minus sentinel overhead")
+
+    def _new_generation(self) -> _ShardedGen:
+        tier = "full" if self.payload_on_device else "keys"
+        if tier == "full":
+            # would the payload survive rebalance?  The drop loop runs
+            # oldest→newest BEFORE any spill, so if demoting every
+            # existing payload still busts the budget, this
+            # generation's payload is doomed — don't allocate (and
+            # transiently spike) shards × slots × 24 B it would free
+            # moments later.
+            floor = (sum(min(g.per_shard_bytes(),
+                             self.generation_slots * KEYS_BYTES)
+                         for g in self.generations)
+                     + self.generation_slots
+                     * (FULL_BYTES + KEYS_BYTES + FULL_BYTES))
+            if floor > self.hbm_budget_bytes:
+                tier = "keys"
+        gen = _ShardedGen(self.mesh, self.generation_slots, tier=tier)
+        self.generations.append(gen)
+        self._rebalance()
+        return self.generations[-1]
 
     def append(self, x, y, dtg_ms) -> "ShardedLeanZ3Index":
         """Distribute this process's rows across its local shards and
@@ -245,15 +501,19 @@ class ShardedLeanZ3Index:
         done = 0
         while done < m_max:
             gen = self.generations[-1] if self.generations else None
-            if gen is None or gen.n_slots + m_pad > gen.slots:
-                gen = _ShardedGen(self.mesh, self.generation_slots)
-                self.generations.append(gen)
+            if gen is None or gen.tier == "host" \
+                    or gen.n_slots + m_pad > gen.slots:
+                gen = self._new_generation()
             take_all = min(m_pad * local_shards, max(0, m_local - done))
             xs = np.zeros((local_shards, m_pad))
             ys = np.zeros((local_shards, m_pad))
             offs = np.zeros((local_shards, m_pad))
             bs = np.zeros((local_shards, m_pad), np.int32)
             ps = np.full((local_shards, m_pad), -1, np.int64)
+            # only the full-tier program consumes timestamps — don't
+            # allocate/copy shards × m_pad × 8 B the keys path discards
+            ts = (np.zeros((local_shards, m_pad), np.int64)
+                  if gen.tier == "full" else None)
             ms = np.zeros((local_shards, 1), np.int32)
             if take_all > 0:
                 sl = slice(done, done + take_all)
@@ -271,12 +531,23 @@ class ShardedLeanZ3Index:
                     offs[s, :k] = ho[lo:hi].astype(np.float64)
                     bs[s, :k] = hb[lo:hi].astype(np.int32)
                     ps[s, :k] = gids[lo:hi]
+                    if ts is not None:
+                        ts[s, :k] = dtg_ms[sl][lo:hi]
                     ms[s, 0] = k
-            arrs = self._shard_put([xs, ys, offs, bs, ps, ms])
-            prog = _append_program(self.mesh, self.sfc)
-            self.dispatch_count += 1
-            gen.bins, gen.z, gen.pos = prog(
-                gen.bins, gen.z, gen.pos, jnp.int32(gen.n_slots), *arrs)
+            if gen.tier == "full":
+                arrs = self._shard_put([xs, ys, offs, bs, ps, ts, ms])
+                prog = _append_program_full(self.mesh, self.sfc)
+                self.dispatch_count += 1
+                (gen.bins, gen.z, gen.pos, gen.x, gen.y,
+                 gen.t) = prog(gen.bins, gen.z, gen.pos, gen.x, gen.y,
+                               gen.t, jnp.int32(gen.n_slots), *arrs)
+            else:
+                arrs = self._shard_put([xs, ys, offs, bs, ps, ms])
+                prog = _append_program(self.mesh, self.sfc)
+                self.dispatch_count += 1
+                gen.bins, gen.z, gen.pos = prog(
+                    gen.bins, gen.z, gen.pos, jnp.int32(gen.n_slots),
+                    *arrs)
             gen.n_slots += m_pad
             done += m_pad * local_shards
         self._n_local += m_local
@@ -341,8 +612,10 @@ class ShardedLeanZ3Index:
     def query_many(self, windows,
                    max_ranges: int = 2000) -> list[np.ndarray]:
         """Batched multi-window scan over every shard × generation:
-        probe + scan dispatches, host exact mask on each process's
-        payload, survivors allgathered — every process returns the same
+        probe + one scan per populated device tier + numpy seeks over
+        spilled runs.  Full-tier hits are exact on device; keys/host
+        candidates get the host exact mask on each process's payload
+        with survivors allgathered — every process returns the same
         sorted GLOBAL gid list per window."""
         n_q = len(windows)
         if n_q == 0 or self._n_total == 0:
@@ -385,50 +658,51 @@ class ShardedLeanZ3Index:
                 else max(2, self._n_total))
         pos_bits = max(1, int(np.ceil(np.log2(span))))
 
-        gens = list(self.generations)
-        n_pad = (-len(gens)) % _GEN_BUCKET
-        padded = gens + [_sentinel_gen(self.mesh,
-                                       self.generation_slots)] * n_pad
-        count_cols: list = []
-        for gen in padded:
-            count_cols += [gen.bins, gen.z]
-        self.dispatch_count += 1
-        totals = _fetch_global(_count_program(self.mesh, len(padded))(
-            rb, rlo, rhi, *count_cols))            # (n_shards, G_pad)
-        per_shard = totals.sum(axis=1)
-        if int(per_shard.max()) == 0:
-            return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
-        # per-generation outputs share one capacity slab (the program
-        # concatenates G per-gen buffers of capacity // G each); when
-        # the shared slab would exceed the per-shard budget, fall back
-        # to per-generation dispatches sized by each generation's OWN
-        # max-shard total — matching rows must never silently truncate
-        # (expand_ranges masks out everything past capacity)
-        per_gen_cap = gather_capacity(
-            int(totals.max()), minimum=self.DEFAULT_CAPACITY)
-        if per_gen_cap * len(padded) <= self.BATCH_SCAN_BUDGET:
-            groups = [list(range(len(padded)))]
-            caps = [per_gen_cap * len(padded)]
-        else:
-            gen_tot = totals.max(axis=0)        # per-gen max over shards
-            groups = [[g] for g in range(len(gens)) if int(gen_tot[g])]
-            caps = [gather_capacity(int(gen_tot[g]),
-                                    minimum=self.DEFAULT_CAPACITY)
-                    for g in range(len(gens)) if int(gen_tot[g])]
-        parts = []
-        for group, cap in zip(groups, caps):
-            scan_cols: list = []
-            for gi in group:
-                gen = padded[gi]
-                scan_cols += [gen.bins, gen.z, gen.pos]
+        full_gens = [g for g in self.generations if g.tier == "full"]
+        keys_gens = [g for g in self.generations if g.tier == "keys"]
+        host_gens = [g for g in self.generations if g.tier == "host"]
+
+        # ONE totals probe across every device generation (full + keys)
+        dev_gens = full_gens + keys_gens
+        totals = np.empty((0, 0))
+        if dev_gens:
+            padded = self._pad_bucket(dev_gens, "keys")
+            count_cols: list = []
+            for gen in padded:
+                count_cols += [gen.bins, gen.z]
             self.dispatch_count += 1
-            packed = _fetch_global(_scan_program(
-                self.mesh, len(group), cap, pos_bits)(
-                rb, rlo, rhi, rq, *scan_cols))
-            part = packed.ravel()
-            parts.append(part[part >= 0])
-        flat = np.concatenate(parts)
+            totals = _fetch_global(_count_program(self.mesh, len(padded))(
+                rb, rlo, rhi, *count_cols))        # (n_shards, G_pad)
+
+        exact_parts: list = []      # full tier — true hits already
+        cand_parts: list = []       # keys/host — need the host mask
+        if full_gens:
+            t_full = totals[:, :len(full_gens)]
+            if int(t_full.sum()):
+                boxes_c, bqid_c = self._concat_boxes(w_boxes)
+                exact_parts += self._scan_tier(
+                    full_gens, t_full, rb, rlo, rhi, rq, pos_bits,
+                    exact_args=(jnp.asarray(boxes_c),
+                                jnp.asarray(bqid_c),
+                                jnp.asarray(qtlo), jnp.asarray(qthi)))
+        if keys_gens:
+            t_keys = totals[:, len(full_gens):len(dev_gens)]
+            if int(t_keys.sum()):
+                cand_parts += self._scan_tier(
+                    keys_gens, t_keys, rb, rlo, rhi, rq, pos_bits,
+                    exact_args=None)
+        # host tier: numpy seeks over this process's spilled runs (its
+        # local rows) — no dispatch at all
+        for gen in host_gens:
+            for run in gen.runs:
+                coded = run.candidates(ra["rbin"], ra["rzlo"],
+                                       ra["rzhi"], ra["rqid"], pos_bits)
+                if len(coded):
+                    cand_parts.append(coded)
+
         mask_bits = (np.int64(1) << pos_bits) - 1
+        flat = (np.concatenate(cand_parts) if cand_parts
+                else np.empty(0, np.int64))
         qids = (flat >> pos_bits).astype(np.int64)
         gids = (flat & mask_bits).astype(np.int64)
         # exact host mask on THIS process's rows, survivors allgathered
@@ -460,9 +734,77 @@ class ShardedLeanZ3Index:
         if self._multihost:
             from .multihost import allgather_concat
             coded_hits = allgather_concat(coded_hits)
+        if exact_parts:
+            coded_hits = np.concatenate([coded_hits, *exact_parts])
         out = []
         hq = (coded_hits >> pos_bits).astype(np.int64)
         hg = (coded_hits & mask_bits).astype(np.int64)
         for q in range(n_q):
             out.append(np.unique(hg[hq == q]))
         return out
+
+    # -- scan helpers -----------------------------------------------------
+    def _pad_bucket(self, gens: list, tier: str) -> list:
+        """Pad a generation list to the compile bucket with this
+        index's shared full-size sentinel generation (zero seeks
+        match)."""
+        n_pad = (-len(gens)) % _GEN_BUCKET
+        return list(gens) + [self._sentinel(tier)] * n_pad
+
+    @staticmethod
+    def _concat_boxes(w_boxes: list):
+        """Concatenate per-window boxes with owning qids, padded to a
+        compile bucket via the shared never-matching-box convention
+        (ops/search.pad_boxes)."""
+        boxes_c = np.concatenate(w_boxes)
+        bqid_c = np.concatenate(
+            [np.full(len(b), q, dtype=np.int32)
+             for q, b in enumerate(w_boxes)])
+        _, boxes_c, bqid_c = pad_boxes(
+            boxes_c, boxes_c, pad_pow2(len(boxes_c), minimum=1), bqid_c)
+        return boxes_c, bqid_c
+
+    def _scan_tier(self, gens, totals, rb, rlo, rhi, rq, pos_bits,
+                   exact_args) -> list:
+        """Run one tier's batched scan, falling back to per-generation
+        dispatches (each sized by its OWN max-shard total) when the
+        shared-capacity batched buffer would exceed the per-shard
+        budget — matching rows must never silently truncate
+        (expand_ranges masks out everything past capacity).  Returns
+        flat int64 coded arrays (padding stripped); full-tier outputs
+        are TRUE hits, keys-tier outputs are candidates."""
+        tier = "full" if exact_args is not None else "keys"
+        per_gen_cap = gather_capacity(
+            int(totals.max()), minimum=self.DEFAULT_CAPACITY)
+        padded = self._pad_bucket(gens, tier)
+        if per_gen_cap * len(padded) <= self.BATCH_SCAN_BUDGET:
+            groups = [padded]
+            caps = [per_gen_cap * len(padded)]
+        else:
+            gen_tot = totals.max(axis=0)     # per-gen max over shards
+            groups = [[gens[g]] for g in range(len(gens))
+                      if int(gen_tot[g])]
+            caps = [gather_capacity(int(gen_tot[g]),
+                                    minimum=self.DEFAULT_CAPACITY)
+                    for g in range(len(gens)) if int(gen_tot[g])]
+        parts = []
+        for group, cap in zip(groups, caps):
+            scan_cols: list = []
+            for gen in group:
+                if tier == "full":
+                    scan_cols += [gen.bins, gen.z, gen.pos,
+                                  gen.x, gen.y, gen.t]
+                else:
+                    scan_cols += [gen.bins, gen.z, gen.pos]
+            self.dispatch_count += 1
+            if tier == "full":
+                packed = _fetch_global(_scan_program_exact(
+                    self.mesh, len(group), cap, pos_bits)(
+                    rb, rlo, rhi, rq, *exact_args, *scan_cols))
+            else:
+                packed = _fetch_global(_scan_program(
+                    self.mesh, len(group), cap, pos_bits)(
+                    rb, rlo, rhi, rq, *scan_cols))
+            part = packed.ravel()
+            parts.append(part[part >= 0])
+        return parts

@@ -155,7 +155,6 @@ class QueryPlanner:
         else:
             positions = self._sort_limit(positions, batch, query)
             local_rows = positions
-        result_batch = batch.take(local_rows)
         properties = query.properties
         if properties is None and "COLUMN_GROUP" in query.hints:
             group = query.hints["COLUMN_GROUP"]
@@ -164,6 +163,20 @@ class QueryPlanner:
                 raise ValueError(f"no column group {group!r} on "
                                  f"{self.sft.name!r}")
             properties = groups[group]
+        take_cols = None
+        if properties is not None:
+            # projection pushes INTO the take: only the projected
+            # physical columns are gathered/copied for the hit rows —
+            # a sum(score) over millions of hits must not materialize
+            # the geometry columns first (_project then just rebinds
+            # the schema)
+            take_cols = set()
+            for p in properties:
+                if self.sft.attribute(p).is_geometry:
+                    take_cols.update((f"{p}_x", f"{p}_y", f"{p}_bbox"))
+                else:
+                    take_cols.add(p)
+        result_batch = batch.take(local_rows, columns=take_cols)
         if properties is not None:
             result_batch = _project(result_batch, properties)
         if query.crs:

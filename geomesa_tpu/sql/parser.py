@@ -84,10 +84,13 @@ def _rewrite_where(text: str) -> str:
 
 class ParsedSQL:
     def __init__(self, table, columns, aggs, where, group, order,
-                 descending, limit):
+                 descending, limit, bare_count_star=False):
         self.table = table
         self.columns = columns      # projection names, or None for *
         self.aggs = aggs            # [(fn, col, alias)] when aggregating
+        #: the statement is exactly an un-aliased ``SELECT count(*)`` —
+        #: the one global-aggregate shape that returns a bare scalar
+        self.bare_count_star = bare_count_star
         self.where = where          # ECQL string or None
         self.group = group
         self.order = order
@@ -104,6 +107,7 @@ def parse_sql(text: str) -> ParsedSQL:
     select = m.group("select").strip()
     columns = None
     aggs = []
+    explicit_alias = []
     if select != "*":
         parts = [p.strip() for p in select.split(",")]
         plain = []
@@ -114,6 +118,7 @@ def parse_sql(text: str) -> ParsedSQL:
                 fn = "mean" if fn == "avg" else fn
                 col = am.group(2)
                 alias = am.group(3) or f"{fn}_{col}".replace("*", "rows")
+                explicit_alias.append(am.group(3) is not None)
                 aggs.append((fn, col, alias))
             else:
                 if not re.match(r"^\w+$", p):
@@ -122,6 +127,15 @@ def parse_sql(text: str) -> ParsedSQL:
         columns = plain or None
         if aggs and plain and m.group("group") is None:
             raise ValueError("mixing columns and aggregates needs GROUP BY")
+        seen: set = set()
+        for _, _, alias in aggs:
+            if alias in seen:
+                # results are keyed by alias — a duplicate would
+                # silently collapse to the last aggregate
+                raise ValueError(
+                    f"duplicate aggregate alias {alias!r}: use AS to "
+                    "name each aggregate uniquely")
+            seen.add(alias)
     where = m.group("where")
     if where is not None:
         where = _rewrite_where(where.strip())
@@ -130,7 +144,10 @@ def parse_sql(text: str) -> ParsedSQL:
         group=m.group("group"),
         order=m.group("order"),
         descending=(m.group("dir") or "").upper() == "DESC",
-        limit=int(m.group("limit")) if m.group("limit") else None)
+        limit=int(m.group("limit")) if m.group("limit") else None,
+        bare_count_star=(len(aggs) == 1 and not columns
+                         and aggs[0][:2] == ("count", "*")
+                         and not explicit_alias[0]))
 
 
 def sql_query(store, text: str):
@@ -146,32 +163,53 @@ def sql_query(store, text: str):
     if q.where:
         frame = frame.where(q.where)
     if q.aggs and q.group is None:
-        if len(q.aggs) == 1 and q.aggs[0][:2] == ("count", "*"):
-            return frame.count()
         # global aggregates: one scan, vectorized reductions over the
         # hit columns (SELECT sum(x), avg(y), min(z) FROM t WHERE ...)
-        if q.order is not None or q.limit is not None:
+        for fn, col, _ in q.aggs:
+            if col == "*" and fn != "count":
+                raise ValueError(f"{fn}(*) is not defined — "
+                                 "aggregate a column")
+        # LIMIT is a semantic no-op on the single result row and stays
+        # accepted (count(*) ... LIMIT 1 is a common probe idiom);
+        # ORDER BY names a column of a one-row result and is rejected
+        # like any other unsupported shape
+        if q.order is not None:
             raise ValueError(
-                "ORDER BY / LIMIT do not apply to a global aggregate "
+                "ORDER BY does not apply to a global aggregate "
                 "(the result is a single row)")
+        if all(col == "*" for _, col, _ in q.aggs):
+            # count(*)-only: the planner's count path, no row scan.
+            # A bare un-aliased count(*) keeps its scalar contract;
+            # aliased/multiple forms return the dict like every other
+            # global aggregate
+            cnt = frame.count()
+            if q.bare_count_star:
+                return cnt
+            return {alias: cnt for _, _, alias in q.aggs}
         # project ONLY the aggregated columns — a sum(score) over a
         # 100M-row store must not materialize the geometry columns
         cols = sorted({col for _, col, _ in q.aggs if col != "*"})
-        if cols:
-            frame = frame.select(*cols)
+        frame = frame.select(*cols)
         batch = frame.collect()
         out: dict = {}
         for fn, col, alias in q.aggs:
             if col == "*":
-                if fn != "count":
-                    raise ValueError(f"{fn}(*) is not defined — "
-                                     "aggregate a column")
                 out[alias] = len(batch)
                 continue
             vals = np.asarray(batch.column(col))
             if len(vals) == 0:
                 out[alias] = 0 if fn == "count" else None
                 continue
+            if fn != "count" and not np.issubdtype(vals.dtype,
+                                                   np.number):
+                # reject non-numeric columns, like the GROUP BY path:
+                # numpy's object-array sum would CONCATENATE a string
+                # column (O(n²) copying) instead of erroring.  Numeric
+                # dtypes reduce natively — an int64 sum must stay
+                # exact, not round through float64
+                raise ValueError(
+                    f"{fn}({col}) needs a numeric column; "
+                    f"{col!r} is not numeric")
             out[alias] = {
                 "count": lambda v: int(len(v)),
                 "sum": lambda v: v.sum(),

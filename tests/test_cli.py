@@ -168,3 +168,18 @@ def test_export_shapefile(catalog, tmp_path):
     geoms, attrs = read_shapefile(out, str(tmp_path / "out.dbf"))
     assert len(geoms) == 3
     assert {s.strip() for s in attrs["name"]} == {"alice", "bob", "carol"}
+
+
+def test_cli_sql_shapes(catalog, capsys):
+    """The sql command renders all three result shapes: rows, GROUP BY
+    arrays, and global-aggregate scalars (one header + one value
+    row)."""
+    cat, _ = catalog
+    main(["sql", "-c", cat, "SELECT count(*) FROM people"])
+    assert capsys.readouterr().out.strip() == "3"
+    main(["sql", "-c", cat,
+          "SELECT count(*) AS n FROM people GROUP BY name"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out[0] == "name,n" and len(out) == 4
+    main(["sql", "-c", cat, "SELECT count(*) AS n FROM people"])
+    assert capsys.readouterr().out.strip().splitlines() == ["n", "3"]

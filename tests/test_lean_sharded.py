@@ -122,3 +122,72 @@ def test_sharded_lean_empty_and_payload_provider(data):
     got = idx.query([(-74.5, 40.5, -73.5, 41.5)], None, None)
     np.testing.assert_array_equal(
         got, _brute(x, y, t, [(-74.5, 40.5, -73.5, 41.5)], None, None))
+
+
+def test_sharded_lean_default_full_tier(data):
+    """New generations carry per-shard payload by default: the exact
+    mask runs fused on device and the tier stays ``full`` under the
+    default budget."""
+    x, y, t = data
+    idx = ShardedLeanZ3Index(period="week", mesh=device_mesh(),
+                             generation_slots=1 << 13)
+    idx.append(x, y, t)
+    tiers = idx.tier_counts()
+    assert tiers["full"] == len(idx.generations)
+    assert idx.generations[0].x is not None
+
+
+def test_sharded_lean_budget_demotes_payload_then_spills(data):
+    """Tight per-shard budgets demote oldest-first — payload drops
+    before key runs spill, the active generation never spills — and
+    queries stay oracle-exact across the mixed-tier regime."""
+    x, y, t = data
+    slots = 1 << 10
+    # keys sentinel (20 B/slot) + two keys generations: forces every
+    # payload off and the oldest runs to host once 3+ generations exist
+    budget = slots * 20 * 3
+    idx = ShardedLeanZ3Index(period="week", mesh=device_mesh(),
+                             generation_slots=slots,
+                             hbm_budget_bytes=budget)
+    for s in range(0, len(x), 15_000):
+        sl = slice(s, min(s + 15_000, len(x)))
+        idx.append(x[sl], y[sl], t[sl])
+    assert len(idx.generations) >= 4
+    tiers = idx.tier_counts()
+    assert tiers["host"] >= 1, tiers
+    assert tiers["full"] == 0, tiers
+    assert idx.generations[-1].tier != "host"
+    assert idx.host_key_bytes() > 0
+    # per-shard residency honors the budget
+    assert idx._per_shard_resident() <= budget
+    box = (-74.5, 40.5, -73.5, 41.5)
+    lo, hi = MS + 2 * DAY, MS + 9 * DAY
+    np.testing.assert_array_equal(idx.query([box], lo, hi),
+                                  _brute(x, y, t, [box], lo, hi))
+    np.testing.assert_array_equal(idx.query([box], None, None),
+                                  _brute(x, y, t, [box], None, None))
+
+
+def test_sharded_lean_mixed_full_keys_oracle(data):
+    """A budget that keeps the NEWEST generation full-fat while older
+    payloads drop serves one query through the fused device-exact path
+    AND the keys candidate path together (payload drops strictly
+    oldest-first, so full + keys is the live mixed-tier regime)."""
+    x, y, t = data
+    slots = 1 << 12
+    # sentinels (keys 20 + full 44 B/slot) + one full gen + two keys
+    budget = slots * (20 + 44) + slots * 44 + 2 * slots * 20
+    idx = ShardedLeanZ3Index(period="week", mesh=device_mesh(),
+                             generation_slots=slots,
+                             hbm_budget_bytes=budget)
+    for s in range(0, len(x), 10_000):
+        sl = slice(s, min(s + 10_000, len(x)))
+        idx.append(x[sl], y[sl], t[sl])
+    tiers = idx.tier_counts()
+    assert tiers["full"] >= 1 and tiers["keys"] >= 1, tiers
+    assert idx.generations[-1].tier == "full"
+    assert sum(tiers.values()) == len(idx.generations)
+    box = (-74.5, 40.5, -73.5, 41.5)
+    lo, hi = MS + 2 * DAY, MS + 9 * DAY
+    np.testing.assert_array_equal(idx.query([box], lo, hi),
+                                  _brute(x, y, t, [box], lo, hi))

@@ -310,15 +310,157 @@ def test_lean_store_over_mesh():
                                   np.sort(b.positions))
 
 
-def test_flush_refuses_and_stats_persist(tmp_path):
+def test_lean_snapshot_roundtrip(tmp_path, monkeypatch):
+    """flush → reload for a lean schema: chunked parquet parts +
+    manifest restore rows, tombstones, visibilities, and the envelope;
+    the index rebuilds lazily through the streaming append path and
+    queries stay oracle-exact (checkpoint/resume at scale)."""
+    import os
+
+    monkeypatch.setattr(TpuDataStore, "LEAN_PART_ROWS", 1 << 12)
+    rng = np.random.default_rng(41)
+    n = 20_000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(MS, MS + 14 * DAY, n)
+    score = rng.uniform(0, 100, n)
+    ds = TpuDataStore(str(tmp_path / "cat"))
+    ds.create_schema("evt", "score:Double,dtg:Date,*geom:Point;"
+                            "geomesa.index.profile=lean")
+    ds.write("evt", {"score": score, "dtg": t, "geom": (x, y)},
+             visibility="user")
+    ds.delete("evt", ["7", "19", "4242"])
+    ds.flush("evt")
+    d = tmp_path / "cat" / "evt.lean"
+    parts = [f for f in os.listdir(d) if f.startswith("part-")]
+    assert len(parts) >= 4            # chunking actually happened
+
+    class Auth:
+        def get_authorizations(self):
+            return frozenset({"user"})
+
+    ds2 = TpuDataStore(str(tmp_path / "cat"), auth_provider=Auth())
+    st2 = ds2._store("evt")
+    assert st2.lean and len(st2.batch) == n
+    assert st2.tombstone is not None and int(st2.tombstone.sum()) == 3
+    assert st2.visibilities is not None
+    assert ds2.stat("evt", "count").count == n - 3   # live rows only
+    ecql = ("BBOX(geom, -74.5, 40.5, -73.5, 41.5) AND score > 50 AND "
+            f"dtg DURING 2018-01-03T00:00:00Z/2018-01-09T00:00:00Z")
+    got = ds2.query("evt", ecql)
+    want = _oracle(ds2, ecql)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(got.ids).astype(np.int64)), want)
+    # the reloaded store keeps ingesting through the same live path
+    ds2.write("evt", {"score": np.array([99.0]),
+                      "dtg": np.array([MS + DAY]),
+                      "geom": (np.array([-74.0]), np.array([41.0]))})
+    assert len(st2.batch) == n + 1
+
+
+def test_lean_stats_persist_without_flush(tmp_path):
     ds = TpuDataStore(str(tmp_path / "cat"))
     ds.create_schema("evt", "dtg:Date,*geom:Point;"
                             "geomesa.index.profile=lean")
     ds.write("evt", {"dtg": np.full(10, MS),
                      "geom": (np.zeros(10), np.zeros(10))})
-    with pytest.raises(ValueError, match="lean-profile"):
-        ds.flush("evt")
     ds.persist_stats("evt")
     ds2 = TpuDataStore(str(tmp_path / "cat"))
     assert ds2._store("evt").lean      # profile survives the catalog
     assert ds2.stat("evt", "count").count == 10
+    # no snapshot was flushed: rows are empty, stats still answer
+    assert len(ds2._store("evt").batch) == 0
+
+
+def test_remove_schema_clears_lean_snapshot(tmp_path):
+    """A removed schema's snapshot dir must go with it — a stale one
+    would resurrect the old rows into a later schema of the same
+    name."""
+    import os
+
+    ds = TpuDataStore(str(tmp_path / "cat"))
+    ds.create_schema("evt", "dtg:Date,*geom:Point;"
+                            "geomesa.index.profile=lean")
+    ds.write("evt", {"dtg": np.full(10, MS),
+                     "geom": (np.zeros(10), np.zeros(10))})
+    ds.flush("evt")
+    assert os.path.isdir(tmp_path / "cat" / "evt.lean")
+    ds.remove_schema("evt")
+    assert not os.path.exists(tmp_path / "cat" / "evt.lean")
+    ds.create_schema("evt", "dtg:Date,*geom:Point;"
+                            "geomesa.index.profile=lean")
+    ds2 = TpuDataStore(str(tmp_path / "cat"))
+    assert len(ds2._store("evt").batch) == 0
+
+
+def test_lean_reflush_is_crash_safe(tmp_path, monkeypatch):
+    """Re-flush writes new-stamp parts, swaps the manifest atomically,
+    THEN removes the prior flush's parts — at every intermediate point
+    the on-disk manifest references only files that exist."""
+    import json
+    import os
+
+    monkeypatch.setattr(TpuDataStore, "LEAN_PART_ROWS", 64)
+    ds = TpuDataStore(str(tmp_path / "cat"))
+    ds.create_schema("evt", "dtg:Date,*geom:Point;"
+                            "geomesa.index.profile=lean")
+    ds.write("evt", {"dtg": np.full(100, MS),
+                     "geom": (np.zeros(100), np.zeros(100))})
+    ds.flush("evt")
+    d = tmp_path / "cat" / "evt.lean"
+    first = {f for f in os.listdir(d) if f.startswith("part-")}
+    ds.write("evt", {"dtg": np.full(100, MS + DAY),
+                     "geom": (np.ones(100), np.ones(100))})
+    ds.flush("evt")
+    with open(d / "manifest.json") as f:
+        manifest = json.load(f)
+    on_disk = {f for f in os.listdir(d) if f.startswith("part-")}
+    assert manifest["stamp"] == 1
+    assert set(manifest["parts"]) == on_disk     # orphans removed
+    assert not (first & on_disk)                 # old stamp retired
+    ds2 = TpuDataStore(str(tmp_path / "cat"))
+    assert len(ds2._store("evt").batch) == 200
+
+
+def test_tight_budget_never_allocates_doomed_payload():
+    """Under a budget too small for any full-tier generation, rollovers
+    create keys-tier generations directly instead of allocating payload
+    arrays the rebalance would free moments later."""
+    from geomesa_tpu.parallel import device_mesh
+    from geomesa_tpu.parallel import lean as plean
+
+    requested = []
+    orig = plean._ShardedGen.__init__
+
+    def spy(self, mesh, slots, tier="keys"):
+        requested.append(tier)
+        orig(self, mesh, slots, tier=tier)
+
+    plean._ShardedGen.__init__ = spy
+    try:
+        rng = np.random.default_rng(3)
+        m = 40_000
+        idx = plean.ShardedLeanZ3Index(
+            period="week", mesh=device_mesh(),
+            generation_slots=1 << 10,
+            hbm_budget_bytes=(1 << 10) * 20 * 3)
+        idx.append(rng.uniform(-75, -73, m), rng.uniform(40, 42, m),
+                   rng.integers(MS, MS + 14 * DAY, m))
+    finally:
+        plean._ShardedGen.__init__ = orig
+    assert len(requested) >= 3
+    assert "full" not in requested
+
+
+def test_projection_pushes_into_take(ds):
+    """Query.properties restricts which physical columns the take
+    materializes (sum(score) over many hits must not copy geometry
+    columns); the result still carries ids and only projected
+    columns."""
+    st = ds._store("evt")
+    sub = st.batch.take(np.arange(50), columns={"score"})
+    assert set(sub.columns) == {"score"} and len(sub.ids) == 50
+    from geomesa_tpu.planning.planner import Query
+    got = ds.query("evt", Query.of(
+        "BBOX(geom,-74.5,40.5,-73.5,41.5)", properties=["score"]))
+    assert set(got.columns) == {"score"}

@@ -106,11 +106,11 @@ def test_sentinel_padding_no_extra_dispatches(data):
         s = 30_000 + i * 1000
         idx.append(x[s:s + 1000], y[s:s + 1000], t[s:s + 1000])
     assert len(idx.generations) == 5
-    from geomesa_tpu.index.z3_lean import _GEN_BUCKET, _sentinel_cols
+    from geomesa_tpu.index.z3_lean import _GEN_BUCKET
     assert _GEN_BUCKET == 4  # 5 gens pad to 8
-    # the shared sentinel generation is full-size (uniform program
-    # shapes -> one compile per bucket) and matches zero seeks
-    sb, sz, sp = _sentinel_cols("keys", 1 << 14)
+    # the shared per-instance sentinel generation is full-size (uniform
+    # program shapes -> one compile per bucket) and matches zero seeks
+    sb, sz, sp = idx._sentinel_cols("keys")
     assert sb.shape == (1 << 14,) and int(sp[0]) == -1
     before = idx.dispatch_count
     box = (-74.5, 40.5, -73.5, 41.5)
