@@ -12,9 +12,14 @@ GROUP BY aggregations run vectorized on the scan result.
 Supported grammar (single table, no joins — the reference's pushed
 fragment; anything beyond it belongs in the caller's dataframe code)::
 
-    SELECT <*|cols|aggs> FROM <schema>
+    SELECT <*|cols|aggs|DISTINCT col> FROM <schema>
       [WHERE <predicate>] [GROUP BY <col>]
+      [HAVING <alias|agg(col)> <op> <literal> [AND ...]]
       [ORDER BY <col> [ASC|DESC]] [LIMIT <n>]
+
+``SELECT <group-col> FROM t GROUP BY <group-col>`` (no aggregates) and
+``SELECT DISTINCT col`` serve the distinct-values idiom; HAVING terms
+may aggregate beyond the SELECT list (computed as hidden columns).
 
 Aggregates: count(*), count(col), sum/min/max/avg(col) with optional
 ``AS alias`` — grouped (GROUP BY) or GLOBAL (no GROUP BY: one scan,
@@ -39,9 +44,25 @@ _CLAUSE = re.compile(
     r"^\s*SELECT\s+(?P<select>.+?)\s+FROM\s+(?P<table>\w+)"
     r"(?:\s+WHERE\s+(?P<where>.+?))?"
     r"(?:\s+GROUP\s+BY\s+(?P<group>\w+))?"
+    r"(?:\s+HAVING\s+(?P<having>.+?))?"
     r"(?:\s+ORDER\s+BY\s+(?P<order>\w+)(?:\s+(?P<dir>ASC|DESC))?)?"
     r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
     re.IGNORECASE | re.DOTALL)
+
+#: one HAVING term: an alias or aggregate call compared to a literal;
+#: terms join with AND (the pushed fragment — OR/expressions belong in
+#: the caller's dataframe code, like the rest of the grammar)
+_HAVING_TERM = re.compile(
+    r"^(?:(?P<alias>\w+)|(?P<fn>count|sum|min|max|avg|mean)\s*\(\s*"
+    r"(?P<col>\*|\w+)\s*\))\s*(?P<op><=|>=|<>|!=|=|<|>)\s*"
+    r"(?P<num>[0-9.eE+-]+|'[^']*')$", re.IGNORECASE)
+
+_OPS = {
+    "=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+    "<>": lambda a, b: a != b, "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b, ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
 
 _AGG = re.compile(r"^(count|sum|min|max|avg|mean)\s*\(\s*(\*|\w+)\s*\)"
                   r"(?:\s+AS\s+(\w+))?$", re.IGNORECASE)
@@ -84,13 +105,16 @@ def _rewrite_where(text: str) -> str:
 
 class ParsedSQL:
     def __init__(self, table, columns, aggs, where, group, order,
-                 descending, limit, bare_count_star=False):
+                 descending, limit, bare_count_star=False, having=None):
         self.table = table
         self.columns = columns      # projection names, or None for *
         self.aggs = aggs            # [(fn, col, alias)] when aggregating
         #: the statement is exactly an un-aliased ``SELECT count(*)`` —
         #: the one global-aggregate shape that returns a bare scalar
         self.bare_count_star = bare_count_star
+        #: [(target, op, literal)] AND-terms; target is an alias str or
+        #: an (fn, col) aggregate pair
+        self.having = having or []
         self.where = where          # ECQL string or None
         self.group = group
         self.order = order
@@ -105,6 +129,16 @@ def parse_sql(text: str) -> ParsedSQL:
                          "SELECT ... FROM <schema> [WHERE ...] "
                          "[GROUP BY ...] [ORDER BY ...] [LIMIT n])")
     select = m.group("select").strip()
+    group = m.group("group")
+    dm = re.match(r"^DISTINCT\s+(\w+)$", select, re.IGNORECASE)
+    if dm:
+        # SELECT DISTINCT col ⇔ SELECT col GROUP BY col
+        if group is not None and group != dm.group(1):
+            raise ValueError("SELECT DISTINCT col supports grouping "
+                             "only by that column")
+        select, group = dm.group(1), dm.group(1)
+    elif re.match(r"^DISTINCT\b", select, re.IGNORECASE):
+        raise ValueError("DISTINCT supports a single column")
     columns = None
     aggs = []
     explicit_alias = []
@@ -135,19 +169,47 @@ def parse_sql(text: str) -> ParsedSQL:
                 raise ValueError(
                     f"duplicate aggregate alias {alias!r}: use AS to "
                     "name each aggregate uniquely")
+            if group is not None and alias == group:
+                # same dict: an alias shadowing the group column would
+                # silently replace the group labels with the aggregate
+                raise ValueError(
+                    f"aggregate alias {alias!r} collides with the "
+                    "GROUP BY column — alias it differently")
             seen.add(alias)
     where = m.group("where")
     if where is not None:
         where = _rewrite_where(where.strip())
+    having = []
+    if m.group("having") is not None:
+        if group is None:
+            raise ValueError("HAVING requires GROUP BY (use WHERE for "
+                             "row predicates)")
+        for term in re.split(r"\s+AND\s+", m.group("having").strip(),
+                             flags=re.IGNORECASE):
+            tm = _HAVING_TERM.match(term.strip())
+            if not tm:
+                raise ValueError(
+                    f"unsupported HAVING term {term!r} (expected "
+                    "<alias|agg(col)> <op> <literal>, AND-joined)")
+            if tm.group("alias"):
+                target = tm.group("alias")
+            else:
+                fn = tm.group("fn").lower()
+                target = ("mean" if fn == "avg" else fn,
+                          tm.group("col"))
+            lit = tm.group("num")
+            lit = lit[1:-1] if lit.startswith("'") else float(lit)
+            having.append((target, tm.group("op"), lit))
     return ParsedSQL(
         table=m.group("table"), columns=columns, aggs=aggs, where=where,
-        group=m.group("group"),
+        group=group,
         order=m.group("order"),
         descending=(m.group("dir") or "").upper() == "DESC",
         limit=int(m.group("limit")) if m.group("limit") else None,
         bare_count_star=(len(aggs) == 1 and not columns
                          and aggs[0][:2] == ("count", "*")
-                         and not explicit_alias[0]))
+                         and not explicit_alias[0]),
+        having=having)
 
 
 def sql_query(store, text: str):
@@ -219,8 +281,9 @@ def sql_query(store, text: str):
             }[fn](vals)
         return out
     if q.group is not None:
-        if not q.aggs:
-            raise ValueError("GROUP BY needs aggregate projections")
+        if not q.aggs and q.columns is None:
+            raise ValueError("SELECT * with GROUP BY is not defined — "
+                             "project the group column or aggregates")
         stray = [c for c in (q.columns or []) if c != q.group]
         if stray:
             raise ValueError(
@@ -229,7 +292,42 @@ def sql_query(store, text: str):
         spec = {alias: (q.group if col == "*" else col,
                         "count" if fn == "count" else fn)
                 for fn, col, alias in q.aggs}
+        # HAVING terms naming an un-projected aggregate compute it as a
+        # hidden column (standard SQL: HAVING may aggregate beyond the
+        # SELECT list), dropped after the mask
+        having_cols = []
+        hidden = []
+        by_agg = {(fn, col): alias for fn, col, alias in q.aggs}
+        for i, (target, op, lit) in enumerate(q.having):
+            if isinstance(target, str):
+                if target != q.group and target not in spec:
+                    raise ValueError(
+                        f"HAVING references {target!r}, which is not "
+                        "the GROUP BY column or an aggregate alias "
+                        f"(have: {sorted([q.group, *spec])})")
+                having_cols.append((target, op, lit))
+            else:
+                fn, col = target
+                alias = by_agg.get((fn, col))
+                if alias is None:
+                    alias = f"__having_{i}"
+                    spec[alias] = (q.group if col == "*" else col,
+                                   "count" if fn == "count" else fn)
+                    hidden.append(alias)
+                having_cols.append((alias, op, lit))
+        if not spec:
+            # SELECT <group-col> FROM t GROUP BY <group-col> — the
+            # DISTINCT idiom; a hidden count drives the grouping
+            spec["__distinct"] = (q.group, "count")
+            hidden.append("__distinct")
         out = frame.group_by(q.group, spec)
+        if having_cols:
+            keep = np.ones(len(np.asarray(out[q.group])), dtype=bool)
+            for alias, op, lit in having_cols:
+                keep &= _OPS[op](np.asarray(out[alias]), lit)
+            out = {k: np.asarray(v)[keep] for k, v in out.items()}
+        for alias in hidden:
+            out.pop(alias, None)
         if q.order is not None and q.order not in out:
             raise ValueError(
                 f"ORDER BY column {q.order!r} is not in the aggregation "

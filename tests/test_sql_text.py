@@ -149,3 +149,82 @@ def test_cli_sql_command(tmp_path):
 def test_group_by_rejects_stray_columns(ds):
     with pytest.raises(ValueError, match="GROUP BY"):
         sql_query(ds, "SELECT score, count(*) AS n FROM evt GROUP BY name")
+
+
+class TestHaving:
+    """HAVING filters GROUP BY output rows — by alias, by the group
+    column, or by an un-projected aggregate (computed hidden)."""
+
+    def _store(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import TpuDataStore
+        ds = TpuDataStore()
+        ds.create_schema("t", "name:String,v:Int,dtg:Date,*geom:Point")
+        names = np.array(["a"] * 5 + ["b"] * 3 + ["c"] * 2, object)
+        ds.write("t", {"name": names, "v": np.arange(10),
+                       "dtg": np.full(10, 1514764800000),
+                       "geom": (np.zeros(10), np.zeros(10))})
+        return ds
+
+    def test_having_on_alias(self):
+        import numpy as np
+        ds = self._store()
+        out = sql_query(ds, "SELECT count(*) AS n FROM t GROUP BY name "
+                            "HAVING n >= 3 ORDER BY n DESC")
+        assert list(out["name"]) == ["a", "b"]
+        assert list(np.asarray(out["n"])) == [5, 3]
+
+    def test_having_on_unprojected_aggregate(self):
+        ds = self._store()
+        out = sql_query(ds, "SELECT name FROM t GROUP BY name "
+                            "HAVING sum(v) > 10 AND count(*) < 4")
+        # sums: a=0+1+2+3+4=10, b=5+6+7=18, c=8+9=17
+        assert list(out["name"]) == ["b", "c"]
+        assert set(out) == {"name"} | set()  # hidden aggs dropped
+
+    def test_having_on_group_column_string(self):
+        ds = self._store()
+        out = sql_query(ds, "SELECT count(*) AS n FROM t GROUP BY name "
+                            "HAVING name != 'a'")
+        assert list(out["name"]) == ["b", "c"]
+
+    def test_having_requires_group_by(self):
+        ds = self._store()
+        with pytest.raises(ValueError, match="HAVING requires GROUP"):
+            sql_query(ds, "SELECT count(*) FROM t HAVING count(*) > 1")
+
+    def test_having_unknown_alias_rejected(self):
+        ds = self._store()
+        with pytest.raises(ValueError, match="HAVING references"):
+            sql_query(ds, "SELECT count(*) AS n FROM t GROUP BY name "
+                          "HAVING z > 1")
+
+
+def test_select_distinct():
+    import numpy as np
+
+    from geomesa_tpu.datastore import TpuDataStore
+    ds = TpuDataStore()
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    ds.write("t", {"name": np.array(["b", "a", "b", "c"], object),
+                   "dtg": np.full(4, 1514764800000),
+                   "geom": (np.zeros(4), np.zeros(4))})
+    out = sql_query(ds, "SELECT DISTINCT name FROM t ORDER BY name")
+    assert list(out["name"]) == ["a", "b", "c"]
+    assert set(out) == {"name"}
+    with pytest.raises(ValueError, match="single column"):
+        sql_query(ds, "SELECT DISTINCT name, dtg FROM t")
+
+
+def test_alias_shadowing_group_column_rejected():
+    import numpy as np
+
+    from geomesa_tpu.datastore import TpuDataStore
+    ds = TpuDataStore()
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    ds.write("t", {"name": np.array(["a"], object),
+                   "dtg": np.full(1, 1514764800000),
+                   "geom": (np.zeros(1), np.zeros(1))})
+    with pytest.raises(ValueError, match="collides with the GROUP BY"):
+        sql_query(ds, "SELECT count(*) AS name FROM t GROUP BY name")
