@@ -750,3 +750,76 @@ __all__ += [
     "st_polygonFromText", "st_mPointFromText", "st_mLineFromText",
     "st_mPolyFromText", "st_byteArray",
 ]
+
+
+# -- SQL projection bridge --------------------------------------------------
+
+#: st_* functions usable as SELECT-list expressions (single geometry/
+#: value column plus optional numeric literal args); the grammar's
+#: projection surface of the reference's SQLTypes UDF registration
+#: (geomesa-spark-sql SQLGeometricAccessorFunctions etc.)
+PROJECTABLE = {
+    "st_x", "st_y", "st_asText", "st_geometryType", "st_isValid",
+    "st_numPoints", "st_centroid", "st_envelope", "st_area",
+    "st_length", "st_lengthSphere", "st_bufferPoint", "st_translate",
+}
+
+
+def resolve_projectable(name: str, attr=None, n_args: int = 0) -> str:
+    """Validate a SELECT-list st_* call and return its canonical
+    function name — the SINGLE definition of projectability, shared by
+    the parser's pre-scan validation and :func:`apply_function` (every
+    check here is scan-independent: an unknown name, wrong arity, or
+    non-geometry column must not cost a 100M-row query first)."""
+    import inspect
+
+    canonical = {f.lower(): f for f in PROJECTABLE}.get(name.lower())
+    if canonical is None:       # SQL function names are case-blind
+        raise ValueError(
+            f"{name} is not a projectable function (supported: "
+            f"{sorted(PROJECTABLE)})")
+    params = list(inspect.signature(
+        globals()[canonical]).parameters.values())[1:]   # [0] = column
+    required = sum(1 for p in params
+                   if p.default is inspect.Parameter.empty)
+    if not required <= n_args <= len(params):
+        raise ValueError(
+            f"{canonical} takes {required}"
+            + (f"–{len(params)}" if len(params) > required else "")
+            + f" argument(s) after the column, got {n_args}")
+    if attr is not None and not attr.is_geometry:
+        raise ValueError(
+            f"{canonical} needs a geometry column, and "
+            f"{attr.name!r} is {attr.type}")
+    return canonical
+
+
+def apply_function(batch, name: str, col: str, *args):
+    """Evaluate a projectable st_* function over a result batch's
+    column (hit-sized — expressions run AFTER the scan, the
+    post-push-down stage of the reference's catalyst plan).  Point
+    layouts feed st_x/st_y their (x, y) tuple directly; other
+    functions see geometry objects (materialized per hit row)."""
+    attr = batch.sft.attribute(col)
+    canonical = resolve_projectable(name, attr, len(args))
+    fn = globals()[canonical]
+    packed = getattr(batch, "geoms", None)
+    if packed is not None and col == batch.sft.default_geom:
+        # the packed store holds exactly the DEFAULT geometry — keying
+        # on `geoms is not None` alone would silently answer for the
+        # wrong column
+        val = np.array([packed.geometry(i)
+                        for i in range(len(batch))], dtype=object)
+    elif f"{col}_x" in batch.columns:
+        if canonical in ("st_x", "st_y"):
+            val = batch.geom_xy(col)
+        else:
+            x, y = batch.geom_xy(col)
+            val = np.array([Point(float(a), float(b))
+                            for a, b in zip(x, y)], dtype=object)
+    else:
+        raise ValueError(
+            f"geometry column {col!r} is not projectable here: "
+            "only the default (packed) geometry or point-layout "
+            "columns can feed st_* expressions")
+    return fn(val, *args)

@@ -20,6 +20,10 @@ fragment; anything beyond it belongs in the caller's dataframe code)::
 ``SELECT <group-col> FROM t GROUP BY <group-col>`` (no aggregates) and
 ``SELECT DISTINCT col`` serve the distinct-values idiom; HAVING terms
 may aggregate beyond the SELECT list (computed as hidden columns).
+Expression projections — ``SELECT st_x(geom) AS lon, name FROM t`` —
+accept the projectable st_* surface (functions.PROJECTABLE): the scan
+pushes down, expressions evaluate on the hit rows, and the result is
+a dict of columns.
 
 Aggregates: count(*), count(col), sum/min/max/avg(col) with optional
 ``AS alias`` — grouped (GROUP BY) or GLOBAL (no GROUP BY: one scan,
@@ -64,8 +68,29 @@ _OPS = {
     ">=": lambda a, b: a >= b,
 }
 
+
+def _order_limit(out: dict, order, descending, limit) -> dict:
+    """Shared ORDER BY / LIMIT over a dict-of-columns result (grouped
+    aggregations and expression projections use the same contract)."""
+    if order is not None:
+        idx = np.argsort(np.asarray(out[order]), kind="stable")
+        if descending:
+            idx = idx[::-1]
+        if limit is not None:
+            idx = idx[:limit]
+        return {k: np.asarray(v)[idx] for k, v in out.items()}
+    if limit is not None:
+        return {k: np.asarray(v)[:limit] for k, v in out.items()}
+    return out
+
 _AGG = re.compile(r"^(count|sum|min|max|avg|mean)\s*\(\s*(\*|\w+)\s*\)"
                   r"(?:\s+AS\s+(\w+))?$", re.IGNORECASE)
+
+#: expression projection: a projectable st_* call over one column with
+#: optional numeric literal args — SELECT st_x(geom) AS lon, ...
+_EXPR = re.compile(r"^(st_\w+)\s*\(\s*(\w+)"
+                   r"((?:\s*,\s*[0-9.eE+-]+)*)\s*\)"
+                   r"(?:\s+AS\s+(\w+))?$", re.IGNORECASE)
 
 #: Spark-SQL spatial call → ECQL predicate rewrites (the SQLRules
 #: push-down step).  ``st_geomFromWKT('WKT')`` unwraps to the bare WKT.
@@ -105,10 +130,13 @@ def _rewrite_where(text: str) -> str:
 
 class ParsedSQL:
     def __init__(self, table, columns, aggs, where, group, order,
-                 descending, limit, bare_count_star=False, having=None):
+                 descending, limit, bare_count_star=False, having=None,
+                 exprs=None):
         self.table = table
         self.columns = columns      # projection names, or None for *
         self.aggs = aggs            # [(fn, col, alias)] when aggregating
+        #: [(fn, col, args, alias)] st_* expression projections
+        self.exprs = exprs or []
         #: the statement is exactly an un-aliased ``SELECT count(*)`` —
         #: the one global-aggregate shape that returns a bare scalar
         self.bare_count_star = bare_count_star
@@ -141,12 +169,17 @@ def parse_sql(text: str) -> ParsedSQL:
         raise ValueError("DISTINCT supports a single column")
     columns = None
     aggs = []
+    exprs = []
     explicit_alias = []
     if select != "*":
-        parts = [p.strip() for p in select.split(",")]
+        # split on top-level commas only (st_translate(geom, 1, 2) has
+        # commas inside the call)
+        parts = [p.strip() for p in
+                 re.split(r",(?![^()]*\))", select)]
         plain = []
         for p in parts:
             am = _AGG.match(p)
+            em = _EXPR.match(p) if am is None else None
             if am:
                 fn = am.group(1).lower()
                 fn = "mean" if fn == "avg" else fn
@@ -154,15 +187,27 @@ def parse_sql(text: str) -> ParsedSQL:
                 alias = am.group(3) or f"{fn}_{col}".replace("*", "rows")
                 explicit_alias.append(am.group(3) is not None)
                 aggs.append((fn, col, alias))
+            elif em:
+                fn = em.group(1).lower()
+                args = tuple(int(a) if re.match(r"^[+-]?\d+$", a)
+                             else float(a) for a in
+                             em.group(3).replace(",", " ").split())
+                alias = em.group(4) or f"{fn}_{em.group(2)}"
+                exprs.append((fn, em.group(2), args, alias))
             else:
                 if not re.match(r"^\w+$", p):
                     raise ValueError(f"unsupported projection {p!r}")
                 plain.append(p)
         columns = plain or None
-        if aggs and plain and m.group("group") is None:
+        if aggs and (plain or exprs) and m.group("group") is None:
             raise ValueError("mixing columns and aggregates needs GROUP BY")
-        seen: set = set()
-        for _, _, alias in aggs:
+        if exprs and (aggs or group is not None):
+            raise ValueError("expression projections do not compose "
+                             "with aggregates/GROUP BY (aggregate in "
+                             "the caller over the expression output)")
+        seen: set = set(plain)
+        for _, _, alias in ([(None, None, a) for _, _, _, a in exprs]
+                            + aggs):
             if alias in seen:
                 # results are keyed by alias — a duplicate would
                 # silently collapse to the last aggregate
@@ -209,7 +254,7 @@ def parse_sql(text: str) -> ParsedSQL:
         bare_count_star=(len(aggs) == 1 and not columns
                          and aggs[0][:2] == ("count", "*")
                          and not explicit_alias[0]),
-        having=having)
+        having=having, exprs=exprs)
 
 
 def sql_query(store, text: str):
@@ -333,19 +378,58 @@ def sql_query(store, text: str):
                 f"ORDER BY column {q.order!r} is not in the aggregation "
                 f"output (have: {sorted(out)}); order by the GROUP BY "
                 "column or an aggregate alias")
-        if q.order is not None:
-            key = out[q.order]
-            idx = np.argsort(key, kind="stable")
-            if q.descending:
-                idx = idx[::-1]
-            if q.limit is not None:
-                idx = idx[: q.limit]
-            out = {k: np.asarray(v)[idx] for k, v in out.items()}
-        elif q.limit is not None:
-            out = {k: np.asarray(v)[: q.limit] for k, v in out.items()}
-        return out
-    # row query: projection / sort / limit push into the planner Query
+        return _order_limit(out, q.order, q.descending, q.limit)
     from ..planning.planner import Query
+    if q.exprs:
+        # expression projections: the scan pushes down (filter,
+        # referenced base columns, and sort/limit when the sort key is
+        # a schema attribute); st_* expressions evaluate on the hit
+        # batch (the post-push-down stage of the catalyst plan) and
+        # the result is a dict of columns keyed by projection name
+        from .functions import apply_function, resolve_projectable
+        sft = store.get_schema(q.table)
+        # every scan-independent validation runs BEFORE the scan — an
+        # unknown function/column/arity must not cost a 100M-row query
+        # first (resolve_projectable is the single definition)
+        for fn, col, args, _ in q.exprs:
+            resolve_projectable(fn, sft.attribute(col), len(args))
+        for c in (q.columns or []):
+            if sft.attribute(c).is_geometry:
+                raise ValueError(
+                    f"project the geometry column {c!r} through an "
+                    "expression (st_asText/st_x/st_y) in an "
+                    "expression query")
+        aliases = {alias for _, _, _, alias in q.exprs}
+        attr_names = {a.name for a in sft.attributes}
+        # ORDER BY resolves aliases first (post-sort), then any schema
+        # attribute (pre-projection pushdown — the plain path's
+        # behavior)
+        pushed_sort = (q.order if q.order is not None
+                       and q.order not in aliases
+                       and q.order in attr_names else None)
+        base = sorted({col for _, col, _, _ in q.exprs}
+                      | set(q.columns or []))
+        query = Query(filter=frame._filter, properties=base,
+                      sort_by=pushed_sort, sort_desc=q.descending,
+                      max_features=q.limit if (pushed_sort
+                                               or q.order is None)
+                      else None)
+        batch = store.query(q.table, query)
+        out = {}
+        for c in (q.columns or []):
+            out[c] = np.asarray(batch.column(c))
+        for fn, col, args, alias in q.exprs:
+            out[alias] = np.asarray(apply_function(batch, fn, col,
+                                                   *args))
+        if pushed_sort is not None:
+            return out
+        if q.order is not None and q.order not in out:
+            raise ValueError(
+                f"ORDER BY column {q.order!r} is not in the "
+                f"projection output or the schema (have: "
+                f"{sorted(set(out) | attr_names)})")
+        return _order_limit(out, q.order, q.descending, q.limit)
+    # row query: projection / sort / limit push into the planner Query
     query = Query(filter=frame._filter, properties=q.columns,
                   sort_by=q.order, sort_desc=q.descending,
                   max_features=q.limit)

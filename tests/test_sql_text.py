@@ -228,3 +228,111 @@ def test_alias_shadowing_group_column_rejected():
                    "geom": (np.zeros(1), np.zeros(1))})
     with pytest.raises(ValueError, match="collides with the GROUP BY"):
         sql_query(ds, "SELECT count(*) AS name FROM t GROUP BY name")
+
+
+class TestExpressionProjections:
+    """SELECT-list st_* expressions (the reference's SQLTypes UDF
+    surface): push-down scan, per-hit evaluation, dict-of-columns
+    result."""
+
+    def _store(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import TpuDataStore
+        ds = TpuDataStore()
+        ds.create_schema("t", "name:String,v:Double,dtg:Date,"
+                              "*geom:Point")
+        self.x = np.array([-74.0, 2.3, 116.4])
+        self.y = np.array([40.7, 48.8, 39.9])
+        ds.write("t", {"name": np.array(["a", "b", "c"], object),
+                       "v": np.array([1.0, 2.0, 3.0]),
+                       "dtg": np.full(3, 1514764800000),
+                       "geom": (self.x, self.y)})
+        return ds
+
+    def test_st_x_y_with_plain_columns(self):
+        import numpy as np
+        ds = self._store()
+        out = sql_query(ds, "SELECT st_x(geom) AS lon, st_y(geom) AS "
+                            "lat, name FROM t ORDER BY lon")
+        order = np.argsort(self.x)
+        np.testing.assert_allclose(out["lon"], self.x[order])
+        np.testing.assert_allclose(out["lat"], self.y[order])
+        assert list(out["name"]) == list(
+            np.array(["a", "b", "c"], object)[order])
+
+    def test_st_astext_and_translate(self):
+        ds = self._store()
+        out = sql_query(ds, "SELECT st_asText(geom) FROM t WHERE "
+                            "name = 'a'")
+        assert out["st_astext_geom"][0] == "POINT (-74 40.7)"
+        out = sql_query(ds, "SELECT st_translate(geom, 1, 2) AS g "
+                            "FROM t WHERE name = 'a'")
+        g = out["g"][0]
+        assert abs(g.x - -73.0) < 1e-12 and abs(g.y - 42.7) < 1e-12
+
+    def test_pushed_filter_and_limit(self):
+        ds = self._store()
+        out = sql_query(ds, "SELECT st_x(geom) AS lon FROM t WHERE "
+                            "BBOX(geom,-80,35,10,50) LIMIT 1")
+        assert len(out["lon"]) == 1
+
+    def test_exprs_reject_aggregate_mix(self):
+        ds = self._store()
+        with pytest.raises(ValueError, match="expression projections"):
+            sql_query(ds, "SELECT st_x(geom), count(*) FROM t "
+                          "GROUP BY name")
+
+    def test_unknown_function_rejected(self):
+        ds = self._store()
+        with pytest.raises(ValueError, match="not a projectable"):
+            sql_query(ds, "SELECT st_nonsense(geom) FROM t")
+
+
+def test_expr_order_by_unprojected_schema_column():
+    import numpy as np
+
+    from geomesa_tpu.datastore import TpuDataStore
+    ds = TpuDataStore()
+    ds.create_schema("t", "v:Double,dtg:Date,*geom:Point")
+    ds.write("t", {"v": np.array([3.0, 1.0, 2.0]),
+                   "dtg": np.full(3, 1514764800000),
+                   "geom": (np.array([1.0, 2.0, 3.0]),
+                            np.zeros(3))})
+    out = sql_query(ds, "SELECT st_x(geom) AS lon FROM t ORDER BY v")
+    np.testing.assert_allclose(out["lon"], [2.0, 3.0, 1.0])
+
+
+def test_expr_secondary_packed_geometry_rejected():
+    import numpy as np
+
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.geometry.types import Polygon
+    ds = TpuDataStore()
+    ds.create_schema("t", "dtg:Date,*shape:Polygon")
+    poly = Polygon(np.array([(0.0, 0), (1, 0), (1, 1), (0.0, 0)]))
+    ds.write("t", {"dtg": np.full(1, 1514764800000), "shape": [poly]})
+    out = sql_query(ds, "SELECT st_asText(shape) AS w FROM t")
+    assert out["w"][0].startswith("POLYGON")
+
+
+def test_expr_validation_pre_scan():
+    """Unknown function, bad arity, non-geometry column, and unknown
+    ORDER BY all raise ValueError before any scan runs."""
+    import numpy as np
+
+    from geomesa_tpu.datastore import TpuDataStore
+    ds = TpuDataStore()
+    ds.create_schema("t", "v:Double,dtg:Date,*geom:Point")
+    ds.write("t", {"v": np.ones(3), "dtg": np.full(3, 1514764800000),
+                   "geom": (np.zeros(3), np.zeros(3))})
+    with pytest.raises(ValueError, match="argument"):
+        sql_query(ds, "SELECT st_bufferPoint(geom) FROM t")
+    with pytest.raises(ValueError, match="needs a geometry column"):
+        sql_query(ds, "SELECT st_x(v) FROM t")
+    with pytest.raises(ValueError, match="projection output or the"):
+        sql_query(ds, "SELECT st_x(geom) AS lon FROM t ORDER BY bogus")
+    # optional args within bounds still pass
+    out = sql_query(ds, "SELECT st_bufferPoint(geom, 1000, 8) AS b "
+                        "FROM t LIMIT 1")
+    assert len(out["b"]) == 1
