@@ -290,6 +290,17 @@ def cmd_fs_partitions(args):
               f"\t{info[name]['features']} features")
 
 
+def cmd_flush(args):
+    """Persist a schema's rows to the catalog (parquet; lean schemas
+    write chunked crash-safe snapshots) — the checkpoint command."""
+    ds = _store(args)
+    ds.flush(args.feature_name)
+    st = ds._store(args.feature_name)
+    n = len(st.batch) if st.batch is not None else 0
+    kind = "lean snapshot" if st.lean else "parquet"
+    print(f"flushed {n} features of {args.feature_name} ({kind})")
+
+
 def cmd_version(args):
     from .. import __version__
     print(f"geomesa-tpu {__version__}")
@@ -360,6 +371,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = add("stats-analyze", cmd_stats_analyze,
              help="recompute and persist stats")
+    catalog(sp)
+
+    sp = add("flush", cmd_flush,
+             help="checkpoint a schema's rows to the catalog")
     catalog(sp)
 
     sp = add("age-off", cmd_age_off, help="expire rows older than a "

@@ -764,6 +764,11 @@ PROJECTABLE = {
     "st_length", "st_lengthSphere", "st_bufferPoint", "st_translate",
 }
 
+#: projectable functions whose OUTPUT is geometry objects — their
+#: aliases cannot drive ORDER BY (geometries have no order)
+GEOM_VALUED = {"st_centroid", "st_envelope", "st_bufferPoint",
+               "st_translate"}
+
 
 def resolve_projectable(name: str, attr=None, n_args: int = 0) -> str:
     """Validate a SELECT-list st_* call and return its canonical

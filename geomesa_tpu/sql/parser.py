@@ -386,13 +386,21 @@ def sql_query(store, text: str):
         # a schema attribute); st_* expressions evaluate on the hit
         # batch (the post-push-down stage of the catalyst plan) and
         # the result is a dict of columns keyed by projection name
-        from .functions import apply_function, resolve_projectable
+        from .functions import (
+            GEOM_VALUED, apply_function, resolve_projectable,
+        )
         sft = store.get_schema(q.table)
         # every scan-independent validation runs BEFORE the scan — an
         # unknown function/column/arity must not cost a 100M-row query
         # first (resolve_projectable is the single definition)
-        for fn, col, args, _ in q.exprs:
-            resolve_projectable(fn, sft.attribute(col), len(args))
+        for fn, col, args, alias in q.exprs:
+            canonical = resolve_projectable(fn, sft.attribute(col),
+                                            len(args))
+            if q.order == alias and canonical in GEOM_VALUED:
+                raise ValueError(
+                    f"ORDER BY {alias!r} is not defined: "
+                    f"{canonical} produces geometry values (order by "
+                    "st_x/st_y/a measure instead)")
         for c in (q.columns or []):
             if sft.attribute(c).is_geometry:
                 raise ValueError(

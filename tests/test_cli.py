@@ -183,3 +183,20 @@ def test_cli_sql_shapes(catalog, capsys):
     assert out[0] == "name,n" and len(out) == 4
     main(["sql", "-c", cat, "SELECT count(*) AS n FROM people"])
     assert capsys.readouterr().out.strip().splitlines() == ["n", "3"]
+
+
+def test_cli_flush_checkpoint(tmp_path, capsys):
+    cat = str(tmp_path / "cat2")
+    main(["create-schema", "-c", cat, "-f", "evt",
+          "-s", "dtg:Date,*geom:Point;geomesa.index.profile=lean"])
+    from geomesa_tpu.datastore import TpuDataStore
+
+    ds = TpuDataStore(cat)
+    ds.write("evt", {"dtg": np.full(5, 1514764800000),
+                     "geom": (np.zeros(5), np.zeros(5))})
+    ds.flush("evt")
+    capsys.readouterr()
+    main(["flush", "-c", cat, "-f", "evt"])
+    assert "lean snapshot" in capsys.readouterr().out
+    ds2 = TpuDataStore(cat)
+    assert len(ds2._store("evt").batch) == 5

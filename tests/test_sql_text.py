@@ -336,3 +336,16 @@ def test_expr_validation_pre_scan():
     out = sql_query(ds, "SELECT st_bufferPoint(geom, 1000, 8) AS b "
                         "FROM t LIMIT 1")
     assert len(out["b"]) == 1
+
+
+def test_order_by_geometry_valued_alias_rejected():
+    import numpy as np
+
+    from geomesa_tpu.datastore import TpuDataStore
+    ds = TpuDataStore()
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.write("t", {"dtg": np.full(2, 1514764800000),
+                   "geom": (np.zeros(2), np.zeros(2))})
+    with pytest.raises(ValueError, match="produces geometry values"):
+        sql_query(ds, "SELECT st_translate(geom, 1, 2) AS g FROM t "
+                      "ORDER BY g")
