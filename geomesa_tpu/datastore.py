@@ -966,6 +966,15 @@ class TpuDataStore:
                             os.replace(old, os.path.join(
                                 self._catalog_dir, f"{sft.name}{suffix}"))
                     import shutil
+                    # stale target-name leftovers (crashed remove of an
+                    # old schema) must not fold into the renamed one
+                    for p in self._proc_stats_files(sft.name):
+                        os.remove(p)
+                    for p in self._proc_stats_files(name):
+                        f = os.path.basename(p)
+                        os.replace(p, os.path.join(
+                            self._catalog_dir,
+                            sft.name + f[len(name):]))
                     for d in self._lean_snapshot_dirs(name):
                         target = os.path.join(
                             self._catalog_dir,
@@ -989,6 +998,8 @@ class TpuDataStore:
                     path = os.path.join(self._catalog_dir, f"{name}{suffix}")
                     if os.path.exists(path):
                         os.remove(path)
+                for p in self._proc_stats_files(name):
+                    os.remove(p)
                 # lean snapshot dirs too: a stale snapshot would
                 # resurrect the removed schema's rows into a later
                 # schema of the same name
@@ -1734,11 +1745,54 @@ class TpuDataStore:
         self.persist_stats(name)
         return 0 if store.batch is None else len(store.batch)
 
+    def _stats_path(self, name: str, store) -> str:
+        """Per-schema stats file.  Multihost (with >1 process, matching
+        the lean id-prefix gating in _init_lean): sketches hold THIS
+        process's local observations, so each process persists (and
+        reloads) its own file — a shared path would race on write and
+        answer with one arbitrary process's locals on load."""
+        suffix = ""
+        if store.multihost:
+            import jax
+            if jax.process_count() > 1:
+                suffix = f".p{jax.process_index()}"
+        return os.path.join(self._catalog_dir,
+                            f"{name}{suffix}.stats.json")
+
+    def _proc_stats_files(self, name: str) -> list[str]:
+        """Per-process multihost stats files (``{name}.pN.stats.json``)
+        in the catalog — the single definition of that naming scheme
+        (rename/remove/merge all use it)."""
+        import re as _re
+        if not self._catalog_dir or not os.path.isdir(self._catalog_dir):
+            return []
+        pat = _re.compile(_re.escape(name) + r"\.p\d+\.stats\.json")
+        return sorted(os.path.join(self._catalog_dir, f)
+                      for f in os.listdir(self._catalog_dir)
+                      if pat.fullmatch(f))
+
     def persist_stats(self, name: str) -> None:
         if not self._catalog_dir:
             return
         store = self._store(name)
-        path = os.path.join(self._catalog_dir, f"{name}.stats.json")
+        path = self._stats_path(name, store)
+        # prune superseded artifacts so a later topology-boundary load
+        # cannot merge them in: a single-controller persist retires the
+        # whole per-process family; a multihost persist (process 0)
+        # retires files from a LARGER prior topology (p >= count)
+        shared = os.path.join(self._catalog_dir, f"{name}.stats.json")
+        if path == shared:
+            for p in self._proc_stats_files(name):
+                os.remove(p)
+        else:
+            import jax
+            if jax.process_index() == 0:
+                count = jax.process_count()
+                for p in self._proc_stats_files(name):
+                    pn = int(os.path.basename(p).rsplit(
+                        ".stats.json", 1)[0].rsplit(".p", 1)[1])
+                    if pn >= count:
+                        os.remove(p)
         with open(path, "w") as f:
             # __meta__ rides along with the sketches: the auto-id
             # counter must survive reload, or deleting the highest ids
@@ -1749,26 +1803,71 @@ class TpuDataStore:
                           for k, s in store._stats.items()}}, f)
 
     def load_stats(self, name: str) -> None:
+        """Reload persisted sketches + the fid counter, across PROCESS
+        TOPOLOGY boundaries: the newest artifact family wins (mtime —
+        a stale shared file must not shadow newer per-process files or
+        vice versa, or next_fid would regress and REUSE deleted ids),
+        per-process files merge on a single-controller open, and a
+        shared (global) file opened multihost loads its sketches on
+        process 0 ONLY — every process loading global sketches as its
+        'locals' would count each row process_count times through the
+        global stats merge.  next_fid takes the max over EVERY stats
+        artifact regardless of recency (monotone-safe)."""
         if not self._catalog_dir:
             return
-        path = os.path.join(self._catalog_dir, f"{name}.stats.json")
-        if os.path.exists(path):
+        store = self._store(name)
+        own = self._stats_path(name, store)
+        shared = os.path.join(self._catalog_dir, f"{name}.stats.json")
+        procs = self._proc_stats_files(name)
+
+        def mtime(p):
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return -1.0
+
+        # (path, load_sketches) sources; next_fid reads every artifact
+        sources: list = []
+        if own == shared:       # single-controller (or 1-proc multihost)
+            if procs and max(map(mtime, procs)) > mtime(shared):
+                sources = [(p, True) for p in procs]
+            elif os.path.exists(shared):
+                sources = [(shared, True)]
+        else:                   # multihost, >1 process
+            import jax
+            if os.path.exists(own) and mtime(own) >= mtime(shared):
+                sources = [(own, True)]
+            elif os.path.exists(shared):
+                sources = [(shared, jax.process_index() == 0)]
+        for p in {shared, own, *procs}:
+            if os.path.exists(p) and p not in {s for s, _ in sources}:
+                sources.append((p, False))
+        if not sources:
+            return
+        drop_freq = getattr(self, "_catalog_found_version",
+                            CATALOG_VERSION) < 3
+        merged: dict = {}
+        for path, with_sketches in sources:
             with open(path) as f:
                 raw = json.load(f)
-            store = self._store(name)
             meta = raw.pop("__meta__", None)  # absent in older catalogs
             if meta is not None:
                 store.next_fid = max(store.next_fid,
                                      int(meta.get("next_fid", 0)))
-            if getattr(self, "_catalog_found_version",
-                       CATALOG_VERSION) < 3:
+            if not with_sketches:
+                continue
+            if drop_freq:
                 # pre-v3 Frequency tables used the old string hashing —
                 # reading them with the current hash would answer from
                 # the wrong buckets; drop them (rebuilt by the next
                 # stats_analyze)
                 raw = {k: v for k, v in raw.items()
                        if v.get("kind") != "frequency"}
-            store._stats = {k: stat_from_json(v) for k, v in raw.items()}
+            for k, v in raw.items():
+                s = stat_from_json(v)
+                merged[k] = merged[k].merge(s) if k in merged else s
+        if merged:
+            store._stats = merged
 
     # -- data persistence (FSDS-analog: parquet files under the catalog) --
     def flush(self, name: str) -> None:
@@ -1969,11 +2068,16 @@ class TpuDataStore:
             else:
                 store.visibilities = np.full(len(store.batch), "",
                                              dtype=object)
-            self.load_stats(name)
-            # rebuild stats if none were persisted
-            if store._stats["count"].count == 0 and len(store.batch):
-                for s in store._stats.values():
-                    s.observe(store.batch)
+        # persisted sketches + the fid counter load whether or not rows
+        # were ever flushed (stats_analyze without flush must survive a
+        # reopen, and so must next_fid — ids are never reused)
+        store = self._schemas[name]
+        self.load_stats(name)
+        # rebuild stats if none were persisted
+        if (store.batch is not None and len(store.batch)
+                and store._stats["count"].count == 0):
+            for s in store._stats.values():
+                s.observe(store.batch)
 
     def _load_catalog(self) -> None:
         for fn in os.listdir(self._catalog_dir):

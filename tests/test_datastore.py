@@ -434,3 +434,68 @@ def test_sampling_hints(store):
     got2 = store.query("events", q2)
     assert set(got2.column("name")) == {"alpha", "beta", "gamma", "delta"}
     assert len(got2) < 100
+
+
+def test_stats_mode_boundary_merge(tmp_path):
+    """A catalog whose stats were written per-process (multihost
+    {name}.pN.stats.json) still answers when reopened single-host: the
+    per-process sketches merge, and next_fid takes the max."""
+    import json
+    import os
+
+    cat = tmp_path / "cat"
+    ds = TpuDataStore(str(cat))
+    ds.create_schema("evt", "v:Double,dtg:Date,*geom:Point")
+    ds.write("evt", {"v": np.array([1.0, 5.0]),
+                     "dtg": np.full(2, 1514764800000),
+                     "geom": (np.zeros(2), np.zeros(2))})
+    ds.persist_stats("evt")
+    shared = cat / "evt.stats.json"
+    raw = json.loads(shared.read_text())
+    # simulate a multihost-written catalog: two per-process files with
+    # disjoint observations, no shared file
+    half = dict(raw)
+    half["__meta__"] = {"next_fid": 7}
+    (cat / "evt.p0.stats.json").write_text(json.dumps(half))
+    half2 = dict(raw)
+    half2["__meta__"] = {"next_fid": 11}
+    (cat / "evt.p1.stats.json").write_text(json.dumps(half2))
+    os.remove(shared)
+    ds2 = TpuDataStore(str(cat))
+    st = ds2._store("evt")
+    # merged count doubles (two copies of the same sketch), proving the
+    # merge path ran; next_fid is the max over processes
+    assert st._stats["count"].count == 4
+    assert st.next_fid >= 11
+
+
+def test_stats_stale_shared_does_not_shadow(tmp_path):
+    """Recency picks the sketch source across topology boundaries: a
+    stale shared stats file must not shadow newer per-process files,
+    and next_fid maxes over EVERY artifact (ids are never reused)."""
+    import json
+    import os
+    import time
+
+    cat = tmp_path / "cat"
+    ds = TpuDataStore(str(cat))
+    ds.create_schema("evt", "v:Double,dtg:Date,*geom:Point")
+    ds.write("evt", {"v": np.array([2.0]),
+                     "dtg": np.full(1, 1514764800000),
+                     "geom": (np.zeros(1), np.zeros(1))})
+    ds.persist_stats("evt")
+    shared = cat / "evt.stats.json"
+    raw = json.loads(shared.read_text())
+    newer = dict(raw)
+    newer["__meta__"] = {"next_fid": 40}
+    (cat / "evt.p0.stats.json").write_text(json.dumps(newer))
+    # shared carries the HIGHEST fid but is older than the .p0 file
+    stale = dict(raw)
+    stale["__meta__"] = {"next_fid": 99}
+    shared.write_text(json.dumps(stale))
+    old = time.time() - 1000
+    os.utime(shared, (old, old))
+    ds2 = TpuDataStore(str(cat))
+    st = ds2._store("evt")
+    assert st._stats["count"].count == 1    # .p0 sketches, not doubled
+    assert st.next_fid >= 99                # fid still maxes over ALL
