@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from contextlib import contextmanager
 
@@ -914,6 +915,15 @@ class TpuDataStore:
             sft = sft_or_name
         else:
             sft = parse_spec(sft_or_name, spec)
+        if not re.fullmatch(r"[A-Za-z0-9_-]+", sft.name):
+            # catalog artifacts encode structure in filename suffixes
+            # ({name}.stats.json, {name}.pN.stats.json, {name}.lean.pN)
+            # — a dotted schema name would collide with another
+            # schema's artifact grammar (the reference's stores
+            # restrict table-backed names the same way)
+            raise ValueError(
+                f"invalid schema name {sft.name!r}: letters, digits, "
+                "underscore and dash only")
         if sft.name in self._schemas:
             raise ValueError(f"schema {sft.name!r} already exists")
         with self._catalog_lock():
@@ -946,10 +956,26 @@ class TpuDataStore:
             # validate BEFORE mutating: a raise below this point would
             # leave store.sft renamed in memory while the catalog (and
             # the old name's registration) still say otherwise
-            if sft.name != name and sft.name in self._schemas:
-                raise ValueError(
-                    f"cannot rename schema {name!r} to {sft.name!r}"
-                    ": that schema already exists")
+            if sft.name != name:
+                if not re.fullmatch(r"[A-Za-z0-9_-]+", sft.name):
+                    # same grammar create_schema enforces — a dotted
+                    # rename would re-create the artifact-suffix
+                    # collisions the validation exists to prevent
+                    raise ValueError(
+                        f"invalid schema name {sft.name!r}: letters, "
+                        "digits, underscore and dash only")
+                on_disk = (self._catalog_dir and os.path.exists(
+                    os.path.join(self._catalog_dir,
+                                 f"{sft.name}.schema.json")))
+                if sft.name in self._schemas or on_disk:
+                    # on-disk re-check under the lock, like
+                    # create_schema: another process sharing the
+                    # catalog may have created the target since we
+                    # loaded — the rename path destroys target-name
+                    # artifacts and must never hit a LIVE schema
+                    raise ValueError(
+                        f"cannot rename schema {name!r} to "
+                        f"{sft.name!r}: that schema already exists")
             store.sft = sft
             self._interceptors.pop(name, None)
             if sft.name != name:
@@ -962,14 +988,24 @@ class TpuDataStore:
                                    ".stats.json", ".vis.json"):
                         old = os.path.join(self._catalog_dir,
                                            f"{name}{suffix}")
+                        target = os.path.join(self._catalog_dir,
+                                              f"{sft.name}{suffix}")
                         if os.path.exists(old):
-                            os.replace(old, os.path.join(
-                                self._catalog_dir, f"{sft.name}{suffix}"))
+                            os.replace(old, target)
+                        elif os.path.exists(target):
+                            # stale target leftover (crashed remove of
+                            # an old schema) with no source to replace
+                            # it: mtime recency in load_stats would let
+                            # it shadow the renamed schema's artifacts
+                            os.remove(target)
                     import shutil
                     # stale target-name leftovers (crashed remove of an
-                    # old schema) must not fold into the renamed one
+                    # old schema) must not fold into the renamed one —
+                    # stats files AND row snapshot dirs
                     for p in self._proc_stats_files(sft.name):
                         os.remove(p)
+                    for d in self._lean_snapshot_dirs(sft.name):
+                        shutil.rmtree(d, ignore_errors=True)
                     for p in self._proc_stats_files(name):
                         f = os.path.basename(p)
                         os.replace(p, os.path.join(
@@ -1763,10 +1799,9 @@ class TpuDataStore:
         """Per-process multihost stats files (``{name}.pN.stats.json``)
         in the catalog — the single definition of that naming scheme
         (rename/remove/merge all use it)."""
-        import re as _re
         if not self._catalog_dir or not os.path.isdir(self._catalog_dir):
             return []
-        pat = _re.compile(_re.escape(name) + r"\.p\d+\.stats\.json")
+        pat = re.compile(re.escape(name) + r"\.p\d+\.stats\.json")
         return sorted(os.path.join(self._catalog_dir, f)
                       for f in os.listdir(self._catalog_dir)
                       if pat.fullmatch(f))
@@ -1775,32 +1810,54 @@ class TpuDataStore:
         if not self._catalog_dir:
             return
         store = self._store(name)
-        path = self._stats_path(name, store)
-        # prune superseded artifacts so a later topology-boundary load
-        # cannot merge them in: a single-controller persist retires the
-        # whole per-process family; a multihost persist (process 0)
-        # retires files from a LARGER prior topology (p >= count)
-        shared = os.path.join(self._catalog_dir, f"{name}.stats.json")
-        if path == shared:
-            for p in self._proc_stats_files(name):
-                os.remove(p)
-        else:
-            import jax
-            if jax.process_index() == 0:
-                count = jax.process_count()
-                for p in self._proc_stats_files(name):
-                    pn = int(os.path.basename(p).rsplit(
-                        ".stats.json", 1)[0].rsplit(".p", 1)[1])
-                    if pn >= count:
-                        os.remove(p)
-        with open(path, "w") as f:
-            # __meta__ rides along with the sketches: the auto-id
-            # counter must survive reload, or deleting the highest ids
-            # then reopening would re-derive a lower counter from the
-            # surviving rows and resurrect deleted ids
-            json.dump({"__meta__": {"next_fid": store.next_fid},
-                       **{k: s.to_json()
-                          for k, s in store._stats.items()}}, f)
+        with self._catalog_lock():
+            path = self._stats_path(name, store)
+            # COMMIT FIRST (tmp + atomic replace, the _flush_lean
+            # discipline): a crash must never leave the catalog with
+            # the old artifacts pruned and the new file missing or
+            # truncated — next_fid would regress and REUSE deleted ids
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                # __meta__ rides along with the sketches: the auto-id
+                # counter must survive reload, or deleting the highest
+                # ids then reopening would re-derive a lower counter
+                # from the surviving rows and resurrect deleted ids
+                json.dump({"__meta__": {"next_fid": store.next_fid},
+                           **{k: s.to_json()
+                              for k, s in store._stats.items()}}, f)
+            os.replace(tmp, path)
+            # then prune superseded artifacts so a later topology-
+            # boundary load cannot merge them in: a single-controller
+            # persist retires the whole per-process family; a multihost
+            # persist (process 0) retires files from a LARGER prior
+            # topology (p >= count) — but never one whose .lean.pN row
+            # snapshot still exists: its sketches were never merged
+            # anywhere, and a later reopen at the old topology would
+            # serve those rows with zeroed stats
+            shared = os.path.join(self._catalog_dir,
+                                  f"{name}.stats.json")
+            if path == shared:
+                victims = self._proc_stats_files(name)
+            else:
+                import jax
+                victims = []
+                if jax.process_index() == 0:
+                    count = jax.process_count()
+                    for p in self._proc_stats_files(name):
+                        pn = int(os.path.basename(p).rsplit(
+                            ".stats.json", 1)[0].rsplit(".p", 1)[1])
+                        if pn >= count:
+                            victims.append(p)
+            for p in victims:
+                pn_tag = os.path.basename(p).rsplit(
+                    ".stats.json", 1)[0].rsplit(".p", 1)[1]
+                if os.path.isdir(os.path.join(
+                        self._catalog_dir, f"{name}.lean.p{pn_tag}")):
+                    continue
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass   # concurrent persist already pruned it
 
     def load_stats(self, name: str) -> None:
         """Reload persisted sketches + the fid counter, across PROCESS
@@ -1816,6 +1873,10 @@ class TpuDataStore:
         if not self._catalog_dir:
             return
         store = self._store(name)
+        with self._catalog_lock():
+            self._load_stats_locked(name, store)
+
+    def _load_stats_locked(self, name: str, store) -> None:
         own = self._stats_path(name, store)
         shared = os.path.join(self._catalog_dir, f"{name}.stats.json")
         procs = self._proc_stats_files(name)
@@ -1847,9 +1908,13 @@ class TpuDataStore:
         drop_freq = getattr(self, "_catalog_found_version",
                             CATALOG_VERSION) < 3
         merged: dict = {}
+        poisoned: set = set()
         for path, with_sketches in sources:
-            with open(path) as f:
-                raw = json.load(f)
+            try:
+                with open(path) as f:
+                    raw = json.load(f)
+            except FileNotFoundError:
+                continue   # pruned by a concurrent persist mid-listing
             meta = raw.pop("__meta__", None)  # absent in older catalogs
             if meta is not None:
                 store.next_fid = max(store.next_fid,
@@ -1864,8 +1929,22 @@ class TpuDataStore:
                 raw = {k: v for k, v in raw.items()
                        if v.get("kind") != "frequency"}
             for k, v in raw.items():
+                if k in poisoned:
+                    continue
                 s = stat_from_json(v)
-                merged[k] = merged[k].merge(s) if k in merged else s
+                if k not in merged:
+                    merged[k] = s
+                    continue
+                try:
+                    merged[k] = merged[k].merge(s)
+                except ValueError:
+                    # per-process sketches can be structurally
+                    # incompatible (e.g. histograms binned over each
+                    # process's LOCAL bounds) — an unopenable catalog
+                    # is worse than a dropped sketch; stats_analyze
+                    # rebuilds it
+                    merged.pop(k, None)
+                    poisoned.add(k)
         if merged:
             store._stats = merged
 

@@ -499,3 +499,39 @@ def test_stats_stale_shared_does_not_shadow(tmp_path):
     st = ds2._store("evt")
     assert st._stats["count"].count == 1    # .p0 sketches, not doubled
     assert st.next_fid >= 99                # fid still maxes over ALL
+
+
+def test_schema_name_validation():
+    ds = TpuDataStore()
+    for bad in ("evt.p2", "a.lean", "x y", ""):
+        with pytest.raises(ValueError, match="invalid schema name|"
+                                             "unsupported"):
+            ds.create_schema(bad, "dtg:Date,*geom:Point")
+    ds.create_schema("ok-Name_2", "dtg:Date,*geom:Point")
+
+
+def test_incompatible_histogram_merge_drops_key(tmp_path):
+    """Per-process histograms binned over local bounds cannot merge —
+    the catalog still opens and the sketch is dropped, not fatal."""
+    import json
+
+    cat = tmp_path / "cat"
+    ds = TpuDataStore(str(cat))
+    ds.create_schema("evt", "v:Double:index=true,dtg:Date,*geom:Point")
+    ds.write("evt", {"v": np.array([1.0, 2.0]),
+                     "dtg": np.full(2, 1514764800000),
+                     "geom": (np.zeros(2), np.zeros(2))})
+    ds.persist_stats("evt")
+    raw = json.loads((cat / "evt.stats.json").read_text())
+    from geomesa_tpu.stats.stat import Histogram
+    h0 = Histogram("v", 16, 0.0, 10.0)
+    h1 = Histogram("v", 16, 5.0, 50.0)
+    a = dict(raw); a["v_histogram"] = h0.to_json()
+    b = dict(raw); b["v_histogram"] = h1.to_json()
+    (cat / "evt.p0.stats.json").write_text(json.dumps(a))
+    (cat / "evt.p1.stats.json").write_text(json.dumps(b))
+    (cat / "evt.stats.json").unlink()
+    ds2 = TpuDataStore(str(cat))           # must not raise
+    st = ds2._store("evt")
+    assert "v_histogram" not in st._stats
+    assert st._stats["count"].count == 4   # other sketches merged
