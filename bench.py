@@ -387,7 +387,8 @@ def main():
                             and pallas.get("hist1d_ok") is not False
                             and pallas["on_tpu"])
 
-    print(json.dumps({
+    scale = _scale_stanza()
+    full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
         "unit": "keys/sec",
@@ -413,10 +414,79 @@ def main():
             "knn25_4m_ms": round(knn_dt * 1e3, 1),
             "tube40_4m_ms": round(tube_dt * 1e3, 1),
             "pallas": pallas,
-            "scale": _scale_stanza(),
+            "scale": scale,
             "device": str(jax.devices()[0]),
         },
-    }))
+    }
+    # Full detail survives in a FILE; the driver only retains the last
+    # ~2,000 chars of stdout, which the round-4 full blob outgrew
+    # (BENCH_r04 parsed: null — round-4 VERDICT weak #1).  The LAST
+    # stdout line is therefore a compact summary, bounded well under the
+    # tail window, carrying the primary metric plus per-config medians,
+    # pallas wins, and scale POINTERS (record file + headline rows/rates
+    # only — never the nested records themselves).
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_FULL.json"), "w") as f:
+        json.dump(full, f, indent=1)
+    print(json.dumps(_compact_summary(full), separators=(",", ":")))
+
+
+def _compact_summary(full: dict) -> dict:
+    """The driver-facing last line: same top-level schema as the full
+    record, `extra` reduced to scalars + scale pointers.  Must stay
+    under ~1,800 chars serialized; past that it hard-trims to a 3-field
+    core (pinned by tests/test_review_fixes.py) so a future field can
+    never re-break the driver capture."""
+    ex = full["extra"]
+    scale = ex.get("scale", {})
+
+    def _scale_ptr(key: str) -> dict:
+        rec = scale.get(key)
+        if not isinstance(rec, dict):
+            return {"absent": True}
+        out = {}
+        for k in ("rows", "ingest_rows_per_sec", "generations", "tiers",
+                  "oracle_exact", "knn_measured_at_rows", "knn25_warm_ms",
+                  "query_warm_ms", "density_1b_ms", "attr_query_warm_ms",
+                  "density_oracle_exact", "attr_oracle_exact"):
+            if k in rec:
+                v = rec[k]
+                if isinstance(v, list):
+                    v = v[:3]
+                out[k] = v
+        return out
+
+    compact = {
+        "metric": full["metric"],
+        "value": full["value"],
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "extra": {
+            "bbox_scan_feats_per_sec": ex["bbox_time_scan_features_per_sec"],
+            "batched_windows_per_sec": ex["batched_windows_per_sec"],
+            "chunked_append_keys_per_sec": ex["chunked_append_keys_per_sec"],
+            "density_256x128_ms": ex["density_256x128_ms"],
+            "z2_or3_ms": ex["z2_or3_ms"],
+            "xz2_query_ms": ex["xz2_query_ms"],
+            "knn25_4m_ms": ex["knn25_4m_ms"],
+            "tube40_4m_ms": ex["tube40_4m_ms"],
+            "pallas_wins": (ex.get("pallas") or {}).get("measured_wins"),
+            "pallas_active": (ex.get("pallas") or {}).get("active"),
+            "scale_1b": _scale_ptr("recorded_1b"),
+            "store_1b": _scale_ptr("store_recorded"),
+            "store_live": _scale_ptr("store_live"),
+            "full_record": "BENCH_FULL.json",
+            "device": ex["device"],
+        },
+    }
+    blob = json.dumps(compact, separators=(",", ":"))
+    if len(blob) > 1800:  # hard-trim rather than re-break the capture
+        compact["extra"] = {
+            "chunked_append_keys_per_sec": ex["chunked_append_keys_per_sec"],
+            "pallas_wins": (ex.get("pallas") or {}).get("measured_wins"),
+            "full_record": "BENCH_FULL.json",
+        }
+    return compact
 
 
 def _scale_stanza() -> dict:

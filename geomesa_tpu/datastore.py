@@ -17,6 +17,7 @@ catalog (metadata/GeoMesaMetadata.scala analog, JSON on disk).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import re
@@ -162,6 +163,11 @@ class _SchemaStore:
         #: lazily-built id set for O(m) explicit-id collision checks
         #: (built on the first explicit-id write, maintained after)
         self._id_set: set | None = None
+        #: monotonic stats-artifact generation counter: persisted in
+        #: ``__meta__`` and preferred over mtime for source arbitration,
+        #: which cross-host clock/mtime-granularity skew can mis-order
+        #: on shared catalog dirs (round-4 ADVICE)
+        self.stats_generation: int = 0
         self._init_stats()
         if self.lean:
             self._init_lean()
@@ -1003,14 +1009,18 @@ class TpuDataStore:
                     # old schema) must not fold into the renamed one —
                     # stats files AND row snapshot dirs
                     for p in self._proc_stats_files(sft.name):
-                        os.remove(p)
+                        with contextlib.suppress(FileNotFoundError):
+                            os.remove(p)
                     for d in self._lean_snapshot_dirs(sft.name):
                         shutil.rmtree(d, ignore_errors=True)
                     for p in self._proc_stats_files(name):
                         f = os.path.basename(p)
-                        os.replace(p, os.path.join(
-                            self._catalog_dir,
-                            sft.name + f[len(name):]))
+                        with contextlib.suppress(FileNotFoundError):
+                            # externally deleted between listdir and
+                            # rename — same tolerance persist_stats has
+                            os.replace(p, os.path.join(
+                                self._catalog_dir,
+                                sft.name + f[len(name):]))
                     for d in self._lean_snapshot_dirs(name):
                         target = os.path.join(
                             self._catalog_dir,
@@ -1032,10 +1042,14 @@ class TpuDataStore:
                 for suffix in (".schema.json", ".parquet", ".stats.json",
                                ".vis.json"):
                     path = os.path.join(self._catalog_dir, f"{name}{suffix}")
-                    if os.path.exists(path):
+                    with contextlib.suppress(FileNotFoundError):
                         os.remove(path)
                 for p in self._proc_stats_files(name):
-                    os.remove(p)
+                    # a concurrent persist's prune (or an external
+                    # delete) between listdir and remove must not crash
+                    # the schema removal mid-cleanup
+                    with contextlib.suppress(FileNotFoundError):
+                        os.remove(p)
                 # lean snapshot dirs too: a stale snapshot would
                 # resurrect the removed schema's rows into a later
                 # schema of the same name
@@ -1822,7 +1836,10 @@ class TpuDataStore:
                 # counter must survive reload, or deleting the highest
                 # ids then reopening would re-derive a lower counter
                 # from the surviving rows and resurrect deleted ids
-                json.dump({"__meta__": {"next_fid": store.next_fid},
+                store.stats_generation += 1
+                json.dump({"__meta__": {
+                               "next_fid": store.next_fid,
+                               "generation": store.stats_generation},
                            **{k: s.to_json()
                               for k, s in store._stats.items()}}, f)
             os.replace(tmp, path)
@@ -1861,8 +1878,10 @@ class TpuDataStore:
 
     def load_stats(self, name: str) -> None:
         """Reload persisted sketches + the fid counter, across PROCESS
-        TOPOLOGY boundaries: the newest artifact family wins (mtime —
-        a stale shared file must not shadow newer per-process files or
+        TOPOLOGY boundaries: the newest artifact family wins — ordered
+        by the monotonic ``__meta__`` generation counter when present,
+        mtime as the pre-counter fallback (a stale shared file must not
+        shadow newer per-process files or
         vice versa, or next_fid would regress and REUSE deleted ids),
         per-process files merge on a single-controller open, and a
         shared (global) file opened multihost loads its sketches on
@@ -1887,21 +1906,45 @@ class TpuDataStore:
             except OSError:
                 return -1.0
 
+        # every candidate artifact parses exactly ONCE (sketches are
+        # large at scale; the arbitration below and the merge loop share
+        # these dicts rather than re-reading files)
+        parsed: dict[str, dict] = {}
+        for p in {shared, own, *procs}:
+            try:
+                with open(p) as f:
+                    parsed[p] = json.load(f)
+            except (OSError, ValueError):
+                pass   # absent, or pruned by a concurrent persist
+
+        def recency(p):
+            """(generation, mtime): the monotonic ``__meta__`` counter
+            decides when present (an artifact carrying it is from a
+            counter-writing catalog and is newer than any that doesn't);
+            mtime is the fallback for pre-counter artifacts only —
+            cross-host clock skew can mis-order mtimes on shared dirs
+            (round-4 ADVICE)."""
+            gen = ((parsed.get(p) or {}).get("__meta__")
+                   or {}).get("generation", -1)
+            return (int(gen), mtime(p))
+
         # (path, load_sketches) sources; next_fid reads every artifact
         sources: list = []
+        live_procs = [p for p in procs if p in parsed]
         if own == shared:       # single-controller (or 1-proc multihost)
-            if procs and max(map(mtime, procs)) > mtime(shared):
-                sources = [(p, True) for p in procs]
-            elif os.path.exists(shared):
+            if live_procs and max(map(recency, live_procs)) \
+                    > recency(shared):
+                sources = [(p, True) for p in live_procs]
+            elif shared in parsed:
                 sources = [(shared, True)]
         else:                   # multihost, >1 process
             import jax
-            if os.path.exists(own) and mtime(own) >= mtime(shared):
+            if own in parsed and recency(own) >= recency(shared):
                 sources = [(own, True)]
-            elif os.path.exists(shared):
+            elif shared in parsed:
                 sources = [(shared, jax.process_index() == 0)]
-        for p in {shared, own, *procs}:
-            if os.path.exists(p) and p not in {s for s, _ in sources}:
+        for p in parsed:
+            if p not in {s for s, _ in sources}:
                 sources.append((p, False))
         if not sources:
             return
@@ -1910,15 +1953,14 @@ class TpuDataStore:
         merged: dict = {}
         poisoned: set = set()
         for path, with_sketches in sources:
-            try:
-                with open(path) as f:
-                    raw = json.load(f)
-            except FileNotFoundError:
-                continue   # pruned by a concurrent persist mid-listing
+            raw = dict(parsed[path])   # parsed once above
             meta = raw.pop("__meta__", None)  # absent in older catalogs
             if meta is not None:
                 store.next_fid = max(store.next_fid,
                                      int(meta.get("next_fid", 0)))
+                store.stats_generation = max(
+                    store.stats_generation,
+                    int(meta.get("generation", 0)))
             if not with_sketches:
                 continue
             if drop_freq:
@@ -1946,6 +1988,14 @@ class TpuDataStore:
                     merged.pop(k, None)
                     poisoned.add(k)
         if merged:
+            # re-seed any default sketch the merge dropped (poisoned) or
+            # an older artifact never carried — code that indexes
+            # _stats["count"] unconditionally must never find the key
+            # missing after a reopen (round-4 ADVICE: an unopenable
+            # catalog is worse than a dropped sketch, and a dropped
+            # sketch must not become an unopenable catalog either)
+            for k, s in store._stats.items():
+                merged.setdefault(k, s)
             store._stats = merged
 
     # -- data persistence (FSDS-analog: parquet files under the catalog) --

@@ -86,7 +86,22 @@ class SpatialFrame:
             if fn == "count":
                 out[name] = np.bincount(inverse, minlength=len(uniq))
                 continue
-            vals = batch.column(col).astype(np.float64)
+            raw = batch.column(col)
+            if (raw.dtype == object or raw.dtype.kind in "US") \
+                    and fn in ("min", "max"):
+                # string min/max: lexicographic per group (sum/mean on
+                # strings still fail loudly in the float cast below)
+                if not len(uniq):
+                    out[name] = raw.astype(str)[:0]
+                    continue
+                order = np.lexsort((raw.astype(str), inverse))
+                firsts = np.searchsorted(inverse[order],
+                                         np.arange(len(uniq)))
+                pick = (firsts if fn == "min"
+                        else np.append(firsts[1:], len(raw)) - 1)
+                out[name] = raw.astype(str)[order][pick]
+                continue
+            vals = raw.astype(np.float64)
             if fn == "sum":
                 out[name] = np.bincount(inverse, weights=vals,
                                         minlength=len(uniq))

@@ -59,7 +59,17 @@ _CLAUSE = re.compile(
 _HAVING_TERM = re.compile(
     r"^(?:(?P<alias>\w+)|(?P<fn>count|sum|min|max|avg|mean)\s*\(\s*"
     r"(?P<col>\*|\w+)\s*\))\s*(?P<op><=|>=|<>|!=|=|<|>)\s*"
-    r"(?P<num>[0-9.eE+-]+|'[^']*')$", re.IGNORECASE)
+    r"(?P<num>'[^']*'|\S+)$", re.IGNORECASE)
+
+#: a well-formed numeric literal — the HAVING literal validator ('1e'
+#: or '+-3' must cost the grammar's descriptive error, never a raw
+#: float() ValueError; round-4 ADVICE)
+_NUM_LIT = re.compile(r"^[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?$")
+
+#: aggregate functions whose output is always numeric — a string
+#: literal compared against one is a type error the parser can report
+#: (min/max inherit their column's type, so strings stay legal there)
+_NUMERIC_FNS = frozenset({"count", "sum", "avg", "mean"})
 
 _OPS = {
     "=": lambda a, b: a == b, "!=": lambda a, b: a != b,
@@ -243,7 +253,31 @@ def parse_sql(text: str) -> ParsedSQL:
                 target = ("mean" if fn == "avg" else fn,
                           tm.group("col"))
             lit = tm.group("num")
-            lit = lit[1:-1] if lit.startswith("'") else float(lit)
+            # resolve the aggregate fn behind an alias too, so
+            # `HAVING n > 'abc'` (n = count(*)) errors at parse time
+            # like the inline form does
+            fn = tm.group("fn")
+            if fn is None:
+                fn = next((f for f, _c, a in aggs
+                           if a == tm.group("alias")), None)
+            if lit.startswith("'"):
+                if not re.fullmatch(r"'[^']*'", lit):
+                    raise ValueError(
+                        f"unsupported HAVING term {term!r}: "
+                        f"unterminated or malformed string literal "
+                        f"{lit}")
+                if fn and fn.lower() in _NUMERIC_FNS:
+                    raise ValueError(
+                        f"unsupported HAVING term {term!r}: "
+                        f"{fn.lower()}(...) is numeric but "
+                        f"the literal {lit} is a string")
+                lit = lit[1:-1]
+            else:
+                if not _NUM_LIT.match(lit):
+                    raise ValueError(
+                        f"unsupported HAVING term {term!r}: {lit!r} is "
+                        "not a number or quoted string literal")
+                lit = float(lit)
             having.append((target, tm.group("op"), lit))
     return ParsedSQL(
         table=m.group("table"), columns=columns, aggs=aggs, where=where,

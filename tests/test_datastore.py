@@ -510,6 +510,81 @@ def test_schema_name_validation():
     ds.create_schema("ok-Name_2", "dtg:Date,*geom:Point")
 
 
+def test_stats_generation_counter_beats_mtime(tmp_path):
+    """The monotonic ``__meta__`` generation counter decides stats-source
+    arbitration when present; mtime skew (shared-dir clock drift) cannot
+    pick the stale artifact (round-4 ADVICE)."""
+    import json
+    import os
+    import time
+
+    cat = tmp_path / "cat"
+    ds = TpuDataStore(str(cat))
+    ds.create_schema("evt", "v:Double,dtg:Date,*geom:Point")
+    ds.write("evt", {"v": np.array([2.0]),
+                     "dtg": np.full(1, 1514764800000),
+                     "geom": (np.zeros(1), np.zeros(1))})
+    ds.persist_stats("evt")
+    assert ds._store("evt").stats_generation == 1
+    ds.persist_stats("evt")
+    assert ds._store("evt").stats_generation == 2
+    shared = cat / "evt.stats.json"
+    raw = json.loads(shared.read_text())
+    # a per-process artifact with a HIGHER generation but an OLDER mtime
+    # (cross-host clock skew shape) must still win the arbitration
+    newer = dict(raw)
+    newer["__meta__"] = {"next_fid": 40, "generation": 9}
+    newer["count"] = {"kind": "count", "count": 123}
+    p0 = cat / "evt.p0.stats.json"
+    p0.write_text(json.dumps(newer))
+    old = time.time() - 1000
+    os.utime(p0, (old, old))
+    ds2 = TpuDataStore(str(cat))
+    st = ds2._store("evt")
+    assert st._stats["count"].count == 123   # generation beat mtime
+    assert st.stats_generation == 9          # counter restored monotone
+
+
+def test_stats_missing_default_key_reseeded(tmp_path):
+    """An artifact family that never carried a default sketch (or whose
+    merge dropped it) must not leave ``_stats['count']`` missing after
+    reopen — unconditional indexing would brick the catalog open
+    (round-4 ADVICE)."""
+    import json
+
+    cat = tmp_path / "cat"
+    ds = TpuDataStore(str(cat))
+    ds.create_schema("evt", "v:Double,dtg:Date,*geom:Point")
+    ds.write("evt", {"v": np.array([1.0]),
+                     "dtg": np.full(1, 1514764800000),
+                     "geom": (np.zeros(1), np.zeros(1))})
+    ds.persist_stats("evt")
+    shared = cat / "evt.stats.json"
+    raw = json.loads(shared.read_text())
+    stripped = {"__meta__": raw["__meta__"],
+                "v_minmax": raw["v_minmax"]}   # no "count" at all
+    shared.write_text(json.dumps(stripped))
+    ds2 = TpuDataStore(str(cat))               # must not raise
+    st = ds2._store("evt")
+    assert "count" in st._stats                # re-seeded default
+    assert "v_minmax" in st._stats
+
+
+def test_remove_schema_tolerates_vanished_stats_file(tmp_path, monkeypatch):
+    """An externally deleted per-process stats file between listdir and
+    remove must not crash remove_schema mid-cleanup (round-4 ADVICE)."""
+    cat = tmp_path / "cat"
+    ds = TpuDataStore(str(cat))
+    ds.create_schema("evt", "dtg:Date,*geom:Point")
+    ghost = str(cat / "evt.p3.stats.json")
+    real = TpuDataStore._proc_stats_files
+    monkeypatch.setattr(
+        TpuDataStore, "_proc_stats_files",
+        lambda self, name: real(self, name) + [ghost])
+    ds.remove_schema("evt")                    # must not raise
+    assert "evt" not in ds.type_names
+
+
 def test_incompatible_histogram_merge_drops_key(tmp_path):
     """Per-process histograms binned over local bounds cannot merge —
     the catalog still opens and the sketch is dropped, not fatal."""

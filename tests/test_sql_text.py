@@ -194,6 +194,47 @@ class TestHaving:
         with pytest.raises(ValueError, match="HAVING requires GROUP"):
             sql_query(ds, "SELECT count(*) FROM t HAVING count(*) > 1")
 
+    def test_having_malformed_numeric_literal_grammar_error(self):
+        # '1e' and '+-3' matched the old sloppy literal class and blew
+        # up in float() with a raw ValueError (round-4 ADVICE)
+        ds = self._store()
+        for bad in ("1e", "+-3", "1.2.3", "e5"):
+            with pytest.raises(ValueError, match="not a number"):
+                sql_query(ds, "SELECT count(*) AS n FROM t "
+                              f"GROUP BY name HAVING n > {bad}")
+
+    def test_having_string_vs_numeric_aggregate_parse_error(self):
+        # a quoted literal ordered against count()/sum() used to surface
+        # as a numpy TypeError at evaluation (round-4 ADVICE)
+        ds = self._store()
+        with pytest.raises(ValueError, match="is numeric"):
+            sql_query(ds, "SELECT count(*) AS n FROM t GROUP BY name "
+                          "HAVING sum(v) > 'abc'")
+
+    def test_having_string_vs_numeric_alias_parse_error(self):
+        # same check through an ALIAS of a numeric aggregate
+        ds = self._store()
+        with pytest.raises(ValueError, match="is numeric"):
+            sql_query(ds, "SELECT count(*) AS n FROM t GROUP BY name "
+                          "HAVING n > 'abc'")
+
+    def test_having_unterminated_string_literal_rejected(self):
+        # a missing close quote must not silently parse as '' or
+        # swallow the quote into the value
+        ds = self._store()
+        for bad in ("'b", "'a'b'"):
+            with pytest.raises(ValueError, match="unterminated|"
+                                                 "unsupported HAVING"):
+                sql_query(ds, "SELECT name FROM t GROUP BY name "
+                              f"HAVING max(name) >= {bad}")
+
+    def test_having_string_vs_min_max_stays_legal(self):
+        # min/max inherit the column type — string comparisons are fine
+        ds = self._store()
+        out = sql_query(ds, "SELECT name FROM t GROUP BY name "
+                            "HAVING max(name) >= 'b'")
+        assert list(out["name"]) == ["b", "c"]
+
     def test_having_unknown_alias_rejected(self):
         ds = self._store()
         with pytest.raises(ValueError, match="HAVING references"):
