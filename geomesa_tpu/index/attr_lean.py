@@ -1,0 +1,541 @@
+"""LeanAttrIndex: tiered generational attribute index for lean schemas.
+
+The round-4 lean profile served ``{z3, id}`` only, so an
+attribute-only ECQL on a 1B-row store degraded to a full host scan and
+an attribute-selective + spatially-wide query gathered every spatial
+candidate first.  The reference serves these from the lexicoded
+attribute index with cost-based selection at any scale
+(geomesa-index-api/.../index/attribute/AttributeIndexKey.scala:38-52,
+.../strategies/AttributeFilterStrategy.scala); this module is that
+index re-expressed in the lean profile's terms (round-4 VERDICT #1).
+
+**Key layout.**  Sorted GENERATIONS (LSM runs, exactly the
+:class:`~geomesa_tpu.index.z3_lean.LeanZ3Index` shape) of
+
+    ``(key int64, sec int64, gid int32)``  — 20 B/row
+
+where ``key`` is an ORDER-PRESERVING int64 encoding of the attribute
+value (the lexicode analog of ``AttributeIndexKey.typeRegistry``):
+
+* ints/longs/dates — the value itself (exact);
+* floats/doubles — the IEEE-754 order-preserving bit transform (exact);
+* strings — the first 8 UTF-8 bytes big-endian (a PREFIX code: ties
+  share a key and the planner's residual filter disambiguates — the
+  same candidate-superset contract every index here honors).
+
+``sec`` is the epoch-millis dtg — the reference's date secondary tier
+(``DateIndexKeySpace``): because runs sort by ``(key, sec)``, an
+equality/IN lookup with a time window seeks the sub-range directly
+(two-key :func:`~geomesa_tpu.ops.search.searchsorted2` — the same
+kernel the z3 index seeks with).  Range/prefix scans span many value
+runs and pass an open ``sec`` window, as in the reference.
+
+**Tiers.**  ``device`` generations hold the three columns in HBM
+(demoted oldest-first under ``hbm_budget_bytes``); ``host`` generations
+spill to RAM and seek through one stacked vectorized bisection, flat in
+run count (the :class:`~geomesa_tpu.index.z3_lean.HostStack`
+discipline).  There is no ``full`` tier: the encoded key IS the
+payload, so the device seek is already as exact as the encoding allows.
+
+Queries batch every (window × generation) into a fixed number of
+dispatches: one totals probe + one gather over all device generations,
+bucket-padded with a shared empty sentinel generation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.search import (
+    coded_pos_bits, expand_ranges, gather_capacity, pad_pow2,
+    searchsorted2, wire_dtype,
+)
+
+__all__ = ["LeanAttrIndex", "encode_attr_values", "encode_attr_value"]
+
+_SENTINEL_KEY = np.int64(np.iinfo(np.int64).max)
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+#: per-slot bytes: key int64 + sec int64 + gid int32
+SLOT_BYTES = 8 + 8 + 4
+
+#: attribute types served by the int64 lexicode (AttributeIndexKey's
+#: typeRegistry analog); geometry/bytes/json are not indexable here,
+#: matching the reference's indexable-type set
+_NUMERIC_TYPES = {"int", "integer", "long", "float", "double", "date"}
+
+
+def _encode_float64(vals: np.ndarray) -> np.ndarray:
+    """IEEE-754 double → order-preserving signed int64 (NaNs sort
+    last)."""
+    v = np.ascontiguousarray(vals, np.float64) + 0.0   # -0.0 → +0.0
+    bits = v.view(np.int64)
+    # negative floats (sign bit set): map reversed into [-2^63, -1];
+    # positives keep their bits — order-preserving in the signed view
+    return np.where(bits < 0, np.int64(-1) - (bits ^ _I64_MIN), bits)
+
+
+def _encode_strings(vals: np.ndarray) -> np.ndarray:
+    """First 8 UTF-8 bytes, big-endian, as signed int64 — a prefix code
+    (lexicographic byte order == unsigned integer order; shifting by
+    2^63 makes it signed-comparable)."""
+    arr = np.asarray(vals)
+    try:
+        raw = arr.astype("S8")           # ASCII fast path (truncating)
+    except UnicodeEncodeError:
+        raw = np.array([("" if v is None else str(v)).encode("utf-8")[:8]
+                        for v in arr], dtype="S8")
+    u = np.ascontiguousarray(raw).view(">u8").astype(np.uint64).ravel()
+    return (u ^ np.uint64(1 << 63)).view(np.int64)
+
+
+def encode_attr_values(vals: np.ndarray, attr_type: str) -> np.ndarray:
+    """Vectorized order-preserving int64 encoding for one column.
+
+    Keys clamp to ``int64 max - 1``: the sentinel padding key is int64
+    max, and a real key equal to it would let open-ended range seeks
+    sweep every generation's padding into the candidate buffer.  The
+    clamp aliases only the two topmost encodable values — a candidate
+    superset the residual filter resolves, like string prefix ties."""
+    t = attr_type.lower()
+    if t in ("int", "integer", "long", "date"):
+        keys = np.ascontiguousarray(vals, np.int64)
+    elif t in ("float", "double"):
+        keys = _encode_float64(np.asarray(vals, np.float64))
+    elif t == "string":
+        keys = _encode_strings(vals)
+    else:
+        raise TypeError(f"attribute type {attr_type!r} is not indexable "
+                        "on a lean schema (indexable: numerics, dates, "
+                        "strings)")
+    return np.minimum(keys, _SENTINEL_KEY - 1)
+
+
+def encode_attr_value(v, attr_type: str) -> np.int64:
+    """Scalar twin of :func:`encode_attr_values` (query planning)."""
+    return np.int64(encode_attr_values(np.array([v]), attr_type)[0])
+
+
+def string_prefix_bounds(prefix: str) -> tuple[np.int64, np.int64]:
+    """Inclusive key bounds covering every string starting with
+    ``prefix`` (for LIKE 'abc%': [code(prefix·00…), code(prefix·ff…)])."""
+    b = prefix.encode("utf-8")[:8]
+    lo = int.from_bytes(b.ljust(8, b"\x00"), "big")
+    hi = int.from_bytes(b.ljust(8, b"\xff"), "big")
+    u = np.array([lo, hi], dtype=np.uint64) ^ np.uint64(1 << 63)
+    s = u.view(np.int64)
+    return np.int64(s[0]), np.int64(min(s[1], _SENTINEL_KEY - 1))
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _attr_append(keys, sec, gid, r, new_k, new_s, new_g, m):
+    """Merge one encoded slice into the generation's sentinel padding at
+    sorted offset ``r`` and re-sort (donated: peak = resident + sort
+    temps)."""
+    valid = jnp.arange(new_k.shape[0]) < m
+    k_new = jnp.where(valid, new_k, _SENTINEL_KEY)
+    s_new = jnp.where(valid, new_s, jnp.int64(_I64_MAX))
+    g_new = jnp.where(valid, new_g, jnp.int32(-1))
+    keys = jax.lax.dynamic_update_slice(keys, k_new, (r,))
+    sec = jax.lax.dynamic_update_slice(sec, s_new, (r,))
+    gid = jax.lax.dynamic_update_slice(gid, g_new, (r,))
+    return jax.lax.sort((keys, sec, gid), dimension=0, num_keys=2)
+
+
+@jax.jit
+def _attr_count_multi(qklo, qkhi, qslo, qshi, *cols):
+    """Totals probe over every device generation in ONE dispatch."""
+    outs = []
+    for g in range(len(cols) // 2):
+        k, s = cols[2 * g], cols[2 * g + 1]
+        starts = searchsorted2(k, s, qklo, qslo, side="left")
+        ends = searchsorted2(k, s, qkhi, qshi, side="right")
+        outs.append(jnp.sum(jnp.maximum(ends - starts, 0)))
+    return jnp.stack(outs)
+
+
+@partial(jax.jit, static_argnames=("capacity", "pos_bits"))
+def _attr_scan_coded(qklo, qkhi, qslo, qshi, qqid, *cols,
+                     capacity: int, pos_bits: int):
+    """Candidate gather over device generations in ONE dispatch,
+    coded ``qid << pos_bits | gid``."""
+    dt = wire_dtype(pos_bits)
+    outs = []
+    for g in range(len(cols) // 3):
+        k, s, gid = cols[3 * g], cols[3 * g + 1], cols[3 * g + 2]
+        starts = searchsorted2(k, s, qklo, qslo, side="left")
+        ends = searchsorted2(k, s, qkhi, qshi, side="right")
+        counts = jnp.maximum(ends - starts, 0)
+        idx, valid, rid = expand_ranges(starts, counts, capacity)
+        coded = ((qqid[rid].astype(dt) << dt(pos_bits))
+                 | gid[idx].astype(dt))
+        outs.append(jnp.where(valid, coded, dt(-1)))
+    return jnp.stack(outs)
+
+
+def _bisect2(k: np.ndarray, s: np.ndarray, qk: np.ndarray,
+             qs: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+             side: str) -> np.ndarray:
+    """Vectorized composite-key binary search of ``(qk, qs)[i]`` within
+    the (key, sec)-sorted segments ``[lo[i], hi[i])`` — the host-tier
+    twin of :func:`~geomesa_tpu.ops.search.searchsorted2`, one bisection
+    pass for every (range × run) pair (flat in run count)."""
+    lo = lo.astype(np.int64).copy()
+    hi = hi.astype(np.int64).copy()
+    while True:
+        active = lo < hi
+        if not active.any():
+            return lo
+        mid = (lo + hi) >> 1
+        safe = np.where(active, mid, 0)
+        km, sm = k[safe], s[safe]
+        if side == "left":
+            below = (km < qk) | ((km == qk) & (sm < qs))
+        else:
+            below = (km < qk) | ((km == qk) & (sm <= qs))
+        go = active & below
+        lo = np.where(go, mid + 1, lo)
+        hi = np.where(active & ~below, mid, hi)
+
+
+class _HostAttrStack:
+    """Spilled (key, sec, gid) runs stacked contiguously: each run is
+    one segment; one composite bisection pass per query batch serves
+    every host generation.  The stack OWNS the concatenated arrays —
+    each constituent part (a mutable ``[k, s, g]`` list) is re-pointed
+    at views into them so host RAM holds ONE copy of the spilled runs
+    (the HostStack discipline; review r5)."""
+
+    __slots__ = ("k", "s", "gid", "seg_lo", "seg_hi")
+
+    def __init__(self, parts: list[list]):
+        ks, ss, gs, lo, hi = [], [], [], [], []
+        off = 0
+        for k, s, g in parts:
+            ks.append(k)
+            ss.append(s)
+            gs.append(g)
+            lo.append(off)
+            hi.append(off + len(k))
+            off += len(k)
+        self.k = np.concatenate(ks) if ks else np.empty(0, np.int64)
+        self.s = np.concatenate(ss) if ss else np.empty(0, np.int64)
+        self.gid = np.concatenate(gs) if gs else np.empty(0, np.int64)
+        self.seg_lo = np.asarray(lo, np.int64)
+        self.seg_hi = np.asarray(hi, np.int64)
+        off = 0
+        for part in parts:
+            n = len(part[0])
+            part[0] = self.k[off:off + n]
+            part[1] = self.s[off:off + n]
+            part[2] = self.gid[off:off + n]
+            off += n
+
+    def candidates(self, qklo, qkhi, qslo, qshi, qqid,
+                   pos_bits: int) -> np.ndarray:
+        if not len(self.k) or not len(qklo):
+            return np.empty(0, np.int64)
+        n_seg = len(self.seg_lo)
+        n_q = len(qklo)
+        # every (range × run) pair — runs are few (spilled generations)
+        rid = np.repeat(np.arange(n_q), n_seg)
+        seg = np.tile(np.arange(n_seg), n_q)
+        lo0, hi0 = self.seg_lo[seg], self.seg_hi[seg]
+        starts = _bisect2(self.k, self.s, qklo[rid], qslo[rid],
+                          lo0, hi0, side="left")
+        ends = _bisect2(self.k, self.s, qkhi[rid], qshi[rid],
+                        lo0, hi0, side="right")
+        cnt = np.maximum(ends - starts, 0)
+        cum = np.cumsum(cnt)
+        total = int(cum[-1]) if len(cum) else 0
+        if total == 0:
+            return np.empty(0, np.int64)
+        j = np.arange(total)
+        pid = np.searchsorted(cum, j, side="right")
+        prev = np.where(pid > 0, cum[pid - 1], 0)
+        idx = starts[pid] + (j - prev)
+        return ((qqid[rid[pid]].astype(np.int64) << pos_bits)
+                | self.gid[idx].astype(np.int64))
+
+
+class _AttrGeneration:
+    __slots__ = ("keys", "sec", "gid", "n", "tier", "spilled")
+
+    def __init__(self, capacity: int):
+        self.keys = jnp.full((capacity,), _SENTINEL_KEY, jnp.int64)
+        self.sec = jnp.full((capacity,), _I64_MAX, jnp.int64)
+        self.gid = jnp.full((capacity,), -1, jnp.int32)
+        self.n = 0
+        self.tier = "device"
+        self.spilled: tuple | None = None
+
+    @property
+    def capacity(self) -> int:
+        return int(self.keys.shape[0])
+
+    def device_bytes(self) -> int:
+        return 0 if self.tier == "host" else self.capacity * SLOT_BYTES
+
+    def spill_to_host(self) -> None:
+        if self.tier != "device":
+            return
+        # a mutable list: _HostAttrStack re-points it at views of the
+        # stacked buffers so only one host copy survives
+        self.spilled = [np.asarray(self.keys)[:self.n],
+                        np.asarray(self.sec)[:self.n],
+                        np.asarray(self.gid)[:self.n]]
+        self.keys = self.sec = self.gid = None
+        self.tier = "host"
+
+
+class LeanAttrIndex:
+    """Tiered generational attribute index (see module doc).
+
+    ``queries`` take lists of inclusive int64 key ranges with optional
+    per-range sec windows; results are CANDIDATE gids (the planner's
+    residual filter makes them exact, as for every index here)."""
+
+    GENERATION_SLOTS = 1 << 24
+    DEFAULT_CAPACITY = 1 << 15
+    BATCH_SCAN_BUDGET = 1 << 26
+    #: default HBM budget — the store splits its lean budget between
+    #: the z3 index and the attribute indexes (docs/scale.md)
+    HBM_BUDGET_BYTES = int(2.0 * 2 ** 30)
+
+    def __init__(self, attr: str, attr_type: str,
+                 generation_slots: int | None = None,
+                 hbm_budget_bytes: int | None = None):
+        self.attr = attr
+        self.attr_type = attr_type.lower()
+        if self.attr_type not in _NUMERIC_TYPES | {"string"}:
+            raise TypeError(
+                f"attribute {attr!r}: type {attr_type!r} is not "
+                "indexable on a lean schema")
+        self.generation_slots = generation_slots or self.GENERATION_SLOTS
+        self.hbm_budget_bytes = hbm_budget_bytes or self.HBM_BUDGET_BYTES
+        self.generations: list[_AttrGeneration] = []
+        self._host_stack: _HostAttrStack | None = None
+        self._n_rows = 0
+        self.dispatch_count = 0
+        self._sentinel: tuple | None = None
+
+    def __len__(self) -> int:
+        return self._n_rows
+
+    def device_bytes(self) -> int:
+        return sum(g.device_bytes() for g in self.generations)
+
+    def tier_counts(self) -> dict:
+        out = {"device": 0, "host": 0}
+        for g in self.generations:
+            out[g.tier] += 1
+        return out
+
+    def block(self) -> None:
+        for gen in reversed(self.generations):
+            if gen.tier == "device":
+                jax.block_until_ready(gen.gid)
+                break
+
+    # -- write path -------------------------------------------------------
+    def _sentinel_cols(self):
+        if self._sentinel is None:
+            slots = self.generation_slots
+            self._sentinel = (
+                jnp.full((slots,), _SENTINEL_KEY, jnp.int64),
+                jnp.full((slots,), _I64_MAX, jnp.int64),
+                jnp.full((slots,), -1, jnp.int32))
+        return self._sentinel
+
+    def _budget_after_sentinels(self) -> int:
+        return (self.hbm_budget_bytes
+                - self.generation_slots * SLOT_BYTES)
+
+    def _rebalance(self) -> None:
+        """Spill oldest-first until device residency (plus the sentinel
+        padding buffer) fits the budget; the ACTIVE generation never
+        spills (appends sort there)."""
+        for gen in self.generations[:-1]:
+            if self.device_bytes() <= self._budget_after_sentinels():
+                return
+            if gen.tier == "device":
+                gen.spill_to_host()
+                self._host_stack = None
+        if self.device_bytes() > self._budget_after_sentinels():
+            raise MemoryError(
+                f"active attr generation ({self.generation_slots} "
+                f"slots) exceeds hbm_budget_bytes="
+                f"{self.hbm_budget_bytes}")
+
+    def append(self, values, dtg_ms, base_gid: int | None = None
+               ) -> "LeanAttrIndex":
+        """Stream one column slice in: encode keys, merge into the
+        current generation (rolling on full).  ``base_gid`` defaults to
+        the running row count (the lean store's implicit ids)."""
+        keys = encode_attr_values(values, self.attr_type)
+        sec = np.ascontiguousarray(dtg_ms, np.int64)
+        base = self._n_rows if base_gid is None else int(base_gid)
+        if base + len(keys) > np.iinfo(np.int32).max:
+            raise ValueError("LeanAttrIndex gids are int32: 2,147M rows "
+                             "max per index/shard")
+        m_total = len(keys)
+        done = 0
+        while done < m_total:
+            gen = (self.generations[-1] if self.generations else None)
+            if gen is None or gen.tier == "host" or gen.n >= gen.capacity:
+                gen = _AttrGeneration(self.generation_slots)
+                self.generations.append(gen)
+                self._rebalance()
+                gen = self.generations[-1]
+            room = gen.capacity - gen.n
+            take = min(room, m_total - done)
+            m_pad = min(gather_capacity(take, minimum=8), room)
+            sl = slice(done, done + take)
+            pad = m_pad - take
+            gids = (base + done
+                    + np.arange(take, dtype=np.int32)).astype(np.int32)
+            self.dispatch_count += 1
+            gen.keys, gen.sec, gen.gid = _attr_append(
+                gen.keys, gen.sec, gen.gid, jnp.int32(gen.n),
+                jnp.asarray(np.pad(keys[sl], (0, pad))),
+                jnp.asarray(np.pad(sec[sl], (0, pad))),
+                jnp.asarray(np.pad(gids, (0, pad))),
+                jnp.int32(take))
+            gen.n += take
+            done += take
+        self._n_rows += m_total
+        return self
+
+    # -- query path -------------------------------------------------------
+    def query_ranges(self, ranges: list, n_windows: int = 1,
+                     total_rows: int | None = None) -> np.ndarray:
+        """Candidate gids for inclusive composite ranges
+        ``(klo, khi, slo, shi, qid)`` — equality narrows by sec, value
+        ranges pass open sec bounds (module doc).  Returns coded
+        ``qid << pos_bits | gid`` when ``n_windows > 1``, else plain
+        sorted unique gids."""
+        if not ranges or self._n_rows == 0:
+            return np.empty(0, np.int64)
+        n_pad = pad_pow2(len(ranges))
+        qklo = np.full(n_pad, 1, np.int64)    # never-matching padding
+        qkhi = np.full(n_pad, 0, np.int64)
+        qslo = np.full(n_pad, 1, np.int64)
+        qshi = np.full(n_pad, 0, np.int64)
+        qqid = np.zeros(n_pad, np.int32)
+        for i, (klo, khi, slo, shi, qid) in enumerate(ranges):
+            qklo[i] = klo
+            qkhi[i] = khi
+            qslo[i] = _I64_MIN if slo is None else slo
+            qshi[i] = _I64_MAX if shi is None else shi
+            qqid[i] = qid
+        pos_bits = coded_pos_bits(
+            total_rows if total_rows is not None else self._n_rows,
+            max(1, n_windows))
+        jklo, jkhi = jnp.asarray(qklo), jnp.asarray(qkhi)
+        jslo, jshi = jnp.asarray(qslo), jnp.asarray(qshi)
+        dev_gens = [g for g in self.generations if g.tier == "device"]
+        host_gens = [g for g in self.generations if g.tier == "host"]
+        parts: list = []
+        if dev_gens:
+            padded = list(dev_gens)
+            n_b = (-len(padded)) % 4
+            padded += [None] * n_b
+            count_cols: list = []
+            for gen in padded:
+                cols = (self._sentinel_cols() if gen is None
+                        else (gen.keys, gen.sec, gen.gid))
+                count_cols += [cols[0], cols[1]]
+            self.dispatch_count += 1
+            totals = np.asarray(_attr_count_multi(
+                jklo, jkhi, jslo, jshi, *count_cols))
+            if int(totals.sum()):
+                capacity = gather_capacity(int(totals.max()),
+                                           minimum=self.DEFAULT_CAPACITY)
+                if len(padded) * capacity <= self.BATCH_SCAN_BUDGET:
+                    groups = [padded]
+                    caps = [capacity]
+                else:
+                    groups = [[g] for g, t in zip(dev_gens, totals)
+                              if int(t)]
+                    caps = [gather_capacity(int(t),
+                                            minimum=self.DEFAULT_CAPACITY)
+                            for t in totals if int(t)]
+                for group, cap in zip(groups, caps):
+                    cols = []
+                    for gen in group:
+                        cols += list(self._sentinel_cols() if gen is None
+                                     else (gen.keys, gen.sec, gen.gid))
+                    self.dispatch_count += 1
+                    packed = _attr_scan_coded(
+                        jklo, jkhi, jslo, jshi, jnp.asarray(qqid),
+                        *cols, capacity=cap, pos_bits=pos_bits)
+                    flat = np.asarray(packed).ravel()
+                    parts.append(flat[flat >= 0].astype(np.int64))
+        if host_gens:
+            if self._host_stack is None:
+                self._host_stack = _HostAttrStack(
+                    [g.spilled for g in host_gens])
+            coded = self._host_stack.candidates(
+                qklo, qkhi, qslo, qshi, qqid, pos_bits)
+            if len(coded):
+                parts.append(coded)
+        if not parts:
+            return np.empty(0, np.int64)
+        merged = np.concatenate(parts)
+        if n_windows > 1:
+            return merged
+        mask = (np.int64(1) << pos_bits) - 1
+        return np.unique(merged & mask)
+
+    # planner-facing surface (mirrors index/attribute.AttributeIndex) --
+    #: date-tier marker: equality/IN narrow by a dtg window
+    secondary = True
+    #: no z3 secondary on the lean attribute index (date tier only)
+    sec_z = None
+
+    def _sec(self, sec_window):
+        if sec_window is None:
+            return None, None
+        return sec_window
+
+    def query_equals(self, value, sec_window=None,
+                     z3_ranges=None) -> np.ndarray:
+        k = encode_attr_value(value, self.attr_type)
+        slo, shi = self._sec(sec_window)
+        return self.query_ranges([(k, k, slo, shi, 0)])
+
+    def query_in(self, values, sec_window=None,
+                 z3_ranges=None) -> np.ndarray:
+        if not len(values):
+            return np.empty(0, np.int64)
+        slo, shi = self._sec(sec_window)
+        ranges = []
+        for v in values:
+            k = encode_attr_value(v, self.attr_type)
+            ranges.append((k, k, slo, shi, 0))
+        return self.query_ranges(ranges)
+
+    def query_range(self, lo=None, hi=None, lo_inclusive=True,
+                    hi_inclusive=True) -> np.ndarray:
+        """Candidate gids for a value range.  Bounds are conservatively
+        INCLUSIVE at the key level (string prefix codes alias; numeric
+        exclusive endpoints survive as candidates) — the residual filter
+        applies the exact operator."""
+        klo = (_I64_MIN if lo is None
+               else encode_attr_value(lo, self.attr_type))
+        # open hi stops just short of the sentinel key (encoded keys
+        # clamp below it, so no real row is missed)
+        khi = (_SENTINEL_KEY - 1 if hi is None
+               else encode_attr_value(hi, self.attr_type))
+        return self.query_ranges([(klo, khi, None, None, 0)])
+
+    def query_prefix(self, prefix: str) -> np.ndarray:
+        if self.attr_type != "string":
+            raise TypeError("prefix queries require a string attribute")
+        klo, khi = string_prefix_bounds(prefix)
+        return self.query_ranges([(klo, khi, None, None, 0)])

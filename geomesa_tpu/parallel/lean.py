@@ -236,6 +236,97 @@ def _scan_program_exact(mesh: Mesh, n_gens: int, capacity: int,
     return jax.jit(scan)
 
 
+@lru_cache(maxsize=8)
+def _density_program_full(mesh: Mesh, n_gens: int, capacity: int,
+                          width: int, height: int, sfc=None):
+    """``full``-tier DensityScan under shard_map: per-shard seek +
+    exact payload mask + grid scatter-add, grids merged with psum over
+    ICI — only the (height, width) grid leaves the devices (round-4
+    VERDICT #2; DensityScan.scala:31-59 next-to-the-data split).  The
+    mask is value-exact on raw payload; binning goes through the z-cell
+    midpoint for cross-platform determinism (see
+    index/z3_lean._lean_density_full)."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None),) * 3 + (P(None, None), P(None))
+             + (P("shard", None),) * (6 * n_gens),
+             out_specs=P(None, None))
+    def dens(rb, rlo, rhi, boxes, tenv, *cols):
+        from ..index.z3_lean import _grid_accum
+        per_gen = capacity // max(1, n_gens)
+        grid = jnp.zeros((height * width,), jnp.float64)
+        env = tenv[:4]
+        qtlo, qthi = tenv[4].astype(jnp.int64), tenv[5].astype(jnp.int64)
+        for g in range(n_gens):
+            b, z, pos, xp, yp, tp = (c[0] for c in
+                                     cols[6 * g: 6 * g + 6])
+            starts = searchsorted2(b, z, rb, rlo, side="left")
+            ends = searchsorted2(b, z, rb, rhi, side="right")
+            counts = jnp.maximum(ends - starts, 0)
+            idx, valid, _rid = expand_ranges(starts, counts, per_gen)
+            xc, yc, tc = xp[idx], yp[idx], tp[idx]
+            in_box = (
+                (xc[:, None] >= boxes[None, :, 0])
+                & (yc[:, None] >= boxes[None, :, 1])
+                & (xc[:, None] <= boxes[None, :, 2])
+                & (yc[:, None] <= boxes[None, :, 3])
+            ).any(axis=1)
+            ok = valid & in_box & (tc >= qtlo) & (tc <= qthi)
+            xd = sfc.lon.denormalize(sfc.lon.normalize(xc, xp=jnp),
+                                     xp=jnp)
+            yd = sfc.lat.denormalize(sfc.lat.normalize(yc, xp=jnp),
+                                     xp=jnp)
+            grid = _grid_accum(xd, yd, ok, env, width, height, grid)
+        return jax.lax.psum(grid.reshape((height, width)), "shard")
+
+    return jax.jit(dens)
+
+
+@lru_cache(maxsize=8)
+def _density_program_keys(mesh: Mesh, n_gens: int, capacity: int,
+                          width: int, height: int, sfc):
+    """``keys``-tier DensityScan: cell-granular masks decoded from the
+    z key (the single-chip _lean_density_keys contract: exact for
+    whole-extent scans, cell-inclusive at edges), psum-merged."""
+    from ..curve.zorder import deinterleave3
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None),) * 3 + (P(None, None), P(None), P(None))
+             + (P("shard", None),) * (2 * n_gens),
+             out_specs=P(None, None))
+    def dens(rb, rlo, rhi, ixy, tb, env, *cols):
+        from ..index.z3_lean import _grid_accum
+        per_gen = capacity // max(1, n_gens)
+        grid = jnp.zeros((height * width,), jnp.float64)
+        for g in range(n_gens):
+            b, z = cols[2 * g][0], cols[2 * g + 1][0]
+            starts = searchsorted2(b, z, rb, rlo, side="left")
+            ends = searchsorted2(b, z, rb, rhi, side="right")
+            counts = jnp.maximum(ends - starts, 0)
+            idx, valid, _rid = expand_ranges(starts, counts, per_gen)
+            zc = z[idx]
+            bc = b[idx].astype(jnp.int64)
+            ix, iy, it = deinterleave3(zc.astype(jnp.uint64))
+            ix = ix.astype(jnp.int32)
+            iy = iy.astype(jnp.int32)
+            it = it.astype(jnp.int32)
+            in_box = (
+                (ix[:, None] >= ixy[None, :, 0])
+                & (iy[:, None] >= ixy[None, :, 1])
+                & (ix[:, None] <= ixy[None, :, 2])
+                & (iy[:, None] <= ixy[None, :, 3])
+            ).any(axis=1)
+            after = (bc > tb[0]) | ((bc == tb[0]) & (it >= tb[1]))
+            before = (bc < tb[2]) | ((bc == tb[2]) & (it <= tb[3]))
+            ok = valid & in_box & after & before
+            xd = sfc.lon.denormalize(ix, xp=jnp)
+            yd = sfc.lat.denormalize(iy, xp=jnp)
+            grid = _grid_accum(xd, yd, ok, env, width, height, grid)
+        return jax.lax.psum(grid.reshape((height, width)), "shard")
+
+    return jax.jit(dens)
+
+
 class _ShardedGen:
     """One generation: stacked per-shard sorted runs.  ``tier`` ∈
     {"full", "keys", "host"} (module doc)."""
@@ -362,6 +453,9 @@ class ShardedLeanZ3Index:
         self.t_min_ms: int | None = None
         self.t_max_ms: int | None = None
         self.dispatch_count = 0
+        #: stacked host-tier runs (lazy; seek cost flat in run count —
+        #: round-4 VERDICT #9, same as the single-chip index)
+        self._host_stack = None
         #: per-INSTANCE bucket-padding sentinels, keyed tier — instance
         #: scope (not a module cache) ties their device arrays to this
         #: index's lifetime, keeps eviction from stealing a sentinel
@@ -442,6 +536,7 @@ class ShardedLeanZ3Index:
         for gen in self.generations[:-1]:
             if gen.tier == "keys":
                 gen.spill_to_host()
+                self._host_stack = None   # restacked on the next query
                 if self._per_shard_resident() <= self.hbm_budget_bytes:
                     return
         if self._per_shard_resident() > self.hbm_budget_bytes:
@@ -691,14 +786,15 @@ class ShardedLeanZ3Index:
                 cand_parts += self._scan_tier(
                     keys_gens, t_keys, rb, rlo, rhi, rq, pos_bits,
                     exact_args=None)
-        # host tier: numpy seeks over this process's spilled runs (its
-        # local rows) — no dispatch at all
-        for gen in host_gens:
-            for run in gen.runs:
-                coded = run.candidates(ra["rbin"], ra["rzlo"],
-                                       ra["rzhi"], ra["rqid"], pos_bits)
-                if len(coded):
-                    cand_parts.append(coded)
+        # host tier: stacked numpy seeks over this process's spilled
+        # runs (its local rows) — flat in run count, no dispatch at all
+        # (round-4 VERDICT #9)
+        if host_gens:
+            coded = self._host_runs_stack(host_gens).candidates(
+                ra["rbin"], ra["rzlo"], ra["rzhi"], ra["rqid"],
+                pos_bits)
+            if len(coded):
+                cand_parts.append(coded)
 
         mask_bits = (np.int64(1) << pos_bits) - 1
         flat = (np.concatenate(cand_parts) if cand_parts
@@ -743,7 +839,124 @@ class ShardedLeanZ3Index:
             out.append(np.unique(hg[hq == q]))
         return out
 
+    # -- aggregation push-down (round-4 VERDICT #2) -----------------------
+    def density(self, boxes, t_lo_ms, t_hi_ms, env,
+                width: int = 256, height: int = 256,
+                max_ranges: int = 2000) -> np.ndarray:
+        """DensityScan push-down over the mesh: per-shard grids
+        accumulated inside shard_map and merged with psum over ICI —
+        full tier masks exactly on its sorted payload, keys tier
+        decodes cell-granular coordinates from the z key, host-tier
+        runs contribute numpy partials summed across processes.  Only
+        grids ever leave the devices (DensityScan.scala:31-59)."""
+        grid = np.zeros((height, width), np.float64)
+        if self._n_total == 0:
+            return grid
+        lo, hi = self._clamp_time(t_lo_ms, t_hi_ms)
+        bxs = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+        from ..index.z3_lean import _MAX_RANGES_PER_WINDOW, _bins_spanned
+        budget = min(max_ranges * _bins_spanned(lo, hi, self.period),
+                     _MAX_RANGES_PER_WINDOW)
+        plan = plan_z3_query(bxs, lo, hi, self.period, budget,
+                             sfc=self.sfc)
+        if plan.num_ranges == 0:
+            return grid
+        ra = pad_ranges(
+            {"rbin": plan.rbin, "rzlo": plan.rzlo, "rzhi": plan.rzhi},
+            pad_pow2(plan.num_ranges))
+        rb = jnp.asarray(ra["rbin"])
+        rlo = jnp.asarray(ra["rzlo"])
+        rhi = jnp.asarray(ra["rzhi"])
+        b_lo, o_lo = to_binned_time(np.int64(max(0, lo)), self.period)
+        b_hi, o_hi = to_binned_time(np.int64(max(0, hi)), self.period)
+        tb = np.array([int(b_lo),
+                       self.sfc.time.normalize_scalar(float(o_lo)),
+                       int(b_hi),
+                       self.sfc.time.normalize_scalar(float(o_hi))],
+                      np.int64)
+        ixy = np.stack([np.array(
+            [self.sfc.lon.normalize_scalar(b[0]),
+             self.sfc.lat.normalize_scalar(b[1]),
+             self.sfc.lon.normalize_scalar(b[2]),
+             self.sfc.lat.normalize_scalar(b[3])], np.int32)
+            for b in bxs])
+        env_t = tuple(float(v) for v in env)
+        full_gens = [g for g in self.generations if g.tier == "full"]
+        keys_gens = [g for g in self.generations if g.tier == "keys"]
+        host_gens = [g for g in self.generations if g.tier == "host"]
+        dev_gens = full_gens + keys_gens
+        totals = np.empty((0, 0))
+        if dev_gens:
+            padded = self._pad_bucket(dev_gens, "keys")
+            count_cols: list = []
+            for gen in padded:
+                count_cols += [gen.bins, gen.z]
+            self.dispatch_count += 1
+            totals = _fetch_global(_count_program(
+                self.mesh, len(padded))(rb, rlo, rhi, *count_cols))
+
+        def _cap(tier_totals, n_padded):
+            per_gen = gather_capacity(int(tier_totals.max()),
+                                      minimum=self.DEFAULT_CAPACITY)
+            return per_gen * n_padded
+
+        if full_gens and int(totals[:, :len(full_gens)].sum()):
+            padded = self._pad_bucket(full_gens, "full")
+            cap = _cap(totals[:, :len(full_gens)], len(padded))
+            cols: list = []
+            for gen in padded:
+                cols += [gen.bins, gen.z, gen.pos, gen.x, gen.y, gen.t]
+            tenv = jnp.asarray(np.array(list(env_t) + [lo, hi],
+                                        np.float64))
+            self.dispatch_count += 1
+            grid += np.asarray(_density_program_full(
+                self.mesh, len(padded), cap, width, height, self.sfc)(
+                rb, rlo, rhi, jnp.asarray(bxs), tenv, *cols),
+                np.float64)
+        if keys_gens and int(totals[:, len(full_gens):len(dev_gens)]
+                             .sum()):
+            padded = self._pad_bucket(keys_gens, "keys")
+            cap = _cap(totals[:, len(full_gens):len(dev_gens)],
+                       len(padded))
+            cols = []
+            for gen in padded:
+                cols += [gen.bins, gen.z]
+            self.dispatch_count += 1
+            grid += np.asarray(_density_program_keys(
+                self.mesh, len(padded), cap, width, height, self.sfc)(
+                rb, rlo, rhi, jnp.asarray(ixy), jnp.asarray(tb),
+                jnp.asarray(np.asarray(env_t)), *cols), np.float64)
+        host_part = np.zeros((height, width), np.float64)
+        if host_gens:
+            host_part = self._host_runs_stack(host_gens).density_partial(
+                ra["rbin"], ra["rzlo"], ra["rzhi"], self.sfc, ixy, tb,
+                env_t, width, height)
+        if self._multihost:
+            from .multihost import allgather_concat
+            host_part = allgather_concat(
+                host_part[None]).sum(axis=0)
+        grid += host_part
+        return grid
+
+    def range_count(self, boxes, t_lo_ms, t_hi_ms,
+                    max_ranges: int = 2000) -> int:
+        """Masked hit count with no candidate materialization (exact on
+        full tiers / whole-extent scans; cell-inclusive otherwise)."""
+        return int(round(self.density(
+            boxes, t_lo_ms, t_hi_ms, (-180.0, -90.0, 180.0, 90.0),
+            1, 1, max_ranges=max_ranges).sum()))
+
     # -- scan helpers -----------------------------------------------------
+    def _host_runs_stack(self, host_gens: list):
+        """This process's spilled runs stacked into one
+        :class:`~geomesa_tpu.index.z3_lean.HostStack` (cached until the
+        next spill)."""
+        if self._host_stack is None:
+            from ..index.z3_lean import HostStack
+            self._host_stack = HostStack(
+                [run for gen in host_gens for run in gen.runs])
+        return self._host_stack
+
     def _pad_bucket(self, gens: list, tier: str) -> list:
         """Pad a generation list to the compile bucket with this
         index's shared full-size sentinel generation (zero seeks

@@ -101,9 +101,13 @@ class QueryPlanner:
             stats = store.stats_map()
             n_plan = (stats["count"].count
                       if getattr(store, "multihost", False) else len(batch))
+            lean = getattr(store, "lean", False)
             decider = StrategyDecider(
                 self.sft, stats, n_plan,
-                allowed_indices=getattr(store, "query_indices", None))
+                allowed_indices=getattr(store, "query_indices", None),
+                attr_z3_tier=not lean,
+                servable_attrs=(set(store._lean_attr_names())
+                                if lean else None))
             strategy = decider.decide(query.filter, explain,
                                       forced=query.hints.get("QUERY_INDEX"))
         plan_ms = plan_span.ms

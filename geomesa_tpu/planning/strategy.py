@@ -88,19 +88,53 @@ class StrategyDecider:
 
     def __init__(self, sft: FeatureType, stats: dict | None = None,
                  total_count: int = 0,
-                 allowed_indices: set[str] | None = None):
+                 allowed_indices: set[str] | None = None,
+                 attr_z3_tier: bool = True,
+                 servable_attrs: set[str] | None = None):
         """``allowed_indices`` further restricts the offered strategies
-        beyond the schema's ``geomesa.indices.enabled`` user data — the
-        store's lean profile serves only {z3, id} (plus full scans)."""
+        beyond the schema's ``geomesa.indices.enabled`` user data (the
+        store's lean profile serves {z3, id, attr} plus full scans).
+        ``attr_z3_tier``: whether the store's attribute index carries a
+        z3 secondary (full-fat yes; the lean generational attribute
+        index tiers by DATE only) — costing a spatial discount the
+        index cannot deliver would mis-prefer attr over z3.
+        ``servable_attrs``: the attributes the store can actually
+        index-serve (None = every indexed attribute) — the lean
+        lexicode covers numerics/dates/strings only, and offering a
+        strategy the executor must reject would turn a fallback-able
+        query into an error."""
         self.sft = sft
         self.stats = stats or {}
         self.total = max(1, total_count)
         self.allowed_indices = allowed_indices
+        self.attr_z3_tier = attr_z3_tier
+        self.servable_attrs = servable_attrs
 
     # -- cost estimates (StatsBasedEstimator spirit) ----------------------
     def _spatial_fraction(self, geometries) -> float:
+        """Estimated fraction of the data a query geometry set covers:
+        the intersection with the DATA extent (the maintained bbox
+        sketch) over that extent — a box covering all the data costs
+        ~1.0 even when it is tiny against the world, so a selective
+        attribute strategy can beat z3 there (round-4 VERDICT #1's
+        wide-bbox + selective-attribute case)."""
         if not geometries:
             return 1.0
+        bb = self.stats.get(f"{self.sft.geom_field}_bbox")
+        if bb is not None and not bb.is_empty:
+            x0, y0, x1, y1 = bb.bounds
+
+            def axis(qlo, qhi, lo, hi):
+                if hi - lo <= 0:   # degenerate extent: in or out
+                    return 1.0 if qlo <= lo <= qhi else 0.0
+                return max(0.0, (min(qhi, hi) - max(qlo, lo)) / (hi - lo))
+
+            inter = sum(axis(g.envelope.as_tuple()[0],
+                             g.envelope.as_tuple()[2], x0, x1)
+                        * axis(g.envelope.as_tuple()[1],
+                               g.envelope.as_tuple()[3], y0, y1)
+                        for g in geometries)
+            return min(1.0, inter)
         area = sum(g.envelope.area for g in geometries)
         return min(1.0, area / (360.0 * 180.0))
 
@@ -210,6 +244,8 @@ class StrategyDecider:
 
         indexed = ({a.name for a in sft.attributes if a.indexed}
                    if self._enabled("attr") else set())
+        if self.servable_attrs is not None:
+            indexed &= self.servable_attrs
         for attr, kind, payload in _collect_attr_predicates(f, indexed):
             cost = self._attr_cost(attr, kind, payload)
             # secondary tiers narrow equality/IN runs (tiered-range
@@ -221,7 +257,7 @@ class StrategyDecider:
             if tiered_ivs:
                 cost *= self._temporal_fraction(all_ivs)
             if (dtg and geom and sft.is_points and kind in ("equals", "in")
-                    and spatial):
+                    and spatial and self.attr_z3_tier):
                 tiered_geoms = tuple(geoms.values)
                 cost *= sp_frac
             out.append(FilterStrategy(

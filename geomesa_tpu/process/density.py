@@ -65,21 +65,43 @@ def density_process(store, schema: str, query, env,
     """Run ``query`` and accumulate matching features into a (height, width)
     weighted grid over envelope ``env`` (xmin, ymin, xmax, ymax)."""
     mesh = getattr(store, "_mesh", None)
-    if mesh is not None and getattr(store, "_auth_provider", None) is None:
+    if getattr(store, "_auth_provider", None) is None:
         from ..planning.planner import Query
         q = query if isinstance(query, Query) else Query.of(query)
         sft = store.get_schema(schema)
         st = store._store(schema)
-        if (sft.is_points and sft.dtg_field and st.batch is not None
-                and len(st.batch)):
+        lean = getattr(st, "lean", False)
+        if ((mesh is not None or lean)
+                and sft.is_points and sft.dtg_field
+                and st.batch is not None
+                and (len(st.batch) or getattr(st, "multihost", False))):
             plan = _bbox_time_only(q.filter, sft.geom_field, sft.dtg_field)
             if plan is not None:
                 boxes, lo, hi = plan
-                weights = (st.batch.column(weight_attr).astype(np.float64)
-                           if weight_attr else None)
-                grid = st.z3_index().density(
-                    boxes, lo, hi, env, width, height, weights=weights)
-                return np.asarray(grid)
+                if lean:
+                    # lean push-down (round-4 VERDICT #2): grids
+                    # accumulate next to the keys per tier; never
+                    # materialize a hit.  Tombstones and per-row
+                    # weights need row access — fall through to the
+                    # query path for those (the gate is AGREED under
+                    # multihost so no process strands a collective)
+                    has_tomb = int(st.tombstone is not None
+                                   and bool(st.tombstone.any()))
+                    if getattr(st, "multihost", False):
+                        from ..parallel.multihost import agreed_int
+                        has_tomb = agreed_int(has_tomb, "max")
+                    if not has_tomb and weight_attr is None:
+                        grid = st.z3_index().density(
+                            boxes, lo, hi, env, width, height)
+                        return np.asarray(grid)
+                else:
+                    weights = (st.batch.column(weight_attr)
+                               .astype(np.float64)
+                               if weight_attr else None)
+                    grid = st.z3_index().density(
+                        boxes, lo, hi, env, width, height,
+                        weights=weights)
+                    return np.asarray(grid)
     result = store.query_result(schema, query)
     batch = result.batch
     if len(batch) == 0:

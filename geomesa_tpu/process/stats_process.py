@@ -21,10 +21,17 @@ def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
     folds per-shard partials through the Stat monoid (the reference's
     per-node StatsScan + client Reducer, iterators/StatsScan.scala:125)."""
     mesh = getattr(store, "_mesh", None)
-    if mesh is not None and getattr(store, "_auth_provider", None) is None:
-        pushed = _collective_stats(store, schema, query, stat_spec)
-        if pushed is not None:
-            return pushed
+    if getattr(store, "_auth_provider", None) is None:
+        st0 = store._store(schema)
+        if getattr(st0, "lean", False):
+            pushed = _lean_count_pushdown(store, schema, query,
+                                          stat_spec)
+            if pushed is not None:
+                return pushed
+        elif mesh is not None:
+            pushed = _collective_stats(store, schema, query, stat_spec)
+            if pushed is not None:
+                return pushed
     result = store.query_result(schema, query)
     # gate on positions, not the batch: under multihost positions is the
     # GLOBAL gid list (identical everywhere) while the local batch slice
@@ -42,6 +49,62 @@ def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
     stat = parse_stat(stat_spec)
     if len(result.batch):
         stat.observe(result.batch)
+    return stat
+
+
+def _lean_count_pushdown(store, schema: str, query, stat_spec: str):
+    """Count() on a lean store answered from the keys with NO candidate
+    materialization (round-4 VERDICT #2 / StatsScan.scala's Count
+    aggregate): the tiered range_count.  Returns None — falling back to
+    the materializing path — unless the count is provably EXACT: every
+    generation full-tier (value-exact device masks), or a whole-extent
+    scan (cell-granular masks cover everything by construction).
+    Tombstones need row visibility, so any tombstone falls back too
+    (the gate is agreed under multihost)."""
+    from ..planning.planner import Query
+    from ..stats.stat import CountStat, SeqStat
+    from .density import _bbox_time_only
+
+    stat = parse_stat(stat_spec)
+    stats = stat.stats if isinstance(stat, SeqStat) else [stat]
+    if not all(isinstance(s, CountStat) for s in stats):
+        return None
+    q = query if isinstance(query, Query) else Query.of(query)
+    sft = store.get_schema(schema)
+    st = store._store(schema)
+    if not (sft.is_points and sft.dtg_field and st.batch is not None):
+        return None
+    plan = _bbox_time_only(q.filter, sft.geom_field, sft.dtg_field)
+    if plan is None:
+        return None
+    boxes, lo, hi = plan
+    has_tomb = int(st.tombstone is not None
+                   and bool(st.tombstone.any()))
+    if getattr(st, "multihost", False):
+        from ..parallel.multihost import agreed_int
+        has_tomb = agreed_int(has_tomb, "max")
+    if has_tomb:
+        return None
+    idx = st.z3_index()
+    tiers = idx.tier_counts()
+    all_full = tiers["keys"] == 0 and tiers["host"] == 0
+    if not all_full:
+        # cell-granular tiers are exact only for whole-extent scans
+        bb = st.stats_map().get(f"{sft.geom_field}_bbox")
+        if bb is None or bb.is_empty:
+            return None
+        x0, y0, x1, y1 = bb.bounds
+        covered = any(b[0] <= x0 and b[1] <= y0
+                      and b[2] >= x1 and b[3] >= y1 for b in boxes)
+        t_open = ((lo is None or (idx.t_min_ms is not None
+                                  and lo <= idx.t_min_ms))
+                  and (hi is None or (idx.t_max_ms is not None
+                                      and hi >= idx.t_max_ms)))
+        if not (covered and t_open):
+            return None
+    count = idx.range_count(boxes, lo, hi)
+    for s in stats:
+        s.count = int(count)
     return stat
 
 

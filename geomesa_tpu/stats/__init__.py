@@ -13,6 +13,7 @@ distributed StatsScan relies on (index/iterators/StatsScan.scala).
 """
 
 from .stat import (
+    BBoxStat,
     CountStat,
     DescriptiveStats,
     EnumerationStat,

@@ -137,6 +137,69 @@ class MinMax(Stat):
 
 
 @dataclass
+class BBoxStat(Stat):
+    """Data envelope of a geometry attribute — the planner's spatial
+    selectivity DENOMINATOR: a query box is fractioned against the
+    data's extent, not the whole world (reference: MinMax[Geometry]
+    feeding StatsBasedEstimator's spatial estimates)."""
+
+    kind = "bbox"
+    attr: str = ""
+    xmin: float | None = None
+    ymin: float | None = None
+    xmax: float | None = None
+    ymax: float | None = None
+
+    def observe(self, batch):
+        try:
+            x = _col(batch, f"{self.attr}_x")
+            y = _col(batch, f"{self.attr}_y")
+        except (KeyError, AttributeError):
+            try:   # non-point schemas: per-row envelopes (n, 4)
+                bb = np.asarray(_col(batch, f"{self.attr}_bbox"))
+                if bb.ndim != 2 or not len(bb):
+                    return
+                self._fold(bb[:, 0].min(), bb[:, 1].min(),
+                           bb[:, 2].max(), bb[:, 3].max())
+                return
+            except (KeyError, AttributeError):
+                return
+        if len(x) == 0:
+            return
+        self._fold(x.min(), y.min(), x.max(), y.max())
+
+    def _fold(self, x0, y0, x1, y1):
+        if self.xmin is None:
+            self.xmin, self.ymin = float(x0), float(y0)
+            self.xmax, self.ymax = float(x1), float(y1)
+        else:
+            self.xmin = min(self.xmin, float(x0))
+            self.ymin = min(self.ymin, float(y0))
+            self.xmax = max(self.xmax, float(x1))
+            self.ymax = max(self.ymax, float(y1))
+
+    def merge(self, other):
+        out = BBoxStat(self.attr, self.xmin, self.ymin,
+                       self.xmax, self.ymax)
+        if other.xmin is not None:
+            out._fold(other.xmin, other.ymin, other.xmax, other.ymax)
+        return out
+
+    @property
+    def is_empty(self):
+        return self.xmin is None
+
+    @property
+    def bounds(self):
+        return (self.xmin, self.ymin, self.xmax, self.ymax)
+
+    def to_json(self):
+        return {"kind": self.kind, "attr": self.attr,
+                "xmin": self.xmin, "ymin": self.ymin,
+                "xmax": self.xmax, "ymax": self.ymax}
+
+
+@dataclass
 class Histogram(Stat):
     """Fixed-bin numeric histogram (the planner's selectivity source —
     reference: utils/stats/Histogram with binned Bounds)."""
@@ -579,6 +642,9 @@ def stat_from_json(obj: dict) -> Stat:
         return CountStat(obj["count"])
     if kind == "minmax":
         return MinMax(obj["attr"], obj["min"], obj["max"])
+    if kind == "bbox":
+        return BBoxStat(obj["attr"], obj["xmin"], obj["ymin"],
+                        obj["xmax"], obj["ymax"])
     if kind == "histogram":
         return Histogram(obj["attr"], obj["bins"], obj["lo"], obj["hi"],
                          np.asarray(obj["counts"], dtype=np.int64))

@@ -35,6 +35,17 @@ _xb._backend_factories.pop("axon", None)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# CI-sized attribute-index generations: the production 16M-slot default
+# would make every CPU append sort a 16M-slot run per indexed attribute
+# (the same sizing discipline as the multihost worker's sharded lean
+# generations — ROUND4.md "CI at 10x speed"); rollover/spill paths get
+# exercised MORE at this size, not less
+from geomesa_tpu.index.attr_lean import LeanAttrIndex  # noqa: E402
+from geomesa_tpu.parallel.attr_lean import ShardedLeanAttrIndex  # noqa: E402
+
+LeanAttrIndex.GENERATION_SLOTS = 1 << 16
+ShardedLeanAttrIndex.GENERATION_SLOTS = 1 << 13
+
 
 @pytest.fixture(scope="session")
 def rng():

@@ -276,14 +276,20 @@ from geomesa_tpu.parallel.lean import ShardedLeanZ3Index
 # every CPU-mesh append sort a 4M-slot run per shard — minutes of pure
 # sort time across the worker; 16k slots exercise identical code paths
 ShardedLeanZ3Index.GENERATION_SLOTS = 1 << 14
+from geomesa_tpu.parallel.attr_lean import ShardedLeanAttrIndex
+ShardedLeanAttrIndex.GENERATION_SLOTS = 1 << 13
 dsl = TpuDataStore(mesh=mesh, multihost=True)
-dsl.create_schema("lean", "score:Double,dtg:Date,*geom:Point;"
+dsl.create_schema("lean", "name:String:index=true,score:Double,"
+                          "dtg:Date,*geom:Point;"
                           "geomesa.index.profile=lean")
 nl = 700 + proc * 11
 lx = rng.uniform(-75, -73, nl); ly = rng.uniform(40, 42, nl)
 lt = rng.integers(MS, MS + 14 * 86_400_000, nl)
 lsc = rng.uniform(0, 100, nl)
-dsl.write("lean", {"score": lsc, "dtg": lt, "geom": (lx, ly)})
+lnm = rng.choice(np.array(["aa", "bb", "rare"], object), nl,
+                 p=[.6, .37, .03])
+dsl.write("lean", {"name": lnm, "score": lsc, "dtg": lt,
+                   "geom": (lx, ly)})
 lst = dsl._store("lean")
 assert isinstance(lst.index("z3"), ShardedLeanZ3Index)
 assert len(lst.batch) == nl                  # data stays distributed
@@ -298,13 +304,60 @@ lr = np.asarray(lgot.positions) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
 assert np.array_equal(np.sort(lr[lp == proc]), lwant), (
     len(lr[lp == proc]), len(lwant))
 assert len(lgot.batch) == len(lwant)
+# round-5: the sharded lean ATTRIBUTE tier under multihost — equality
+# served from the (key, sec, gid) generational runs, candidates fetched
+# globally, residual-filtered per process, survivors allgathered
+assert isinstance(lst.attribute_index("name"), ShardedLeanAttrIndex)
+aecql = "name = 'rare'"
+agot = dsl.query_result("lean", aecql)
+assert agot.strategy.index == "attr:name", agot.strategy
+awant = np.flatnonzero(evaluate_filter(parse_ecql(aecql), lfb))
+ap = np.asarray(agot.positions) >> GID_PROC_SHIFT
+ar = np.asarray(agot.positions) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
+assert np.array_equal(np.sort(ar[ap == proc]), awant), (
+    len(ar[ap == proc]), len(awant))
+# equality + time window rides the (key, sec) date tier
+awin = ("name = 'aa' AND dtg DURING "
+        "2018-01-03T00:00:00Z/2018-01-05T00:00:00Z")
+agot2 = dsl.query_result("lean", awin)
+awant2 = np.flatnonzero(evaluate_filter(parse_ecql(awin), lfb))
+ap2 = np.asarray(agot2.positions) >> GID_PROC_SHIFT
+ar2 = np.asarray(agot2.positions) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
+assert np.array_equal(np.sort(ar2[ap2 == proc]), awant2)
+print(f"[p{proc}] sharded lean attr tier: eq={len(agot.positions)} "
+      f"eq+win={len(agot2.positions)}")
+
+# tight per-shard budget: attr generations spill to the OWNING process,
+# the stacked host bisection still answers, and both processes see the
+# same GLOBAL candidate list
+slots_a = 1 << 9
+aidx = ShardedLeanAttrIndex("name", "string", mesh=mesh,
+                            multihost=True, generation_slots=slots_a,
+                            hbm_budget_bytes=slots_a * 24 * 2)
+na = 4000   # equal per process: every append is collective
+anm = rng.choice(np.array(["x", "y", "rareish"], object), na,
+                 p=[.5, .47, .03])
+adt = rng.integers(MS, MS + 14 * 86_400_000, na)
+for s in range(0, na, 1000):
+    aidx.append(anm[s:s + 1000], adt[s:s + 1000], base_gid=s)
+atc = aidx.tier_counts()
+assert atc["host"] >= 1, atc
+acand = aidx.query_equals("rareish")
+acp = np.asarray(acand) >> GID_PROC_SHIFT
+acr = np.asarray(acand) & ((np.int64(1) << GID_PROC_SHIFT) - 1)
+assert np.array_equal(np.sort(acr[acp == proc]),
+                      np.flatnonzero(anm == "rareish"))
+print(f"[p{proc}] sharded lean attr spill: {atc} "
+      f"cand={len(acand)}")
+
 # prefixed implicit id lookup: one row of proc 0
 one_l = dsl.query_result("lean", "IN ('p0.5')")
 assert len(one_l.positions) == 1
 assert len(one_l.batch) == (1 if proc == 0 else 0)
 # incremental collective append
 ml = 30 + proc * 3
-dsl.write("lean", {"score": rng.uniform(0, 100, ml),
+dsl.write("lean", {"name": np.full(ml, "aa", dtype=object),
+                   "score": rng.uniform(0, 100, ml),
                    "dtg": rng.integers(MS, MS + 14 * 86_400_000, ml),
                    "geom": (rng.uniform(-75, -73, ml),
                             rng.uniform(40, 42, ml))})

@@ -176,6 +176,87 @@ def test_budget_demotes_payload_then_spills(data):
                                   _brute(ax, ay, at, [box], lo, hi))
 
 
+def test_budget_reserves_live_generation_payload(data):
+    """The NEWEST generation keeps its device payload under budget
+    pressure (round-4 VERDICT #5): older payloads drop and older key
+    runs spill to host to make room, so the hot window is served by the
+    fused device-exact path at any store size."""
+    x, y, t = data
+    slots = 1 << 14
+    # budget holds: live full gen (40 B/slot) + both sentinels
+    # (16 + 40 B/slot) + ~1 keys-tier gen (16 B/slot); 4 generations of
+    # data must therefore end mixed full/keys/host with full >= 1
+    budget = slots * (40 + 16 + 40 + 16 + 8)
+    idx = LeanZ3Index(period="week", generation_slots=slots,
+                      hbm_budget_bytes=budget, payload_on_device=True)
+    idx.append(x, y, t)   # 60k rows -> 4 generations
+    tiers = idx.tier_counts()
+    assert tiers["full"] >= 1
+    assert idx.generations[-1].tier == "full"   # the LIVE one
+    assert tiers["host"] >= 1                   # others made room
+    assert idx.device_bytes() <= budget
+    box = (-74.5, 40.5, -73.5, 41.5)
+    lo, hi = MS + 2 * DAY, MS + 9 * DAY
+    np.testing.assert_array_equal(idx.query([box], lo, hi),
+                                  _brute(x, y, t, [box], lo, hi))
+    # a hot-window query touching only the live generation's rows is
+    # answered exactly too (served from the fused device path)
+    np.testing.assert_array_equal(
+        idx.query([box], None, None),
+        _brute(x, y, t, [box], None, None))
+
+
+def test_host_stack_flat_seek_many_runs(monkeypatch):
+    """50+ host-spilled runs answer a query batch with a BOUNDED number
+    of searchsorted/bisection passes — the stacked seek is flat in run
+    count (round-4 VERDICT #9), not a Python loop per run per bin."""
+    rng = np.random.default_rng(21)
+    n = 60_000
+    x = rng.uniform(-75, -73, n)
+    y = rng.uniform(40, 42, n)
+    t = rng.integers(MS, MS + 21 * DAY, n)
+    slots = 1 << 10
+    idx = LeanZ3Index(period="week", generation_slots=slots,
+                      hbm_budget_bytes=2 * slots * (16 + 16 + 40),
+                      payload_on_device=False)
+    idx.append(x, y, t)
+    tiers = idx.tier_counts()
+    assert tiers["host"] >= 50
+    import geomesa_tpu.index.z3_lean as zl
+    calls = {"searchsorted": 0, "bisect": 0}
+    real_ss = np.searchsorted
+    real_bs = zl._bisect_segments
+
+    def count_ss(*a, **k):
+        calls["searchsorted"] += 1
+        return real_ss(*a, **k)
+
+    def count_bs(*a, **k):
+        calls["bisect"] += 1
+        return real_bs(*a, **k)
+
+    monkeypatch.setattr(zl.np, "searchsorted", count_ss)
+    monkeypatch.setattr(zl, "_bisect_segments", count_bs)
+    box = (-74.5, 40.5, -73.5, 41.5)
+    lo, hi = MS + 2 * DAY, MS + 9 * DAY
+    got = idx.query([box], lo, hi)
+    # flat: exactly 2 bisection passes serve all 50+ runs (the old path
+    # did 2 searchsorted calls x runs x distinct bins); the global
+    # searchsorted count (numpy is patched module-wide, so planning
+    # bookkeeping is included) must stay below one call per run
+    assert calls["bisect"] == 2
+    assert calls["searchsorted"] < tiers["host"]
+    np.testing.assert_array_equal(got, _brute(x, y, t, [box], lo, hi))
+    # spill MORE runs: the stack rebuilds and stays exact
+    x2 = rng.uniform(-74.4, -73.6, 5_000)
+    y2 = rng.uniform(40.6, 41.4, 5_000)
+    t2 = rng.integers(MS, MS + 21 * DAY, 5_000)
+    idx.append(x2, y2, t2)
+    ax, ay, at = np.r_[x, x2], np.r_[y, y2], np.r_[t, t2]
+    np.testing.assert_array_equal(idx.query([box], lo, hi),
+                                  _brute(ax, ay, at, [box], lo, hi))
+
+
 def test_empty_and_budget_bookkeeping():
     idx = LeanZ3Index(period="week")
     # open bounds on an empty index must not crash in planning
