@@ -497,16 +497,21 @@ def _scale_stanza() -> dict:
     regression number.  ``SCALE_LIVE_N=0`` skips the live run."""
     out: dict = {}
     here = os.path.dirname(os.path.abspath(__file__))
-    for key, fn in (("recorded_500m", "SCALE_r03.json"),
-                    ("store_recorded", "STORE_SCALE_r04.json"),
-                    ("recorded_1b", "SCALE_1B_r04.json")):
-        rec = os.path.join(here, fn)
-        if os.path.exists(rec):
-            try:
-                with open(rec) as f:
-                    out[key] = json.load(f)
-            except Exception as e:
-                out[f"{key}_error"] = repr(e)
+    for key, fns in (
+            ("recorded_500m", ["SCALE_r03.json"]),
+            ("store_recorded", ["STORE_SCALE_r05.json",
+                                "STORE_SCALE_r04.json"]),
+            ("recorded_1b", ["SCALE_1B_r05.json",
+                             "SCALE_1B_r04.json"])):
+        for fn in fns:   # newest round's record wins when present
+            rec = os.path.join(here, fn)
+            if os.path.exists(rec):
+                try:
+                    with open(rec) as f:
+                        out[key] = json.load(f)
+                except Exception as e:
+                    out[f"{key}_error"] = repr(e)
+                break
     n_live = int(os.environ.get("SCALE_LIVE_N", 32_000_000))
     if n_live:
         try:

@@ -238,16 +238,23 @@ def _lean_scan_exact_coded(rb, rlo, rhi, rqid, boxes, bqid, qtlo, qthi,
 
 
 def _grid_accum(xc, yc, ok, env, width: int, height: int, grid):
-    """Scatter-add masked points into a flat (height*width) grid.
-    float64 accumulation: a float32 cell silently stops counting at
-    2^24 — reachable per dispatch at exactly the 1B scale the
-    push-down targets (review r5)."""
+    """Count masked points into a flat (height*width) float64 grid via
+    sort + boundary differences (the ops/density.density_grid_sorted
+    shape): integer counts from searchsorted bounds are EXACT at any
+    magnitude (no f32 saturation at 2^24 — review r5) and the native
+    int32 sort beats TPU's emulated-f64 scatter-add by ~20x at scale
+    (11.8s → sub-second per 40M, measured on chip).  Masked rows sort
+    to a sentinel cell past the grid."""
     fx = (xc - env[0]) / jnp.maximum(env[2] - env[0], 1e-12) * width
     fy = (yc - env[1]) / jnp.maximum(env[3] - env[1], 1e-12) * height
     gx = jnp.clip(fx.astype(jnp.int32), 0, width - 1)
     gy = jnp.clip(fy.astype(jnp.int32), 0, height - 1)
-    return grid.at[gy * width + gx].add(
-        jnp.where(ok, jnp.float64(1.0), jnp.float64(0.0)))
+    flat = jnp.where(ok, gy * width + gx, jnp.int32(width * height))
+    flat_s = jnp.sort(flat)
+    bounds = jnp.searchsorted(
+        flat_s, jnp.arange(width * height + 1, dtype=jnp.int32),
+        side="left")
+    return grid + (bounds[1:] - bounds[:-1]).astype(jnp.float64)
 
 
 @partial(jax.jit, static_argnames=("sfc", "capacity", "width", "height"))
@@ -324,6 +331,47 @@ def _lean_density_keys(sfc, rb, rlo, rhi, ixy, tb, env, *cols,
         yd = sfc.lat.denormalize(iy, xp=jnp)
         grid = _grid_accum(xd, yd, ok, env, width, height, grid)
     return grid.reshape((height, width))
+
+
+@partial(jax.jit, static_argnames=("sfc", "width", "height", "world"))
+def _lean_density_sweep(sfc, env, *zs, width: int, height: int,
+                        world: bool):
+    """WHOLE-EXTENT DensityScan: no seek, no expand — every slot of
+    every generation decodes its grid cell straight from the z key and
+    counts via sort + boundary differences.  With a world envelope the
+    binning is pure integer arithmetic ((cell * width) >> precision —
+    exactly the midpoint binning for any width ≤ 2^20), so the whole
+    1B-heatmap path runs on native int ops; sentinel slots sort past
+    the grid."""
+    from ..curve.zorder import deinterleave3
+    grid = jnp.zeros((height * width,), jnp.float64)
+    p = sfc.lon.precision
+    for z in zs:
+        ok = z != _SENTINEL_Z
+        ix, iy, _it = deinterleave3(z.astype(jnp.uint64))
+        if world:
+            gx = ((ix.astype(jnp.int64) * width) >> p).astype(jnp.int32)
+            gy = ((iy.astype(jnp.int64) * height) >> p).astype(jnp.int32)
+        else:
+            xd = sfc.lon.denormalize(ix.astype(jnp.int32), xp=jnp)
+            yd = sfc.lat.denormalize(iy.astype(jnp.int32), xp=jnp)
+            fx = ((xd - env[0]) / jnp.maximum(env[2] - env[0], 1e-12)
+                  * width)
+            fy = ((yd - env[1]) / jnp.maximum(env[3] - env[1], 1e-12)
+                  * height)
+            gx = jnp.clip(fx.astype(jnp.int32), 0, width - 1)
+            gy = jnp.clip(fy.astype(jnp.int32), 0, height - 1)
+        flat = jnp.where(ok, gy * width + gx,
+                         jnp.int32(width * height))
+        flat_s = jnp.sort(flat)
+        bounds = jnp.searchsorted(
+            flat_s, jnp.arange(width * height + 1, dtype=jnp.int32),
+            side="left")
+        grid = grid + (bounds[1:] - bounds[:-1]).astype(jnp.float64)
+    return grid.reshape((height, width))
+
+
+_WORLD_ENV = (-180.0, -90.0, 180.0, 90.0)
 
 
 #: generation-count compile bucket for the multi-generation programs
@@ -1022,6 +1070,14 @@ class LeanZ3Index:
         grid = np.zeros((height, width), np.float64)
         if self._n_rows == 0:
             return grid
+        # whole-extent fast path: a covering box + the full time extent
+        # needs no seeks at all — sweep every generation's z column
+        lo_c, hi_c = self._clamp_time(t_lo_ms, t_hi_ms)
+        bxs0 = np.atleast_2d(np.asarray(boxes, dtype=np.float64))
+        covers = any(b[0] <= -180.0 and b[1] <= -90.0
+                     and b[2] >= 180.0 and b[3] >= 90.0 for b in bxs0)
+        if (covers and lo_c == self.t_min_ms and hi_c == self.t_max_ms):
+            return self._density_sweep(env, width, height)
         planned = self._plan_one(boxes, t_lo_ms, t_hi_ms, max_ranges)
         if planned is None:
             return grid
@@ -1111,6 +1167,51 @@ class LeanZ3Index:
             grid += self._host_stack.density_partial(
                 ra["rbin"], ra["rzlo"], ra["rzhi"], self.sfc, ixy, tb,
                 env_t, width, height)
+        return grid
+
+    def _density_sweep(self, env, width: int, height: int) -> np.ndarray:
+        """Whole-extent grid: one sweep dispatch per generation bucket
+        (device) + one numpy pass over the stacked host runs."""
+        from ..curve.zorder import deinterleave3
+        env_t = tuple(float(v) for v in env)
+        world = env_t == _WORLD_ENV
+        env_j = jnp.asarray(np.asarray(env_t))
+        grid = np.zeros((height, width), np.float64)
+        dev = [g for g in self.generations if g.tier != "host"]
+        for s in range(0, max(len(dev), 0), _GEN_BUCKET * 2):
+            group = self._pad_bucket(dev[s:s + _GEN_BUCKET * 2])
+            zs = [(self._sentinel_cols("keys")[1] if g is None
+                   else g.z) for g in group]
+            self.dispatch_count += 1
+            grid += np.asarray(_lean_density_sweep(
+                self.sfc, env_j, *zs, width=width, height=height,
+                world=world), np.float64)
+        host_gens = [g for g in self.generations if g.tier == "host"]
+        if host_gens:
+            if self._host_stack is None:
+                self._host_stack = HostStack(
+                    [g.run for g in host_gens])
+            z = self._host_stack.z
+            ix, iy, _ = deinterleave3(z.astype(np.uint64), xp=np)
+            p = self.sfc.lon.precision
+            if world:
+                gx = (ix.astype(np.int64) * width) >> p
+                gy = (iy.astype(np.int64) * height) >> p
+            else:
+                xd = self.sfc.lon.denormalize(ix.astype(np.int64),
+                                              xp=np)
+                yd = self.sfc.lat.denormalize(iy.astype(np.int64),
+                                              xp=np)
+                gx = np.clip(((xd - env_t[0])
+                              / max(env_t[2] - env_t[0], 1e-12)
+                              * width).astype(np.int64), 0, width - 1)
+                gy = np.clip(((yd - env_t[1])
+                              / max(env_t[3] - env_t[1], 1e-12)
+                              * height).astype(np.int64), 0, height - 1)
+            grid += np.bincount(
+                (gy * width + gx).astype(np.int64),
+                minlength=width * height
+            )[:width * height].reshape((height, width))
         return grid
 
     def range_count(self, boxes, t_lo_ms, t_hi_ms,

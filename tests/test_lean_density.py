@@ -111,9 +111,9 @@ def test_store_density_process_pushdown_no_materialization(pts):
     idx = st.index("z3")
     before = idx.dispatch_count
     grid = density_process(ds, "evt", "INCLUDE", WORLD, 256, 128)
-    # probe + one grid dispatch: the whole-extent heatmap costs two
-    # round trips regardless of generation count, and no hits cross
-    assert idx.dispatch_count - before == 2
+    # the whole-extent sweep costs ONE dispatch per generation bucket
+    # (no probe, no expand) and no hits cross the wire
+    assert idx.dispatch_count - before == 1
     np.testing.assert_array_equal(
         grid, _brute_grid(x, y, np.ones(len(x), bool), WORLD, 256, 128))
 

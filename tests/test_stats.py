@@ -180,3 +180,36 @@ def test_stats_analyze_builds_range_histograms():
     after = ds.query_result("h", "v BETWEEN 10 AND 20").strategy.cost
     assert after < before / 2           # histogram sharpened the estimate
     assert ds.stat("h", "v_histogram") is not None
+
+
+def test_observe_shared_matches_per_stat_observe():
+    """The shared-intermediate observe path (factorize-based for object
+    strings, incl. the None → "None" convention) must fold identically
+    to each stat's own observe()."""
+    import numpy as np
+
+    from geomesa_tpu.stats.stat import (
+        CountStat, EnumerationStat, MinMax, TopK, observe_shared,
+    )
+    rng = np.random.default_rng(5)
+    n = 10_000
+    names = rng.choice(np.array(["a", "b", "c", None], object), n,
+                       p=[.5, .3, .15, .05])
+    vals = rng.uniform(0, 10, n)
+    batch = {"name": names, "v": vals}
+    shared = {"name_topk": TopK("name"),
+              "name_enumeration": EnumerationStat("name"),
+              "v_minmax": MinMax("v"), "count": CountStat()}
+    solo = {"name_topk": TopK("name"),
+            "name_enumeration": EnumerationStat("name"),
+            "v_minmax": MinMax("v"), "count": CountStat()}
+    observe_shared(shared, batch)
+    for s in solo.values():
+        s.observe(batch)
+    assert shared["count"].count == solo["count"].count
+    assert shared["v_minmax"].bounds == solo["v_minmax"].bounds
+    assert shared["name_enumeration"].counts == \
+        solo["name_enumeration"].counts
+    assert shared["name_topk"].counters == solo["name_topk"].counters
+    assert shared["name_enumeration"].counts.get("None") == \
+        int(sum(v is None for v in names))
