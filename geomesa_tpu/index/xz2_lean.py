@@ -1,0 +1,101 @@
+"""LeanXZ2Index: tiered generational XZ2 index — polygons/lines at the
+lean profile's scale (round-4 VERDICT #4).
+
+Round 4 capped non-point schemas at the full-fat host-side
+:class:`~geomesa_tpu.index.xz2.XZ2Index` (~150M/chip); the reference's
+XZ indexes are first-class at cluster scale
+(geomesa-z3/.../curve/XZ2SFC.scala:54-77,
+geomesa-index-api/.../index/z2/XZ2IndexKeySpace.scala:44).  This module
+is the XZ2 key space on the lean generational machinery: the sequence
+code IS an order-preserving int64, so the sorted runs, device/host
+residency tiers, HBM budget, stacked host bisection and batched
+seek programs of :class:`~geomesa_tpu.index.attr_lean.LeanAttrIndex`
+serve it verbatim (key = xz2 code, secondary unused).
+
+Queries plan covering code ranges host-side (``XZ2SFC.ranges`` — the
+published Böhm et al. arithmetic), seek all generations in the fixed
+dispatch pattern, and return CANDIDATE gids; the planner's residual
+filter applies the exact geometry predicate (the client-side re-check,
+exactly the full-fat index's split).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import DEFAULT_MAX_RANGES
+from ..curve.xz2 import xz2_sfc
+from ..geometry.types import Geometry
+from .attr_lean import LeanAttrIndex
+
+__all__ = ["LeanXZ2Index", "XZ2Facade"]
+
+
+class XZ2Facade:
+    """Shared XZ2 surface over a pluggable generational (key, sec, gid)
+    core — the single definition both the single-chip and the sharded
+    variants present (review r5: two hand-copied facades had already
+    drifted)."""
+
+    def __init__(self, core, g: int = 12):
+        self.g = g
+        self.sfc = xz2_sfc(g)
+        self._core = core
+
+    def __len__(self) -> int:
+        return len(self._core)
+
+    @property
+    def generations(self):
+        return self._core.generations
+
+    @property
+    def dispatch_count(self) -> int:
+        return self._core.dispatch_count
+
+    def device_bytes(self) -> int:
+        return self._core.device_bytes()
+
+    def tier_counts(self) -> dict:
+        return self._core.tier_counts()
+
+    def block(self) -> None:
+        self._core.block()
+
+    def append_bboxes(self, bbox: np.ndarray,
+                      base_gid: int | None = None) -> "XZ2Facade":
+        """Stream one slice of per-feature envelopes (n, 4) in: encode
+        sequence codes, merge into the current generation."""
+        bb = np.asarray(bbox, np.float64).reshape((-1, 4))
+        codes = self.sfc.index(bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3],
+                               xp=np).astype(np.int64)
+        self._core.append(codes, np.zeros(len(codes), np.int64),
+                          base_gid=base_gid)
+        return self
+
+    def query(self, geometry: Geometry,
+              max_ranges: int = DEFAULT_MAX_RANGES,
+              exact: bool = True) -> np.ndarray:
+        """CANDIDATE gids whose envelope code falls in the covering
+        ranges of ``geometry``'s envelope.  ``exact`` is accepted for
+        interface parity and ignored: exactness always comes from the
+        caller's residual geometry predicate (the planner re-checks
+        candidates; a device payload tier has nothing to re-check
+        against here — the code is envelope-granular by design)."""
+        env = geometry.envelope
+        ranges = self.sfc.ranges([env.as_tuple()],
+                                 max_ranges=max_ranges)
+        if not len(ranges) or not len(self):
+            return np.empty(0, dtype=np.int64)
+        return self._core.query_ranges(
+            [(int(lo), int(hi), None, None, 0) for lo, hi in ranges])
+
+
+class LeanXZ2Index(XZ2Facade):
+    """Single-chip generational tiered XZ2 index (module doc)."""
+
+    def __init__(self, g: int = 12, generation_slots: int | None = None,
+                 hbm_budget_bytes: int | None = None):
+        super().__init__(LeanAttrIndex(
+            "__xz2__", "long", generation_slots=generation_slots,
+            hbm_budget_bytes=hbm_budget_bytes), g=g)

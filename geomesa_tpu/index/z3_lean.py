@@ -338,11 +338,13 @@ def _lean_density_sweep(sfc, env, *zs, width: int, height: int,
                         world: bool):
     """WHOLE-EXTENT DensityScan: no seek, no expand — every slot of
     every generation decodes its grid cell straight from the z key and
-    counts via sort + boundary differences.  With a world envelope the
-    binning is pure integer arithmetic ((cell * width) >> precision —
-    exactly the midpoint binning for any width ≤ 2^20), so the whole
-    1B-heatmap path runs on native int ops; sentinel slots sort past
-    the grid."""
+    counts via sort + boundary differences.  With a world envelope AND
+    power-of-two grid dims the binning is pure integer arithmetic
+    ((cell * width) >> precision — exactly the midpoint binning when
+    width divides 2^precision, which pow2 widths ≤ 2^20 do); any other
+    envelope/width takes the f64 midpoint path so the fast and slow
+    scan paths always bin identically (review r5).  Sentinel slots
+    sort past the grid."""
     from ..curve.zorder import deinterleave3
     grid = jnp.zeros((height * width,), jnp.float64)
     p = sfc.lon.precision
@@ -1174,7 +1176,9 @@ class LeanZ3Index:
         (device) + one numpy pass over the stacked host runs."""
         from ..curve.zorder import deinterleave3
         env_t = tuple(float(v) for v in env)
-        world = env_t == _WORLD_ENV
+        world = (env_t == _WORLD_ENV
+                 and width & (width - 1) == 0
+                 and height & (height - 1) == 0)
         env_j = jnp.asarray(np.asarray(env_t))
         grid = np.zeros((height, width), np.float64)
         dev = [g for g in self.generations if g.tier != "host"]

@@ -48,8 +48,9 @@ from ..ops.search import (
     expand_ranges, gather_capacity, pad_pow2, searchsorted2,
 )
 from .scan import _fetch_global, encode_gids
+from ..index.xz2_lean import XZ2Facade as _XZ2Facade
 
-__all__ = ["ShardedLeanAttrIndex"]
+__all__ = ["ShardedLeanAttrIndex", "ShardedLeanXZ2Index"]
 
 _GEN_BUCKET = 4
 
@@ -198,6 +199,12 @@ class ShardedLeanAttrIndex:
         for g in self.generations:
             out[g.tier] += 1
         return out
+
+    def device_bytes(self) -> int:
+        """Total HBM across every shard's device generations."""
+        shards = int(self.mesh.devices.size)
+        return sum(g.per_shard_bytes() * shards
+                   for g in self.generations)
 
     def block(self) -> None:
         for gen in reversed(self.generations):
@@ -421,3 +428,20 @@ class ShardedLeanAttrIndex:
             raise TypeError("prefix queries require a string attribute")
         klo, khi = string_prefix_bounds(prefix)
         return self.query_ranges([(klo, khi, None, None, 0)])
+
+
+class ShardedLeanXZ2Index(_XZ2Facade):
+    """The lean XZ2 index over a mesh: the XZ2 sequence code rides the
+    sharded (key, sec, gid) generational machinery verbatim (key =
+    code, secondary unused) — non-point schemas at cluster scale
+    (round-4 VERDICT #4; XZ2IndexKeySpace.scala:44).  The query/append
+    surface is the shared XZ2Facade — one definition, no drift
+    (review r5)."""
+
+    def __init__(self, mesh: Mesh, g: int = 12, multihost: bool = False,
+                 generation_slots: int | None = None,
+                 hbm_budget_bytes: int | None = None):
+        super().__init__(ShardedLeanAttrIndex(
+            "__xz2__", "long", mesh=mesh, multihost=multihost,
+            generation_slots=generation_slots,
+            hbm_budget_bytes=hbm_budget_bytes), g=g)

@@ -10,6 +10,8 @@ whose ``where`` pushes ECQL predicates into the query planner.
 
 from . import functions as st
 from .frame import SpatialFrame
+from .join import explain_join, parse_join, sql_join
 from .parser import parse_sql, sql_query
 
-__all__ = ["st", "SpatialFrame", "sql_query", "parse_sql"]
+__all__ = ["st", "SpatialFrame", "sql_query", "parse_sql",
+           "sql_join", "parse_join", "explain_join"]

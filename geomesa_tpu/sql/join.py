@@ -1,0 +1,351 @@
+"""SQL joins: inner equi-joins and spatial joins between two schemas
+(round-4 VERDICT #8 — the reference's Spark SQL surface runs joins over
+spatial relations with push-down on each side,
+geomesa-spark/geomesa-spark-sql/.../GeoMesaSparkSQL.scala +
+org/apache/spark/sql/SQLRules.scala).
+
+Shape::
+
+    SELECT a.name, b.score FROM evt a JOIN obs b ON a.site = b.site
+        WHERE a.score > 50 AND b.kind = 'x' [LIMIT n]
+    SELECT ... FROM regions a JOIN points b
+        ON st_intersects(a.geom, b.geom) WHERE ...
+
+Planning: WHERE terms must be fully qualified; each term pushes down
+into ITS side's indexed scan (the SQLRules split), the join itself runs
+on the host columns:
+
+* equi-join — when the left side's distinct key set is small it becomes
+  an ``IN`` filter on the right side (served by the attribute index,
+  the JoinProcess trick); the pairing is a hash join either way.
+* spatial join — the left hits' envelopes batch into ONE
+  ``query_windows`` dispatch against the right side's z3 index (the
+  BatchScanner shape), then the exact geometry predicate filters the
+  candidate pairs.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..planning.planner import Query
+
+__all__ = ["parse_join", "is_join", "sql_join", "explain_join"]
+
+_JOIN_CLAUSE = re.compile(
+    r"^\s*SELECT\s+(?P<select>.+?)\s+FROM\s+(?P<lt>\w+)(?:\s+AS)?"
+    r"\s+(?P<la>\w+)\s+JOIN\s+(?P<rt>\w+)(?:\s+AS)?\s+(?P<ra>\w+)"
+    r"\s+ON\s+(?P<on>.+?)"
+    r"(?:\s+WHERE\s+(?P<where>.+?))?"
+    r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL)
+
+_ON_EQ = re.compile(r"^(\w+)\.(\w+)\s*=\s*(\w+)\.(\w+)$")
+_ON_SPATIAL = re.compile(
+    r"^st_(intersects|dwithin)\s*\(\s*(\w+)\.(\w+)\s*,\s*(\w+)\.(\w+)"
+    r"\s*(?:,\s*([0-9.eE+-]+)\s*)?\)$", re.IGNORECASE)
+
+#: join queries keep JOIN-free clauses out of scope loudly
+_UNSUPPORTED = re.compile(r"\b(GROUP\s+BY|HAVING|ORDER\s+BY)\b",
+                          re.IGNORECASE)
+
+#: cap on left-side hits for the spatial join's window batch — beyond
+#: this the batched windows would dominate; raise a clear error rather
+#: than degrade silently
+SPATIAL_JOIN_MAX_LEFT = 65_536
+
+
+def is_join(text: str) -> bool:
+    """Structural detection — the FROM clause must carry the join shape
+    (``FROM t a JOIN``); the bare word JOIN inside a string literal
+    must not hijack a normal query (review r5)."""
+    return bool(re.search(
+        r"\bFROM\s+\w+(?:\s+AS)?\s+\w+\s+JOIN\b", text, re.IGNORECASE))
+
+
+class ParsedJoin:
+    def __init__(self, left, right, la, ra, on_kind, on_payload,
+                 select, where_left, where_right, limit):
+        self.left, self.right = left, right
+        self.la, self.ra = la, ra
+        self.on_kind = on_kind          # 'equi' | 'intersects' | 'dwithin'
+        self.on_payload = on_payload    # (lcol, rcol[, dist])
+        self.select = select            # [(alias_side, col, out_name)]
+        self.where_left = where_left    # ECQL or None
+        self.where_right = where_right
+        self.limit = limit
+
+
+def parse_join(text: str) -> ParsedJoin:
+    if _UNSUPPORTED.search(text):
+        raise ValueError(
+            "JOIN queries support SELECT/ON/WHERE/LIMIT only — "
+            "aggregate the join output in the caller")
+    m = _JOIN_CLAUSE.match(text)
+    if not m:
+        raise ValueError(
+            f"unsupported JOIN statement: {text!r} (expected SELECT ... "
+            "FROM <schema> <alias> JOIN <schema> <alias> ON "
+            "<a.x = b.y | st_intersects(a.geom, b.geom)> [WHERE ...] "
+            "[LIMIT n])")
+    la, ra = m.group("la"), m.group("ra")
+    if la == ra:
+        raise ValueError(f"join aliases must differ (both {la!r})")
+    on = m.group("on").strip()
+    em = _ON_EQ.match(on)
+    sm = _ON_SPATIAL.match(on)
+    if em:
+        s1, c1, s2, c2 = em.groups()
+        sides = {s1: c1, s2: c2}
+        if set(sides) != {la, ra}:
+            raise ValueError(
+                f"ON must reference both aliases {la!r} and {ra!r}")
+        kind, payload = "equi", (sides[la], sides[ra])
+    elif sm:
+        fn, s1, c1, s2, c2, dist = sm.groups()
+        if {s1, s2} != {la, ra}:
+            raise ValueError(
+                f"ON must reference both aliases {la!r} and {ra!r}")
+        if s1 != la:     # normalize to (left geom, right geom)
+            c1, c2 = c2, c1
+        kind = fn.lower()
+        if kind == "dwithin":
+            if dist is None:
+                raise ValueError("st_dwithin needs a distance (meters)")
+            payload = (c1, c2, float(dist))
+        else:
+            payload = (c1, c2)
+    else:
+        raise ValueError(
+            f"unsupported ON condition {on!r} (expected "
+            "<a.x = b.y>, st_intersects(a.g, b.g) or "
+            "st_dwithin(a.g, b.g, meters))")
+    # SELECT list: qualified columns with optional aliases, or *
+    select = []
+    sel = m.group("select").strip()
+    if sel != "*":
+        for part in (p.strip() for p in sel.split(",")):
+            pm = re.match(r"^(\w+)\.(\w+)(?:\s+AS\s+(\w+))?$", part,
+                          re.IGNORECASE)
+            if not pm:
+                raise ValueError(
+                    f"unsupported JOIN projection {part!r} (use "
+                    "qualified columns: <alias>.<col> [AS name])")
+            side, col, out = pm.groups()
+            if side not in (la, ra):
+                raise ValueError(f"unknown alias {side!r} in projection "
+                                 f"{part!r} (have {la!r}, {ra!r})")
+            select.append((side, col, out or f"{side}.{col}"))
+    # WHERE: AND-split; every term fully on one side.  BETWEEN's
+    # internal AND is repaired after the split (review r5)
+    wl, wr = [], []
+    raw = m.group("where")
+    if raw:
+        parts = re.split(r"\s+AND\s+", raw.strip(),
+                         flags=re.IGNORECASE)
+        terms: list = []
+        for p in parts:
+            if terms and re.search(r"\bBETWEEN\s+\S+$", terms[-1],
+                                   re.IGNORECASE):
+                terms[-1] = f"{terms[-1]} AND {p}"
+            else:
+                terms.append(p)
+        for term in terms:
+            refs = {s for s, _ in re.findall(r"\b(\w+)\.(\w+)", term)
+                    if s in (la, ra)}
+            if len(refs) != 1:
+                raise ValueError(
+                    f"JOIN WHERE term {term!r} must reference exactly "
+                    "one side (qualify columns with the table alias); "
+                    "cross-side predicates belong in ON")
+            side = refs.pop()
+            stripped = re.sub(rf"\b{side}\.(\w+)", r"\1", term)
+            (wl if side == la else wr).append(stripped)
+    from .parser import _rewrite_where
+    where_left = _rewrite_where(" AND ".join(wl)) if wl else None
+    where_right = _rewrite_where(" AND ".join(wr)) if wr else None
+    return ParsedJoin(
+        m.group("lt"), m.group("rt"), la, ra, kind, payload, select,
+        where_left, where_right,
+        int(m.group("limit")) if m.group("limit") else None)
+
+
+#: left distinct-key cap for pushing the equi-join as an IN filter on
+#: the right side's attribute index (the JoinProcess trick)
+_IN_PUSHDOWN_MAX = 10_000
+
+
+def _pairs_equi(store, q: ParsedJoin, lres):
+    lcol, rcol = q.on_payload
+    lv = lres.batch.column(lcol)
+    uniq = (np.unique(lv[lv != np.array(None)])
+            if lv.dtype == object else np.unique(lv))
+    from ..filters.ast import And, In
+    from ..filters.ecql import parse_ecql
+    rfilter = (parse_ecql(q.where_right) if q.where_right
+               else None)
+    if 0 < len(uniq) <= _IN_PUSHDOWN_MAX:
+        semi = In(rcol, tuple(uniq.tolist()))
+        rfilter = semi if rfilter is None else And((rfilter, semi))
+    rres = store.query_result(q.right,
+                              Query(filter=rfilter) if rfilter
+                              else Query())
+    rv = rres.batch.column(rcol)
+    import pandas as pd
+    # SQL NULL semantics: NULL never equals NULL — mask None rows on
+    # BOTH sides so results cannot depend on whether the IN push-down
+    # fired (review r5: pandas merge pairs None==None)
+    li = np.arange(len(lv))
+    rj = np.arange(len(rv))
+    if lv.dtype == object:
+        keep = lv != np.array(None)
+        li, lv = li[keep], lv[keep]
+    if rv.dtype == object:
+        keep = rv != np.array(None)
+        rj, rv = rj[keep], rv[keep]
+    lp = pd.DataFrame({"i": li, "k": lv})
+    rp = pd.DataFrame({"j": rj, "k": rv})
+    merged = lp.merge(rp, on="k", how="inner")
+    return (merged["i"].to_numpy(), merged["j"].to_numpy(), rres)
+
+
+class _RightSlice:
+    """Quacks like a QueryResult for sql_join's column stage: the
+    candidate rows ONLY (never the whole right table — review r5)."""
+
+    def __init__(self, batch):
+        self.batch = batch
+
+
+def _pairs_spatial(store, q: ParsedJoin, lres):
+    from ..features.batch import FeatureBatch
+    from ..geometry.predicates import (
+        geometry_intersects, point_in_polygon,
+    )
+    from ..process.knn import haversine_m
+    lbatch = lres.batch
+    n_l = len(lbatch)
+    r_sft = store.get_schema(q.right)
+    if n_l > SPATIAL_JOIN_MAX_LEFT:
+        raise ValueError(
+            f"spatial join: left side matched {n_l} features "
+            f"(cap {SPATIAL_JOIN_MAX_LEFT}) — tighten the left WHERE")
+    if n_l == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.int64),
+                _RightSlice(FeatureBatch.empty(r_sft)))
+    dist_m = q.on_payload[2] if q.on_kind == "dwithin" else 0.0
+    lgeoms = ([lbatch.geoms.geometry(i) for i in range(n_l)]
+              if lbatch.geoms is not None else None)
+    if lgeoms is not None:
+        envs = [g.envelope.as_tuple() for g in lgeoms]
+    else:
+        lx, ly = lbatch.geom_xy()
+        envs = [(lx[i], ly[i], lx[i], ly[i]) for i in range(n_l)]
+    windows = []
+    for e in envs:
+        pad_lat = float(np.degrees(dist_m / 6_371_008.8)) * 1.05
+        # longitude degrees shrink by cos(lat): pad by the window's
+        # worst-case latitude or the join silently drops true pairs
+        # past ~48 deg (review r5)
+        cos = max(0.01, float(np.cos(np.radians(
+            min(88.0, max(abs(e[1]) , abs(e[3])) + pad_lat)))))
+        pad_lon = pad_lat / cos
+        windows.append(([(e[0] - pad_lon, e[1] - pad_lat,
+                          e[2] + pad_lon, e[3] + pad_lat)],
+                        None, None))
+    # ONE batched windows dispatch against the right index; only the
+    # CANDIDATE rows ever materialize (tombstones/visibility are
+    # already applied by query_windows)
+    hits = store.query_windows(q.right, windows)
+    flat = ([np.asarray(h, np.int64) for h in hits if len(h)]
+            or [np.empty(0, np.int64)])
+    union = np.unique(np.concatenate(flat))
+    st_r = store._store(q.right)
+    rb = st_r.batch.take(union) if len(union) \
+        else FeatureBatch.empty(r_sft)
+    if q.where_right and len(union):
+        from ..filters.ecql import parse_ecql
+        from ..filters.evaluate import evaluate_filter
+        mask = evaluate_filter(parse_ecql(q.where_right), rb)
+        union = union[mask]
+        rb = rb.take(np.flatnonzero(mask))
+    rmap = {int(p): j for j, p in enumerate(union)}
+    r_pts = r_sft.is_points
+    rx, ry = rb.geom_xy() if (r_pts and len(rb)) else (None, None)
+    li, rj = [], []
+    for i, cand in enumerate(hits):
+        rows = [rmap[int(c)] for c in cand if int(c) in rmap]
+        if not rows:
+            continue
+        rows = np.asarray(rows, np.int64)
+        if q.on_kind == "dwithin":
+            if not (r_pts and lgeoms is None):
+                raise ValueError("st_dwithin joins support point-to-"
+                                 "point schemas (use st_intersects "
+                                 "for polygon relations)")
+            d = haversine_m(envs[i][0], envs[i][1], rx[rows], ry[rows])
+            keep = rows[d <= dist_m]
+        elif r_pts and lgeoms is not None:
+            inside = point_in_polygon(rx[rows], ry[rows], lgeoms[i])
+            keep = rows[inside]
+        elif r_pts:
+            keep = rows[(rx[rows] == envs[i][0])
+                        & (ry[rows] == envs[i][1])]
+        else:
+            keep = np.asarray(
+                [r for r in rows if geometry_intersects(
+                    lgeoms[i], rb.geoms.geometry(int(r)))], np.int64)
+        li.extend([i] * len(keep))
+        rj.extend(keep.tolist())
+    return (np.asarray(li, np.int64), np.asarray(rj, np.int64),
+            _RightSlice(rb))
+
+
+def sql_join(store, text: str) -> dict:
+    """Execute a JOIN statement; returns a dict of output columns."""
+    q = parse_join(text)
+    lres = store.query_result(
+        q.left, Query.of(q.where_left) if q.where_left else Query())
+    if q.on_kind == "equi":
+        li, rj, rres = _pairs_equi(store, q, lres)
+    else:
+        li, rj, rres = _pairs_spatial(store, q, lres)
+    if q.limit is not None:
+        li, rj = li[:q.limit], rj[:q.limit]
+    lb, rb = lres.batch, rres.batch
+    select = q.select or (
+        [(q.la, a.name, f"{q.la}.{a.name}") for a in lb.sft.attributes
+         if not a.is_geometry]
+        + [(q.ra, a.name, f"{q.ra}.{a.name}") for a in rb.sft.attributes
+           if not a.is_geometry])
+    out: dict = {}
+    for side, col, name in select:
+        batch, rows = (lb, li) if side == q.la else (rb, rj)
+        if name in out:
+            raise ValueError(f"duplicate output column {name!r} — "
+                             "alias one side with AS")
+        out[name] = np.asarray(batch.column(col))[rows]
+    return out
+
+
+def explain_join(store, text: str) -> str:
+    """The join plan: each side's pushed-down strategy (via the store's
+    explain) + the join method — the SQLRules push-down made visible."""
+    q = parse_join(text)
+    parts = [f"JOIN plan: {q.left} {q.la} {q.on_kind.upper()} "
+             f"{q.right} {q.ra} ON {q.on_payload}"]
+    parts.append(f"-- left side ({q.left}): WHERE "
+                 f"{q.where_left or 'INCLUDE'}")
+    parts.append(store.explain(
+        q.left, Query.of(q.where_left) if q.where_left else Query()))
+    parts.append(f"-- right side ({q.right}): WHERE "
+                 f"{q.where_right or 'INCLUDE'}"
+                 + (" + semi-join IN push-down (attribute index) when "
+                    "the left key set is small"
+                    if q.on_kind == "equi" else
+                    " + batched envelope windows (z3 index)"))
+    parts.append(store.explain(
+        q.right, Query.of(q.where_right) if q.where_right else Query()))
+    return "\n".join(parts)

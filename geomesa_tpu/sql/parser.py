@@ -295,10 +295,14 @@ def sql_query(store, text: str):
     """Execute a SELECT against a TpuDataStore.
 
     Returns a :class:`FeatureBatch` for row queries, a dict of columns
-    for GROUP BY aggregations, a dict of scalars for global aggregates
-    (``SELECT sum(x), avg(y) FROM t WHERE …``), or a scalar for a bare
-    global count(*).
+    for GROUP BY aggregations (or for JOIN queries — ``SELECT a.x, b.y
+    FROM s1 a JOIN s2 b ON …``), a dict of scalars for global
+    aggregates (``SELECT sum(x), avg(y) FROM t WHERE …``), or a scalar
+    for a bare global count(*).
     """
+    from .join import is_join, sql_join
+    if is_join(text):
+        return sql_join(store, text)
     q = parse_sql(text)
     frame = SpatialFrame(store, q.table)
     if q.where:

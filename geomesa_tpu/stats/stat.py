@@ -660,10 +660,7 @@ def observe_shared(stats, batch) -> None:
         else:
             rest.append(s)
     for attr, ss in shared.items():
-        try:
-            col = _col(batch, attr)
-        except (KeyError, AttributeError):
-            continue
+        col = _col(batch, attr)   # missing column raises, like observe
         if col.dtype == object:
             try:
                 # hash-based factorize beats sort-based np.unique ~5x
@@ -675,9 +672,18 @@ def observe_shared(stats, batch) -> None:
                                   else codes, minlength=len(uniq))
                 uniq = np.asarray(uniq, dtype=object).astype(str)
                 n_na = len(codes) - int(valid.sum())
-                if n_na:               # old astype(str) counted "None"
-                    uniq = np.append(uniq, "None")
-                    cnt = np.append(cnt, n_na)
+                if n_na:
+                    # label NA values exactly as astype(str) would
+                    # ("None" / "nan"), so the incremental path and the
+                    # recompute path report identical keys (review r5)
+                    sub = col[~valid]
+                    n_none = sum(1 for v in sub if v is None)
+                    if n_none:
+                        uniq = np.append(uniq, "None")
+                        cnt = np.append(cnt, n_none)
+                    if n_na - n_none:
+                        uniq = np.append(uniq, "nan")
+                        cnt = np.append(cnt, n_na - n_none)
             except ImportError:  # pragma: no cover
                 uniq, cnt = np.unique(col.astype(str),
                                       return_counts=True)

@@ -241,8 +241,13 @@ def test_lean_rejections(ds):
         ds._store("evt").attribute_index("score")
     with pytest.raises(AttributeError, match="implicit ids"):
         _ = ds._store("evt").batch.ids
+    # round-5: non-point lean schemas are SERVED (the lean XZ2 tier);
+    # a lean schema with no geometry at all still rejects
+    ds.create_schema("poly-ok", "v:Int,*poly:Polygon;"
+                                "geomesa.index.profile=lean")
+    assert ds._store("poly-ok").lean_kind == "xz2"
     with pytest.raises(ValueError, match="point geometry"):
-        ds.create_schema("bad", "v:Int,*poly:Polygon;"
+        ds.create_schema("bad", "v:Int,dtg:Date;"
                                 "geomesa.index.profile=lean")
 
 

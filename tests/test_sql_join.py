@@ -1,0 +1,232 @@
+"""SQL joins (round-4 VERDICT #8): inner equi-join + spatial join
+between two schemas with per-side predicate push-down, validated
+against a pandas oracle.  Reference surface: GeoMesaSparkSQL.scala +
+SQLRules.scala (join relations with push-down on each side)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.sql import explain_join, sql_query
+
+MS = 1514764800000
+DAY = 86_400_000
+
+
+@pytest.fixture(scope="module")
+def stores():
+    rng = np.random.default_rng(17)
+    ds = TpuDataStore()
+    n1, n2 = 3000, 5000
+    ds.create_schema("evt", "site:String:index=true,score:Double,"
+                            "dtg:Date,*geom:Point")
+    ds.create_schema("obs", "site:String:index=true,kind:String,"
+                            "val:Double,dtg:Date,*geom:Point")
+    sites = np.array([f"s{i}" for i in range(40)], object)
+    e = {"site": rng.choice(sites, n1),
+         "score": rng.uniform(0, 100, n1),
+         "dtg": rng.integers(MS, MS + 7 * DAY, n1),
+         "geom": (rng.uniform(-75, -73, n1), rng.uniform(40, 42, n1))}
+    o = {"site": rng.choice(sites, n2),
+         "kind": rng.choice(np.array(["x", "y"], object), n2),
+         "val": rng.uniform(0, 10, n2),
+         "dtg": rng.integers(MS, MS + 7 * DAY, n2),
+         "geom": (rng.uniform(-75, -73, n2), rng.uniform(40, 42, n2))}
+    ds.write("evt", e)
+    ds.write("obs", o)
+    return ds, e, o
+
+
+def test_equi_join_matches_pandas(stores):
+    ds, e, o = stores
+    out = sql_query(ds, "SELECT a.site, a.score, b.val FROM evt a "
+                        "JOIN obs b ON a.site = b.site "
+                        "WHERE a.score > 90 AND b.kind = 'x'")
+    le = pd.DataFrame({"site": e["site"], "score": e["score"]})
+    ro = pd.DataFrame({"site": o["site"], "kind": o["kind"],
+                       "val": o["val"]})
+    want = le[le.score > 90].merge(ro[ro.kind == "x"], on="site")
+    got = pd.DataFrame({"site": out["a.site"], "score": out["a.score"],
+                        "val": out["b.val"]})
+    assert len(got) == len(want)
+    key = lambda d: d.sort_values(["site", "score", "val"]) \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(key(got),
+                                  key(want[["site", "score", "val"]]))
+
+
+def test_equi_join_select_star_and_limit(stores):
+    ds, e, o = stores
+    out = sql_query(ds, "SELECT * FROM evt a JOIN obs b "
+                        "ON a.site = b.site WHERE a.score > 99 LIMIT 7")
+    assert "a.site" in out and "b.val" in out
+    assert len(out["a.site"]) <= 7
+
+
+def test_equi_join_pushdown_visible_in_explain(stores):
+    ds, *_ = stores
+    plan = explain_join(ds, "SELECT a.site, b.val FROM evt a JOIN obs "
+                            "b ON a.site = b.site WHERE a.score > 90 "
+                            "AND b.kind = 'x'")
+    assert "left side" in plan and "right side" in plan
+    assert "semi-join IN push-down" in plan
+    # each side's WHERE went to ITS scan
+    assert "score > 90" in plan and "kind = 'x'" in plan
+
+
+def test_spatial_join_points_in_polygons():
+    rng = np.random.default_rng(23)
+    ds = TpuDataStore()
+    ds.create_schema("regions", "rid:Integer,*geom:Polygon")
+    ds.create_schema("pts", "pid:Integer,dtg:Date,*geom:Point")
+    # 12 disjoint square regions + labeled points, some outside any
+    boxes = []
+    rid = []
+    for i in range(12):
+        x0 = -75.0 + (i % 4) * 0.6
+        y0 = 40.0 + (i // 4) * 0.8
+        boxes.append((x0, y0, x0 + 0.4, y0 + 0.5))
+        rid.append(i)
+    from geomesa_tpu.geometry.types import Polygon
+    polys = [Polygon([(b[0], b[1]), (b[2], b[1]), (b[2], b[3]),
+                      (b[0], b[3])]) for b in boxes]
+    ds.write("regions", {"rid": np.array(rid), "geom": polys})
+    n = 4000
+    px = rng.uniform(-75.2, -72.2, n)
+    py = rng.uniform(39.8, 42.4, n)
+    ds.write("pts", {"pid": np.arange(n),
+                     "dtg": np.full(n, MS),
+                     "geom": (px, py)})
+    out = sql_query(ds, "SELECT a.rid, b.pid FROM regions a JOIN pts b "
+                        "ON st_intersects(a.geom, b.geom)")
+    # pandas/numpy oracle: point-in-box pairs (boundary-inclusive)
+    want = set()
+    for i, b in enumerate(boxes):
+        inside = np.flatnonzero((px >= b[0]) & (px <= b[2])
+                                & (py >= b[1]) & (py <= b[3]))
+        want.update((rid[i], int(p)) for p in inside)
+    got = set(zip(out["a.rid"].tolist(), out["b.pid"].tolist()))
+    assert got == want
+    # per-side push-down composes with the spatial ON
+    out2 = sql_query(ds, "SELECT a.rid, b.pid FROM regions a JOIN pts "
+                         "b ON st_intersects(a.geom, b.geom) "
+                         "WHERE a.rid = 3 AND b.pid < 2000")
+    want2 = {(r, p) for r, p in want if r == 3 and p < 2000}
+    got2 = set(zip(out2["a.rid"].tolist(), out2["b.pid"].tolist()))
+    assert got2 == want2
+
+
+def test_dwithin_join_point_to_point():
+    rng = np.random.default_rng(29)
+    ds = TpuDataStore()
+    ds.create_schema("anchor", "aid:Integer,dtg:Date,*geom:Point")
+    ds.create_schema("near", "nid:Integer,dtg:Date,*geom:Point")
+    ax = np.array([-74.0, -73.5])
+    ay = np.array([40.7, 41.2])
+    ds.write("anchor", {"aid": np.arange(2), "dtg": np.full(2, MS),
+                        "geom": (ax, ay)})
+    n = 2000
+    nx = rng.uniform(-74.3, -73.2, n)
+    ny = rng.uniform(40.4, 41.5, n)
+    ds.write("near", {"nid": np.arange(n), "dtg": np.full(n, MS),
+                      "geom": (nx, ny)})
+    out = sql_query(ds, "SELECT a.aid, b.nid FROM anchor a JOIN near b "
+                        "ON st_dwithin(a.geom, b.geom, 20000)")
+    from geomesa_tpu.process.knn import haversine_m
+    want = set()
+    for i in range(2):
+        d = haversine_m(ax[i], ay[i], nx, ny)
+        want.update((i, int(j)) for j in np.flatnonzero(d <= 20000))
+    got = set(zip(out["a.aid"].tolist(), out["b.nid"].tolist()))
+    assert got == want
+
+
+def test_join_word_in_literal_not_hijacked(stores):
+    ds, e, _ = stores
+    # 'join' inside a string literal must stay a normal query (review)
+    out = sql_query(ds, "SELECT count(*) FROM evt WHERE site = 'join'")
+    assert out == 0
+
+
+def test_join_where_between_survives_and_split(stores):
+    ds, e, o = stores
+    out = sql_query(ds, "SELECT a.site, b.val FROM evt a JOIN obs b "
+                        "ON a.site = b.site "
+                        "WHERE a.score BETWEEN 95 AND 99 "
+                        "AND b.kind = 'y'")
+    le = pd.DataFrame({"site": e["site"], "score": e["score"]})
+    ro = pd.DataFrame({"site": o["site"], "kind": o["kind"],
+                       "val": o["val"]})
+    want = le[(le.score >= 95) & (le.score <= 99)].merge(
+        ro[ro.kind == "y"], on="site")
+    assert len(out["a.site"]) == len(want)
+
+
+def test_equi_join_null_keys_never_match():
+    ds = TpuDataStore()
+    ds.create_schema("l", "k:String,dtg:Date,*geom:Point")
+    ds.create_schema("r", "k:String,dtg:Date,*geom:Point")
+    ds.write("l", {"k": np.array(["a", None, "b"], object),
+                   "dtg": np.full(3, MS),
+                   "geom": (np.zeros(3), np.zeros(3))})
+    ds.write("r", {"k": np.array([None, "a", None], object),
+                   "dtg": np.full(3, MS),
+                   "geom": (np.zeros(3), np.zeros(3))})
+    out = sql_query(ds, "SELECT a.k, b.k AS rk FROM l a JOIN r b "
+                        "ON a.k = b.k")
+    # SQL: NULL = NULL is not true — only the 'a' pair joins
+    assert list(out["a.k"]) == ["a"] and list(out["rk"]) == ["a"]
+
+
+def test_dwithin_join_high_latitude_pairs_survive():
+    # at 70N one longitude degree is ~38km; an under-padded window
+    # would drop a 15km-east pair (review)
+    ds = TpuDataStore()
+    ds.create_schema("anchor", "aid:Integer,dtg:Date,*geom:Point")
+    ds.create_schema("near", "nid:Integer,dtg:Date,*geom:Point")
+    ds.write("anchor", {"aid": np.array([0]), "dtg": np.array([MS]),
+                        "geom": (np.array([10.0]), np.array([70.0]))})
+    # ~15km due east at 70N is ~0.39 degrees of longitude
+    ds.write("near", {"nid": np.array([0]), "dtg": np.array([MS]),
+                      "geom": (np.array([10.39]), np.array([70.0]))})
+    out = sql_query(ds, "SELECT a.aid, b.nid FROM anchor a JOIN near b "
+                        "ON st_dwithin(a.geom, b.geom, 16000)")
+    assert len(out["a.aid"]) == 1
+
+
+class TestJoinGrammar:
+    def _ds(self):
+        ds = TpuDataStore()
+        ds.create_schema("t1", "k:String,dtg:Date,*geom:Point")
+        ds.create_schema("t2", "k:String,dtg:Date,*geom:Point")
+        for nm in ("t1", "t2"):
+            ds.write(nm, {"k": np.array(["a"], object),
+                          "dtg": np.array([MS]),
+                          "geom": (np.zeros(1), np.zeros(1))})
+        return ds
+
+    def test_same_alias_rejected(self):
+        with pytest.raises(ValueError, match="aliases must differ"):
+            sql_query(self._ds(), "SELECT a.k FROM t1 a JOIN t2 a "
+                                  "ON a.k = a.k")
+
+    def test_cross_side_where_rejected(self):
+        with pytest.raises(ValueError, match="exactly one side"):
+            sql_query(self._ds(), "SELECT a.k FROM t1 a JOIN t2 b "
+                                  "ON a.k = b.k WHERE a.k = b.k")
+
+    def test_group_by_rejected_loudly(self):
+        with pytest.raises(ValueError, match="SELECT/ON/WHERE/LIMIT"):
+            sql_query(self._ds(), "SELECT a.k FROM t1 a JOIN t2 b "
+                                  "ON a.k = b.k GROUP BY a.k")
+
+    def test_unqualified_projection_rejected(self):
+        with pytest.raises(ValueError, match="qualified columns"):
+            sql_query(self._ds(), "SELECT k FROM t1 a JOIN t2 b "
+                                  "ON a.k = b.k")
+
+    def test_duplicate_output_name_rejected(self):
+        with pytest.raises(ValueError, match="duplicate output"):
+            sql_query(self._ds(), "SELECT a.k AS k, b.k AS k FROM t1 a "
+                                  "JOIN t2 b ON a.k = b.k")
