@@ -1657,10 +1657,13 @@ class TpuDataStore:
         # take the (slower) per-window planner path, which applies them;
         # schemas restricting their index set also take the planner path
         # (it honors the restriction)
-        if store.lean and not self._interceptors[sft.name]:
-            # lean fast path: ALL windows (timed or not — the index
-            # clamps open bounds to the data extent) through the lean
-            # index's single batched multi-window program
+        if (store.lean and store.lean_kind == "z3"
+                and not self._interceptors[sft.name]):
+            # lean fast path (z3 point schemas): ALL windows (timed or
+            # not — the index clamps open bounds to the data extent)
+            # through the lean index's single batched multi-window
+            # program; non-point (xz2) lean schemas take the per-window
+            # planner path below (review r5)
             t0 = time.time()
             hits = store.index("z3").query_many(
                 [(boxes, lo, hi) for boxes, lo, hi in windows])

@@ -105,9 +105,8 @@ class LeanBatch:
         if not self._geom_chunks:
             return None
         if self._geoms_flat is None:
-            flat = self._geom_chunks[0]
-            for g in self._geom_chunks[1:]:
-                flat = flat.concat(g)
+            from ..geometry.packed import PackedGeometry
+            flat = PackedGeometry.concat_many(self._geom_chunks)
             self._geoms_flat = flat
             self._geom_chunks = [flat]
         return self._geoms_flat

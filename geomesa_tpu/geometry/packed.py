@@ -142,6 +142,32 @@ class PackedGeometry:
                  other.geom_part_offsets[1:] + self.geom_part_offsets[-1]]),
             bbox=np.concatenate([self.bbox, other.bbox]))
 
+    @staticmethod
+    def concat_many(parts: list["PackedGeometry"]) -> "PackedGeometry":
+        """One-pass concatenation of many packed columns (offset shifts
+        computed per field) — pairwise ``concat`` over k chunks copies
+        the accumulated buffers k times (O(total x k)); this copies
+        each buffer exactly once (review r5)."""
+        if len(parts) == 1:
+            return parts[0]
+
+        def offsets(field: str) -> np.ndarray:
+            arrs = [getattr(parts[0], field)]
+            base = arrs[0][-1]
+            for p in parts[1:]:
+                o = getattr(p, field)
+                arrs.append(o[1:] + base)
+                base = base + o[-1]
+            return np.concatenate(arrs)
+
+        return PackedGeometry(
+            kinds=np.concatenate([p.kinds for p in parts]),
+            coords=np.concatenate([p.coords for p in parts]),
+            ring_offsets=offsets("ring_offsets"),
+            part_ring_offsets=offsets("part_ring_offsets"),
+            geom_part_offsets=offsets("geom_part_offsets"),
+            bbox=np.concatenate([p.bbox for p in parts]))
+
     def rings_of(self, i: int) -> list[np.ndarray]:
         """All rings of geometry i as coordinate arrays."""
         p0, p1 = self.geom_part_offsets[i], self.geom_part_offsets[i + 1]

@@ -987,6 +987,16 @@ class ShardedLeanZ3Index:
         flat int64 coded arrays (padding stripped); full-tier outputs
         are TRUE hits, keys-tier outputs are candidates."""
         tier = "full" if exact_args is not None else "keys"
+        # scan only generations with candidates anywhere on the mesh
+        # (process-invariant: totals is the fetched global probe) —
+        # time-partitioned ingest leaves most generations empty for a
+        # window and the shared capacity must not be spent on them
+        live = [i for i in range(len(gens))
+                if int(totals[:, i].max())]
+        if not live:
+            return []
+        gens = [gens[i] for i in live]
+        totals = totals[:, live]
         per_gen_cap = gather_capacity(
             int(totals.max()), minimum=self.DEFAULT_CAPACITY)
         padded = self._pad_bucket(gens, tier)

@@ -57,12 +57,22 @@ _UNSUPPORTED = re.compile(r"\b(GROUP\s+BY|HAVING|ORDER\s+BY)\b",
 SPATIAL_JOIN_MAX_LEFT = 65_536
 
 
+def _mask_literals(text: str) -> str:
+    """Replace single-quoted literal CONTENTS with spaces (same length,
+    quotes kept) so structural regexes can never match inside data
+    (review r5)."""
+    return re.sub(r"'[^']*'",
+                  lambda m: "'" + " " * (len(m.group(0)) - 2) + "'",
+                  text)
+
+
 def is_join(text: str) -> bool:
     """Structural detection — the FROM clause must carry the join shape
-    (``FROM t a JOIN``); the bare word JOIN inside a string literal
-    must not hijack a normal query (review r5)."""
+    (``FROM t a JOIN``) OUTSIDE string literals; join-shaped data in a
+    literal must not hijack a normal query (review r5)."""
     return bool(re.search(
-        r"\bFROM\s+\w+(?:\s+AS)?\s+\w+\s+JOIN\b", text, re.IGNORECASE))
+        r"\bFROM\s+\w+(?:\s+AS)?\s+\w+\s+JOIN\b", _mask_literals(text),
+        re.IGNORECASE))
 
 
 class ParsedJoin:
@@ -153,7 +163,11 @@ def parse_join(text: str) -> ParsedJoin:
             else:
                 terms.append(p)
         for term in terms:
-            refs = {s for s, _ in re.findall(r"\b(\w+)\.(\w+)", term)
+            # detect and rewrite alias-qualified tokens OUTSIDE string
+            # literals only — `b.note = 'a.x'` is a single-side term
+            # and the literal must survive untouched (review r5)
+            masked = _mask_literals(term)
+            refs = {s for s, _ in re.findall(r"\b(\w+)\.(\w+)", masked)
                     if s in (la, ra)}
             if len(refs) != 1:
                 raise ValueError(
@@ -161,7 +175,15 @@ def parse_join(text: str) -> ParsedJoin:
                     "one side (qualify columns with the table alias); "
                     "cross-side predicates belong in ON")
             side = refs.pop()
-            stripped = re.sub(rf"\b{side}\.(\w+)", r"\1", term)
+            stripped = ""
+            last = 0
+            for m2 in re.finditer(rf"\b{side}\.(\w+)", term):
+                # skip matches inside literals (masked shows spaces)
+                if masked[m2.start():m2.end()] != m2.group(0):
+                    continue
+                stripped += term[last:m2.start()] + m2.group(1)
+                last = m2.end()
+            stripped += term[last:]
             (wl if side == la else wr).append(stripped)
     from .parser import _rewrite_where
     where_left = _rewrite_where(" AND ".join(wl)) if wl else None
@@ -199,12 +221,19 @@ def _pairs_equi(store, q: ParsedJoin, lres):
     # fired (review r5: pandas merge pairs None==None)
     li = np.arange(len(lv))
     rj = np.arange(len(rv))
-    if lv.dtype == object:
-        keep = lv != np.array(None)
-        li, lv = li[keep], lv[keep]
-    if rv.dtype == object:
-        keep = rv != np.array(None)
-        rj, rv = rj[keep], rv[keep]
+
+    def _non_null(vals, rows):
+        if vals.dtype == object:
+            keep = vals != np.array(None)
+        elif vals.dtype.kind == "f":
+            # pandas merge pairs NaN==NaN; SQL says NULL never matches
+            keep = ~np.isnan(vals)
+        else:
+            return vals, rows
+        return vals[keep], rows[keep]
+
+    lv, li = _non_null(lv, li)
+    rv, rj = _non_null(rv, rj)
     lp = pd.DataFrame({"i": li, "k": lv})
     rp = pd.DataFrame({"j": rj, "k": rv})
     merged = lp.merge(rp, on="k", how="inner")
@@ -236,6 +265,15 @@ def _pairs_spatial(store, q: ParsedJoin, lres):
         return (np.empty(0, np.int64), np.empty(0, np.int64),
                 _RightSlice(FeatureBatch.empty(r_sft)))
     dist_m = q.on_payload[2] if q.on_kind == "dwithin" else 0.0
+    # shape validation happens BEFORE any scan, not inside the
+    # candidate loop — an unsupported shape must error loudly even
+    # when no candidates surface (review r5)
+    if q.on_kind == "dwithin" and not (
+            r_sft.is_points
+            and store.get_schema(q.left).is_points):
+        raise ValueError("st_dwithin joins support point-to-point "
+                         "schemas (use st_intersects for polygon "
+                         "relations)")
     lgeoms = ([lbatch.geoms.geometry(i) for i in range(n_l)]
               if lbatch.geoms is not None else None)
     if lgeoms is not None:
@@ -281,10 +319,6 @@ def _pairs_spatial(store, q: ParsedJoin, lres):
             continue
         rows = np.asarray(rows, np.int64)
         if q.on_kind == "dwithin":
-            if not (r_pts and lgeoms is None):
-                raise ValueError("st_dwithin joins support point-to-"
-                                 "point schemas (use st_intersects "
-                                 "for polygon relations)")
             d = haversine_m(envs[i][0], envs[i][1], rx[rows], ry[rows])
             keep = rows[d <= dist_m]
         elif r_pts and lgeoms is not None:
@@ -294,9 +328,17 @@ def _pairs_spatial(store, q: ParsedJoin, lres):
             keep = rows[(rx[rows] == envs[i][0])
                         & (ry[rows] == envs[i][1])]
         else:
+            # non-point right side: exact pairwise predicate; a POINT
+            # left side wraps its coordinate as a geometry (review r5:
+            # this branch crashed on lgeoms=None)
+            if lgeoms is not None:
+                lg = lgeoms[i]
+            else:
+                from ..geometry.types import Point
+                lg = Point(float(envs[i][0]), float(envs[i][1]))
             keep = np.asarray(
                 [r for r in rows if geometry_intersects(
-                    lgeoms[i], rb.geoms.geometry(int(r)))], np.int64)
+                    lg, rb.geoms.geometry(int(r)))], np.int64)
         li.extend([i] * len(keep))
         rj.extend(keep.tolist())
     return (np.asarray(li, np.int64), np.asarray(rj, np.int64),

@@ -195,6 +195,80 @@ def test_dwithin_join_high_latitude_pairs_survive():
     assert len(out["a.aid"]) == 1
 
 
+def test_spatial_join_points_left_polygons_right():
+    rng = np.random.default_rng(41)
+    ds = TpuDataStore()
+    ds.create_schema("pts", "pid:Integer,dtg:Date,*geom:Point")
+    ds.create_schema("regions", "rid:Integer,*geom:Polygon")
+    from geomesa_tpu.geometry.types import Polygon
+    boxes = [(-75.0 + i * 0.6, 40.0, -75.0 + i * 0.6 + 0.4, 40.5)
+             for i in range(4)]
+    ds.write("regions", {"rid": np.arange(4),
+                         "geom": [Polygon([(b[0], b[1]), (b[2], b[1]),
+                                           (b[2], b[3]), (b[0], b[3])])
+                                  for b in boxes]})
+    n = 1000
+    px = rng.uniform(-75.2, -72.4, n)
+    py = rng.uniform(39.8, 40.7, n)
+    ds.write("pts", {"pid": np.arange(n), "dtg": np.full(n, MS),
+                     "geom": (px, py)})
+    out = sql_query(ds, "SELECT a.pid, b.rid FROM pts a JOIN regions b "
+                        "ON st_intersects(a.geom, b.geom)")
+    want = set()
+    for i, b in enumerate(boxes):
+        inside = np.flatnonzero((px >= b[0]) & (px <= b[2])
+                                & (py >= b[1]) & (py <= b[3]))
+        want.update((int(p), i) for p in inside)
+    got = set(zip(out["a.pid"].tolist(), out["b.rid"].tolist()))
+    assert got == want and len(got) > 0
+
+
+def test_join_shape_in_literal_not_hijacked(stores):
+    ds, *_ = stores
+    out = sql_query(ds, "SELECT count(*) FROM evt WHERE "
+                        "site = 'x FROM one two JOIN three'")
+    assert out == 0
+
+
+def test_join_where_alias_token_inside_literal(stores):
+    ds, e, o = stores
+    # 'a.x'-shaped DATA inside a right-side literal must not be
+    # rewritten or counted as a left-side reference
+    out = sql_query(ds, "SELECT a.site, b.val FROM evt a JOIN obs b "
+                        "ON a.site = b.site WHERE b.kind = 'a.x'")
+    assert len(out["a.site"]) == 0   # no such kind — but no error
+
+
+def test_equi_join_float_nan_keys_never_match():
+    ds = TpuDataStore()
+    ds.create_schema("l", "v:Double,dtg:Date,*geom:Point")
+    ds.create_schema("r", "v:Double,dtg:Date,*geom:Point")
+    ds.write("l", {"v": np.array([1.0, np.nan]),
+                   "dtg": np.full(2, MS),
+                   "geom": (np.zeros(2), np.zeros(2))})
+    ds.write("r", {"v": np.array([np.nan, 1.0]),
+                   "dtg": np.full(2, MS),
+                   "geom": (np.zeros(2), np.zeros(2))})
+    out = sql_query(ds, "SELECT a.v, b.v AS rv FROM l a JOIN r b "
+                        "ON a.v = b.v")
+    assert list(out["a.v"]) == [1.0]
+
+
+def test_dwithin_polygon_left_errors_loudly_before_scan():
+    ds = TpuDataStore()
+    ds.create_schema("regions", "rid:Integer,*geom:Polygon")
+    ds.create_schema("pts", "pid:Integer,dtg:Date,*geom:Point")
+    from geomesa_tpu.geometry.types import Polygon
+    ds.write("regions", {"rid": np.array([0]),
+                         "geom": [Polygon([(0, 0), (1, 0), (1, 1),
+                                           (0, 1)])]})
+    ds.write("pts", {"pid": np.array([0]), "dtg": np.array([MS]),
+                     "geom": (np.array([50.0]), np.array([50.0]))})
+    with pytest.raises(ValueError, match="point-to-point"):
+        sql_query(ds, "SELECT a.rid, b.pid FROM regions a JOIN pts b "
+                      "ON st_dwithin(a.geom, b.geom, 1000)")
+
+
 class TestJoinGrammar:
     def _ds(self):
         ds = TpuDataStore()
