@@ -34,7 +34,8 @@ from .types import (
     Polygon,
 )
 
-__all__ = ["PackedGeometry", "pack_geometries", "GEOM_KIND"]
+__all__ = ["PackedGeometry", "pack_geometries", "packed_from_boxes",
+           "GEOM_KIND"]
 
 GEOM_KIND = {
     "Point": 0, "MultiPoint": 1, "LineString": 2,
@@ -229,3 +230,29 @@ def pack_geometries(geoms) -> PackedGeometry:
         part_ring_offsets=part_ring_offsets,
         geom_part_offsets=geom_part_offsets, bbox=bbox,
     )
+
+
+def packed_from_boxes(bbox: np.ndarray) -> "PackedGeometry":
+    """Vectorized axis-aligned rectangles ``(n, 4)`` → packed polygons:
+    the OBJECT-FREE bulk-ingest path (constructing 200M Python Polygon
+    objects would dominate a scale build; real bulk feeds — building
+    footprints, tiles, coverage cells — arrive as envelope arrays
+    anyway).  Shells follow the packer's convention (closed ring, CCW
+    corner order)."""
+    bb = np.ascontiguousarray(np.asarray(bbox, np.float64)
+                              .reshape((-1, 4)))
+    n = len(bb)
+    coords = np.empty((n * 5, 2), np.float64)
+    coords[0::5] = bb[:, [0, 1]]
+    coords[1::5] = bb[:, [2, 1]]
+    coords[2::5] = bb[:, [2, 3]]
+    coords[3::5] = bb[:, [0, 3]]
+    coords[4::5] = bb[:, [0, 1]]
+    idx = np.arange(n + 1, dtype=np.int64)
+    return PackedGeometry(
+        kinds=np.full(n, GEOM_KIND["Polygon"], np.int8),
+        coords=coords,
+        ring_offsets=idx * 5,
+        part_ring_offsets=idx.copy(),
+        geom_part_offsets=idx.copy(),
+        bbox=bb.copy())
