@@ -78,49 +78,64 @@ class SpatialFrame:
         """Aggregate: ``aggs`` maps output name → (column, fn) with fn in
         {"count", "sum", "min", "max", "mean"}."""
         batch = self.collect()
-        keys = batch.column(key)
-        keys = keys.astype(str) if keys.dtype == object else keys
-        uniq, inverse = np.unique(keys, return_inverse=True)
-        out: dict = {key: uniq}
-        for name, (col, fn) in aggs.items():
-            if fn == "count":
-                out[name] = np.bincount(inverse, minlength=len(uniq))
-                continue
-            raw = batch.column(col)
-            if (raw.dtype == object or raw.dtype.kind in "US") \
-                    and fn in ("min", "max"):
-                # string min/max: lexicographic per group (sum/mean on
-                # strings still fail loudly in the float cast below)
-                if not len(uniq):
-                    out[name] = raw.astype(str)[:0]
-                    continue
-                order = np.lexsort((raw.astype(str), inverse))
-                firsts = np.searchsorted(inverse[order],
-                                         np.arange(len(uniq)))
-                pick = (firsts if fn == "min"
-                        else np.append(firsts[1:], len(raw)) - 1)
-                out[name] = raw.astype(str)[order][pick]
-                continue
-            vals = raw.astype(np.float64)
-            if fn == "sum":
-                out[name] = np.bincount(inverse, weights=vals,
-                                        minlength=len(uniq))
-            elif fn == "mean":
-                s = np.bincount(inverse, weights=vals, minlength=len(uniq))
-                c = np.bincount(inverse, minlength=len(uniq))
-                out[name] = s / np.maximum(c, 1)
-            elif fn in ("min", "max"):
-                red = np.full(len(uniq), np.inf if fn == "min" else -np.inf)
-                np.minimum.at(red, inverse, vals) if fn == "min" else \
-                    np.maximum.at(red, inverse, vals)
-                out[name] = red
-            else:
-                raise ValueError(f"unknown aggregation {fn!r}")
-        return out
+        uniq, out = group_aggregate(batch.column(key), batch.column,
+                                    aggs)
+        return {key: uniq, **out}
 
     def to_arrow(self):
         from ..io.export import to_arrow
         return to_arrow(self.collect())
 
+    # (group_aggregate lives at module level — shared with the SQL
+    # text parser's expression-GROUP BY path)
+
     def to_pandas(self):  # pragma: no cover - convenience
         return self.to_arrow().to_pandas()
+
+
+def group_aggregate(keys: np.ndarray, col_of, spec: dict):
+    """Shared GROUP BY reduction over an arbitrary key array (the one
+    definition behind SpatialFrame.group_by AND the SQL parser's
+    expression-GROUP BY): ``col_of(name) -> np.ndarray`` supplies the
+    aggregate inputs; ``spec`` maps output name → (column, fn) with fn
+    in {"count", "sum", "min", "max", "mean"}.  Returns
+    ``(unique_keys, {name: reduced})``."""
+    keys = np.asarray(keys)
+    keys = keys.astype(str) if keys.dtype == object else keys
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    out: dict = {}
+    for name, (col, fn) in spec.items():
+        if fn == "count":
+            out[name] = np.bincount(inverse, minlength=len(uniq))
+            continue
+        raw = np.asarray(col_of(col))
+        if (raw.dtype == object or raw.dtype.kind in "US") \
+                and fn in ("min", "max"):
+            # string min/max: lexicographic per group (sum/mean on
+            # strings still fail loudly in the float cast below)
+            if not len(uniq):
+                out[name] = raw.astype(str)[:0]
+                continue
+            order = np.lexsort((raw.astype(str), inverse))
+            firsts = np.searchsorted(inverse[order],
+                                     np.arange(len(uniq)))
+            pick = (firsts if fn == "min"
+                    else np.append(firsts[1:], len(raw)) - 1)
+            out[name] = raw.astype(str)[order][pick]
+            continue
+        vals = raw.astype(np.float64)
+        if fn == "sum":
+            out[name] = np.bincount(inverse, weights=vals,
+                                    minlength=len(uniq))
+        elif fn == "mean":
+            s = np.bincount(inverse, weights=vals, minlength=len(uniq))
+            c = np.bincount(inverse, minlength=len(uniq))
+            out[name] = s / np.maximum(c, 1)
+        elif fn in ("min", "max"):
+            red = np.full(len(uniq), np.inf if fn == "min" else -np.inf)
+            np.minimum.at(red, inverse, vals) if fn == "min" else \
+                np.maximum.at(red, inverse, vals)
+            out[name] = red
+        else:
+            raise ValueError(f"unknown aggregation {fn!r}")
+    return uniq, out

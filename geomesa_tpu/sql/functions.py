@@ -762,7 +762,12 @@ PROJECTABLE = {
     "st_x", "st_y", "st_asText", "st_geometryType", "st_isValid",
     "st_numPoints", "st_centroid", "st_envelope", "st_area",
     "st_length", "st_lengthSphere", "st_bufferPoint", "st_translate",
+    "st_geoHash",
 }
+
+#: projectable functions defined over POINT columns only (validated
+#: pre-scan; review r5)
+_POINT_ONLY = {"st_x", "st_y", "st_geoHash", "st_bufferPoint"}
 
 #: projectable functions whose OUTPUT is geometry objects — their
 #: aliases cannot drive ORDER BY (geometries have no order)
@@ -796,6 +801,13 @@ def resolve_projectable(name: str, attr=None, n_args: int = 0) -> str:
         raise ValueError(
             f"{canonical} needs a geometry column, and "
             f"{attr.name!r} is {attr.type}")
+    if (canonical in _POINT_ONLY and attr is not None
+            and attr.type != "point"):
+        # scan-independent: a polygon column reaching _points_xy would
+        # crash AFTER the scan ran (review r5)
+        raise ValueError(
+            f"{canonical} needs a Point column, and {attr.name!r} is "
+            f"{attr.type} (use st_centroid first)")
     return canonical
 
 
@@ -816,7 +828,7 @@ def apply_function(batch, name: str, col: str, *args):
         val = np.array([packed.geometry(i)
                         for i in range(len(batch))], dtype=object)
     elif f"{col}_x" in batch.columns:
-        if canonical in ("st_x", "st_y"):
+        if canonical in ("st_x", "st_y", "st_geoHash"):
             val = batch.geom_xy(col)
         else:
             x, y = batch.geom_xy(col)

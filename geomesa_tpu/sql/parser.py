@@ -212,10 +212,19 @@ def parse_sql(text: str) -> ParsedSQL:
         if aggs and (plain or exprs) and m.group("group") is None:
             raise ValueError("mixing columns and aggregates needs GROUP BY")
         if exprs and (aggs or group is not None):
-            raise ValueError("expression projections do not compose "
-                             "with aggregates/GROUP BY (aggregate in "
-                             "the caller over the expression output)")
+            # GROUP BY <expr alias> (e.g. GROUP BY st_geohash(geom, 4)
+            # AS gh … — the round-4 weak-#7 wall): exactly one
+            # expression, which IS the group key, plus aggregates
+            if not (group is not None and len(exprs) == 1
+                    and exprs[0][3] == group and not plain):
+                raise ValueError(
+                    "expression projections compose with GROUP BY only "
+                    "as the group key (SELECT st_fn(col) AS k, aggs... "
+                    "GROUP BY k); aggregate other expression outputs "
+                    "in the caller")
         seen: set = set(plain)
+        expr_group_alias = (exprs[0][3] if exprs and group is not None
+                            and exprs[0][3] == group else None)
         for _, _, alias in ([(None, None, a) for _, _, _, a in exprs]
                             + aggs):
             if alias in seen:
@@ -224,9 +233,12 @@ def parse_sql(text: str) -> ParsedSQL:
                 raise ValueError(
                     f"duplicate aggregate alias {alias!r}: use AS to "
                     "name each aggregate uniquely")
-            if group is not None and alias == group:
+            if (group is not None and alias == group
+                    and alias != expr_group_alias):
                 # same dict: an alias shadowing the group column would
                 # silently replace the group labels with the aggregate
+                # (the expression key's OWN alias IS the group column
+                # by design — GROUP BY st_fn(col) AS k)
                 raise ValueError(
                     f"aggregate alias {alias!r} collides with the "
                     "GROUP BY column — alias it differently")
@@ -364,7 +376,7 @@ def sql_query(store, text: str):
             }[fn](vals)
         return out
     if q.group is not None:
-        if not q.aggs and q.columns is None:
+        if not q.aggs and q.columns is None and not q.exprs:
             raise ValueError("SELECT * with GROUP BY is not defined — "
                              "project the group column or aggregates")
         stray = [c for c in (q.columns or []) if c != q.group]
@@ -403,7 +415,43 @@ def sql_query(store, text: str):
             # DISTINCT idiom; a hidden count drives the grouping
             spec["__distinct"] = (q.group, "count")
             hidden.append("__distinct")
-        out = frame.group_by(q.group, spec)
+        expr_key = next((e for e in q.exprs if e[3] == q.group), None)
+        if expr_key is not None:
+            # GROUP BY <expr alias>: ONE scan (push-down + projection
+            # to the referenced columns), the key computed on the hit
+            # batch, then the shared reduction (the catalyst
+            # project-then-aggregate split)
+            from .frame import group_aggregate
+            from .functions import (
+                GEOM_VALUED, apply_function, resolve_projectable,
+            )
+            fn, col, args, alias = expr_key
+            sft_g = store.get_schema(q.table)
+            if any(a.name == alias for a in sft_g.attributes):
+                # `min(v)` must mean the COLUMN v — an expression alias
+                # shadowing a schema attribute would silently aggregate
+                # the group keys instead (review r5)
+                raise ValueError(
+                    f"expression alias {alias!r} shadows a schema "
+                    f"attribute of {q.table!r} — alias it differently")
+            canonical = resolve_projectable(fn, sft_g.attribute(col),
+                                            len(args))
+            if canonical in GEOM_VALUED:
+                raise ValueError(
+                    f"GROUP BY {alias!r} is not defined: {canonical} "
+                    "produces geometry values (group by st_geohash/"
+                    "st_x/st_y or another scalar expression)")
+            needed = sorted({col} | {c for c, _ in spec.values()
+                                     if c != "*" and c != q.group})
+            batch = frame.select(*needed).collect()
+            keys = np.asarray(apply_function(batch, fn, col, *args))
+            uniq, red = group_aggregate(
+                keys,
+                lambda c: keys if c == q.group else batch.column(c),
+                spec)
+            out = {q.group: uniq, **red}
+        else:
+            out = frame.group_by(q.group, spec)
         if having_cols:
             keep = np.ones(len(np.asarray(out[q.group])), dtype=bool)
             for alias, op, lit in having_cols:

@@ -275,3 +275,43 @@ def test_packed_intersects_matches_scalar_oracle():
     want = np.array([geometry_intersects(geoms[i], queries[0])
                      for i in pos])
     np.testing.assert_array_equal(got, want)
+
+
+def test_packed_from_boxes_matches_object_packing():
+    """The vectorized envelope-array constructor must produce the same
+    packed layout as pack_geometries over equivalent Polygon objects
+    (the object-free bulk-ingest path of the polygon scale proof)."""
+    import numpy as np
+
+    from geomesa_tpu.geometry.packed import (
+        pack_geometries, packed_from_boxes,
+    )
+    from geomesa_tpu.geometry.predicates import (
+        geometry_intersects, point_in_polygon,
+    )
+    from geomesa_tpu.geometry.types import Polygon
+
+    rng = np.random.default_rng(9)
+    n = 500
+    x0 = rng.uniform(-170, 170, n)
+    y0 = rng.uniform(-80, 80, n)
+    w = rng.uniform(0.01, 0.5, n)
+    bb = np.stack([x0, y0, x0 + w, y0 + w], axis=1)
+    fast = packed_from_boxes(bb)
+    np.testing.assert_allclose(fast.bbox, bb)
+    assert len(fast) == n
+    # object-path equivalence on a sample
+    for i in (0, 7, n - 1):
+        b = bb[i]
+        obj = pack_geometries([Polygon(
+            [(b[0], b[1]), (b[2], b[1]), (b[2], b[3]),
+             (b[0], b[3])])]).geometry(0)
+        g = fast.geometry(int(i))
+        assert geometry_intersects(g, obj)
+        # interior point containment agrees
+        cx, cy = (b[0] + b[2]) / 2, (b[1] + b[3]) / 2
+        assert point_in_polygon(np.array([cx]), np.array([cy]), g)[0]
+    # take/concat roundtrip on the vectorized layout
+    sub = fast.take(np.array([3, 100, 400]))
+    assert len(sub) == 3
+    np.testing.assert_allclose(sub.bbox, bb[[3, 100, 400]])

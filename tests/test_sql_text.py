@@ -390,3 +390,103 @@ def test_order_by_geometry_valued_alias_rejected():
     with pytest.raises(ValueError, match="produces geometry values"):
         sql_query(ds, "SELECT st_translate(geom, 1, 2) AS g FROM t "
                       "ORDER BY g")
+
+
+class TestGroupByExpression:
+    """GROUP BY an expression alias (the round-4 weak-#7 wall:
+    ``GROUP BY st_geohash(geom)``): one scan, the key computed on the
+    hit batch, the shared reduction, HAVING/ORDER/LIMIT composing."""
+
+    def _store(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import TpuDataStore
+        rng = np.random.default_rng(3)
+        n = 20_000
+        ds = TpuDataStore()
+        ds.create_schema("t", "v:Double,dtg:Date,*geom:Point")
+        x = rng.uniform(-75, -73, n)
+        y = rng.uniform(40, 42, n)
+        v = rng.uniform(0, 10, n)
+        ds.write("t", {"v": v, "dtg": np.full(n, 1514764800000),
+                       "geom": (x, y)})
+        return ds, x, y, v
+
+    def test_geohash_group_matches_pandas(self):
+        import numpy as np
+        import pandas as pd
+
+        from geomesa_tpu.sql.functions import st_geoHash
+        ds, x, y, v = self._store()
+        out = sql_query(ds, "SELECT st_geohash(geom, 4) AS gh, "
+                            "count(*) AS n, sum(v) AS sv FROM t "
+                            "GROUP BY gh HAVING n > 100 "
+                            "ORDER BY n DESC LIMIT 5")
+        df = pd.DataFrame({"gh": np.asarray(st_geoHash((x, y), 4)),
+                           "v": v})
+        want = df.groupby("gh").agg(
+            n=("gh", "size"), sv=("v", "sum")).reset_index()
+        want = want[want.n > 100].sort_values(
+            "n", ascending=False).head(5)
+        assert list(out["gh"]) == list(want.gh)
+        assert list(np.asarray(out["n"])) == list(want.n)
+        np.testing.assert_allclose(np.asarray(out["sv"]),
+                                   want.sv.to_numpy())
+
+    def test_where_pushes_down(self):
+        import numpy as np
+        import pandas as pd
+
+        from geomesa_tpu.sql.functions import st_geoHash
+        ds, x, y, v = self._store()
+        out = sql_query(ds, "SELECT st_geohash(geom, 3) AS gh, "
+                            "count(*) AS n FROM t WHERE v > 5 "
+                            "GROUP BY gh")
+        m = v > 5
+        want = pd.DataFrame(
+            {"gh": np.asarray(st_geoHash((x[m], y[m]), 3))}
+        ).groupby("gh").size()
+        got = dict(zip(out["gh"], np.asarray(out["n"]).tolist()))
+        assert got == want.to_dict()
+
+    def test_geometry_valued_key_rejected(self):
+        ds, *_ = self._store()
+        with pytest.raises(ValueError, match="produces geometry"):
+            sql_query(ds, "SELECT st_centroid(geom) AS c, count(*) "
+                          "AS n FROM t GROUP BY c")
+
+    def test_non_key_expression_still_rejected(self):
+        ds, *_ = self._store()
+        with pytest.raises(ValueError, match="only as the group key"):
+            sql_query(ds, "SELECT st_x(geom) AS lon, count(*) AS n "
+                          "FROM t GROUP BY v")
+
+    def test_expr_distinct_idiom(self):
+        import numpy as np
+        ds, x, y, v = self._store()
+        out = sql_query(ds, "SELECT st_geohash(geom, 3) AS gh FROM t "
+                            "GROUP BY gh")
+        from geomesa_tpu.sql.functions import st_geoHash
+        want = sorted(set(np.asarray(st_geoHash((x, y), 3)).tolist()))
+        assert sorted(out["gh"].tolist()) == want
+        assert set(out) == {"gh"}
+
+    def test_alias_shadowing_schema_attr_rejected(self):
+        ds, *_ = self._store()
+        with pytest.raises(ValueError, match="shadows a schema"):
+            sql_query(ds, "SELECT st_geohash(geom, 3) AS v, min(v) AS "
+                          "mv FROM t GROUP BY v")
+
+    def test_geohash_on_polygon_rejected_pre_scan(self):
+        import numpy as np
+
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.geometry.types import Polygon
+        ds = TpuDataStore()
+        ds.create_schema("p", "v:Int,*geom:Polygon")
+        ds.write("p", {"v": np.array([1]),
+                       "geom": [Polygon([(0, 0), (1, 0), (1, 1),
+                                         (0, 1)])]})
+        with pytest.raises(ValueError, match="Point column"):
+            sql_query(ds, "SELECT st_geohash(geom, 4) AS gh, count(*) "
+                          "AS n FROM p GROUP BY gh")
