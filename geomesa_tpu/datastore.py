@@ -214,8 +214,9 @@ class _SchemaStore:
             self.lean_kind = "z3"
         elif sft.geom_field and not sft.is_points:
             # round-5 (VERDICT #4): non-point schemas ride the
-            # generational XZ2 index — polygons at the lean scale
-            self.lean_kind = "xz2"
+            # generational XZ tier — XZ3 (bin, code) when the schema
+            # has time, XZ2 otherwise
+            self.lean_kind = "xz3" if sft.dtg_field else "xz2"
         else:
             raise ValueError(
                 "geomesa.index.profile=lean requires a point geometry "
@@ -285,6 +286,27 @@ class _SchemaStore:
                 for i in range(n_steps):
                     lo = i * step
                     idx.append_bboxes(bb[lo:lo + step], base_gid=lo)
+        elif kind == "xz3":
+            if self.mesh is not None:
+                from .parallel.attr_lean import ShardedLeanXZ3Index
+                idx = ShardedLeanXZ3Index(
+                    period=self.sft.z3_interval, mesh=self.mesh,
+                    multihost=self.multihost,
+                    hbm_budget_bytes=self._lean_z3_budget())
+            else:
+                from .index.xz2_lean import LeanXZ3Index
+                idx = LeanXZ3Index(
+                    period=self.sft.z3_interval,
+                    hbm_budget_bytes=self._lean_z3_budget())
+            if n_steps:
+                bb = self.batch.geom_bbox()
+                t = self.batch.column(self.sft.dtg_field)
+                for i in range(n_steps):
+                    lo = i * step
+                    idx.append_bboxes(bb[lo:lo + step],
+                                      np.asarray(t[lo:lo + step],
+                                                 np.int64),
+                                      base_gid=lo)
         else:
             if self.mesh is not None:
                 from .parallel.lean import ShardedLeanZ3Index
@@ -418,12 +440,16 @@ class _SchemaStore:
             if self.tombstone is not None:
                 self.tombstone = np.concatenate(
                     [self.tombstone, np.zeros(n_new, dtype=bool)])
-            if self.lean_kind == "xz2":
-                idx.append_bboxes(chunk.geoms.bbox, base_gid=prior)
+            if self.lean_kind in ("xz2", "xz3"):
                 dtg = (np.asarray(chunk.column(self.sft.dtg_field),
                                   np.int64)
                        if self.sft.dtg_field else
                        np.zeros(n_new, np.int64))
+                if self.lean_kind == "xz3":
+                    idx.append_bboxes(chunk.geoms.bbox, dtg,
+                                      base_gid=prior)
+                else:
+                    idx.append_bboxes(chunk.geoms.bbox, base_gid=prior)
             else:
                 x, y = chunk.geom_xy(self.sft.geom_field)
                 dtg = np.asarray(chunk.column(self.sft.dtg_field),

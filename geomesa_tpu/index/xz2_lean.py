@@ -28,18 +28,15 @@ from ..curve.xz2 import xz2_sfc
 from ..geometry.types import Geometry
 from .attr_lean import LeanAttrIndex
 
-__all__ = ["LeanXZ2Index", "XZ2Facade"]
+__all__ = ["LeanXZ2Index", "LeanXZ3Index", "XZ2Facade"]
 
 
-class XZ2Facade:
-    """Shared XZ2 surface over a pluggable generational (key, sec, gid)
-    core — the single definition both the single-chip and the sharded
-    variants present (review r5: two hand-copied facades had already
-    drifted)."""
+class LeanCoreFacade:
+    """Delegation base over a pluggable generational (key, sec, gid)
+    core — the single definition of the core surface every lean XZ
+    facade presents (review r5: hand-copied facades drift)."""
 
-    def __init__(self, core, g: int = 12):
-        self.g = g
-        self.sfc = xz2_sfc(g)
+    def __init__(self, core):
         self._core = core
 
     def __len__(self) -> int:
@@ -61,6 +58,16 @@ class XZ2Facade:
 
     def block(self) -> None:
         self._core.block()
+
+
+class XZ2Facade(LeanCoreFacade):
+    """Shared XZ2 surface — single-chip and sharded variants differ
+    only in the core they plug in."""
+
+    def __init__(self, core, g: int = 12):
+        super().__init__(core)
+        self.g = g
+        self.sfc = xz2_sfc(g)
 
     def append_bboxes(self, bbox: np.ndarray,
                       base_gid: int | None = None) -> "XZ2Facade":
@@ -99,3 +106,86 @@ class LeanXZ2Index(XZ2Facade):
         super().__init__(LeanAttrIndex(
             "__xz2__", "long", generation_slots=generation_slots,
             hbm_budget_bytes=hbm_budget_bytes), g=g)
+
+
+class LeanXZ3Index(LeanCoreFacade):
+    """Generational tiered XZ3 index — polygons/lines WITH TIME at the
+    lean scale (the reference's XZ3IndexKeySpace key =
+    ``[2B bin][8B code]``; geomesa-index-api/.../index/z3/
+    XZ3IndexKeySpace.scala).  The (bin, code) pair IS the attribute
+    core's (key, sec) composite: per-bin code ranges seek with the
+    same two-key searchsorted the whole lean family uses — bin
+    equality narrows, code ranges span, residual exactness stays with
+    the planner.  Range planning is the SHARED
+    :func:`~geomesa_tpu.index.xz3.xz3_bin_code_ranges` the full-fat
+    index uses."""
+
+    def __init__(self, period="week", g: int = 12,
+                 generation_slots: int | None = None,
+                 hbm_budget_bytes: int | None = None, core=None):
+        from ..curve.binnedtime import TimePeriod
+        from ..curve.xz3 import xz3_sfc
+        super().__init__(core if core is not None else LeanAttrIndex(
+            "__xz3__", "long", generation_slots=generation_slots,
+            hbm_budget_bytes=hbm_budget_bytes))
+        self.period = TimePeriod.parse(period)
+        self.g = g
+        self.sfc = xz3_sfc(self.period, g)
+        self.t_min_ms: int | None = None
+        self.t_max_ms: int | None = None
+
+    def append_bboxes(self, bbox: np.ndarray, dtg_ms: np.ndarray,
+                      base_gid: int | None = None) -> "LeanXZ3Index":
+        """Stream (envelope, timestamp) slices: per-row (bin, code)
+        keys into the generational runs.  The time extent is AGREED
+        under multihost (every process clamps open query bounds
+        identically, or collective dispatches would diverge — the
+        ShardedLeanZ3Index discipline)."""
+        from ..curve.binnedtime import to_binned_time
+        bb = np.asarray(bbox, np.float64).reshape((-1, 4))
+        t = np.ascontiguousarray(dtg_ms, np.int64)
+        bins, offs = to_binned_time(t, self.period)
+        offs_f = offs.astype(np.float64)
+        codes = self.sfc.index(bb[:, 0], bb[:, 1], offs_f,
+                               bb[:, 2], bb[:, 3], offs_f,
+                               xp=np).astype(np.int64)
+        self._core.append(bins.astype(np.int64), codes,
+                          base_gid=base_gid)
+        t_min = int(t.min()) if len(t) else np.iinfo(np.int64).max
+        t_max = int(t.max()) if len(t) else np.iinfo(np.int64).min
+        if getattr(self._core, "_multihost", False):
+            from ..parallel.multihost import allgather_concat
+            trip = allgather_concat(np.array([[t_min, t_max]],
+                                             dtype=np.int64))
+            t_min = int(trip[:, 0].min())
+            t_max = int(trip[:, 1].max())
+        if t_min <= t_max:   # at least one row somewhere
+            self.t_min_ms = (t_min if self.t_min_ms is None
+                             else min(self.t_min_ms, t_min))
+            self.t_max_ms = (t_max if self.t_max_ms is None
+                             else max(self.t_max_ms, t_max))
+        return self
+
+    def query(self, geometry: Geometry, t_lo_ms=None, t_hi_ms=None,
+              max_ranges: int = DEFAULT_MAX_RANGES,
+              exact: bool = True) -> np.ndarray:
+        """CANDIDATE gids for envelope ∩ [t_lo, t_hi] (open bounds
+        clamp to the agreed data extent); the caller's residual
+        predicate is the exactness stage."""
+        if not len(self) or self.t_min_ms is None:
+            return np.empty(0, dtype=np.int64)
+        t_lo_ms = self.t_min_ms if t_lo_ms is None else int(t_lo_ms)
+        t_hi_ms = self.t_max_ms if t_hi_ms is None else int(t_hi_ms)
+        t_lo_ms = max(t_lo_ms, self.t_min_ms)
+        t_hi_ms = min(t_hi_ms, self.t_max_ms)
+        if t_lo_ms > t_hi_ms:
+            return np.empty(0, dtype=np.int64)
+        from .xz3 import xz3_bin_code_ranges
+        env = geometry.envelope
+        triples = xz3_bin_code_ranges(self.sfc, env.as_tuple(),
+                                      t_lo_ms, t_hi_ms, self.period,
+                                      max_ranges)
+        if not triples:
+            return np.empty(0, dtype=np.int64)
+        return self._core.query_ranges(
+            [(b, b, lo, hi, 0) for b, lo, hi in triples])

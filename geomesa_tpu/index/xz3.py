@@ -18,7 +18,32 @@ from ..geometry.predicates import bbox_intersects, geometry_intersects
 from ..geometry.types import Geometry
 from .z3 import _time_windows_by_bin
 
-__all__ = ["XZ3Index"]
+__all__ = ["XZ3Index", "xz3_bin_code_ranges"]
+
+
+def xz3_bin_code_ranges(sfc, env: tuple, t_lo_ms: int, t_hi_ms: int,
+                        period, max_ranges: int) -> list:
+    """Shared XZ3 range planning — per-bin covering ``(bin, code_lo,
+    code_hi)`` triples for an envelope × interval (whole-period bins
+    grouped to share one decomposition; the range budget splits across
+    windows).  The one definition behind the full-fat AND lean XZ3
+    indexes (review r5)."""
+    windows = _time_windows_by_bin(t_lo_ms, t_hi_ms, period)
+    if not windows:
+        return []
+    target = max(1, max_ranges // max(1, len(windows)))
+    by_window: dict[tuple, list[int]] = {}
+    for b, w in windows.items():
+        by_window.setdefault(w, []).append(b)
+    out = []
+    xmin, ymin, xmax, ymax = env
+    for (wlo, whi), bs in by_window.items():
+        ranges = sfc.ranges(
+            [(xmin, ymin, float(wlo), xmax, ymax, float(whi))],
+            max_ranges=target)
+        for b in bs:
+            out.extend((int(b), int(lo), int(hi)) for lo, hi in ranges)
+    return out
 
 
 class XZ3Index:
@@ -58,31 +83,27 @@ class XZ3Index:
               max_ranges: int = DEFAULT_MAX_RANGES,
               exact: bool = True) -> np.ndarray:
         env = geometry.envelope
-        windows = _time_windows_by_bin(t_lo_ms, t_hi_ms, self.period)
-        if not windows or not len(self):
+        if not len(self):
             return np.empty(0, dtype=np.int64)
-        target = max(1, max_ranges // max(1, len(windows)))
-        # group whole-period bins to share one decomposition
-        by_window: dict[tuple, list[int]] = {}
-        for b, w in windows.items():
-            by_window.setdefault(w, []).append(b)
+        # open bounds clamp to the data's extent — the same trick the
+        # z3 point index uses, so a spatial-only query can ride xz3
+        # when no xz2 index is enabled (review r5)
+        if t_lo_ms is None:
+            t_lo_ms = int(self.dtg.min())
+        if t_hi_ms is None:
+            t_hi_ms = int(self.dtg.max())
+        bin_ranges = xz3_bin_code_ranges(self.sfc, env.as_tuple(),
+                                         t_lo_ms, t_hi_ms, self.period,
+                                         max_ranges)
         cands = []
-        for (wlo, whi), bs in by_window.items():
-            ranges = self.sfc.ranges(
-                [(env.xmin, env.ymin, float(wlo), env.xmax, env.ymax, float(whi))],
-                max_ranges=target,
-            )
-            if not len(ranges):
-                continue
-            for b in bs:
-                lo_i = np.searchsorted(self.bins, b, side="left")
-                hi_i = np.searchsorted(self.bins, b, side="right")
-                seg = self.codes[lo_i:hi_i]
-                starts = np.searchsorted(seg, ranges[:, 0], side="left") + lo_i
-                ends = np.searchsorted(seg, ranges[:, 1], side="right") + lo_i
-                for s, e in zip(starts, ends):
-                    if e > s:
-                        cands.append(self.pos[s:e])
+        for b, rlo, rhi in bin_ranges:
+            lo_i = np.searchsorted(self.bins, b, side="left")
+            hi_i = np.searchsorted(self.bins, b, side="right")
+            seg = self.codes[lo_i:hi_i]
+            s = np.searchsorted(seg, rlo, side="left") + lo_i
+            e = np.searchsorted(seg, rhi, side="right") + lo_i
+            if e > s:
+                cands.append(self.pos[s:e])
         if not cands:
             return np.empty(0, dtype=np.int64)
         cand = np.concatenate(cands)

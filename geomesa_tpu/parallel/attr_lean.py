@@ -48,9 +48,12 @@ from ..ops.search import (
     expand_ranges, gather_capacity, pad_pow2, searchsorted2,
 )
 from .scan import _fetch_global, encode_gids
-from ..index.xz2_lean import XZ2Facade as _XZ2Facade
+from ..index.xz2_lean import (
+    LeanXZ3Index as _LeanXZ3Facade, XZ2Facade as _XZ2Facade,
+)
 
-__all__ = ["ShardedLeanAttrIndex", "ShardedLeanXZ2Index"]
+__all__ = ["ShardedLeanAttrIndex", "ShardedLeanXZ2Index",
+           "ShardedLeanXZ3Index"]
 
 _GEN_BUCKET = 4
 
@@ -445,3 +448,20 @@ class ShardedLeanXZ2Index(_XZ2Facade):
             "__xz2__", "long", mesh=mesh, multihost=multihost,
             generation_slots=generation_slots,
             hbm_budget_bytes=hbm_budget_bytes), g=g)
+
+
+class ShardedLeanXZ3Index(_LeanXZ3Facade):
+    """The lean XZ3 tier over a mesh: (bin, code) keys on the sharded
+    attribute core (XZ3IndexKeySpace.scala's ``[2B bin][8B code]`` at
+    cluster scale)."""
+
+    def __init__(self, period="week", mesh: Mesh = None, g: int = 12,
+                 multihost: bool = False,
+                 generation_slots: int | None = None,
+                 hbm_budget_bytes: int | None = None):
+        super().__init__(period=period, g=g,
+                         core=ShardedLeanAttrIndex(
+                             "__xz3__", "long", mesh=mesh,
+                             multihost=multihost,
+                             generation_slots=generation_slots,
+                             hbm_budget_bytes=hbm_budget_bytes))

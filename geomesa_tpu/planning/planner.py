@@ -264,8 +264,15 @@ class QueryPlanner:
             return store.z2_index().query(boxes)
         if name == "xz3":
             idx = store.xz3_index()
+            # temporal-only: scan the whole world (a strategy with no
+            # geometry used to produce ZERO scan parts and silently
+            # empty results — review r5)
+            from ..geometry.types import Polygon as _Poly
+            geoms_q = strategy.geometries or (
+                _Poly([(-180.0, -90.0), (180.0, -90.0),
+                       (180.0, 90.0), (-180.0, 90.0)]),)
             parts = []
-            for g in strategy.geometries or ():
+            for g in geoms_q:
                 for lo, hi in strategy.intervals:
                     parts.append(idx.query(g, lo, hi, exact=False))
             return self._add_tail(_union(parts), "xz3")

@@ -241,6 +241,15 @@ class StrategyDecider:
                     "z3", max(1.0, self.total * sp_frac),
                     geometries=tuple(geoms.values),
                     intervals=((None, None),)))
+            elif (not temporal and dtg and not sft.is_points
+                  and self._enabled("xz3")):
+                # the non-point analog: a lean XZ3 schema (no xz2
+                # available) serves pure-spatial queries with an open
+                # clamped interval
+                out.append(FilterStrategy(
+                    "xz3", max(1.0, self.total * sp_frac),
+                    geometries=tuple(geoms.values),
+                    intervals=((None, None),)))
 
         indexed = ({a.name for a in sft.attributes if a.indexed}
                    if self._enabled("attr") else set())

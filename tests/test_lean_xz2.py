@@ -145,3 +145,119 @@ def test_sharded_lean_xz2_matches_single_chip(polys):
         b = plain.query_result("osm", q)
         np.testing.assert_array_equal(np.sort(a.positions),
                                       np.sort(b.positions))
+
+
+class TestLeanXZ3:
+    """Polygons WITH TIME at the lean tier: (bin, code) keys on the
+    attribute core (XZ3IndexKeySpace.scala's [2B bin][8B code])."""
+
+    def _store(self, mesh=None):
+        rng = np.random.default_rng(37)
+        n = 30_000
+        cx = rng.uniform(-170, 170, n)
+        cy = rng.uniform(-80, 80, n)
+        w = rng.uniform(0.001, 0.05, n)
+        t = rng.integers(MS, MS + 14 * 86_400_000, n)
+        geoms = [Polygon([(a - d, b - d), (a + d, b - d),
+                          (a + d, b + d), (a - d, b + d)])
+                 for a, b, d in zip(cx, cy, w)]
+        ds = TpuDataStore(mesh=mesh)
+        ds.create_schema("osm", "kind:String:index=true,dtg:Date,"
+                                "*geom:Polygon;"
+                                "geomesa.index.profile=lean")
+        kind = rng.choice(np.array(["a", "b", "rare"], object), n,
+                          p=[.6, .39, .01])
+        for lo in range(0, n, 10_000):
+            ds.write("osm", {"kind": kind[lo:lo + 10_000],
+                             "dtg": t[lo:lo + 10_000],
+                             "geom": geoms[lo:lo + 10_000]})
+        return ds, cx, cy, w, t, kind
+
+    def test_kind_and_spatiotemporal_oracle(self):
+        from geomesa_tpu.index.xz2_lean import LeanXZ3Index
+        ds, cx, cy, w, t, kind = self._store()
+        st = ds._store("osm")
+        assert st.lean_kind == "xz3"
+        assert st.query_indices == {"xz3", "id", "attr"}
+        assert isinstance(st.index("xz3"), LeanXZ3Index)
+        lo, hi = MS + 2 * 86_400_000, MS + 9 * 86_400_000
+        q = ("INTERSECTS(geom, POLYGON((-80 30, -60 30, -60 50, "
+             "-80 50, -80 30))) AND dtg DURING "
+             "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+        r = ds.query_result("osm", q)
+        assert r.strategy.index == "xz3"
+        want = np.flatnonzero((cx + w >= -80) & (cx - w <= -60)
+                              & (cy + w >= 30) & (cy - w <= 50)
+                              & (t >= lo) & (t <= hi))
+        np.testing.assert_array_equal(np.sort(r.positions), want)
+
+    def test_spatial_only_open_interval_fallback(self):
+        ds, cx, cy, w, t, kind = self._store()
+        r = ds.query_result("osm", "BBOX(geom, 0, 0, 20, 20)")
+        assert r.strategy.index == "xz3"
+        want = np.flatnonzero((cx + w >= 0) & (cx - w <= 20)
+                              & (cy + w >= 0) & (cy - w <= 20))
+        np.testing.assert_array_equal(np.sort(r.positions), want)
+
+    def test_temporal_only(self):
+        ds, cx, cy, w, t, kind = self._store()
+        r = ds.query_result(
+            "osm", "dtg DURING 2018-01-02T00:00:00Z/"
+                   "2018-01-04T00:00:00Z")
+        lo, hi = MS + 86_400_000, MS + 3 * 86_400_000
+        want = np.flatnonzero((t >= lo) & (t <= hi))
+        np.testing.assert_array_equal(np.sort(r.positions), want)
+
+    def test_attr_tier_composes(self):
+        ds, cx, cy, w, t, kind = self._store()
+        r = ds.query_result("osm", "kind = 'rare'")
+        assert r.strategy.index == "attr:kind"
+        np.testing.assert_array_equal(np.sort(r.positions),
+                                      np.flatnonzero(kind == "rare"))
+
+    def test_mesh_variant_matches(self):
+        from geomesa_tpu.parallel import device_mesh
+        from geomesa_tpu.parallel.attr_lean import ShardedLeanXZ3Index
+        dsm, cx, cy, w, t, kind = self._store(mesh=device_mesh())
+        st = dsm._store("osm")
+        assert isinstance(st.index("xz3"), ShardedLeanXZ3Index)
+        lo, hi = MS + 2 * 86_400_000, MS + 9 * 86_400_000
+        q = ("INTERSECTS(geom, POLYGON((-80 30, -60 30, -60 50, "
+             "-80 50, -80 30))) AND dtg DURING "
+             "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+        r = dsm.query_result("osm", q)
+        want = np.flatnonzero((cx + w >= -80) & (cx - w <= -60)
+                              & (cy + w >= 30) & (cy - w <= 50)
+                              & (t >= lo) & (t <= hi))
+        np.testing.assert_array_equal(np.sort(r.positions), want)
+
+
+def test_fullfat_polygon_temporal_only_fixed():
+    """Pre-existing planner bug (review r5): a temporal-only query on a
+    full-fat polygon schema chose xz3 with NO geometry and silently
+    returned zero hits."""
+    ds = TpuDataStore()
+    ds.create_schema("p", "dtg:Date,*geom:Polygon")
+    ds.write("p", {"dtg": np.array([MS, MS + 86_400_000 * 5]),
+                   "geom": [Polygon([(0, 0), (1, 0), (1, 1), (0, 1)]),
+                            Polygon([(2, 2), (3, 2), (3, 3),
+                                     (2, 3)])]})
+    r = ds.query_result(
+        "p", "dtg DURING 2018-01-01T00:00:00Z/2018-01-02T00:00:00Z")
+    assert list(r.positions) == [0]
+
+
+def test_fullfat_xz3_only_schema_spatial_fallback():
+    """A full-fat polygon schema restricted to xz3 (xz2 disabled) still
+    answers pure-spatial queries: the strategy falls back to xz3 with
+    an open interval, which the index clamps to the data extent
+    (review r5 — this used to crash in _time_windows_by_bin)."""
+    ds = TpuDataStore()
+    ds.create_schema("p", "dtg:Date,*geom:Polygon;"
+                          "geomesa.indices.enabled=xz3,id")
+    ds.write("p", {"dtg": np.array([MS, MS + 86_400_000]),
+                   "geom": [Polygon([(0, 0), (1, 0), (1, 1), (0, 1)]),
+                            Polygon([(5, 5), (6, 5), (6, 6), (5, 6)])]})
+    r = ds.query_result("p", "BBOX(geom, -1, -1, 2, 2)")
+    assert r.strategy.index == "xz3"
+    assert list(r.positions) == [0]
