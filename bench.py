@@ -388,6 +388,7 @@ def main():
                             and pallas["on_tpu"])
 
     scale = _scale_stanza()
+    compaction = _compaction_stanza()
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -415,6 +416,7 @@ def main():
             "tube40_4m_ms": round(tube_dt * 1e3, 1),
             "pallas": pallas,
             "scale": scale,
+            "compaction": compaction,
             "device": str(jax.devices()[0]),
         },
     }
@@ -472,6 +474,11 @@ def _compact_summary(full: dict) -> dict:
             "tube40_4m_ms": ex["tube40_4m_ms"],
             "pallas_wins": (ex.get("pallas") or {}).get("measured_wins"),
             "pallas_active": (ex.get("pallas") or {}).get("active"),
+            "compaction": {
+                k: (ex.get("compaction") or {}).get(k)
+                for k in ("generations_before", "generations_after",
+                          "warm_speedup", "density_warm_ms")
+                if k in (ex.get("compaction") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -503,14 +510,19 @@ def _scale_stanza() -> dict:
                                 "STORE_SCALE_r04.json"]),
             ("recorded_1b", ["SCALE_1B_r05.json",
                              "SCALE_1B_r04.json"])):
-        for fn in fns:   # newest round's record wins when present
+        for fn in fns:   # newest PARSEABLE round's record wins
             rec = os.path.join(here, fn)
             if os.path.exists(rec):
                 try:
                     with open(rec) as f:
                         out[key] = json.load(f)
                 except Exception as e:
+                    # a truncated/corrupt newer record must not mask an
+                    # older round's good one — keep looking; the error
+                    # survives only if every candidate fails
                     out[f"{key}_error"] = repr(e)
+                    continue
+                out.pop(f"{key}_error", None)
                 break
     n_live = int(os.environ.get("SCALE_LIVE_N", 32_000_000))
     if n_live:
@@ -529,6 +541,78 @@ def _scale_stanza() -> dict:
                 progress=lambda *_: None, record=False)
         except Exception as e:
             out["store_live_error"] = repr(e)
+    return out
+
+
+def _compaction_stanza() -> dict:
+    """LSM lifecycle regression numbers: stream a many-generation lean
+    build, measure cold density, compact, measure post-compaction
+    density, then the WARM repeat (sealed-generation partial cache) —
+    the generation-count and warm-speedup trends every future
+    BENCH_*.json tracks.  ``COMPACT_BENCH_N=0`` skips."""
+    import time
+
+    import numpy as np
+
+    from geomesa_tpu.index.z3_lean import LeanZ3Index
+
+    n = int(os.environ.get("COMPACT_BENCH_N", 4_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    try:
+        rng = np.random.default_rng(11)
+        slots = 1 << 17
+        ms0 = 1_514_764_800_000
+        idx = LeanZ3Index(period="week", generation_slots=slots,
+                          payload_on_device=False)
+        t0 = time.perf_counter()
+        step = slots  # one generation per slice — the LSM flush shape
+        for lo in range(0, n, step):
+            m = min(step, n - lo)
+            idx.append(rng.uniform(-180, 180, m),
+                       rng.uniform(-90, 90, m),
+                       rng.integers(ms0, ms0 + 14 * 86_400_000, m))
+        idx.block()
+        out["rows"] = n
+        out["ingest_s"] = round(time.perf_counter() - t0, 2)
+        out["generations_before"] = len(idx.generations)
+        box = [(-60.0, -30.0, 60.0, 30.0)]
+        lo_t, hi_t = ms0 + 86_400_000, ms0 + 9 * 86_400_000
+        t0 = time.perf_counter()
+        cold = idx.density(box, lo_t, hi_t, (-180, -90, 180, 90),
+                           256, 128)
+        out["density_cold_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        t0 = time.perf_counter()
+        stats = idx.compact()
+        out["compact_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        out["merged_groups"] = stats["merged_groups"]
+        out["generations_after"] = stats["generations"]
+        # compaction invalidated the merged runs' partials — this call
+        # re-seeds the cache over the compacted shape...
+        t0 = time.perf_counter()
+        seeded = idx.density(box, lo_t, hi_t, (-180, -90, 180, 90),
+                             256, 128)
+        out["density_compacted_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        # ...and the warm repeat re-scans only the live generation
+        # (first warm call compiles the live-only shapes; time the
+        # steady state)
+        warm = idx.density(box, lo_t, hi_t, (-180, -90, 180, 90),
+                           256, 128)
+        t0 = time.perf_counter()
+        warm = idx.density(box, lo_t, hi_t, (-180, -90, 180, 90),
+                           256, 128)
+        out["density_warm_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        out["warm_speedup"] = round(
+            out["density_compacted_ms"]
+            / max(out["density_warm_ms"], 1e-3), 1)
+        out["grids_equal"] = bool(
+            np.array_equal(cold, seeded) and np.array_equal(cold, warm))
+    except Exception as e:  # never kill the bench over the stanza
+        out["error"] = repr(e)
     return out
 
 

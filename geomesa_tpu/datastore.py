@@ -177,6 +177,14 @@ class _SchemaStore:
     #: (split evenly among them); the z3 scale index keeps the rest
     LEAN_ATTR_BUDGET_FRACTION = 0.25
 
+    #: default opportunistic LSM compaction factor for lean indexes:
+    #: merge when ≥ F sealed same-tier same-size-class runs accumulate
+    #: (``geomesa.lean.compaction.factor`` user data overrides; 0
+    #: disables the opportunistic trigger — explicit compact() still
+    #: works).  Conservative enough that small stores never trigger it;
+    #: a 60-generation 1B streamed build ends at O(log) runs.
+    LEAN_COMPACTION_FACTOR = 8
+
     #: which generational scale index a lean schema rides ("z3" for
     #: points+dtg, "xz2" for non-point geometries); set by _init_lean
     lean_kind = "z3"
@@ -276,11 +284,15 @@ class _SchemaStore:
                 from .parallel.attr_lean import ShardedLeanXZ2Index
                 idx = ShardedLeanXZ2Index(
                     mesh=self.mesh, multihost=self.multihost,
-                    hbm_budget_bytes=self._lean_z3_budget())
+                    generation_slots=self._lean_generation_slots(),
+                    hbm_budget_bytes=self._lean_z3_budget(),
+                    compaction_factor=self._lean_compaction_factor())
             else:
                 from .index.xz2_lean import LeanXZ2Index
                 idx = LeanXZ2Index(
-                    hbm_budget_bytes=self._lean_z3_budget())
+                    generation_slots=self._lean_generation_slots(),
+                    hbm_budget_bytes=self._lean_z3_budget(),
+                    compaction_factor=self._lean_compaction_factor())
             if n_steps:
                 bb = self.batch.geom_bbox()
                 for i in range(n_steps):
@@ -292,12 +304,16 @@ class _SchemaStore:
                 idx = ShardedLeanXZ3Index(
                     period=self.sft.z3_interval, mesh=self.mesh,
                     multihost=self.multihost,
-                    hbm_budget_bytes=self._lean_z3_budget())
+                    generation_slots=self._lean_generation_slots(),
+                    hbm_budget_bytes=self._lean_z3_budget(),
+                    compaction_factor=self._lean_compaction_factor())
             else:
                 from .index.xz2_lean import LeanXZ3Index
                 idx = LeanXZ3Index(
                     period=self.sft.z3_interval,
-                    hbm_budget_bytes=self._lean_z3_budget())
+                    generation_slots=self._lean_generation_slots(),
+                    hbm_budget_bytes=self._lean_z3_budget(),
+                    compaction_factor=self._lean_compaction_factor())
             if n_steps:
                 bb = self.batch.geom_bbox()
                 t = self.batch.column(self.sft.dtg_field)
@@ -314,12 +330,17 @@ class _SchemaStore:
                     period=self.sft.z3_interval, mesh=self.mesh,
                     version=self.index_versions["z3"],
                     multihost=self.multihost,
-                    hbm_budget_bytes=self._lean_z3_budget())
+                    generation_slots=self._lean_generation_slots(),
+                    hbm_budget_bytes=self._lean_z3_budget(),
+                    compaction_factor=self._lean_compaction_factor())
             else:
                 from .index.z3_lean import LeanZ3Index
-                idx = LeanZ3Index(period=self.sft.z3_interval,
-                                  version=self.index_versions["z3"],
-                                  hbm_budget_bytes=self._lean_z3_budget())
+                idx = LeanZ3Index(
+                    period=self.sft.z3_interval,
+                    version=self.index_versions["z3"],
+                    generation_slots=self._lean_generation_slots(),
+                    hbm_budget_bytes=self._lean_z3_budget(),
+                    compaction_factor=self._lean_compaction_factor())
             idx.payload_provider = self._lean_payload
             if n_steps:
                 x, y = self.batch.geom_xy()
@@ -340,6 +361,49 @@ class _SchemaStore:
         ud = self.sft.user_data or {}
         raw = ud.get("geomesa.lean.hbm.budget")
         return int(raw) if raw else LeanZ3Index.HBM_BUDGET_BYTES
+
+    def _lean_compaction_factor(self) -> int:
+        """Opportunistic compaction factor for the lean indexes
+        (``geomesa.lean.compaction.factor`` user data; 0 disables)."""
+        ud = self.sft.user_data or {}
+        raw = ud.get("geomesa.lean.compaction.factor")
+        return (int(raw) if raw is not None
+                else self.LEAN_COMPACTION_FACTOR)
+
+    def _lean_generation_slots(self) -> int | None:
+        """Per-generation slot override
+        (``geomesa.lean.generation.slots`` user data; None = the index
+        class default).  Small values force the many-generation LSM
+        regime at test scale."""
+        ud = self.sft.user_data or {}
+        raw = ud.get("geomesa.lean.generation.slots")
+        return int(raw) if raw is not None else None
+
+    def compact_lean(self, budget_ms: float | None = None) -> dict:
+        """Explicit LSM maintenance over every LIVE lean index (scale
+        index + attribute indexes): fold sealed same-tier runs until
+        done or past ``budget_ms`` (the remaining budget carries across
+        indexes; each index still makes ≥ 1 group of progress when
+        eligible, so repeated calls always converge).  The role the
+        reference delegates to Accumulo/HBase periodic major
+        compaction."""
+        import time
+        out: dict = {}
+        if not self.lean:
+            return out
+        t0 = time.perf_counter()
+
+        def remaining():
+            if budget_ms is None:
+                return None
+            return max(0.0, budget_ms - (time.perf_counter() - t0) * 1e3)
+
+        for key in [self.lean_kind] + [f"attr:{a}"
+                                       for a in self._lean_attr_names()]:
+            idx = self._indexes.get(key)
+            if idx is not None and hasattr(idx, "compact"):
+                out[key] = idx.compact(budget_ms=remaining())
+        return out
 
     def _lean_z3_budget(self) -> int:
         """The z3 index's share: the full lean budget minus the
@@ -373,7 +437,8 @@ class _SchemaStore:
                         // max(1, len(names))))
                 idx = ShardedLeanAttrIndex(
                     attr, a.type, mesh=self.mesh,
-                    multihost=self.multihost, hbm_budget_bytes=budget)
+                    multihost=self.multihost, hbm_budget_bytes=budget,
+                    compaction_factor=self._lean_compaction_factor())
             else:
                 from .index.attr_lean import LeanAttrIndex
                 budget = max(
@@ -381,8 +446,9 @@ class _SchemaStore:
                     int(self._lean_budget()
                         * self.LEAN_ATTR_BUDGET_FRACTION
                         // max(1, len(names))))
-                idx = LeanAttrIndex(attr, a.type,
-                                    hbm_budget_bytes=budget)
+                idx = LeanAttrIndex(
+                    attr, a.type, hbm_budget_bytes=budget,
+                    compaction_factor=self._lean_compaction_factor())
             n = len(self.batch)
             step = 1 << 22
             n_steps = -(-n // step)
@@ -1995,6 +2061,22 @@ class TpuDataStore:
         store.recompute_stats()
         self.persist_stats(name)
         return 0 if store.batch is None else len(store.batch)
+
+    def compact(self, name: str,
+                budget_ms: float | None = None) -> dict:
+        """Explicit LSM compaction of a lean schema's generational
+        indexes — the maintenance analog of the reference's
+        ``compact`` tool command (Accumulo major compaction): fold
+        sealed same-tier sorted runs into O(log) merged runs so query
+        and density fan-out stops growing with ingest history.
+
+        ``budget_ms`` bounds the work; interrupted compaction resumes
+        on the next call (each eligible index makes ≥ 1 merge of
+        progress).  Returns per-index ``{"merged_groups",
+        "generations", "tiers"}`` — empty for non-lean schemas, whose
+        indexes compact through their own tail-rebuild policy
+        (_maybe_compact)."""
+        return self._store(name).compact_lean(budget_ms=budget_ms)
 
     def _stats_path(self, name: str, store) -> str:
         """Per-schema stats file.  Multihost (with >1 process, matching

@@ -83,8 +83,17 @@ def _encode_float64(vals: np.ndarray) -> np.ndarray:
 def _encode_strings(vals: np.ndarray) -> np.ndarray:
     """First 8 UTF-8 bytes, big-endian, as signed int64 — a prefix code
     (lexicographic byte order == unsigned integer order; shifting by
-    2^63 makes it signed-comparable)."""
+    2^63 makes it signed-comparable).  ``None`` encodes as the EMPTY
+    key on both paths: the fast ``astype('S8')`` path would stringify
+    it to ``b'None'`` while the unicode fallback yields ``b''`` — the
+    candidate set of an equality query must not depend on which path a
+    batch happened to take."""
     arr = np.asarray(vals)
+    if arr.dtype == object:
+        none_mask = arr == np.array(None)
+        if none_mask.any():
+            arr = arr.copy()
+            arr[none_mask] = ""
     try:
         raw = arr.astype("S8")           # ASCII fast path (truncating)
     except UnicodeEncodeError:
@@ -178,6 +187,36 @@ def _attr_scan_coded(qklo, qkhi, qslo, qshi, qqid, *cols,
     return jnp.stack(outs)
 
 
+@partial(jax.jit, static_argnames=("out_cap",))
+def _attr_merge(*cols, out_cap: int):
+    """COMPACTION merge: fold K sorted (key, sec, gid) runs into ONE
+    sorted run in a single dispatch — lax.sort over the concatenation
+    floats every sentinel slot past the ``out_cap`` (= total valid)
+    leading rows, so the merged run carries zero padding and releases
+    the source runs' slack slots (the z3_lean._lean_merge_keys shape)."""
+    k = len(cols) // 3
+    keys = jnp.concatenate([cols[3 * i] for i in range(k)])
+    sec = jnp.concatenate([cols[3 * i + 1] for i in range(k)])
+    gid = jnp.concatenate([cols[3 * i + 2] for i in range(k)])
+    keys, sec, gid = jax.lax.sort((keys, sec, gid), dimension=0,
+                                  num_keys=2)
+    return keys[:out_cap], sec[:out_cap], gid[:out_cap]
+
+
+def merge_spilled_parts(parts: list[list]) -> list:
+    """COMPACTION merge for spilled (key, sec, gid) runs: composite
+    lexsort over the concatenation — the host twin of
+    :func:`_attr_merge`.  Returns a fresh mutable part list (the
+    _HostAttrStack re-pointing contract)."""
+    k = np.concatenate([np.asarray(p[0]) for p in parts])
+    s = np.concatenate([np.asarray(p[1]) for p in parts])
+    g = np.concatenate([np.asarray(p[2]) for p in parts])
+    order = np.lexsort((s, k))
+    return [np.ascontiguousarray(k[order]),
+            np.ascontiguousarray(s[order]),
+            np.ascontiguousarray(g[order])]
+
+
 def _bisect2(k: np.ndarray, s: np.ndarray, qk: np.ndarray,
              qs: np.ndarray, lo: np.ndarray, hi: np.ndarray,
              side: str) -> np.ndarray:
@@ -266,6 +305,27 @@ class _HostAttrStack:
 class _AttrGeneration:
     __slots__ = ("keys", "sec", "gid", "n", "tier", "spilled")
 
+    @classmethod
+    def merged_device(cls, keys, sec, gid, n: int) -> "_AttrGeneration":
+        """A compacted device run from already-merged columns (length
+        == n: zero sentinel padding)."""
+        gen = cls.__new__(cls)
+        gen.keys, gen.sec, gen.gid = keys, sec, gid
+        gen.n = int(n)
+        gen.tier = "device"
+        gen.spilled = None
+        return gen
+
+    @classmethod
+    def merged_host(cls, part: list) -> "_AttrGeneration":
+        """A compacted host run from an already-merged spilled part."""
+        gen = cls.__new__(cls)
+        gen.keys = gen.sec = gen.gid = None
+        gen.n = len(part[0])
+        gen.tier = "host"
+        gen.spilled = part
+        return gen
+
     def __init__(self, capacity: int):
         self.keys = jnp.full((capacity,), _SENTINEL_KEY, jnp.int64)
         self.sec = jnp.full((capacity,), _I64_MAX, jnp.int64)
@@ -306,10 +366,15 @@ class LeanAttrIndex:
     #: default HBM budget — the store splits its lean budget between
     #: the z3 index and the attribute indexes (docs/scale.md)
     HBM_BUDGET_BYTES = int(2.0 * 2 ** 30)
+    #: size-tiered compaction trigger (explicit compact() default; pass
+    #: compaction_factor=F to run it opportunistically after appends) —
+    #: the index/z3_lean.LeanZ3Index policy on the attribute runs
+    COMPACTION_FACTOR = 4
 
     def __init__(self, attr: str, attr_type: str,
                  generation_slots: int | None = None,
-                 hbm_budget_bytes: int | None = None):
+                 hbm_budget_bytes: int | None = None,
+                 compaction_factor: int | None = None):
         self.attr = attr
         self.attr_type = attr_type.lower()
         if self.attr_type not in _NUMERIC_TYPES | {"string"}:
@@ -323,6 +388,9 @@ class LeanAttrIndex:
         self._n_rows = 0
         self.dispatch_count = 0
         self._sentinel: tuple | None = None
+        #: opportunistic compaction factor (0 = off)
+        self.compaction_factor = int(compaction_factor or 0)
+        self.compactions = 0
 
     def __len__(self) -> int:
         return self._n_rows
@@ -409,7 +477,64 @@ class LeanAttrIndex:
             gen.n += take
             done += take
         self._n_rows += m_total
+        if self.compaction_factor:
+            # bounded opportunistic trigger: one merge group per append
+            self.compact(factor=self.compaction_factor, max_groups=1)
         return self
+
+    # -- compaction (LSM maintenance) -------------------------------------
+    def _compaction_groups(self, factor: int) -> list[list]:
+        from .lsm import plan_size_tiered
+        return plan_size_tiered(self.generations[:-1],
+                                ("device", "host"), lambda g: g.n,
+                                factor)
+
+    def _merge_group(self, group: list) -> None:
+        from .lsm import merged_capacity, replace_group
+        total = int(sum(g.n for g in group))
+        if group[0].tier == "device":
+            cols: list = []
+            for g in group:
+                cols += [g.keys, g.sec, g.gid]
+            out_cap = merged_capacity(
+                total, sum(g.capacity for g in group), gather_capacity)
+            self.dispatch_count += 1
+            keys, sec, gid = _attr_merge(*cols, out_cap=out_cap)
+            merged = _AttrGeneration.merged_device(keys, sec, gid,
+                                                   n=total)
+        else:
+            merged = _AttrGeneration.merged_host(
+                merge_spilled_parts([g.spilled for g in group]))
+            self._host_stack = None   # restacked lazily
+        self.generations = replace_group(self.generations, group,
+                                         merged)
+        self.compactions += 1
+        from ..metrics import (
+            LEAN_COMPACTION_MERGES, LEAN_COMPACTION_ROWS,
+            registry as _metrics,
+        )
+        _metrics.counter(LEAN_COMPACTION_MERGES).inc()
+        _metrics.counter(LEAN_COMPACTION_ROWS).inc(total)
+
+    def compact(self, budget_ms: float | None = None,
+                factor: int | None = None,
+                max_groups: int | None = None) -> dict:
+        """Incremental size-tiered merge compaction over the attribute
+        runs — merge one group, re-plan, stop past ``budget_ms`` or
+        ``max_groups`` (≥ 1 group of progress per call; resumes on the
+        next — index/lsm.py).  Candidate sets are identical at every
+        intermediate state."""
+        from .lsm import compact_incremental
+        f = int(factor or self.compaction_factor
+                or self.COMPACTION_FACTOR)
+        merged = compact_incremental(
+            lambda: self._compaction_groups(f), self._merge_group,
+            budget_ms=budget_ms, max_groups=max_groups)
+        if merged:
+            self._rebalance()
+        return {"merged_groups": merged,
+                "generations": len(self.generations),
+                "tiers": self.tier_counts()}
 
     # -- query path -------------------------------------------------------
     def query_ranges(self, ranges: list, n_windows: int = 1,

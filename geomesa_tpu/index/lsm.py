@@ -1,0 +1,90 @@
+"""Shared LSM compaction policy for the lean generational indexes.
+
+One definition of the size-tiered merge planner and the budgeted
+merge-one-replan loop, parameterized by each index variant's tier
+names, size metric, and merge mechanics — the policy appeared four
+times (z3_lean, attr_lean, parallel/lean, parallel/attr_lean) and a
+fix applied to one copy silently missed the others (review: the
+factor=1 non-termination guard lives HERE, once).
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["plan_size_tiered", "compact_incremental", "merged_capacity",
+           "replace_group"]
+
+
+def replace_group(generations: list, group: list, merged) -> list:
+    """The merge epilogue shared by every index variant: drop the
+    source runs and place the merged run at the group's OLDEST position
+    (list order is demotion age), returning the new generation list."""
+    i0 = min(generations.index(g) for g in group)
+    dead = {id(g) for g in group}
+    out = [g for g in generations if id(g) not in dead]
+    out.insert(i0, merged)
+    return out
+
+
+def plan_size_tiered(sealed: list, tiers: tuple, size_of, factor: int
+                     ) -> list[list]:
+    """Size-tiered merge plan: sealed same-tier runs bucketed by size
+    class (log2 of ``size_of(run)``); any bucket holding ≥ ``factor``
+    runs yields oldest-first groups of ``factor``.  Repeated
+    application turns N flush-sized runs into O(log N) — merged runs
+    land in higher buckets and cascade.
+
+    ``factor`` is clamped to ≥ 2: a factor-1 "group" would replace a
+    run with an identical-size merged run and re-plan it forever."""
+    factor = max(2, int(factor))
+    groups: list = []
+    for tier in tiers:
+        by_size: dict[int, list] = {}
+        for g in sealed:
+            if g.tier != tier:
+                continue
+            by_size.setdefault(max(1, int(size_of(g))).bit_length(),
+                               []).append(g)
+        for b in sorted(by_size):
+            runs = by_size[b]
+            while len(runs) >= factor:
+                groups.append(runs[:factor])
+                runs = runs[factor:]
+    return groups
+
+
+def compact_incremental(plan, merge_one, budget_ms: float | None = None,
+                        max_groups: int | None = None) -> int:
+    """The merge-one-replan loop shared by every compact(): each call
+    makes ≥ 1 group of progress when any is eligible, then stops past
+    ``budget_ms`` (wall clock — single-controller only) or
+    ``max_groups`` (deterministic — the multihost-safe bound and the
+    opportunistic trigger's one-group cap).  Returns groups merged;
+    interrupted compaction resumes on the next call because the plan
+    recomputes from the surviving runs."""
+    t0 = time.perf_counter()
+    merged = 0
+    while True:
+        groups = plan()
+        if not groups:
+            break
+        merge_one(groups[0])
+        merged += 1
+        if max_groups is not None and merged >= max_groups:
+            break
+        if (budget_ms is not None
+                and (time.perf_counter() - t0) * 1e3 >= budget_ms):
+            break
+    return merged
+
+
+def merged_capacity(total_valid: int, total_source_cap: int,
+                    gather_capacity) -> int:
+    """Slot count for a merged run: the pow2 ``gather_capacity`` quantum
+    when that fits inside the source runs' combined footprint (bounds
+    the distinct merged shapes to O(log) so post-compaction scans reuse
+    compiles), else exactly ``total_valid`` (padding must never make a
+    merge GROW residency — slack-heavy sources release their slack)."""
+    cap = gather_capacity(int(total_valid), minimum=8)
+    return cap if cap <= total_source_cap else int(total_valid)

@@ -59,6 +59,19 @@ class LeanCoreFacade:
     def block(self) -> None:
         self._core.block()
 
+    @property
+    def compactions(self) -> int:
+        return self._core.compactions
+
+    def compact(self, budget_ms: float | None = None,
+                factor: int | None = None,
+                max_groups: int | None = None) -> dict:
+        """Incremental size-tiered merge compaction of the core's
+        generational runs (the LSM maintenance job — see
+        LeanAttrIndex.compact)."""
+        return self._core.compact(budget_ms=budget_ms, factor=factor,
+                                  max_groups=max_groups)
+
 
 class XZ2Facade(LeanCoreFacade):
     """Shared XZ2 surface — single-chip and sharded variants differ
@@ -102,10 +115,12 @@ class LeanXZ2Index(XZ2Facade):
     """Single-chip generational tiered XZ2 index (module doc)."""
 
     def __init__(self, g: int = 12, generation_slots: int | None = None,
-                 hbm_budget_bytes: int | None = None):
+                 hbm_budget_bytes: int | None = None,
+                 compaction_factor: int | None = None):
         super().__init__(LeanAttrIndex(
             "__xz2__", "long", generation_slots=generation_slots,
-            hbm_budget_bytes=hbm_budget_bytes), g=g)
+            hbm_budget_bytes=hbm_budget_bytes,
+            compaction_factor=compaction_factor), g=g)
 
 
 class LeanXZ3Index(LeanCoreFacade):
@@ -122,12 +137,14 @@ class LeanXZ3Index(LeanCoreFacade):
 
     def __init__(self, period="week", g: int = 12,
                  generation_slots: int | None = None,
-                 hbm_budget_bytes: int | None = None, core=None):
+                 hbm_budget_bytes: int | None = None, core=None,
+                 compaction_factor: int | None = None):
         from ..curve.binnedtime import TimePeriod
         from ..curve.xz3 import xz3_sfc
         super().__init__(core if core is not None else LeanAttrIndex(
             "__xz3__", "long", generation_slots=generation_slots,
-            hbm_budget_bytes=hbm_budget_bytes))
+            hbm_budget_bytes=hbm_budget_bytes,
+            compaction_factor=compaction_factor))
         self.period = TimePeriod.parse(period)
         self.g = g
         self.sfc = xz3_sfc(self.period, g)

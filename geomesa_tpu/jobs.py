@@ -16,7 +16,8 @@ from __future__ import annotations
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass, field
 
-__all__ = ["IngestJob", "IngestResult", "run_ingest"]
+__all__ = ["IngestJob", "IngestResult", "run_ingest",
+           "CompactionJob", "run_compaction"]
 
 
 @dataclass
@@ -85,6 +86,35 @@ class IngestJob:
 def run_ingest(store, type_name: str, converter_config: dict,
                paths: list[str], workers: int = 4) -> IngestResult:
     return IngestJob(store, type_name, converter_config, workers).run(paths)
+
+
+@dataclass
+class CompactionJob:
+    """Explicit LSM maintenance over a lean schema's generational
+    indexes — the analog of the reference's ``compact`` tool command
+    (an Accumulo major compaction request): fold sealed same-tier
+    sorted runs so query/density fan-out stops growing with ingest
+    history.  ``budget_ms`` bounds each run; an interrupted job resumes
+    where it stopped, so schedulers can call it on a fixed cadence with
+    a fixed budget (the BatchWriter + periodic-compaction operating
+    pattern this store is built for).
+
+    ``store`` — TpuDataStore; ``budget_ms`` — wall-clock bound per
+    ``run()`` (None = run to completion).
+    """
+
+    store: object
+    type_name: str
+    budget_ms: float | None = None
+
+    def run(self) -> dict:
+        return self.store.compact(self.type_name,
+                                  budget_ms=self.budget_ms)
+
+
+def run_compaction(store, type_name: str,
+                   budget_ms: float | None = None) -> dict:
+    return CompactionJob(store, type_name, budget_ms).run()
 
 
 def local_paths_for_process(paths: list[str], process_index: int,

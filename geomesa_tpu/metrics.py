@@ -16,7 +16,18 @@ import time
 from dataclasses import dataclass, field
 
 __all__ = ["MetricRegistry", "Timer", "Counter", "HistogramMetric",
-           "LoggingReporter", "DelimitedFileReporter", "registry"]
+           "LoggingReporter", "DelimitedFileReporter", "registry",
+           "LEAN_COMPACTION_MERGES", "LEAN_COMPACTION_ROWS",
+           "LEAN_DENSITY_CACHE_HITS", "LEAN_DENSITY_CACHE_MISSES"]
+
+#: canonical counter names for the lean LSM lifecycle — compaction work
+#: (index/*_lean compact()) and the sealed-generation density-partial
+#: cache.  Named here so every index variant and the bench report read
+#: the same registry keys.
+LEAN_COMPACTION_MERGES = "lean.compaction.merges"
+LEAN_COMPACTION_ROWS = "lean.compaction.rows_merged"
+LEAN_DENSITY_CACHE_HITS = "lean.density.cache.hits"
+LEAN_DENSITY_CACHE_MISSES = "lean.density.cache.misses"
 
 
 @dataclass

@@ -60,9 +60,15 @@ _GEN_BUCKET = 4
 
 @lru_cache(maxsize=8)
 def _append_program(mesh: Mesh):
+    """Per-shard append at PER-SHARD offsets: ``r`` is a
+    ``(n_shards, 1)`` fill vector, so each shard merges its slice into
+    its OWN unused padded region — collective steps whose slices are
+    smaller than ``m_pad`` no longer burn the padding gap on every
+    shard (each shard's valid rows sort to the front, so its fill IS
+    its next write offset)."""
+
     @partial(shard_map, mesh=mesh,
-             in_specs=(P("shard", None),) * 3 + (P(),)
-             + (P("shard", None),) * 4,
+             in_specs=(P("shard", None),) * 8,
              out_specs=(P("shard", None),) * 3)
     def app(keys, sec, gid, r, ks, ss, gs, m):
         k0, s0, g0 = keys[0], sec[0], gid[0]
@@ -70,9 +76,9 @@ def _append_program(mesh: Mesh):
         k_new = jnp.where(valid, ks[0], _SENTINEL_KEY)
         s_new = jnp.where(valid, ss[0], jnp.int64(_I64_MAX))
         g_new = jnp.where(valid, gs[0], jnp.int64(-1))
-        k0 = jax.lax.dynamic_update_slice(k0, k_new, (r,))
-        s0 = jax.lax.dynamic_update_slice(s0, s_new, (r,))
-        g0 = jax.lax.dynamic_update_slice(g0, g_new, (r,))
+        k0 = jax.lax.dynamic_update_slice(k0, k_new, (r[0, 0],))
+        s0 = jax.lax.dynamic_update_slice(s0, s_new, (r[0, 0],))
+        g0 = jax.lax.dynamic_update_slice(g0, g_new, (r[0, 0],))
         k0, s0, g0 = jax.lax.sort((k0, s0, g0), dimension=0, num_keys=2)
         return k0[None], s0[None], g0[None]
 
@@ -120,7 +126,34 @@ def _scan_program(mesh: Mesh, n_gens: int, capacity: int, pos_bits: int):
 
 
 class _ShardedAttrGen:
-    __slots__ = ("keys", "sec", "gid", "n_slots", "tier", "spilled")
+    __slots__ = ("keys", "sec", "gid", "n_slots", "tier", "spilled",
+                 "fill")
+
+    @classmethod
+    def merged_device(cls, keys, sec, gid,
+                      n_slots: int) -> "_ShardedAttrGen":
+        """A compacted device generation from already-merged per-shard
+        columns (zero slack slots)."""
+        gen = cls.__new__(cls)
+        gen.keys, gen.sec, gen.gid = keys, sec, gid
+        gen.n_slots = int(n_slots)
+        gen.tier = "device"
+        gen.spilled = None
+        gen.fill = None
+        return gen
+
+    @classmethod
+    def merged_host(cls, parts: list,
+                    n_slots: int) -> "_ShardedAttrGen":
+        """A compacted host generation from already-merged spilled
+        parts (this process's local rows)."""
+        gen = cls.__new__(cls)
+        gen.keys = gen.sec = gen.gid = None
+        gen.n_slots = int(n_slots)
+        gen.tier = "host"
+        gen.spilled = parts
+        gen.fill = None
+        return gen
 
     def __init__(self, mesh: Mesh, slots: int):
         shards = int(mesh.devices.size)
@@ -134,6 +167,12 @@ class _ShardedAttrGen:
         self.n_slots = 0
         self.tier = "device"
         self.spilled: list[tuple] | None = None
+        #: per-LOCAL-shard valid-row counts (write offsets): appends
+        #: merge each slice into the shard's own unused padded region
+        #: instead of burning ``m_pad`` sentinel slots fleet-wide on
+        #: every collective step.  ``n_slots`` remains the agreed
+        #: (process-invariant) upper bound any shard's fill can reach.
+        self.fill: np.ndarray | None = None
 
     @property
     def slots(self) -> int:
@@ -176,11 +215,14 @@ class ShardedLeanAttrIndex:
     BATCH_SCAN_BUDGET = 1 << 26
     #: default PER-SHARD HBM budget (the store splits its lean budget)
     HBM_BUDGET_BYTES = int(2.0 * 2 ** 30)
+    #: size-tiered compaction trigger (see index/attr_lean)
+    COMPACTION_FACTOR = 4
 
     def __init__(self, attr: str, attr_type: str, mesh: Mesh,
                  generation_slots: int | None = None,
                  multihost: bool = False,
-                 hbm_budget_bytes: int | None = None):
+                 hbm_budget_bytes: int | None = None,
+                 compaction_factor: int | None = None):
         self.attr = attr
         self.attr_type = attr_type.lower()
         self.mesh = mesh
@@ -193,6 +235,9 @@ class ShardedLeanAttrIndex:
         self._n_total = 0
         self.dispatch_count = 0
         self._sentinel_gen: _ShardedAttrGen | None = None
+        #: opportunistic compaction factor (0 = off)
+        self.compaction_factor = int(compaction_factor or 0)
+        self.compactions = 0
 
     def __len__(self) -> int:
         return self._n_total
@@ -274,6 +319,8 @@ class ShardedLeanAttrIndex:
                 self.generations.append(gen)
                 self._rebalance()
                 gen = self.generations[-1]
+            if gen.fill is None:
+                gen.fill = np.zeros(local_shards, np.int64)
             take_all = min(m_pad * local_shards, max(0, m_local - done))
             ks = np.full((local_shards, m_pad), _SENTINEL_KEY, np.int64)
             ss = np.full((local_shards, m_pad), _I64_MAX, np.int64)
@@ -293,21 +340,97 @@ class ShardedLeanAttrIndex:
                     ss[s, :k] = sec[sl][lo:hi]
                     gs[s, :k] = gids[lo:hi]
                     ms[s, 0] = k
+            # per-shard write offsets: each shard's valid rows sort to
+            # the front, so its fill is exactly where its sentinel
+            # padding begins
+            rs = gen.fill.reshape((local_shards, 1)).astype(np.int32)
             sh = NamedSharding(self.mesh, P("shard", None))
             if self._multihost:
                 arrs = [jax.make_array_from_process_local_data(sh, a)
-                        for a in (ks, ss, gs, ms)]
+                        for a in (rs, ks, ss, gs, ms)]
             else:
-                arrs = [jax.device_put(a, sh) for a in (ks, ss, gs, ms)]
+                arrs = [jax.device_put(a, sh)
+                        for a in (rs, ks, ss, gs, ms)]
             self.dispatch_count += 1
             gen.keys, gen.sec, gen.gid = _append_program(self.mesh)(
-                gen.keys, gen.sec, gen.gid, jnp.int32(gen.n_slots),
-                *arrs)
-            gen.n_slots += m_pad
+                gen.keys, gen.sec, gen.gid, *arrs)
+            gen.fill += ms[:, 0]
+            # the agreed bound: the busiest shard anywhere gained at
+            # most min(m_pad, rows remaining) valid rows this step —
+            # NOT m_pad unconditionally (the old slot burn)
+            gen.n_slots += int(min(m_pad, m_max - done))
             done += m_pad * local_shards
         self._n_local += m_local
         self._n_total += self._agreed(m_local, "sum")
+        if self.compaction_factor:
+            # deterministic one-group cap per append (multihost-safe)
+            self.compact(factor=self.compaction_factor, max_groups=1)
         return self
+
+    # -- compaction (LSM maintenance) -------------------------------------
+    def _compaction_groups(self, factor: int) -> list[list]:
+        """Size-tiered merge plan over SEALED generations, bucketed by
+        consumed slot count (agreed metadata — identical on every
+        multihost process)."""
+        from ..index.lsm import plan_size_tiered
+        return plan_size_tiered(self.generations[:-1],
+                                ("device", "host"),
+                                lambda g: g.n_slots, factor)
+
+    def _merge_group(self, group: list) -> None:
+        from ..index.attr_lean import merge_spilled_parts
+        from ..index.lsm import merged_capacity, replace_group
+        from .lean import _merge_program
+        n_slots = int(sum(g.n_slots for g in group))
+        if group[0].tier == "device":
+            cols: list = []
+            for g in group:
+                cols += [g.keys, g.sec, g.gid]
+            out_slots = merged_capacity(
+                n_slots, sum(g.slots for g in group), gather_capacity)
+            self.dispatch_count += 1
+            keys, sec, gid = _merge_program(
+                self.mesh, len(group), out_slots)(*cols)
+            merged = _ShardedAttrGen.merged_device(keys, sec, gid,
+                                                   n_slots=n_slots)
+        else:
+            merged = _ShardedAttrGen.merged_host(
+                [merge_spilled_parts(
+                    [p for g in group for p in g.spilled])],
+                n_slots=n_slots)
+            self._host_stack = None
+        self.generations = replace_group(self.generations, group,
+                                         merged)
+        self.compactions += 1
+        from ..metrics import (
+            LEAN_COMPACTION_MERGES, LEAN_COMPACTION_ROWS,
+            registry as _metrics,
+        )
+        _metrics.counter(LEAN_COMPACTION_MERGES).inc()
+        # consumed-slot upper bound × shards (exact per-shard valid
+        # counts live on device)
+        _metrics.counter(LEAN_COMPACTION_ROWS).inc(
+            n_slots * int(self.mesh.devices.size))
+
+    def compact(self, budget_ms: float | None = None,
+                factor: int | None = None,
+                max_groups: int | None = None) -> dict:
+        """Incremental size-tiered merge compaction of the sharded
+        attribute runs.  ``budget_ms`` is ignored under multihost
+        (``max_groups`` and the invariant plan are the agreed stopping
+        points — see ShardedLeanZ3Index.compact)."""
+        from ..index.lsm import compact_incremental
+        f = int(factor or self.compaction_factor
+                or self.COMPACTION_FACTOR)
+        merged = compact_incremental(
+            lambda: self._compaction_groups(f), self._merge_group,
+            budget_ms=None if self._multihost else budget_ms,
+            max_groups=max_groups)
+        if merged:
+            self._rebalance()
+        return {"merged_groups": merged,
+                "generations": len(self.generations),
+                "tiers": self.tier_counts()}
 
     # -- query path -------------------------------------------------------
     def query_ranges(self, ranges: list, n_windows: int = 1,
@@ -443,11 +566,13 @@ class ShardedLeanXZ2Index(_XZ2Facade):
 
     def __init__(self, mesh: Mesh, g: int = 12, multihost: bool = False,
                  generation_slots: int | None = None,
-                 hbm_budget_bytes: int | None = None):
+                 hbm_budget_bytes: int | None = None,
+                 compaction_factor: int | None = None):
         super().__init__(ShardedLeanAttrIndex(
             "__xz2__", "long", mesh=mesh, multihost=multihost,
             generation_slots=generation_slots,
-            hbm_budget_bytes=hbm_budget_bytes), g=g)
+            hbm_budget_bytes=hbm_budget_bytes,
+            compaction_factor=compaction_factor), g=g)
 
 
 class ShardedLeanXZ3Index(_LeanXZ3Facade):
@@ -458,10 +583,12 @@ class ShardedLeanXZ3Index(_LeanXZ3Facade):
     def __init__(self, period="week", mesh: Mesh = None, g: int = 12,
                  multihost: bool = False,
                  generation_slots: int | None = None,
-                 hbm_budget_bytes: int | None = None):
+                 hbm_budget_bytes: int | None = None,
+                 compaction_factor: int | None = None):
         super().__init__(period=period, g=g,
                          core=ShardedLeanAttrIndex(
                              "__xz3__", "long", mesh=mesh,
                              multihost=multihost,
                              generation_slots=generation_slots,
-                             hbm_budget_bytes=hbm_budget_bytes))
+                             hbm_budget_bytes=hbm_budget_bytes,
+                             compaction_factor=compaction_factor))

@@ -144,6 +144,30 @@ def _append_program_full(mesh: Mesh, sfc):
 
 
 @lru_cache(maxsize=8)
+def _merge_program(mesh: Mesh, n_gens: int, out_slots: int):
+    """COMPACTION merge under shard_map: each device concatenates its
+    rows of the K sorted runs and re-sorts — sentinels float past the
+    valid rows, and the leading ``out_slots`` (= the group's consumed
+    slot count, an upper bound on any shard's valid rows) slots are the
+    merged per-shard run.  One dispatch folds K runs into one across
+    every shard (the index/z3_lean._lean_merge_keys shape on the
+    mesh)."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard", None),) * (3 * n_gens),
+             out_specs=(P("shard", None),) * 3)
+    def merge(*cols):
+        b = jnp.concatenate([cols[3 * i][0] for i in range(n_gens)])
+        z = jnp.concatenate([cols[3 * i + 1][0] for i in range(n_gens)])
+        p = jnp.concatenate([cols[3 * i + 2][0] for i in range(n_gens)])
+        b, z, p = jax.lax.sort((b, z, p), dimension=0, num_keys=2)
+        return (b[None, :out_slots], z[None, :out_slots],
+                p[None, :out_slots])
+
+    return jax.jit(merge)
+
+
+@lru_cache(maxsize=8)
 def _count_program(mesh: Mesh, n_gens: int):
     """Totals probe: per (shard, generation) candidate counts in ONE
     dispatch — out ``(n_shards, n_gens)``.  Tier-agnostic: both device
@@ -334,6 +358,30 @@ class _ShardedGen:
     __slots__ = ("bins", "z", "pos", "x", "y", "t", "n_slots", "tier",
                  "runs")
 
+    @classmethod
+    def merged_keys(cls, bins, z, pos, n_slots: int) -> "_ShardedGen":
+        """A compacted ``keys``-tier generation from already-merged
+        per-shard columns (``(n_shards, n_slots)``: zero slack)."""
+        gen = cls.__new__(cls)
+        gen.bins, gen.z, gen.pos = bins, z, pos
+        gen.x = gen.y = gen.t = None
+        gen.n_slots = int(n_slots)
+        gen.tier = "keys"
+        gen.runs = None
+        return gen
+
+    @classmethod
+    def merged_host(cls, runs: list, n_slots: int) -> "_ShardedGen":
+        """A compacted ``host``-tier generation from already-merged
+        runs (this process's local rows)."""
+        gen = cls.__new__(cls)
+        gen.bins = gen.z = gen.pos = None
+        gen.x = gen.y = gen.t = None
+        gen.n_slots = int(n_slots)
+        gen.tier = "host"
+        gen.runs = runs
+        return gen
+
     def __init__(self, mesh: Mesh, slots: int, tier: str = "keys"):
         shards = int(mesh.devices.size)
         sh = NamedSharding(mesh, P("shard", None))
@@ -423,6 +471,8 @@ class ShardedLeanZ3Index:
     #: default PER-SHARD HBM budget for key/payload residency (the
     #: single-chip default: v5e usable minus scan slack, docs/scale.md)
     HBM_BUDGET_BYTES = int(13.5 * 2**30)
+    #: size-tiered compaction trigger (see index/z3_lean.LeanZ3Index)
+    COMPACTION_FACTOR = 4
 
     def __init__(self, period: TimePeriod | str = TimePeriod.WEEK,
                  mesh: Mesh | None = None,
@@ -430,7 +480,8 @@ class ShardedLeanZ3Index:
                  generation_slots: int | None = None,
                  multihost: bool = False,
                  hbm_budget_bytes: int | None = None,
-                 payload_on_device: bool = True):
+                 payload_on_device: bool = True,
+                 compaction_factor: int | None = None):
         assert mesh is not None
         self.period = TimePeriod.parse(period)
         self.version = version
@@ -462,6 +513,11 @@ class ShardedLeanZ3Index:
         #: another live index is padding with, and lets the budget
         #: accounting free the full-tier one when its charge ends
         self._sentinels: dict = {}
+        #: opportunistic compaction factor (0 = off); under multihost
+        #: the merge plan derives from process-invariant metadata so
+        #: every process folds the same groups
+        self.compaction_factor = int(compaction_factor or 0)
+        self.compactions = 0
 
     def _sentinel(self, tier: str) -> _ShardedGen:
         """Shared empty full-size generation for bucket padding
@@ -665,7 +721,81 @@ class ShardedLeanZ3Index:
                          else min(self.t_min_ms, t_min))
         self.t_max_ms = (t_max if self.t_max_ms is None
                          else max(self.t_max_ms, t_max))
+        if self.compaction_factor:
+            # bounded opportunistic trigger — max_groups is a
+            # DETERMINISTIC cap, so every multihost process folds
+            # exactly one group per append (a wall-clock budget could
+            # stop processes after different merges and strand the
+            # next collective)
+            self.compact(factor=self.compaction_factor, max_groups=1)
         return self
+
+    # -- compaction (LSM maintenance) -------------------------------------
+    def _compaction_groups(self, factor: int) -> list[list]:
+        """Size-tiered merge plan over SEALED generations, bucketed by
+        CONSUMED SLOT COUNT — n_slots is agreed at append time and
+        retained through spills, so multihost processes always plan
+        identical groups (per-process host row counts are NOT
+        invariant and must not drive the plan)."""
+        from ..index.lsm import plan_size_tiered
+        return plan_size_tiered(self.generations[:-1],
+                                ("keys", "host"),
+                                lambda g: g.n_slots, factor)
+
+    def _merge_group(self, group: list) -> None:
+        from ..index.lsm import merged_capacity, replace_group
+        from ..index.z3_lean import merge_host_runs
+        n_slots = int(sum(g.n_slots for g in group))
+        if group[0].tier == "keys":
+            cols: list = []
+            for g in group:
+                cols += [g.bins, g.z, g.pos]
+            out_slots = merged_capacity(
+                n_slots, sum(g.slots for g in group), gather_capacity)
+            self.dispatch_count += 1
+            bins, z, pos = _merge_program(
+                self.mesh, len(group), out_slots)(*cols)
+            merged = _ShardedGen.merged_keys(bins, z, pos,
+                                             n_slots=n_slots)
+        else:
+            merged = _ShardedGen.merged_host(
+                [merge_host_runs([r for g in group for r in g.runs])],
+                n_slots=n_slots)
+            self._host_stack = None
+        self.generations = replace_group(self.generations, group,
+                                         merged)
+        self.compactions += 1
+        from ..metrics import (
+            LEAN_COMPACTION_MERGES, LEAN_COMPACTION_ROWS,
+            registry as _metrics,
+        )
+        _metrics.counter(LEAN_COMPACTION_MERGES).inc()
+        # consumed-slot upper bound × shards: per-shard VALID counts
+        # live on device, so exact rows would cost a fetch per merge
+        _metrics.counter(LEAN_COMPACTION_ROWS).inc(
+            n_slots * int(self.mesh.devices.size))
+
+    def compact(self, budget_ms: float | None = None,
+                factor: int | None = None,
+                max_groups: int | None = None) -> dict:
+        """Incremental size-tiered merge compaction over the sharded
+        runs (see index/z3_lean.LeanZ3Index.compact).  Under multihost
+        ``budget_ms`` is IGNORED — a wall-clock cut could stop
+        different processes after different merges and strand the next
+        collective; ``max_groups`` (deterministic) and the invariant
+        plan are the agreed stopping points."""
+        from ..index.lsm import compact_incremental
+        f = int(factor or self.compaction_factor
+                or self.COMPACTION_FACTOR)
+        merged = compact_incremental(
+            lambda: self._compaction_groups(f), self._merge_group,
+            budget_ms=None if self._multihost else budget_ms,
+            max_groups=max_groups)
+        if merged:
+            self._rebalance()
+        return {"merged_groups": merged,
+                "generations": len(self.generations),
+                "tiers": self.tier_counts()}
 
     def _shard_put(self, arrs: list):
         """Host (local_shards, …) arrays → global sharded arrays."""

@@ -63,7 +63,31 @@ def density_process(store, schema: str, query, env,
                     width: int = 256, height: int = 256,
                     weight_attr: str | None = None) -> np.ndarray:
     """Run ``query`` and accumulate matching features into a (height, width)
-    weighted grid over envelope ``env`` (xmin, ymin, xmax, ymax)."""
+    weighted grid over envelope ``env`` (xmin, ymin, xmax, ymax).
+
+    **Exactness contract on lean tiered stores** (docs/density.md).
+    The lean push-down accumulates each generation's grid next to its
+    keys, and DEMOTED (keys/host-tier) generations have no payload to
+    mask against — their bbox/time masks compare at z-CELL granularity
+    (~1.7e-4° per cell, ``_lean_density_keys`` /
+    ``HostStack.density_partial``).  Consequences for a PARTIAL-window
+    query (one that does not cover the whole extent):
+
+    * whole-extent queries are EXACT on every tier;
+    * full-tier generations are value-exact for any window;
+    * keys/host-tier generations may OVER-INCLUDE points lying within
+      one z cell outside the query's bbox/time edges (never exclude a
+      true hit), so the grid total can exceed the materializing
+      fallback's by at most the number of points within one cell of
+      the window boundary — per-cell divergence is bounded the same
+      way and confined to boundary cells.
+
+    Repeat calls on a warm store are served from cached
+    sealed-generation partials (cache hits change nothing: cached
+    grids are byte-identical to the tier's scan output).  Callers
+    needing value-exact partial-window grids on a demoted store should
+    run the query path (e.g. ``weight_attr`` forces it) and bin the
+    materialized hits."""
     mesh = getattr(store, "_mesh", None)
     if getattr(store, "_auth_provider", None) is None:
         from ..planning.planner import Query

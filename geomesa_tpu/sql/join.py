@@ -345,9 +345,35 @@ def _pairs_spatial(store, q: ParsedJoin, lres):
             _RightSlice(rb))
 
 
+def _require_single_process(store, q: ParsedJoin) -> None:
+    """JOIN pairing indexes ``.batch`` — each process's LOCAL rows — by
+    query positions, which are GLOBAL gids on a multihost store: rows
+    living on another process would silently vanish from the join
+    output.  Refuse loudly until both sides' key/geometry columns are
+    allgathered (the correct fix; not yet implemented).  A
+    multihost-MODE store on a single process holds every row locally,
+    so the hazard only exists past one process."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    for name in (q.left, q.right):
+        st = store._store(name)
+        if getattr(st, "multihost", False):
+            raise NotImplementedError(
+                f"sql_join over multihost schema {name!r}: join "
+                "pairing indexes process-local batches with global gid "
+                "positions, so cross-process pairs would be silently "
+                "dropped — allgather both sides' join columns or run "
+                "the join on a single-process store")
+
+
 def sql_join(store, text: str) -> dict:
-    """Execute a JOIN statement; returns a dict of output columns."""
+    """Execute a JOIN statement; returns a dict of output columns.
+
+    Multihost stores are rejected (NotImplementedError): see
+    :func:`_require_single_process`."""
     q = parse_join(text)
+    _require_single_process(store, q)
     lres = store.query_result(
         q.left, Query.of(q.where_left) if q.where_left else Query())
     if q.on_kind == "equi":
