@@ -389,6 +389,8 @@ def main():
 
     scale = _scale_stanza()
     compaction = _compaction_stanza()
+    stats_pd = _stats_pushdown_stanza()
+    xz3_scale = _xz3_scale_stanza()
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -417,6 +419,8 @@ def main():
             "pallas": pallas,
             "scale": scale,
             "compaction": compaction,
+            "stats_pushdown": stats_pd,
+            "xz3_scale": xz3_scale,
             "device": str(jax.devices()[0]),
         },
     }
@@ -427,10 +431,17 @@ def main():
     # tail window, carrying the primary metric plus per-config medians,
     # pallas wins, and scale POINTERS (record file + headline rows/rates
     # only — never the nested records themselves).
+    compact = _compact_summary(full)
+    # regression gate (round-5 VERDICT: silent median dips): compare
+    # the compact record — the schema every BENCH_r*.json captures —
+    # against the newest prior round, log loudly, and RECORD the list
+    regressions = _regression_gate(compact)
+    full["regressions"] = regressions
+    compact["extra"]["regressions"] = len(regressions)
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "BENCH_FULL.json"), "w") as f:
         json.dump(full, f, indent=1)
-    print(json.dumps(_compact_summary(full), separators=(",", ":")))
+    print(json.dumps(compact, separators=(",", ":")))
 
 
 def _compact_summary(full: dict) -> dict:
@@ -450,7 +461,10 @@ def _compact_summary(full: dict) -> dict:
         for k in ("rows", "ingest_rows_per_sec", "generations", "tiers",
                   "oracle_exact", "knn_measured_at_rows", "knn25_warm_ms",
                   "query_warm_ms", "density_1b_ms", "attr_query_warm_ms",
-                  "density_oracle_exact", "attr_oracle_exact"):
+                  "density_oracle_exact", "attr_oracle_exact",
+                  "stats_pushdown_cold_ms", "stats_pushdown_warm_ms",
+                  "stats_pushdown_speedup",
+                  "stats_materialized_fallbacks"):
             if k in rec:
                 v = rec[k]
                 if isinstance(v, list):
@@ -479,6 +493,16 @@ def _compact_summary(full: dict) -> dict:
                 for k in ("generations_before", "generations_after",
                           "warm_speedup", "density_warm_ms")
                 if k in (ex.get("compaction") or {})},
+            "stats_pushdown": {
+                k: (ex.get("stats_pushdown") or {}).get(k)
+                for k in ("cold_ms", "warm_ms", "warm_speedup",
+                          "materialized_fallbacks")
+                if k in (ex.get("stats_pushdown") or {})},
+            "xz3_scale": {
+                k: (ex.get("xz3_scale") or {}).get(k)
+                for k in ("ingest_rows_per_sec", "query_warm_ms",
+                          "oracle_exact")
+                if k in (ex.get("xz3_scale") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -612,6 +636,246 @@ def _compaction_stanza() -> dict:
         out["grids_equal"] = bool(
             np.array_equal(cold, seeded) and np.array_equal(cold, warm))
     except Exception as e:  # never kill the bench over the stanza
+        out["error"] = repr(e)
+    return out
+
+
+#: relative tolerance band for the regression gate — tunnel-noise-scale
+#: wiggle is not a regression; beyond 20% in the BAD direction is
+REGRESSION_TOLERANCE = 0.20
+
+#: metric-name direction conventions: timings regress UP, rates/speedups
+#: regress DOWN; anything else (hit counts, row totals, booleans) is
+#: not a performance direction and is never flagged
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s")
+_HIGHER_BETTER_MARKS = ("per_sec", "speedup", "wins", "value")
+
+
+def _flat_scalars(rec, prefix: str = "", depth: int = 0) -> dict:
+    """Dotted-key numeric leaves of a (possibly nested) record —
+    booleans excluded, two levels deep (the compact-summary shape)."""
+    out: dict = {}
+    if not isinstance(rec, dict):
+        return out
+    for k, v in rec.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict) and depth < 2:
+            out.update(_flat_scalars(v, f"{key}.", depth + 1))
+    return out
+
+
+def compare_bench_records(current: dict, prior: dict,
+                          tolerance: float = REGRESSION_TOLERANCE
+                          ) -> list:
+    """Regression gate (round-5 VERDICT: two silent median dips with
+    no tracking): every directional scalar metric shared by the
+    current record and the most recent prior one is compared; a move
+    beyond ``tolerance`` in the bad direction yields an entry
+    ``{"metric", "prior", "current", "ratio"}`` (ratio > 1 = that many
+    times worse).  Pure on its inputs so tests can drive it with
+    synthetic records."""
+    cur = _flat_scalars(current)
+    old = _flat_scalars(prior)
+    regs = []
+    for name, prev in old.items():
+        now = cur.get(name)
+        if now is None or prev <= 0:
+            continue
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf.endswith(_LOWER_BETTER_SUFFIXES):
+            ratio = now / prev
+        elif any(m in name for m in _HIGHER_BETTER_MARKS):
+            # matched against the FULL dotted name: pallas win leaves
+            # are kernel names under "pallas_wins." — leaf-only
+            # matching would silently skip exactly those regressions
+            ratio = prev / now if now > 0 else float("inf")
+        else:
+            continue
+        if ratio > 1.0 + tolerance:
+            regs.append({"metric": name, "prior": prev, "current": now,
+                         "ratio": round(ratio, 3)})
+    regs.sort(key=lambda r: -r["ratio"])
+    return regs
+
+
+def _latest_prior_record() -> tuple[dict | None, str | None]:
+    """The newest prior round's parsed compact record
+    (``BENCH_r*.json`` is the driver's capture: ``{"n", "tail",
+    "parsed"}``) — the regression gate's baseline."""
+    import glob
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    best, best_n = None, -1
+    for fn in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", fn)
+        if not m:
+            continue
+        n = int(m.group(1))
+        if n > best_n:
+            best, best_n = fn, n
+    if best is None:
+        return None, None
+    try:
+        with open(best) as f:
+            rec = json.load(f)
+        parsed = rec.get("parsed")
+        return (parsed if isinstance(parsed, dict) else None,
+                os.path.basename(best))
+    except Exception:
+        return None, os.path.basename(best)
+
+
+def _regression_gate(compact: dict) -> list:
+    """Compare this run's compact record against the most recent
+    BENCH_r*.json and LOG LOUDLY — silent dips are the failure mode
+    this exists to kill."""
+    prior, src = _latest_prior_record()
+    if prior is None:
+        return []
+    regs = compare_bench_records(compact, prior)
+    for r in regs:
+        print(f"BENCH REGRESSION vs {src}: {r['metric']} "
+              f"{r['prior']} -> {r['current']} "
+              f"({r['ratio']}x worse)", flush=True)
+    return regs
+
+
+def _xz3_scale_stanza() -> dict:
+    """Lean XZ3 (non-point WITH time) scale record — round-5 VERDICT:
+    'lean XZ3 has no scale record'.  Streams envelope+timestamp slices
+    through the generational (bin, code) runs, then measures a warm
+    INTERSECTS-with-time query whose residual-filtered result is
+    asserted ORACLE-EXACT (candidates must cover the oracle; the
+    residual makes them exact — the planner's normal split).
+    ``XZ3_SCALE_N=0`` skips."""
+    import time
+
+    import numpy as np
+
+    n = int(os.environ.get("XZ3_SCALE_N", 2_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    try:
+        from geomesa_tpu.geometry.types import Polygon
+        from geomesa_tpu.index.xz2_lean import LeanXZ3Index
+
+        rng = np.random.default_rng(23)
+        cx = rng.uniform(-170, 170, n)
+        cy = rng.uniform(-75, 75, n)
+        hw = rng.uniform(0.001, 0.05, n)
+        bbox = np.column_stack([cx - hw, cy - hw, cx + hw, cy + hw])
+        t = rng.integers(MS_2018, MS_2018 + 28 * 86_400_000, n)
+        idx = LeanXZ3Index(period="week",
+                           generation_slots=1 << 20)
+        step = 1 << 20
+        t0 = time.perf_counter()
+        for lo in range(0, n, step):
+            sl = slice(lo, lo + step)
+            idx.append_bboxes(bbox[sl], t[sl])
+        idx.block()
+        out["rows"] = n
+        out["ingest_s"] = round(time.perf_counter() - t0, 2)
+        out["ingest_rows_per_sec"] = round(n / max(
+            time.perf_counter() - t0, 1e-9))
+        out["generations"] = len(idx.generations)
+        out["tiers"] = idx.tier_counts()
+        qx0, qy0, qx1, qy1 = -80.0, 30.0, -60.0, 50.0
+        t_lo = MS_2018 + 7 * 86_400_000
+        t_hi = MS_2018 + 14 * 86_400_000
+        poly = Polygon([(qx0, qy0), (qx1, qy0), (qx1, qy1),
+                        (qx0, qy1)])
+        cand = idx.query(poly, t_lo, t_hi)   # warm/compile
+        t0 = time.perf_counter()
+        cand = idx.query(poly, t_lo, t_hi)
+        out["query_warm_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        # residual exactness: envelope-intersects ∧ time window (axis-
+        # aligned rects, so envelope-intersect IS intersects)
+        hit = ((bbox[:, 0] <= qx1) & (bbox[:, 2] >= qx0)
+               & (bbox[:, 1] <= qy1) & (bbox[:, 3] >= qy0)
+               & (t >= t_lo) & (t <= t_hi))
+        oracle = np.flatnonzero(hit)
+        cand = np.asarray(cand, np.int64)
+        got = np.unique(cand[hit[cand]])
+        covered = bool(np.isin(oracle, cand).all())
+        out["candidates"] = int(len(cand))
+        out["hits"] = int(len(oracle))
+        out["oracle_exact"] = bool(covered
+                                   and np.array_equal(got, oracle))
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
+    return out
+
+
+def _stats_pushdown_stanza() -> dict:
+    """Stat-sketch push-down regression numbers (ISSUE 3): a
+    many-generation lean store answers ``Count();MinMax;Histogram``
+    over a bbox+time window from per-run sketches — cold folds every
+    run, the warm repeat serves sealed runs from the sketch-partial
+    cache and folds only the live one; zero candidate materialization
+    asserted via the ``lean.sketch.materialized_fallbacks`` counter.
+    The recorded 1B twin lives in STORE_SCALE records
+    (store_scale_proof.run's stats_pushdown_* fields).
+    ``STATS_BENCH_N=0`` skips."""
+    import time
+
+    import numpy as np
+
+    n = int(os.environ.get("STATS_BENCH_N", 4_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    try:
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.metrics import (
+            LEAN_STATS_MATERIALIZED, registry,
+        )
+
+        rng = np.random.default_rng(29)
+        slots = 1 << 17
+        ds = TpuDataStore()
+        ds.create_schema(
+            "sbench", "score:Double:index=true,dtg:Date,*geom:Point;"
+                      "geomesa.index.profile=lean,"
+                      f"geomesa.lean.generation.slots={slots},"
+                      "geomesa.lean.compaction.factor=0")
+        t0 = time.perf_counter()
+        for lo in range(0, n, slots):
+            m = min(slots, n - lo)
+            ds.write("sbench", {
+                "score": rng.normal(50.0, 20.0, m),
+                "dtg": rng.integers(MS_2018,
+                                    MS_2018 + 14 * 86_400_000, m),
+                "geom": (rng.uniform(-180, 180, m),
+                         rng.uniform(-90, 90, m)),
+            })
+        out["rows"] = n
+        out["ingest_s"] = round(time.perf_counter() - t0, 2)
+        st = ds._store("sbench")
+        out["attr_runs"] = len(st._lean_attr_index("score").generations)
+        spec = "Count();MinMax(score);Histogram(score,20,0,100)"
+        q = ("BBOX(geom,-180,-90,180,90) AND dtg DURING "
+             "2018-01-02T00:00:00Z/2018-01-10T00:00:00Z")
+        m0 = registry.counter(LEAN_STATS_MATERIALIZED).count
+        t0 = time.perf_counter()
+        cold = ds.stats("sbench", q, spec)
+        out["cold_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        ds.stats("sbench", q, spec)   # compiles the live-only shape
+        t0 = time.perf_counter()
+        warm = ds.stats("sbench", q, spec)
+        out["warm_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        out["warm_speedup"] = round(
+            out["cold_ms"] / max(out["warm_ms"], 1e-3), 1)
+        out["materialized_fallbacks"] = int(
+            registry.counter(LEAN_STATS_MATERIALIZED).count - m0)
+        out["results_equal"] = bool(
+            cold.to_json() == warm.to_json())
+    except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
     return out
 

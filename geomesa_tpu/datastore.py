@@ -438,6 +438,7 @@ class _SchemaStore:
                 idx = ShardedLeanAttrIndex(
                     attr, a.type, mesh=self.mesh,
                     multihost=self.multihost, hbm_budget_bytes=budget,
+                    generation_slots=self._lean_generation_slots(),
                     compaction_factor=self._lean_compaction_factor())
             else:
                 from .index.attr_lean import LeanAttrIndex
@@ -448,6 +449,7 @@ class _SchemaStore:
                         // max(1, len(names))))
                 idx = LeanAttrIndex(
                     attr, a.type, hbm_budget_bytes=budget,
+                    generation_slots=self._lean_generation_slots(),
                     compaction_factor=self._lean_compaction_factor())
             n = len(self.batch)
             step = 1 << 22
@@ -2052,6 +2054,19 @@ class TpuDataStore:
                                         store.sft.default_geom, ud)
             self._persist_schema(store.sft)
         return old
+
+    def stats(self, name: str, query="INCLUDE",
+              spec: str = "Count()"):
+        """Evaluate a Stat DSL over the features matching ``query``
+        (the reference's stats-count / stats-histogram surface,
+        STATS_STRING hint).  Lean tiered schemas answer pushable specs
+        from per-run sketches folded next to the index keys — sealed
+        generations served from the sketch-partial cache, zero
+        candidate materialization (process/stats_process, ISSUE 3);
+        everything else materializes hits and folds through the Stat
+        monoid."""
+        from .process.stats_process import stats_process
+        return stats_process(self, name, query, spec)
 
     def stats_analyze(self, name: str) -> int:
         """Recompute a schema's sketches from its stored rows and persist

@@ -64,6 +64,10 @@ _I64_MAX = np.int64(np.iinfo(np.int64).max)
 #: per-slot bytes: key int64 + sec int64 + gid int32
 SLOT_BYTES = 8 + 8 + 4
 
+#: generation-count compile bucket for the multi-generation programs
+#: (the z3_lean._GEN_BUCKET discipline)
+_GEN_BUCKET = 4
+
 #: attribute types served by the int64 lexicode (AttributeIndexKey's
 #: typeRegistry analog); geometry/bytes/json are not indexable here,
 #: matching the reference's indexable-type set
@@ -217,6 +221,25 @@ def merge_spilled_parts(parts: list[list]) -> list:
             np.ascontiguousarray(g[order])]
 
 
+@partial(jax.jit, static_argnames=("bins", "depth", "width", "is_float"))
+def _attr_sketch_multi(slo, shi, hlo, hhi, *cols, bins: int, depth: int,
+                       width: int, is_float: bool):
+    """Stat-sketch fold over EVERY device generation in ONE dispatch
+    (ISSUE 3): per run, the shared :func:`stats.sketch.device_fold_body`
+    decodes the sorted keys and folds masked moments / histogram /
+    count-min partials — only the tiny stacked partials cross the wire,
+    never a key or candidate."""
+    from ..stats.sketch import device_fold_body
+    outs: list[list] = [[], [], [], [], [], [], []]
+    for g in range(len(cols) // 2):
+        res = device_fold_body(cols[2 * g], cols[2 * g + 1], slo, shi,
+                               hlo, hhi, bins=bins, depth=depth,
+                               width=width, is_float=is_float)
+        for acc, r in zip(outs, res):
+            acc.append(r)
+    return tuple(jnp.stack(a) for a in outs)
+
+
 def _bisect2(k: np.ndarray, s: np.ndarray, qk: np.ndarray,
              qs: np.ndarray, lo: np.ndarray, hi: np.ndarray,
              side: str) -> np.ndarray:
@@ -303,7 +326,7 @@ class _HostAttrStack:
 
 
 class _AttrGeneration:
-    __slots__ = ("keys", "sec", "gid", "n", "tier", "spilled")
+    __slots__ = ("keys", "sec", "gid", "n", "tier", "spilled", "gen_id")
 
     @classmethod
     def merged_device(cls, keys, sec, gid, n: int) -> "_AttrGeneration":
@@ -314,6 +337,7 @@ class _AttrGeneration:
         gen.n = int(n)
         gen.tier = "device"
         gen.spilled = None
+        gen.gen_id = -1
         return gen
 
     @classmethod
@@ -324,6 +348,7 @@ class _AttrGeneration:
         gen.n = len(part[0])
         gen.tier = "host"
         gen.spilled = part
+        gen.gen_id = -1
         return gen
 
     def __init__(self, capacity: int):
@@ -333,6 +358,10 @@ class _AttrGeneration:
         self.n = 0
         self.tier = "device"
         self.spilled: tuple | None = None
+        #: store-lifetime-unique run identity (assigned by the owning
+        #: index; compaction mints fresh ids for merged runs — the
+        #: sketch-partial cache invalidation key, like z3_lean)
+        self.gen_id = -1
 
     @property
     def capacity(self) -> int:
@@ -370,6 +399,12 @@ class LeanAttrIndex:
     #: compaction_factor=F to run it opportunistically after appends) —
     #: the index/z3_lean.LeanZ3Index policy on the attribute runs
     COMPACTION_FACTOR = 4
+    #: distinct sketch-fold specs whose per-sealed-run partials are
+    #: retained (LRU; the density-cache policy on sketch partials —
+    #: each partial is a handful of scalars + small hist/cms tables)
+    SKETCH_CACHE_SPECS = 8
+    #: host-RAM ceiling across all cached sketch specs
+    SKETCH_CACHE_MAX_BYTES = 64 * 2 ** 20
 
     def __init__(self, attr: str, attr_type: str,
                  generation_slots: int | None = None,
@@ -391,6 +426,17 @@ class LeanAttrIndex:
         #: opportunistic compaction factor (0 = off)
         self.compaction_factor = int(compaction_factor or 0)
         self.compactions = 0
+        #: sealed-run sketch partials: fold spec → {gen_id: RunSketch}
+        #: (the z3_lean density-cache policy — index/partial_cache)
+        from .partial_cache import PartialCache
+        self._sketch_cache = PartialCache(self.SKETCH_CACHE_SPECS,
+                                          self.SKETCH_CACHE_MAX_BYTES)
+        #: store-lifetime run-id source (see _AttrGeneration.gen_id)
+        self._gen_counter = 0
+
+    def _next_gen_id(self) -> int:
+        self._gen_counter += 1
+        return self._gen_counter
 
     def __len__(self) -> int:
         return self._n_rows
@@ -457,6 +503,7 @@ class LeanAttrIndex:
             gen = (self.generations[-1] if self.generations else None)
             if gen is None or gen.tier == "host" or gen.n >= gen.capacity:
                 gen = _AttrGeneration(self.generation_slots)
+                gen.gen_id = self._next_gen_id()
                 self.generations.append(gen)
                 self._rebalance()
                 gen = self.generations[-1]
@@ -506,6 +553,10 @@ class LeanAttrIndex:
             merged = _AttrGeneration.merged_host(
                 merge_spilled_parts([g.spilled for g in group]))
             self._host_stack = None   # restacked lazily
+        merged.gen_id = self._next_gen_id()
+        # stale sketch partials must never double-count (the density
+        # cache's compaction-mints-new-generation invalidation)
+        self._sketch_cache.drop_generations([g.gen_id for g in group])
         self.generations = replace_group(self.generations, group,
                                          merged)
         self.compactions += 1
@@ -535,6 +586,91 @@ class LeanAttrIndex:
         return {"merged_groups": merged,
                 "generations": len(self.generations),
                 "tiers": self.tier_counts()}
+
+    # -- stat-sketch push-down (ISSUE 3) ----------------------------------
+    def sketch_scan(self, fold) -> "RunSketch":
+        """Fold every run's rows matching ``fold``'s sec window into ONE
+        merged :class:`~geomesa_tpu.stats.sketch.RunSketch` — the
+        StatsScan push-down re-expressed over the sorted key runs: the
+        encoded key IS the value, so MinMax/Histogram/DescriptiveStats/
+        Frequency (and Count) fold on DEVICE for device runs, host runs
+        fold in one stacked numpy pass with per-run attribution, and no
+        candidate row ever materializes.  Sealed runs' partials cache
+        under ``fold`` (LRU + byte ceiling; compaction mints new
+        gen_ids), so a warm repeat folds only the live run.
+
+        ``want_values`` folds (TopK/Enumeration's exact value→count
+        maps) are dict-valued and run host-side over the runs' key
+        columns (device runs fetch once; the partial caches like any
+        other)."""
+        from ..metrics import (
+            LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
+            registry as _metrics,
+        )
+        from ..stats.sketch import RunSketch, fold_attr_runs
+        merged = RunSketch()
+        if not self.generations:
+            return merged
+        live = self.generations[-1]
+        cache = self._sketch_cache.spec_cache(fold)
+        dev_scan: list = []
+        host_scan: list = []
+        for g in self.generations:
+            part = cache.get(g.gen_id) if g is not live else None
+            if part is not None:
+                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                merged = merged + part
+            elif g.tier == "device":
+                dev_scan.append(g)
+            else:
+                host_scan.append(g)
+        is_float = self.attr_type in ("float", "double")
+        new_parts: dict[int, object] = {}
+        if dev_scan and not fold.want_values:
+            # every uncached device run in ONE dispatch (bucket-padded:
+            # all-sentinel padding folds to an empty partial)
+            padded = (list(dev_scan)
+                      + [None] * ((-len(dev_scan)) % _GEN_BUCKET))
+            cols: list = []
+            for g in padded:
+                c = (self._sentinel_cols() if g is None
+                     else (g.keys, g.sec))
+                cols += [c[0], c[1]]
+            self.dispatch_count += 1
+            cnt, kmin, kmax, vsum, vsumsq, hist, cms = [
+                np.asarray(a) for a in _attr_sketch_multi(
+                    jnp.int64(fold.slo), jnp.int64(fold.shi),
+                    jnp.float64(fold.hlo), jnp.float64(fold.hhi),
+                    *cols, bins=int(fold.bins), depth=int(fold.depth),
+                    width=int(fold.width), is_float=is_float)]
+            for i, g in enumerate(dev_scan):
+                n = int(cnt[i])
+                new_parts[id(g)] = RunSketch(
+                    n, int(kmin[i]) if n else None,
+                    int(kmax[i]) if n else None,
+                    float(vsum[i]), float(vsumsq[i]),
+                    np.array(hist[i]) if fold.bins else None,
+                    np.array(cms[i]) if fold.depth else None)
+        elif dev_scan:
+            # exact value→count folds are dict-valued — host fold over
+            # the fetched sorted key runs (valid rows sort to the front)
+            runs = [(np.asarray(g.keys[:g.n]), np.asarray(g.sec[:g.n]))
+                    for g in dev_scan]
+            for g, p in zip(dev_scan,
+                            fold_attr_runs(runs, fold, self.attr_type)):
+                new_parts[id(g)] = p
+        if host_scan:
+            runs = [(g.spilled[0], g.spilled[1]) for g in host_scan]
+            for g, p in zip(host_scan,
+                            fold_attr_runs(runs, fold, self.attr_type)):
+                new_parts[id(g)] = p
+        for g in dev_scan + host_scan:
+            p = new_parts[id(g)]
+            merged = merged + p
+            if g is not live:
+                _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                self._sketch_cache.add(cache, g.gen_id, p)
+        return merged
 
     # -- query path -------------------------------------------------------
     def query_ranges(self, ranges: list, n_windows: int = 1,
@@ -568,7 +704,7 @@ class LeanAttrIndex:
         parts: list = []
         if dev_gens:
             padded = list(dev_gens)
-            n_b = (-len(padded)) % 4
+            n_b = (-len(padded)) % _GEN_BUCKET
             padded += [None] * n_b
             count_cols: list = []
             for gen in padded:

@@ -72,6 +72,16 @@ class LeanCoreFacade:
         return self._core.compact(budget_ms=budget_ms, factor=factor,
                                   max_groups=max_groups)
 
+    def sketch_scan(self, fold):
+        """Stat-sketch fold over the core's own (key, sec) runs
+        (ISSUE 3) — direct-index surface parity with the lean family:
+        e.g. a whole-window Count over an XZ run set with the same
+        sealed-run partial cache.  A non-point lean STORE's attribute
+        stats route through its attr indexes instead (stats_process);
+        this exposes the fold for callers driving the XZ index
+        directly (LeanAttrIndex.sketch_scan)."""
+        return self._core.sketch_scan(fold)
+
 
 class XZ2Facade(LeanCoreFacade):
     """Shared XZ2 surface — single-chip and sharded variants differ

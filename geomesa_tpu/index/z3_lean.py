@@ -75,6 +75,7 @@ from ..index.z3 import Z3_INDEX_VERSION, plan_z3_query, z3_sfc_for_version
 from ..metrics import (
     LEAN_COMPACTION_MERGES, LEAN_COMPACTION_ROWS,
     LEAN_DENSITY_CACHE_HITS, LEAN_DENSITY_CACHE_MISSES,
+    LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
     registry as _metrics,
 )
 from ..ops.search import (
@@ -441,6 +442,30 @@ def _lean_density_sweep(sfc, env, *zs, width: int, height: int,
     return jnp.stack(grids)
 
 
+@partial(jax.jit, static_argnames=("bits", "nb"))
+def _z3_cells_multi(b0, *cols, bits: int, nb: int):
+    """Z3Histogram push-down fold over device generations in ONE
+    dispatch (ISSUE 3): every slot's coarse cell is the TOP BITS of its
+    z key (``z >> (63 - bits)`` — exactly Z3HistogramStat's cell
+    function), so the per-generation (time-bin × cell) count tables
+    accumulate with no payload and no candidate; only the tiny stacked
+    tables cross the wire.  ``nb`` is the time-bin span ``[b0, b0+nb)``
+    of the data extent; sentinel slots (and any out-of-span bin) fold
+    into a discarded overflow slot."""
+    size = nb << bits
+    outs = []
+    for g in range(len(cols) // 2):
+        b, z = cols[2 * g], cols[2 * g + 1]
+        mask = z != _SENTINEL_Z
+        cell = z >> jnp.int64(63 - bits)
+        flat = (b.astype(jnp.int64) - b0) * jnp.int64(1 << bits) + cell
+        ok = mask & (flat >= 0) & (flat < size)
+        flat = jnp.where(ok, flat, size).astype(jnp.int32)
+        outs.append(jnp.zeros((size + 1,), jnp.int64)
+                    .at[flat].add(1)[:size])
+    return jnp.stack(outs)
+
+
 _WORLD_ENV = (-180.0, -90.0, 180.0, 90.0)
 
 
@@ -524,6 +549,20 @@ class HostRun:
             return np.empty(0, np.int64)
         return ((rqid[rid].astype(np.int64) << pos_bits)
                 | self.pos[idx].astype(np.int64))
+
+    def cell_counts(self, b0: int, nb: int, bits: int) -> np.ndarray:
+        """Z3Histogram partial over THIS spilled run: flat
+        ``(bin - b0) << bits | cell`` counts — the numpy twin of one
+        generation's slice of :func:`_z3_cells_multi` (bins rebuild
+        from the segment table; the stack owns the columns)."""
+        bins = np.repeat(self._bin_vals,
+                         np.diff(self._bin_starts)).astype(np.int64)
+        cell = np.asarray(self.z).astype(np.int64) >> (63 - bits)
+        size = nb << bits
+        flat = (bins - b0) * (1 << bits) + cell
+        ok = (flat >= 0) & (flat < size)
+        return np.bincount(flat[ok], minlength=size)[:size] \
+            .astype(np.int64)
 
     def sweep_partial(self, sfc, env, width: int, height: int,
                       world: bool) -> np.ndarray:
@@ -840,6 +879,10 @@ class LeanZ3Index:
     #: check runs at spec lookup, so one call may overshoot before the
     #: oldest specs evict)
     DENSITY_CACHE_MAX_BYTES = 512 * 2**20
+    #: stat-sketch partial cache bounds (cell-count folds are small:
+    #: time-bins × 2^bits int64 per sealed generation)
+    SKETCH_CACHE_SPECS = 8
+    SKETCH_CACHE_MAX_BYTES = 64 * 2**20
 
     def __init__(self, period: TimePeriod | str = TimePeriod.WEEK,
                  version: int = Z3_INDEX_VERSION,
@@ -885,9 +928,16 @@ class LeanZ3Index:
         #: given (boxes, window, env, grid) spec is IMMUTABLE, so warm
         #: repeat density calls sum cached grids and re-scan only the
         #: live generation (+ full-tier generations, whose value-exact
-        #: edge cells the cache must not coarsen).  dict order is the
-        #: LRU order over specs.
-        self._density_cache: dict = {}
+        #: edge cells the cache must not coarsen).  The LRU + byte
+        #: ceiling + compaction-invalidation policy is the shared
+        #: :class:`~geomesa_tpu.index.partial_cache.PartialCache`.
+        from .partial_cache import PartialCache
+        self._density_cache = PartialCache(self.DENSITY_CACHE_SPECS,
+                                           self.DENSITY_CACHE_MAX_BYTES)
+        #: sealed-generation stat-sketch partials (ISSUE 3): the same
+        #: policy over the z3 cell-count folds Z3Histogram pushes down
+        self._sketch_cache = PartialCache(self.SKETCH_CACHE_SPECS,
+                                          self.SKETCH_CACHE_MAX_BYTES)
         #: store-lifetime generation id source (see _Generation.gen_id)
         self._gen_counter = 0
 
@@ -1150,40 +1200,18 @@ class LeanZ3Index:
                 "tiers": self.tier_counts()}
 
     def _drop_cached_partials(self, gen_ids: list) -> None:
-        for cache in self._density_cache.values():
-            for gid in gen_ids:
-                cache.pop(gid, None)
-
-    def _cached_bytes(self) -> int:
-        return sum(g.nbytes for c in self._density_cache.values()
-                   for g in c.values())
+        self._density_cache.drop_generations(gen_ids)
+        self._sketch_cache.drop_generations(gen_ids)
 
     def _cache_partial(self, cache: dict, gen_id: int, part) -> None:
-        """Store one sealed-generation partial unless it would push the
-        TOTAL cached bytes — every spec, including the active one —
-        past DENSITY_CACHE_MAX_BYTES: a single huge-grid spec over many
-        generations must bound its own growth, not just evict
-        siblings."""
-        if (self._cached_bytes() + part.nbytes
-                <= self.DENSITY_CACHE_MAX_BYTES):
-            cache[gen_id] = part
+        """Store one sealed-generation density partial (the shared
+        PartialCache byte-ceiling policy)."""
+        self._density_cache.add(cache, gen_id, part)
 
     def _density_spec_cache(self, spec) -> dict:
-        """The per-generation partial dict for one density spec,
-        LRU-touched; oldest OTHER specs evict past DENSITY_CACHE_SPECS
-        or the DENSITY_CACHE_MAX_BYTES ceiling (inserts enforce the
-        ceiling against the active spec too — _cache_partial)."""
-        cache = self._density_cache.pop(spec, None)
-        if cache is None:
-            cache = {}
-            while len(self._density_cache) >= self.DENSITY_CACHE_SPECS:
-                self._density_cache.pop(
-                    next(iter(self._density_cache)))
-        self._density_cache[spec] = cache
-        while (len(self._density_cache) > 1
-               and self._cached_bytes() > self.DENSITY_CACHE_MAX_BYTES):
-            self._density_cache.pop(next(iter(self._density_cache)))
-        return cache
+        """The per-generation partial dict for one density spec (LRU +
+        byte ceiling — index/partial_cache)."""
+        return self._density_cache.spec_cache(spec)
 
     # -- payload ----------------------------------------------------------
     def _payload_flat(self):
@@ -1591,6 +1619,75 @@ class LeanZ3Index:
         return int(round(self.density(
             boxes, t_lo_ms, t_hi_ms, (-180.0, -90.0, 180.0, 90.0),
             1, 1, max_ranges=max_ranges).sum()))
+
+    def z3_cell_counts(self, bits: int) -> dict:
+        """WHOLE-EXTENT Z3Histogram push-down (ISSUE 3): fold every
+        generation's sorted keys into coarse ``(time-bin, z-cell)``
+        counts — the stat's own cell function applied to the key the
+        index already stores, so no payload, no candidates, and an
+        exactly-oracle-matching table (the keys were encoded by the
+        same curve the stat bins with).  Sealed generations' tables
+        cache under ``(bits, bin-span)`` (LRU + byte ceiling;
+        compaction invalidates); warm repeats fold only the live
+        generation.  Returns ``{(bin, cell): count}``."""
+        out: dict = {}
+        if self._n_rows == 0 or self.t_min_ms is None:
+            return out
+        b0, _ = to_binned_time(np.int64(max(0, self.t_min_ms)),
+                               self.period)
+        b1, _ = to_binned_time(np.int64(max(0, self.t_max_ms)),
+                               self.period)
+        b0, nb = int(b0), int(b1) - int(b0) + 1
+        spec = ("z3cells", int(bits), b0, nb)
+        cache = self._sketch_cache.spec_cache(spec)
+        live = self.generations[-1] if self.generations else None
+        total = np.zeros(nb << bits, np.int64)
+        scan: list = []
+        for g in self.generations:
+            if g.tier == "host":
+                continue
+            part = cache.get(g.gen_id) if g is not live else None
+            if part is None:
+                scan.append(g)
+            else:
+                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                total += part
+        for s in range(0, len(scan), _GEN_BUCKET * 2):
+            chunk = scan[s:s + _GEN_BUCKET * 2]
+            group = self._pad_bucket(chunk)
+            cols: list = []
+            for g in group:
+                c = (self._sentinel_cols("keys") if g is None
+                     else (g.bins, g.z))
+                cols += [c[0], c[1]]
+            self.dispatch_count += 1
+            stacked = np.asarray(_z3_cells_multi(
+                jnp.int64(b0), *cols, bits=int(bits), nb=nb))
+            for i, g in enumerate(chunk):
+                # copy, not a view: a cached view would pin the WHOLE
+                # stacked bucket (padding + live rows) in host RAM and
+                # break the cache's byte accounting
+                part = np.array(stacked[i])
+                total += part
+                if g is not live:
+                    _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                    self._sketch_cache.add(cache, g.gen_id, part)
+        for g in self.generations:
+            if g.tier != "host":
+                continue
+            part = cache.get(g.gen_id)
+            if part is None:
+                _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                part = g.run.cell_counts(b0, nb, int(bits))
+                self._sketch_cache.add(cache, g.gen_id, part)
+            else:
+                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+            total += part
+        c_per_bin = 1 << bits
+        for i in np.flatnonzero(total):
+            out[(b0 + int(i) // c_per_bin, int(i) % c_per_bin)] = \
+                int(total[i])
+        return out
 
     # -- scan helpers -----------------------------------------------------
     @staticmethod

@@ -18,7 +18,9 @@ from dataclasses import dataclass, field
 __all__ = ["MetricRegistry", "Timer", "Counter", "HistogramMetric",
            "LoggingReporter", "DelimitedFileReporter", "registry",
            "LEAN_COMPACTION_MERGES", "LEAN_COMPACTION_ROWS",
-           "LEAN_DENSITY_CACHE_HITS", "LEAN_DENSITY_CACHE_MISSES"]
+           "LEAN_DENSITY_CACHE_HITS", "LEAN_DENSITY_CACHE_MISSES",
+           "LEAN_SKETCH_CACHE_HITS", "LEAN_SKETCH_CACHE_MISSES",
+           "LEAN_SKETCH_SCANS", "LEAN_STATS_MATERIALIZED"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -28,6 +30,15 @@ LEAN_COMPACTION_MERGES = "lean.compaction.merges"
 LEAN_COMPACTION_ROWS = "lean.compaction.rows_merged"
 LEAN_DENSITY_CACHE_HITS = "lean.density.cache.hits"
 LEAN_DENSITY_CACHE_MISSES = "lean.density.cache.misses"
+#: stat-sketch push-down lifecycle (process/stats_process + the lean
+#: indexes' sketch_scan): per-sealed-run partial cache traffic, served
+#: push-down scans, and — the acceptance counter — stat requests that
+#: fell back to MATERIALIZING candidate hits on a lean store (the cost
+#: class the push-down exists to eliminate; ISSUE 3)
+LEAN_SKETCH_CACHE_HITS = "lean.sketch.cache.hits"
+LEAN_SKETCH_CACHE_MISSES = "lean.sketch.cache.misses"
+LEAN_SKETCH_SCANS = "lean.sketch.scans"
+LEAN_STATS_MATERIALIZED = "lean.sketch.materialized_fallbacks"
 
 
 @dataclass

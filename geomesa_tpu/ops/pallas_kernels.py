@@ -177,37 +177,68 @@ def load_tuning() -> dict:
     return {}
 
 
+def device_kind() -> str:
+    """The accelerator model string tuning records key on (e.g.
+    ``'TPU v5 lite'``): a pallas-vs-XLA win is a property of ONE chip
+    generation — applying it on another chip is wrong in both
+    directions (ISSUE 3 satellite: a v5e measurement must not disable
+    kernels on a v6e, nor keep a slower kernel enabled there)."""
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # pragma: no cover — backend probing never fatal
+        return "unknown"
+
+
 def apply_tuning(wins: dict) -> None:
     """Apply measured pallas-vs-XLA speedups to the gates: a win below
     1.0 disables the kernel (loudly) — wiring a measured-slower kernel
-    into the hot path is a regression vector (round-4 VERDICT #6)."""
+    into the hot path is a regression vector (round-4 VERDICT #6).
+
+    Entries are ``{kind: {"win": float, "device": str}}`` and apply
+    ONLY when their device string matches this process's chip; foreign-
+    device entries (and legacy un-attributed bare floats) are ignored —
+    a win measured on one chip must not gate another."""
     import logging
-    for kind, win in wins.items():
+    dev = None   # resolved lazily: device_kind() initializes the jax
+    #              backend, which a no-entry import must never force
+    for kind, rec in wins.items():
         gate = GATES.get(kind)
-        try:
-            win = float(win)
-        except (TypeError, ValueError):
-            win = None  # hand-edited/foreign file: ignore, don't crash
-        if gate is None or win is None:
+        if gate is None:
             continue
-        gate.measured_win = float(win)
-        slower = float(win) < 1.0
+        if not isinstance(rec, dict):
+            continue  # legacy bare-float entry: chip unknown — ignore
+        if dev is None:
+            dev = device_kind()
+        if str(rec.get("device")) != dev:
+            continue  # foreign chip's measurement
+        try:
+            win = float(rec.get("win"))
+        except (TypeError, ValueError):
+            continue  # hand-edited/foreign file: ignore, don't crash
+        gate.measured_win = win
+        slower = win < 1.0
         if slower and not gate.disabled:
             logging.getLogger("geomesa_tpu.pallas").warning(
                 "pallas %s measured %.2fx vs XLA on this chip — "
                 "disabled by measurement (.pallas_tuning.json)",
-                kind, float(win))
+                kind, win)
         gate.disabled = slower
 
 
 def record_tuning(wins: dict) -> None:
     """Persist measured speedups (bench.py calls this after timing each
     kernel against its XLA twin on the real chip) and apply them to the
-    current process.  Merge semantics; atomic replace."""
+    current process.  Each record carries THIS chip's device string;
+    same-device entries overwrite, foreign-device entries survive
+    untouched (per-chip merge semantics; atomic replace).  Legacy
+    un-attributed float entries for the re-measured kinds are dropped."""
     import json
     import os
+    dev = device_kind()
     merged = load_tuning()
-    merged.update({k: float(v) for k, v in wins.items() if v is not None})
+    for k, v in wins.items():
+        if v is not None:
+            merged[k] = {"win": float(v), "device": dev}
     path = _tuning_path()
     try:
         with open(path + ".tmp", "w") as f:

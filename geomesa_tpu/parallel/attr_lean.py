@@ -125,9 +125,51 @@ def _scan_program(mesh: Mesh, n_gens: int, capacity: int, pos_bits: int):
     return jax.jit(scan)
 
 
+@lru_cache(maxsize=16)
+def _sketch_program(mesh: Mesh, n_gens: int, bins: int, depth: int,
+                    width: int, is_float: bool):
+    """Per-shard stat-sketch fold under shard_map (ISSUE 3): each
+    device folds its own sorted runs through the SHARED
+    :func:`~geomesa_tpu.stats.sketch.device_fold_body` (one definition
+    with the single-chip kernel — no drift); hist/count-min tables
+    merge with ``psum`` over ICI, the five scalar partials come back
+    per-shard (the chip backend lowers only SUM all-reduces, so
+    min/max reduce on the host — the parallel.stats._moments_program
+    discipline)."""
+    from ..stats.sketch import device_fold_body
+
+    specs_in = (P(),) * 4 + (P("shard", None),) * (2 * n_gens)
+    out_specs = (P("shard", None),) * 5 + (P(None, None), P(None, None, None))
+
+    @partial(shard_map, mesh=mesh, in_specs=specs_in,
+             out_specs=out_specs)
+    def fold(slo, shi, hlo, hhi, *cols):
+        cnts, kmins, kmaxs, sums, sumsqs, hists, cmss = \
+            [], [], [], [], [], [], []
+        for g in range(n_gens):
+            k, s = cols[2 * g][0], cols[2 * g + 1][0]
+            cnt, kmin, kmax, vsum, vsumsq, hist, cms = device_fold_body(
+                k, s, slo, shi, hlo, hhi, bins=bins, depth=depth,
+                width=width, is_float=is_float)
+            cnts.append(cnt)
+            kmins.append(kmin)
+            kmaxs.append(kmax)
+            sums.append(vsum)
+            sumsqs.append(vsumsq)
+            hists.append(hist)
+            cmss.append(cms)
+        return (jnp.stack(cnts)[None], jnp.stack(kmins)[None],
+                jnp.stack(kmaxs)[None], jnp.stack(sums)[None],
+                jnp.stack(sumsqs)[None],
+                jax.lax.psum(jnp.stack(hists), "shard"),
+                jax.lax.psum(jnp.stack(cmss), "shard"))
+
+    return jax.jit(fold)
+
+
 class _ShardedAttrGen:
     __slots__ = ("keys", "sec", "gid", "n_slots", "tier", "spilled",
-                 "fill")
+                 "fill", "gen_id")
 
     @classmethod
     def merged_device(cls, keys, sec, gid,
@@ -140,6 +182,7 @@ class _ShardedAttrGen:
         gen.tier = "device"
         gen.spilled = None
         gen.fill = None
+        gen.gen_id = -1
         return gen
 
     @classmethod
@@ -153,6 +196,7 @@ class _ShardedAttrGen:
         gen.tier = "host"
         gen.spilled = parts
         gen.fill = None
+        gen.gen_id = -1
         return gen
 
     def __init__(self, mesh: Mesh, slots: int):
@@ -173,6 +217,11 @@ class _ShardedAttrGen:
         #: every collective step.  ``n_slots`` remains the agreed
         #: (process-invariant) upper bound any shard's fill can reach.
         self.fill: np.ndarray | None = None
+        #: store-lifetime-unique run identity — minted by the owning
+        #: index from agreed (process-invariant) appends/merges, so
+        #: every multihost process keys the sketch-partial cache
+        #: identically (index/attr_lean._AttrGeneration.gen_id)
+        self.gen_id = -1
 
     @property
     def slots(self) -> int:
@@ -238,6 +287,19 @@ class ShardedLeanAttrIndex:
         #: opportunistic compaction factor (0 = off)
         self.compaction_factor = int(compaction_factor or 0)
         self.compactions = 0
+        #: sealed-run sketch partials: fold spec → {gen_id: RunSketch}
+        #: — GLOBAL (post-collective) partials, so every multihost
+        #: process caches identical values and cache hits stay agreed
+        from ..index.attr_lean import LeanAttrIndex
+        from ..index.partial_cache import PartialCache
+        self._sketch_cache = PartialCache(
+            LeanAttrIndex.SKETCH_CACHE_SPECS,
+            LeanAttrIndex.SKETCH_CACHE_MAX_BYTES)
+        self._gen_counter = 0
+
+    def _next_gen_id(self) -> int:
+        self._gen_counter += 1
+        return self._gen_counter
 
     def __len__(self) -> int:
         return self._n_total
@@ -316,6 +378,7 @@ class ShardedLeanAttrIndex:
             if gen is None or gen.tier == "host" \
                     or gen.n_slots + m_pad > gen.slots:
                 gen = _ShardedAttrGen(self.mesh, self.generation_slots)
+                gen.gen_id = self._next_gen_id()
                 self.generations.append(gen)
                 self._rebalance()
                 gen = self.generations[-1]
@@ -399,6 +462,8 @@ class ShardedLeanAttrIndex:
                     [p for g in group for p in g.spilled])],
                 n_slots=n_slots)
             self._host_stack = None
+        merged.gen_id = self._next_gen_id()
+        self._sketch_cache.drop_generations([g.gen_id for g in group])
         self.generations = replace_group(self.generations, group,
                                          merged)
         self.compactions += 1
@@ -431,6 +496,111 @@ class ShardedLeanAttrIndex:
         return {"merged_groups": merged,
                 "generations": len(self.generations),
                 "tiers": self.tier_counts()}
+
+    # -- stat-sketch push-down (ISSUE 3) ----------------------------------
+    def _local_runs(self, gen) -> list:
+        """(keys, sec) arrays of THIS process's addressable shards for
+        one device generation (valid rows sort to each shard's
+        front)."""
+        local: dict = {}
+        for name, arr in (("k", gen.keys), ("s", gen.sec),
+                          ("g", gen.gid)):
+            for sh in arr.addressable_shards:
+                row = sh.index[0].start or 0
+                local.setdefault(row, {})[name] = np.asarray(sh.data)[0]
+        runs = []
+        for row in sorted(local):
+            c = local[row]
+            valid = c["g"] >= 0
+            runs.append((c["k"][valid], c["s"][valid]))
+        return runs
+
+    def sketch_scan(self, fold):
+        """Fold every run's rows matching ``fold``'s sec window into
+        ONE merged RunSketch across the whole mesh — the sharded twin
+        of :meth:`~geomesa_tpu.index.attr_lean.LeanAttrIndex.
+        sketch_scan`: device runs fold per shard under shard_map with
+        hist/count-min tables psum-merged over ICI; host-tier runs
+        fold on their owning process and allgather through the monoid;
+        sealed runs' GLOBAL partials cache identically on every
+        process (agreed cache hits — no process strands a
+        collective)."""
+        from ..metrics import (
+            LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
+            registry as _metrics,
+        )
+        from ..stats.sketch import RunSketch, fold_attr_runs
+        from .stats import allreduce_run_sketch
+        merged = RunSketch()
+        if not self.generations:
+            return merged
+        live = self.generations[-1]
+        cache = self._sketch_cache.spec_cache(fold)
+        dev_scan: list = []
+        host_scan: list = []
+        for g in self.generations:
+            part = cache.get(g.gen_id) if g is not live else None
+            if part is not None:
+                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                merged = merged + part
+            elif g.tier == "device":
+                dev_scan.append(g)
+            else:
+                host_scan.append(g)
+        is_float = self.attr_type in ("float", "double")
+        new_parts: dict[int, object] = {}
+        if dev_scan and not fold.want_values:
+            n_b = (-len(dev_scan)) % _GEN_BUCKET
+            padded = list(dev_scan) + [self._sentinel()] * n_b
+            cols: list = []
+            for g in padded:
+                cols += [g.keys, g.sec]
+            self.dispatch_count += 1
+            prog = _sketch_program(self.mesh, len(padded),
+                                   int(fold.bins), int(fold.depth),
+                                   int(fold.width), is_float)
+            outs = prog(jnp.int64(fold.slo), jnp.int64(fold.shi),
+                        jnp.float64(fold.hlo), jnp.float64(fold.hhi),
+                        *cols)
+            cnt = _fetch_global(outs[0]).sum(axis=0)
+            kmin = _fetch_global(outs[1]).min(axis=0)
+            kmax = _fetch_global(outs[2]).max(axis=0)
+            vsum = _fetch_global(outs[3]).sum(axis=0)
+            vsumsq = _fetch_global(outs[4]).sum(axis=0)
+            hist = np.asarray(outs[5])
+            cms = np.asarray(outs[6])
+            for i, g in enumerate(dev_scan):
+                n = int(cnt[i])
+                new_parts[id(g)] = RunSketch(
+                    n, int(kmin[i]) if n else None,
+                    int(kmax[i]) if n else None,
+                    float(vsum[i]), float(vsumsq[i]),
+                    np.array(hist[i]) if fold.bins else None,
+                    np.array(cms[i]) if fold.depth else None)
+        elif dev_scan:
+            # exact value→count folds: each process folds its
+            # addressable shards, partials allgather through the monoid
+            for g in dev_scan:
+                local = RunSketch()
+                for p in fold_attr_runs(self._local_runs(g), fold,
+                                        self.attr_type):
+                    local = local + p
+                new_parts[id(g)] = allreduce_run_sketch(local) \
+                    if self._multihost else local
+        for g in host_scan:
+            local = RunSketch()
+            for p in fold_attr_runs([(p[0], p[1]) for p in g.spilled],
+                                    fold, self.attr_type):
+                local = local + p
+            new_parts[id(g)] = allreduce_run_sketch(local) \
+                if self._multihost else local
+        for g in dev_scan + host_scan:
+            p = new_parts[id(g)]
+            merged = merged + p
+            if g is not live:
+                _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                self._sketch_cache.add(cache, g.gen_id, p)
+        return merged
 
     # -- query path -------------------------------------------------------
     def query_ranges(self, ranges: list, n_windows: int = 1,

@@ -351,12 +351,41 @@ def _density_program_keys(mesh: Mesh, n_gens: int, capacity: int,
     return jax.jit(dens)
 
 
+@lru_cache(maxsize=8)
+def _cells_program(mesh: Mesh, n_gens: int, bits: int, nb: int):
+    """Z3Histogram cell-count fold under shard_map (ISSUE 3): each
+    shard folds its own sorted runs' coarse ``(bin, cell)`` keys into a
+    flat table, psum-merged over ICI — the sharded twin of
+    index/z3_lean._z3_cells_multi (same cell function, same overflow
+    slot for sentinels)."""
+    size = nb << bits
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(),) + (P("shard", None),) * (2 * n_gens),
+             out_specs=P(None, None))
+    def cells(b0, *cols):
+        outs = []
+        for g in range(n_gens):
+            b, z = cols[2 * g][0], cols[2 * g + 1][0]
+            mask = z != _SENTINEL_Z
+            cell = z >> jnp.int64(63 - bits)
+            flat = ((b.astype(jnp.int64) - b0) * jnp.int64(1 << bits)
+                    + cell)
+            ok = mask & (flat >= 0) & (flat < size)
+            flat = jnp.where(ok, flat, size).astype(jnp.int32)
+            outs.append(jnp.zeros((size + 1,), jnp.int64)
+                        .at[flat].add(1)[:size])
+        return jax.lax.psum(jnp.stack(outs), "shard")
+
+    return jax.jit(cells)
+
+
 class _ShardedGen:
     """One generation: stacked per-shard sorted runs.  ``tier`` ∈
     {"full", "keys", "host"} (module doc)."""
 
     __slots__ = ("bins", "z", "pos", "x", "y", "t", "n_slots", "tier",
-                 "runs")
+                 "runs", "gen_id")
 
     @classmethod
     def merged_keys(cls, bins, z, pos, n_slots: int) -> "_ShardedGen":
@@ -368,6 +397,7 @@ class _ShardedGen:
         gen.n_slots = int(n_slots)
         gen.tier = "keys"
         gen.runs = None
+        gen.gen_id = -1
         return gen
 
     @classmethod
@@ -380,6 +410,7 @@ class _ShardedGen:
         gen.n_slots = int(n_slots)
         gen.tier = "host"
         gen.runs = runs
+        gen.gen_id = -1
         return gen
 
     def __init__(self, mesh: Mesh, slots: int, tier: str = "keys"):
@@ -404,6 +435,10 @@ class _ShardedGen:
         self.tier = tier
         #: host-tier: this process's spilled per-shard runs
         self.runs: list[HostRun] | None = None
+        #: store-lifetime-unique run identity, minted from agreed
+        #: (process-invariant) appends/merges — the sketch-partial
+        #: cache invalidation key (index/z3_lean._Generation.gen_id)
+        self.gen_id = -1
 
     @property
     def slots(self) -> int:
@@ -518,6 +553,18 @@ class ShardedLeanZ3Index:
         #: every process folds the same groups
         self.compaction_factor = int(compaction_factor or 0)
         self.compactions = 0
+        #: sealed-run stat-sketch partials (ISSUE 3): GLOBAL z3
+        #: cell-count tables keyed by agreed gen_ids, so multihost
+        #: cache hits stay process-invariant
+        from ..index.partial_cache import PartialCache
+        from ..index.z3_lean import LeanZ3Index as _L
+        self._sketch_cache = PartialCache(_L.SKETCH_CACHE_SPECS,
+                                          _L.SKETCH_CACHE_MAX_BYTES)
+        self._gen_counter = 0
+
+    def _next_gen_id(self) -> int:
+        self._gen_counter += 1
+        return self._gen_counter
 
     def _sentinel(self, tier: str) -> _ShardedGen:
         """Shared empty full-size generation for bucket padding
@@ -618,6 +665,7 @@ class ShardedLeanZ3Index:
             if floor > self.hbm_budget_bytes:
                 tier = "keys"
         gen = _ShardedGen(self.mesh, self.generation_slots, tier=tier)
+        gen.gen_id = self._next_gen_id()
         self.generations.append(gen)
         self._rebalance()
         return self.generations[-1]
@@ -762,6 +810,8 @@ class ShardedLeanZ3Index:
                 [merge_host_runs([r for g in group for r in g.runs])],
                 n_slots=n_slots)
             self._host_stack = None
+        merged.gen_id = self._next_gen_id()
+        self._sketch_cache.drop_generations([g.gen_id for g in group])
         self.generations = replace_group(self.generations, group,
                                          merged)
         self.compactions += 1
@@ -1075,6 +1125,76 @@ class ShardedLeanZ3Index:
         return int(round(self.density(
             boxes, t_lo_ms, t_hi_ms, (-180.0, -90.0, 180.0, 90.0),
             1, 1, max_ranges=max_ranges).sum()))
+
+    def z3_cell_counts(self, bits: int) -> dict:
+        """WHOLE-EXTENT Z3Histogram push-down over the mesh (ISSUE 3):
+        per-shard (time-bin × z-cell) tables fold inside shard_map and
+        merge with psum over ICI; host-tier runs fold on their owning
+        process and allreduce.  Sealed generations' GLOBAL tables cache
+        identically on every process (agreed gen_ids), so warm repeats
+        fold only the live generation.  Returns ``{(bin, cell):
+        count}`` — the single-chip LeanZ3Index.z3_cell_counts
+        contract."""
+        from ..metrics import (
+            LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
+            registry as _metrics,
+        )
+        from .stats import allreduce_counts
+        out: dict = {}
+        if self._n_total == 0 or self.t_min_ms is None:
+            return out
+        b0, _ = to_binned_time(np.int64(max(0, self.t_min_ms)),
+                               self.period)
+        b1, _ = to_binned_time(np.int64(max(0, self.t_max_ms)),
+                               self.period)
+        b0, nb = int(b0), int(b1) - int(b0) + 1
+        spec = ("z3cells", int(bits), b0, nb)
+        cache = self._sketch_cache.spec_cache(spec)
+        live = self.generations[-1] if self.generations else None
+        total = np.zeros(nb << bits, np.int64)
+        scan: list = []
+        host_scan: list = []
+        for g in self.generations:
+            part = cache.get(g.gen_id) if g is not live else None
+            if part is not None:
+                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                total += part
+            elif g.tier == "host":
+                host_scan.append(g)
+            else:
+                scan.append(g)
+        if scan:
+            n_b = (-len(scan)) % _GEN_BUCKET
+            padded = list(scan) + [self._sentinel("keys")] * n_b
+            cols: list = []
+            for g in padded:
+                cols += [g.bins, g.z]
+            self.dispatch_count += 1
+            stacked = np.asarray(_cells_program(
+                self.mesh, len(padded), int(bits), nb)(
+                jnp.int64(b0), *cols))
+            for i, g in enumerate(scan):
+                # copy, not a view: a cached view would pin the whole
+                # stacked bucket and break the byte accounting
+                part = np.array(stacked[i])
+                total += part
+                if g is not live:
+                    _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                    self._sketch_cache.add(cache, g.gen_id, part)
+        for g in host_scan:
+            _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+            local = np.zeros(nb << bits, np.int64)
+            for run in g.runs:
+                local += run.cell_counts(b0, nb, int(bits))
+            part = (allreduce_counts(local) if self._multihost
+                    else local)
+            self._sketch_cache.add(cache, g.gen_id, part)
+            total += part
+        c_per_bin = 1 << bits
+        for i in np.flatnonzero(total):
+            out[(b0 + int(i) // c_per_bin, int(i) % c_per_bin)] = \
+                int(total[i])
+        return out
 
     # -- scan helpers -----------------------------------------------------
     def _host_runs_stack(self, host_gens: list):

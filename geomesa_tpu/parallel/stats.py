@@ -32,7 +32,39 @@ except ImportError:  # pragma: no cover — older jax
 from ..stats.stat import Stat, parse_stat
 
 __all__ = ["sharded_stats_scan", "sharded_frequency_scan",
-           "merged_stats", "merged_arrow"]
+           "merged_stats", "merged_arrow", "allreduce_run_sketch",
+           "allreduce_counts"]
+
+
+def allreduce_run_sketch(part):
+    """Merge one per-process :class:`~geomesa_tpu.stats.sketch.
+    RunSketch` across all processes through the monoid (the multihost
+    client-Reducer step of the lean sketch push-down, ISSUE 3): host-
+    tier runs spill to their OWNING process's RAM, so their partials
+    fold locally and allgather here.  Identity under one process."""
+    if jax.process_count() == 1:
+        return part
+    import json
+
+    from ..stats.sketch import RunSketch
+    from .multihost import allgather_strings
+    merged = None
+    for blob in allgather_strings(
+            np.array([json.dumps(part.to_json())], dtype=object)):
+        p = RunSketch.from_json(json.loads(blob))
+        merged = p if merged is None else merged + p
+    return merged
+
+
+def allreduce_counts(counts: np.ndarray) -> np.ndarray:
+    """Element-wise sum of one per-process int64 count table across all
+    processes (the Z3Histogram cell-table merge for host-tier runs).
+    Identity under one process."""
+    if jax.process_count() == 1:
+        return counts
+    from .multihost import allgather_concat
+    return allgather_concat(
+        np.asarray(counts, np.int64)[None, :]).sum(axis=0)
 
 
 def _bbox_time_mask(xs, ys, ts, gs, bx, t_lo, t_hi):

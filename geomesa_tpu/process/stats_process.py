@@ -13,25 +13,40 @@ def stats_process(store, schema: str, query, stat_spec: str) -> Stat:
     """Evaluate ``stat_spec`` (e.g. "Count();MinMax(score)") over the
     features matching ``query``.
 
-    On a mesh-backed store the stat runs as the distributed reduce:
-    pure bbox+time queries with Count/MinMax/Histogram specs take the
-    PUSH-DOWN path — per-shard moments/histograms merged with psum over
-    ICI, no host candidate materialization (`parallel.stats.
+    On a LEAN store every spec whose sub-stats are all pushable and
+    whose candidate set is provably exact folds into per-run sketches
+    NEXT TO THE KEYS (`_lean_sketch_pushdown` — ISSUE 3's tiered
+    stat-sketch push-down with sealed-generation partial caching); a
+    fallback to the materializing path is counted on
+    ``lean.sketch.materialized_fallbacks``.  On a mesh-backed store
+    the stat runs as the distributed reduce: pure bbox+time queries
+    with Count/MinMax/Histogram specs take the collective PUSH-DOWN
+    path — per-shard moments/histograms merged with psum over ICI, no
+    host candidate materialization (`parallel.stats.
     sharded_stats_scan`); everything else materializes the hits and
     folds per-shard partials through the Stat monoid (the reference's
     per-node StatsScan + client Reducer, iterators/StatsScan.scala:125)."""
     mesh = getattr(store, "_mesh", None)
+    st0 = None
     if getattr(store, "_auth_provider", None) is None:
         st0 = store._store(schema)
         if getattr(st0, "lean", False):
             pushed = _lean_count_pushdown(store, schema, query,
                                           stat_spec)
+            if pushed is None:
+                pushed = _lean_sketch_pushdown(store, schema, query,
+                                               stat_spec)
             if pushed is not None:
                 return pushed
         elif mesh is not None:
             pushed = _collective_stats(store, schema, query, stat_spec)
             if pushed is not None:
                 return pushed
+    if st0 is not None and getattr(st0, "lean", False):
+        # the acceptance counter: a stat on a lean store whose cost
+        # grows with materialized hit count instead of sketch size
+        from ..metrics import LEAN_STATS_MATERIALIZED, registry
+        registry.counter(LEAN_STATS_MATERIALIZED).inc()
     result = store.query_result(schema, query)
     # gate on positions, not the batch: under multihost positions is the
     # GLOBAL gid list (identical everywhere) while the local batch slice
@@ -105,6 +120,113 @@ def _lean_count_pushdown(store, schema: str, query, stat_spec: str):
     count = idx.range_count(boxes, lo, hi)
     for s in stats:
         s.count = int(count)
+    return stat
+
+
+def _lean_sketch_pushdown(store, schema: str, query, stat_spec: str):
+    """Tiered stat-sketch push-down on a lean store (ISSUE 3): when
+    every sub-stat is pushable and the candidate set is exact, the
+    whole spec folds into per-run mergeable sketches next to the index
+    keys — device folds for device runs, one stacked host pass for
+    spilled runs, sealed-run partials cached per generation — and NO
+    candidate hit ever materializes.
+
+    **Exactness gates** (docs/stats_pushdown.md), all derived from
+    agreed (process-invariant) state so no multihost process strands a
+    collective:
+
+    * the filter is a pure bbox+time conjunction whose boxes COVER the
+      data extent (the spatial constraint is then a no-op — attribute
+      keys carry no geometry); the time window is served EXACTLY by
+      the attr index's ``sec`` column at any selectivity;
+    * attribute sub-stats need a lean-indexed attribute whose lexicode
+      decodes exactly (numerics/dates; strings are prefix codes —
+      fallback);
+    * Z3Histogram needs the z3-kind index at the current key version,
+      a matching period, and a whole-extent window (its cells come
+      straight off the keys);
+    * tombstones need row visibility — fallback.
+
+    Returns the filled Stat, or ``None`` → the materializing path."""
+    import numpy as np
+
+    from ..curve.binnedtime import TimePeriod
+    from ..planning.planner import Query
+    from ..stats.sketch import (
+        fill_stats_from_partial, flatten_stats, plan_pushdown,
+    )
+    from .density import _bbox_time_only
+
+    q = query if isinstance(query, Query) else Query.of(query)
+    sft = store.get_schema(schema)
+    st = store._store(schema)
+    if st.batch is None:
+        return None
+    smap = st.stats_map()
+    n_rows = int(smap["count"].count)
+    if n_rows == 0:
+        return None
+    plan0 = _bbox_time_only(q.filter, sft.geom_field, sft.dtg_field)
+    if plan0 is None:
+        return None
+    boxes, lo, hi = plan0
+    has_tomb = int(st.tombstone is not None
+                   and bool(st.tombstone.any()))
+    if getattr(st, "multihost", False):
+        from ..parallel.multihost import agreed_int
+        has_tomb = agreed_int(has_tomb, "max")
+    if has_tomb:
+        return None
+    bb = smap.get(f"{sft.geom_field}_bbox")
+    if bb is None or bb.is_empty:
+        return None
+    x0, y0, x1, y1 = bb.bounds
+    if not any(b[0] <= x0 and b[1] <= y0 and b[2] >= x1 and b[3] >= y1
+               for b in boxes):
+        return None
+    mm = smap.get("dtg_minmax")
+    if mm is not None and not mm.is_empty:
+        t_open = ((lo is None or lo <= int(mm.min))
+                  and (hi is None or hi >= int(mm.max)))
+    else:
+        t_open = lo is None and hi is None
+    i64 = np.iinfo(np.int64)
+    slo = i64.min if lo is None else int(lo)
+    shi = i64.max if hi is None else int(hi)
+
+    stat = parse_stat(stat_spec)
+    stats = flatten_stats(stat)
+    attr_types = {a: st.sft.attribute(a).type
+                  for a in st._lean_attr_names()}
+    z3_period = None
+    if st.lean_kind == "z3":
+        idx = st._lean_index()
+        if getattr(idx, "version", 0) >= 2:
+            z3_period = idx.period
+    plan = plan_pushdown(stats, attr_types, st.lean_kind,
+                         sft.geom_field, sft.dtg_field, slo, shi,
+                         t_open, z3_period=z3_period)
+    if plan is None:
+        return None
+
+    parts: dict = {}
+    for attr, (fold, group) in plan.attr_groups.items():
+        part = st._lean_attr_index(attr).sketch_scan(fold)
+        parts[attr] = part
+        fill_stats_from_partial(group, part, attr_types[attr])
+    for s in plan.z3hists:
+        period = TimePeriod.parse(s.period)
+        assert period == z3_period
+        s.counts = st._lean_index().z3_cell_counts(int(s.bits))
+    if plan.counts:
+        if plan.count_source.startswith("attr:"):
+            count = parts[plan.count_source[5:]].count
+        else:
+            count = n_rows
+        for s in plan.counts:
+            s.count = int(count)
+    from ..metrics import LEAN_SKETCH_SCANS, registry
+    registry.counter(LEAN_SKETCH_SCANS).inc()
     return stat
 
 

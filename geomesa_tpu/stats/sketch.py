@@ -1,0 +1,465 @@
+"""Stat-sketch push-down partials for the lean tiered indexes.
+
+The reference answers ``Stat`` specs server-side (StatsScan,
+iterators/StatsScan.scala:125): each tablet folds its rows into
+mergeable sketches and ships only the sketch, never the rows.  On the
+lean tiered store the same split falls out of the KEY layout instead of
+a row scan (ISSUE 3):
+
+* the attribute index's key IS the order-preserving int64 lexicode of
+  the value (index/attr_lean), so for numeric/date attributes a run's
+  sorted ``(key, sec)`` columns decode straight back to exact values
+  and timestamps — MinMax / Histogram / DescriptiveStats / Frequency /
+  TopK / Enumeration (and Count) fold per run with NO row access;
+* the z3 index's key decodes to coarse (bin, cell) pairs — exactly
+  Z3Histogram's domain (utils/stats/Z3Histogram.scala:34).
+
+This module owns the shared pieces: the per-run mergeable partial
+(:class:`RunSketch`), the fold configuration / cache-spec key
+(:class:`SketchFold`), the traced fold body both the single-chip jit
+and the shard_map program inline (:func:`device_fold_body`), the
+stacked host-tier fold with per-run attribution
+(:func:`fold_attr_runs`), and the spec classifier
+(:func:`plan_pushdown`) ``stats_process`` gates on.
+
+**Exactness** (docs/stats_pushdown.md): int/long/date keys are the
+value; float/double keys are the invertible IEEE-754 bit transform —
+both decode exactly.  String keys are 8-byte PREFIX codes (ties alias)
+so every string-valued stat falls back to materialization.  The only
+lossy corner is the key clamp at ``int64 max - 1`` (index/attr_lean
+``encode_attr_values``), which aliases the two topmost encodable
+values — and NaN floats, which the lexicode sorts last while a numpy
+oracle would propagate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .stat import (
+    CountStat, DescriptiveStats, EnumerationStat, Frequency, Histogram,
+    MinMax, SeqStat, TopK, Z3HistogramStat, _hash_col,
+)
+
+__all__ = ["SketchFold", "RunSketch", "PushPlan", "plan_pushdown",
+           "decode_attr_keys", "decode_attr_key", "device_fold_body",
+           "fold_attr_runs", "fill_stats_from_partial",
+           "EXACT_DECODE_TYPES"]
+
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+#: the attr index's sentinel padding key (index/attr_lean)
+_SENTINEL_KEY = _I64_MAX
+
+#: attribute types whose int64 lexicode decodes EXACTLY back to the
+#: value (strings are prefix codes — never pushable)
+EXACT_DECODE_TYPES = frozenset(
+    {"int", "integer", "long", "date", "float", "double"})
+_FLOAT_TYPES = frozenset({"float", "double"})
+
+
+def decode_attr_keys(keys: np.ndarray, attr_type: str) -> np.ndarray:
+    """Inverse of :func:`index.attr_lean.encode_attr_values` for the
+    exactly-decodable types (int64 for ints/dates, float64 for
+    floats)."""
+    k = np.asarray(keys, np.int64)
+    if attr_type.lower() in _FLOAT_TYPES:
+        bits = np.where(k < 0, (np.int64(-1) - k) ^ _I64_MIN, k)
+        return bits.astype(np.int64).view(np.float64)
+    return k
+
+
+def decode_attr_key(key, attr_type: str):
+    """Scalar twin of :func:`decode_attr_keys` (python int / float)."""
+    v = decode_attr_keys(np.array([key], np.int64), attr_type)[0]
+    return float(v) if attr_type.lower() in _FLOAT_TYPES else int(v)
+
+
+@dataclass(frozen=True)
+class SketchFold:
+    """Configuration of one per-run sketch fold over an attribute
+    index — ALSO the partial-cache spec key, so two stats requests
+    needing the same fold over the same sec window share cached
+    sealed-run partials."""
+
+    slo: int = int(_I64_MIN)    # inclusive sec (dtg-ms) window
+    shi: int = int(_I64_MAX)
+    bins: int = 0               # histogram bins (0 = no histogram)
+    hlo: float = 0.0
+    hhi: float = 1.0
+    depth: int = 0              # count-min depth (0 = no sketch)
+    width: int = 0
+    want_values: bool = False   # exact value→count fold (TopK/Enum)
+
+
+@dataclass
+class RunSketch:
+    """One run's mergeable stat partial: moments + key-space min/max
+    (decoded lazily — order-preserving keys make ``min(keys)`` equal
+    ``encode(min(values))``), an optional fixed-bin histogram, an
+    optional count-min table, and an optional exact value→count map.
+    A monoid, like every sketch in stats/stat.py."""
+
+    count: int = 0
+    kmin: int | None = None     # encoded-key min over matched rows
+    kmax: int | None = None
+    vsum: float = 0.0
+    vsumsq: float = 0.0
+    hist: np.ndarray | None = None
+    cms: np.ndarray | None = None
+    values: dict | None = None
+
+    def merge(self, other: "RunSketch") -> "RunSketch":
+        out = RunSketch(self.count + other.count, self.kmin, self.kmax,
+                        self.vsum + other.vsum,
+                        self.vsumsq + other.vsumsq)
+        if other.kmin is not None:
+            out.kmin = (other.kmin if out.kmin is None
+                        else min(out.kmin, other.kmin))
+            out.kmax = (other.kmax if out.kmax is None
+                        else max(out.kmax, other.kmax))
+        if self.hist is not None or other.hist is not None:
+            a, b = self.hist, other.hist
+            out.hist = (np.array(a if b is None else b
+                                 if a is None else a + b, np.int64))
+        if self.cms is not None or other.cms is not None:
+            a, b = self.cms, other.cms
+            out.cms = (np.array(a if b is None else b
+                                if a is None else a + b, np.int64))
+        if self.values is not None or other.values is not None:
+            out.values = dict(self.values or {})
+            for v, n in (other.values or {}).items():
+                out.values[v] = out.values.get(v, 0) + n
+        return out
+
+    def __add__(self, other):
+        return self.merge(other)
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes this partial retains (the cache byte ceiling)."""
+        n = 64
+        if self.hist is not None:
+            n += self.hist.nbytes
+        if self.cms is not None:
+            n += self.cms.nbytes
+        if self.values is not None:
+            n += 48 * len(self.values)
+        return n
+
+    def to_json(self) -> dict:
+        return {"count": self.count, "kmin": self.kmin,
+                "kmax": self.kmax, "vsum": self.vsum,
+                "vsumsq": self.vsumsq,
+                "hist": None if self.hist is None else self.hist.tolist(),
+                "cms": None if self.cms is None else self.cms.tolist(),
+                "values": (None if self.values is None
+                           else [[v, n] for v, n in self.values.items()])}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "RunSketch":
+        return cls(
+            int(obj["count"]),
+            None if obj["kmin"] is None else int(obj["kmin"]),
+            None if obj["kmax"] is None else int(obj["kmax"]),
+            float(obj["vsum"]), float(obj["vsumsq"]),
+            None if obj["hist"] is None
+            else np.asarray(obj["hist"], np.int64),
+            None if obj["cms"] is None
+            else np.asarray(obj["cms"], np.int64),
+            None if obj["values"] is None
+            else {v: int(n) for v, n in obj["values"]})
+
+
+# ---------------------------------------------------------------------------
+# device fold body (traced inline by the single-chip jit AND the
+# sharded shard_map program — one definition, no drift)
+# ---------------------------------------------------------------------------
+
+def _decode_f64_j(k):
+    """jnp twin of :func:`decode_attr_keys` for float lexicodes."""
+    import jax
+    import jax.numpy as jnp
+    bits = jnp.where(k < 0, (jnp.int64(-1) - k) ^ jnp.int64(_I64_MIN), k)
+    return jax.lax.bitcast_convert_type(bits, jnp.float64)
+
+
+def _splitmix_j(h):
+    import jax.numpy as jnp
+    h = (h ^ (h >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return h ^ (h >> jnp.uint64(31))
+
+
+def device_fold_body(k, s, slo, shi, hlo, hhi, *, bins: int, depth: int,
+                     width: int, is_float: bool):
+    """One run's sketch fold over its device-resident (key, sec)
+    columns: masked moments (int64 key min/max — exact at any
+    magnitude), a bincount histogram matching ``Histogram.observe``'s
+    outlier-clamped binning, and count-min rows hashed bit-identically
+    to the host sketch (stats/stat._hash_col's numeric path — the
+    parallel.stats._frequency_program discipline).  Returns
+    ``(count, kmin, kmax, vsum, vsumsq, hist, cms)``; hist/cms are
+    zero-size when not requested so shapes stay static."""
+    import jax.numpy as jnp
+    mask = (k != jnp.int64(_SENTINEL_KEY)) & (s >= slo) & (s <= shi)
+    vf = _decode_f64_j(k) if is_float else k.astype(jnp.float64)
+    count = jnp.sum(mask).astype(jnp.int64)
+    kmin = jnp.min(jnp.where(mask, k, jnp.int64(_I64_MAX)))
+    kmax = jnp.max(jnp.where(mask, k, jnp.int64(_I64_MIN)))
+    vsum = jnp.sum(jnp.where(mask, vf, 0.0))
+    vsumsq = jnp.sum(jnp.where(mask, vf * vf, 0.0))
+    one = jnp.where(mask, 1, 0).astype(jnp.int64)
+    if bins:
+        norm = bins / (hhi - hlo)
+        b = jnp.clip(((vf - hlo) * norm).astype(jnp.int32), 0, bins - 1)
+        # NaN values drop from the histogram ONLY (matching the
+        # materializing oracle: np.histogram ignores NaN and the
+        # outlier clamp's comparisons are False for it) — Count and
+        # the other folds still see the row
+        one_h = jnp.where(jnp.isnan(vf), jnp.int64(0), one) \
+            if is_float else one
+        hist = jnp.zeros((bins,), jnp.int64).at[b].add(one_h)
+    else:
+        hist = jnp.zeros((0,), jnp.int64)
+    if depth:
+        if is_float:
+            # canonicalize non-finite / out-of-range floats to numpy's
+            # INT64_MIN truncation before the int64 cast (_hash_col)
+            flo = jnp.float64(np.iinfo(np.int64).min)
+            ok = (jnp.isfinite(vf) & (vf >= flo)
+                  & (vf < jnp.float64(2.0 ** 63)))
+            v64 = jnp.where(ok, vf, flo).astype(jnp.int64)
+        else:
+            v64 = k            # exact: never round-trip ints through f64
+        rows = []
+        for d in range(depth):
+            seed = jnp.uint64((d + 1) * 0x9E3779B97F4A7C15
+                              & 0xFFFFFFFFFFFFFFFF)
+            h = _splitmix_j(v64.astype(jnp.uint64) ^ seed)
+            hb = (h % jnp.uint64(width)).astype(jnp.int32)
+            rows.append(jnp.zeros((width,), jnp.int64).at[hb].add(one))
+        cms = jnp.stack(rows)
+    else:
+        cms = jnp.zeros((0, 0), jnp.int64)
+    return count, kmin, kmax, vsum, vsumsq, hist, cms
+
+
+# ---------------------------------------------------------------------------
+# host-tier fold: ONE stacked pass with per-run attribution
+# ---------------------------------------------------------------------------
+
+def fold_attr_runs(runs: list, fold: SketchFold,
+                   attr_type: str) -> list[RunSketch]:
+    """Fold host-resident ``(key, sec)`` runs into one
+    :class:`RunSketch` each in a SINGLE stacked vectorized pass: every
+    run's rows concatenate with an owning-run id, the sec mask and
+    value decode run once, and per-run partials come out of
+    id-segmented bincounts / ``minimum.at`` folds — flat overhead in
+    run count (the HostStack discipline, round-4 VERDICT #9)."""
+    n_runs = len(runs)
+    parts = [RunSketch(
+        hist=np.zeros(fold.bins, np.int64) if fold.bins else None,
+        cms=(np.zeros((fold.depth, fold.width), np.int64)
+             if fold.depth else None),
+        values={} if fold.want_values else None)
+        for _ in range(n_runs)]
+    if not n_runs:
+        return parts
+    ks = np.concatenate([np.asarray(k, np.int64) for k, _ in runs])
+    ss = np.concatenate([np.asarray(s, np.int64) for _, s in runs])
+    rid = np.repeat(np.arange(n_runs),
+                    [len(k) for k, _ in runs]).astype(np.int64)
+    mask = ((ks != _SENTINEL_KEY) & (ss >= np.int64(fold.slo))
+            & (ss <= np.int64(fold.shi)))
+    km, rm = ks[mask], rid[mask]
+    counts = np.bincount(rm, minlength=n_runs)
+    kmin = np.full(n_runs, _I64_MAX)
+    kmax = np.full(n_runs, _I64_MIN)
+    np.minimum.at(kmin, rm, km)
+    np.maximum.at(kmax, rm, km)
+    is_float = attr_type.lower() in _FLOAT_TYPES
+    vals = decode_attr_keys(km, attr_type)
+    vf = vals.astype(np.float64)
+    vsum = np.bincount(rm, weights=vf, minlength=n_runs)
+    vsumsq = np.bincount(rm, weights=vf * vf, minlength=n_runs)
+    for i, p in enumerate(parts):
+        p.count = int(counts[i])
+        if p.count:
+            p.kmin, p.kmax = int(kmin[i]), int(kmax[i])
+        p.vsum, p.vsumsq = float(vsum[i]), float(vsumsq[i])
+    if fold.bins:
+        norm = fold.bins / (fold.hhi - fold.hlo)
+        ok = ~np.isnan(vf) if is_float else slice(None)
+        with np.errstate(invalid="ignore"):
+            b = np.clip(((vf[ok] - fold.hlo) * norm).astype(np.int64),
+                        0, fold.bins - 1)
+        flat = np.bincount(rm[ok] * fold.bins + b,
+                           minlength=n_runs * fold.bins)
+        for i, p in enumerate(parts):
+            p.hist = flat[i * fold.bins:(i + 1) * fold.bins] \
+                .astype(np.int64)
+    if fold.depth:
+        col = vf if is_float else km
+        for d in range(fold.depth):
+            h = (_hash_col(col, d + 1)
+                 % np.uint64(fold.width)).astype(np.int64)
+            flat = np.bincount(rm * fold.width + h,
+                               minlength=n_runs * fold.width)
+            for i, p in enumerate(parts):
+                p.cms[d] = flat[i * fold.width:(i + 1) * fold.width]
+    if fold.want_values and len(km):
+        order = np.lexsort((vals, rm))
+        rs, vs = rm[order], vals[order]
+        edge = np.r_[True, (rs[1:] != rs[:-1]) | (vs[1:] != vs[:-1])]
+        starts = np.flatnonzero(edge)
+        lens = np.diff(np.r_[starts, len(vs)])
+        uv = vs[starts].tolist()
+        ur = rs[starts]
+        for v, r, n in zip(uv, ur, lens.tolist()):
+            parts[int(r)].values[v] = parts[int(r)].values.get(v, 0) + n
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# spec classification (the stats_process gate)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PushPlan:
+    """One executable push-down: per-attribute folds (with the stats
+    they serve), whole-extent Z3Histograms, the Count stats, and which
+    source supplies the count ('attr:<name>' rides a fold; 'rows' is
+    the agreed live-row total for whole-extent windows)."""
+
+    attr_groups: dict = field(default_factory=dict)
+    z3hists: list = field(default_factory=list)
+    counts: list = field(default_factory=list)
+    count_source: str = "rows"
+
+
+def plan_pushdown(stats: list, attr_types: dict, lean_kind: str,
+                  geom_field: str, dtg_field: str | None,
+                  slo: int, shi: int, t_open: bool,
+                  z3_period=None) -> PushPlan | None:
+    """Classify a parsed spec list into an executable push-down plan,
+    or ``None`` when ANY sub-stat needs row materialization.
+
+    ``attr_types`` maps lean-INDEXED attribute names to their schema
+    types; only exactly-decodable types push (module doc).  ``t_open``
+    says the window covers the whole time extent — required by
+    Z3Histogram (cell-granular time) and by the row-count source; attr
+    folds filter ``sec`` exactly for ANY window."""
+    groups: dict[str, dict] = {}
+    plan = PushPlan()
+
+    def _grp(attr):
+        return groups.setdefault(attr, {
+            "hist": None, "freq": None, "want_values": False,
+            "stats": []})
+
+    for s in stats:
+        if isinstance(s, CountStat):
+            plan.counts.append(s)
+            continue
+        attr = getattr(s, "attr", None)
+        if isinstance(s, Z3HistogramStat):
+            from ..curve.binnedtime import TimePeriod
+            if (lean_kind == "z3" and t_open
+                    and s.geom == geom_field and s.dtg == dtg_field
+                    and z3_period is not None
+                    and z3_period == TimePeriod.parse(s.period)):
+                plan.z3hists.append(s)
+                continue
+            return None
+        if attr not in attr_types \
+                or attr_types[attr].lower() not in EXACT_DECODE_TYPES:
+            return None
+        g = _grp(attr)
+        if isinstance(s, (MinMax, DescriptiveStats)):
+            pass
+        elif isinstance(s, Histogram):
+            cfg = (s.bins, s.lo, s.hi)
+            if g["hist"] is not None and g["hist"] != cfg:
+                return None   # two binnings would need two folds
+            g["hist"] = cfg
+        elif isinstance(s, Frequency):
+            cfg = (s.depth, s.width)
+            if g["freq"] is not None and g["freq"] != cfg:
+                return None
+            g["freq"] = cfg
+        elif isinstance(s, (TopK, EnumerationStat)):
+            g["want_values"] = True
+        else:
+            return None       # GroupBy / string stats / unknown kinds
+        g["stats"].append(s)
+
+    if plan.counts and not groups:
+        if not t_open:
+            # a selective time window needs the exact sec filter of an
+            # attr fold — ride any indexed numeric attribute
+            ride = next((a for a, t in attr_types.items()
+                         if t.lower() in EXACT_DECODE_TYPES), None)
+            if ride is None:
+                return None
+            _grp(ride)
+    if not groups and not plan.z3hists and not plan.counts:
+        return None
+    for attr, g in groups.items():
+        hist = g["hist"] or (0, 0.0, 1.0)
+        freq = g["freq"] or (0, 0)
+        plan.attr_groups[attr] = (SketchFold(
+            slo=int(slo), shi=int(shi),
+            bins=int(hist[0]), hlo=float(hist[1]), hhi=float(hist[2]),
+            depth=int(freq[0]), width=int(freq[1]),
+            want_values=bool(g["want_values"])), g["stats"])
+    if plan.attr_groups:
+        plan.count_source = f"attr:{next(iter(plan.attr_groups))}"
+    return plan
+
+
+def fill_stats_from_partial(stats: list, part: RunSketch,
+                            attr_type: str) -> None:
+    """Populate the user-facing stats an attr fold serves from its
+    merged :class:`RunSketch` (the client-side Reducer step)."""
+    is_float = attr_type.lower() in _FLOAT_TYPES
+    vmin = (None if part.kmin is None
+            else decode_attr_key(part.kmin, attr_type))
+    vmax = (None if part.kmax is None
+            else decode_attr_key(part.kmax, attr_type))
+    for s in stats:
+        if isinstance(s, MinMax):
+            s.min, s.max = vmin, vmax
+        elif isinstance(s, DescriptiveStats):
+            s.n = part.count
+            if part.count:
+                s.mean = part.vsum / part.count
+                s.m2 = max(part.vsumsq - part.count * s.mean * s.mean,
+                           0.0)
+                s.min = float(vmin)
+                s.max = float(vmax)
+        elif isinstance(s, Histogram):
+            if part.hist is not None:
+                s.counts = np.asarray(part.hist, np.int64)
+        elif isinstance(s, Frequency):
+            if part.cms is not None:
+                s.table = np.asarray(part.cms, np.int64)
+        elif isinstance(s, EnumerationStat):
+            s.counts = dict(part.values or {})
+        elif isinstance(s, TopK):
+            # the fold is an EXACT value→count map, so feeding it
+            # through observe_counts yields a top-k at least as tight
+            # as the space-saving sketch's bounded-error contract
+            vals = part.values or {}
+            if vals:
+                uv = np.array(list(vals.keys()),
+                              dtype=np.float64 if is_float else np.int64)
+                s.observe_counts(uv, np.array(list(vals.values()),
+                                              np.int64))
+
+
+def flatten_stats(stat) -> list:
+    """A spec's sub-stats as a flat list (SeqStat or single)."""
+    return list(stat.stats) if isinstance(stat, SeqStat) else [stat]
