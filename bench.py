@@ -391,6 +391,7 @@ def main():
     compaction = _compaction_stanza()
     stats_pd = _stats_pushdown_stanza()
     xz3_scale = _xz3_scale_stanza()
+    obs_stanza = _obs_stanza()
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -421,6 +422,7 @@ def main():
             "compaction": compaction,
             "stats_pushdown": stats_pd,
             "xz3_scale": xz3_scale,
+            "obs": obs_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -491,18 +493,24 @@ def _compact_summary(full: dict) -> dict:
             "compaction": {
                 k: (ex.get("compaction") or {}).get(k)
                 for k in ("generations_before", "generations_after",
-                          "warm_speedup", "density_warm_ms")
+                          "warm_speedup", "density_warm_ms",
+                          "recompiles")
                 if k in (ex.get("compaction") or {})},
             "stats_pushdown": {
                 k: (ex.get("stats_pushdown") or {}).get(k)
                 for k in ("cold_ms", "warm_ms", "warm_speedup",
-                          "materialized_fallbacks")
+                          "materialized_fallbacks", "recompiles")
                 if k in (ex.get("stats_pushdown") or {})},
             "xz3_scale": {
                 k: (ex.get("xz3_scale") or {}).get(k)
                 for k in ("ingest_rows_per_sec", "query_warm_ms",
-                          "oracle_exact")
+                          "oracle_exact", "recompiles")
                 if k in (ex.get("xz3_scale") or {})},
+            "obs": {
+                k: (ex.get("obs") or {}).get(k)
+                for k in ("overhead_pct", "warm_recompiles",
+                          "trace_spans")
+                if k in (ex.get("obs") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -585,6 +593,8 @@ def _compaction_stanza() -> dict:
         return {"skipped": True}
     out: dict = {}
     try:
+        from geomesa_tpu.obs import compile_count
+        _c0 = compile_count()
         rng = np.random.default_rng(11)
         slots = 1 << 17
         ms0 = 1_514_764_800_000
@@ -635,7 +645,82 @@ def _compaction_stanza() -> dict:
             / max(out["density_warm_ms"], 1e-3), 1)
         out["grids_equal"] = bool(
             np.array_equal(cold, seeded) and np.array_equal(cold, warm))
+        out["recompiles"] = int(compile_count() - _c0)
     except Exception as e:  # never kill the bench over the stanza
+        out["error"] = repr(e)
+    return out
+
+
+def _obs_stanza() -> dict:
+    """Observability overhead + retrace budget (ISSUE 5): the batched-
+    window query stanza measured with the default always-on sampler vs
+    tracing disabled — the tracing tax must stay in low single-digit
+    percent — plus the warm-repeat recompile count (must be 0: a warm
+    lean query that recompiles is the silent TPU perf cliff the
+    recompile tracker exists to catch).  ``OBS_BENCH_N=0`` skips."""
+    import time
+
+    import numpy as np
+
+    n = int(os.environ.get("OBS_BENCH_N", 2_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    try:
+        from geomesa_tpu.config import clear_property, set_property
+        from geomesa_tpu.index.z3_lean import LeanZ3Index
+        from geomesa_tpu.obs import compile_count, recompile, tracer
+        # a warm_recompiles of 0 is only meaningful when the listener
+        # covers every compile (the counting_jit fallback is opt-in)
+        out["recompile_listener"] = bool(recompile.installed())
+
+        rng = np.random.default_rng(17)
+        ms0 = 1_514_764_800_000
+        slots = 1 << 18
+        idx = LeanZ3Index(period="week", generation_slots=slots,
+                          payload_on_device=False)
+        for lo in range(0, n, slots):
+            m = min(slots, n - lo)
+            idx.append(rng.uniform(-180, 180, m),
+                       rng.uniform(-90, 90, m),
+                       rng.integers(ms0, ms0 + 14 * 86_400_000, m))
+        idx.block()
+        windows = []
+        for i in range(8):
+            cx, cy = -150.0 + 40.0 * (i % 8), -30.0 + 8.0 * i
+            lo_t = ms0 + (i % 9) * 86_400_000
+            windows.append(([(cx - 3, cy - 3, cx + 3, cy + 3)],
+                            lo_t, lo_t + 3 * 86_400_000))
+        idx.query_many(windows)          # warm/compile
+        # warm-repeat recompile budget: repeated identical lean queries
+        # must hit every executable cache
+        c0 = compile_count()
+        for _ in range(3):
+            idx.query_many(windows)
+        out["warm_recompiles"] = int(compile_count() - c0)
+        traced_dt = _median_time(lambda: idx.query_many(windows),
+                                 iters=7)
+        # one query under an explicit root so the recorded trace shows
+        # the full span tree (decompose / device / host under "query")
+        from geomesa_tpu.obs import span as obs_span
+        with obs_span("query", bench=True):
+            idx.query_many(windows)
+        ring = tracer.ring
+        if ring is not None:
+            last = ring.traces()[-1] if len(ring) else None
+            out["trace_spans"] = len(last.spans) if last else 0
+        set_property("geomesa.obs.enabled", False)
+        try:
+            idx.query_many(windows)      # settle
+            untraced_dt = _median_time(lambda: idx.query_many(windows),
+                                       iters=7)
+        finally:
+            clear_property("geomesa.obs.enabled")
+        out["query_traced_ms"] = round(traced_dt * 1e3, 2)
+        out["query_untraced_ms"] = round(untraced_dt * 1e3, 2)
+        out["overhead_pct"] = round(
+            (traced_dt / max(untraced_dt, 1e-9) - 1.0) * 100.0, 2)
+    except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
     return out
 
@@ -683,16 +768,26 @@ def compare_bench_records(current: dict, prior: dict,
     regs = []
     for name, prev in old.items():
         now = cur.get(name)
-        if now is None or prev <= 0:
+        if now is None:
             continue
         leaf = name.rsplit(".", 1)[-1]
-        if leaf.endswith(_LOWER_BETTER_SUFFIXES):
+        if leaf == "recompiles" or leaf.endswith("_recompiles"):
+            # retrace budget: a stanza that compiled NOTHING last round
+            # and compiles now is exactly the silent recompile cliff
+            # (ISSUE 5) — prev == 0 flags at a finite sentinel ratio so
+            # the record stays JSON-serializable
+            if now <= prev:
+                continue
+            ratio = now / prev if prev > 0 else 999.0
+        elif prev <= 0:
+            continue
+        elif leaf.endswith(_LOWER_BETTER_SUFFIXES):
             ratio = now / prev
         elif any(m in name for m in _HIGHER_BETTER_MARKS):
             # matched against the FULL dotted name: pallas win leaves
             # are kernel names under "pallas_wins." — leaf-only
             # matching would silently skip exactly those regressions
-            ratio = prev / now if now > 0 else float("inf")
+            ratio = prev / now if now > 0 else 999.0
         else:
             continue
         if ratio > 1.0 + tolerance:
@@ -761,6 +856,8 @@ def _xz3_scale_stanza() -> dict:
         return {"skipped": True}
     out: dict = {}
     try:
+        from geomesa_tpu.obs import compile_count
+        _c0 = compile_count()
         from geomesa_tpu.geometry.types import Polygon
         from geomesa_tpu.index.xz2_lean import LeanXZ3Index
 
@@ -807,6 +904,7 @@ def _xz3_scale_stanza() -> dict:
         out["hits"] = int(len(oracle))
         out["oracle_exact"] = bool(covered
                                    and np.array_equal(got, oracle))
+        out["recompiles"] = int(compile_count() - _c0)
     except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
     return out
@@ -831,6 +929,8 @@ def _stats_pushdown_stanza() -> dict:
         return {"skipped": True}
     out: dict = {}
     try:
+        from geomesa_tpu.obs import compile_count
+        _c0 = compile_count()
         from geomesa_tpu.datastore import TpuDataStore
         from geomesa_tpu.metrics import (
             LEAN_STATS_MATERIALIZED, registry,
@@ -875,6 +975,7 @@ def _stats_pushdown_stanza() -> dict:
             registry.counter(LEAN_STATS_MATERIALIZED).count - m0)
         out["results_equal"] = bool(
             cold.to_json() == warm.to_json())
+        out["recompiles"] = int(compile_count() - _c0)
     except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
     return out

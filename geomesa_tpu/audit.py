@@ -32,6 +32,10 @@ class QueryEvent:
     scan_time_ms: float = 0.0
     hits: int = 0
     timestamp: float = field(default_factory=time.time)
+    #: correlating trace id (obs/trace.py) — "" when the query ran
+    #: untraced; a slow audit record joins to its full span tree via
+    #: ``GET /traces/<trace_id>``
+    trace_id: str = ""
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), default=str)

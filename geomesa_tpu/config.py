@@ -17,20 +17,34 @@ import threading
 from dataclasses import dataclass
 from typing import Any
 
-__all__ = ["SystemProperty", "QueryProperties", "set_property", "clear_property"]
+__all__ = ["SystemProperty", "QueryProperties", "ObsProperties",
+           "set_property", "clear_property", "config_generation"]
 
 _overrides: dict[str, Any] = {}
 _lock = threading.Lock()
+#: bumped on every programmatic override change — hot paths (obs
+#: tracing) cache resolved property values keyed on this so they pay a
+#: plain int read per call instead of the override lock, while
+#: ``set_property`` still takes effect immediately
+_generation = 0
+
+
+def config_generation() -> int:
+    return _generation
 
 
 def set_property(name: str, value) -> None:
+    global _generation
     with _lock:
         _overrides[name] = value
+        _generation += 1
 
 
 def clear_property(name: str) -> None:
+    global _generation
     with _lock:
         _overrides.pop(name, None)
+        _generation += 1
 
 
 @dataclass(frozen=True)
@@ -88,6 +102,32 @@ class QueryProperties:
     #: use the Pallas candidate-filter kernel on TPU backends (falls back
     #: to the fused XLA path automatically if lowering fails)
     PALLAS_SCAN = SystemProperty("geomesa.scan.pallas", True)
+
+
+class ObsProperties:
+    """Observability knobs (the ``geomesa.obs.*`` option family —
+    docs/observability.md).  Sampler kind and the slow threshold are
+    re-read per trace so tests and operators can flip them live via
+    :func:`set_property`; capacities are read once at tracer
+    construction."""
+
+    #: master switch — off makes every span a shared no-op
+    ENABLED = SystemProperty("geomesa.obs.enabled", True)
+    #: root-span sampling: 'always', 'ratio', 'slow' (retain only
+    #: slower-than-threshold traces), or 'never'
+    SAMPLER = SystemProperty("geomesa.obs.sampler", "always")
+    #: fraction of root spans recorded under the 'ratio' sampler
+    SAMPLE_RATIO = SystemProperty("geomesa.obs.sample.ratio", 0.1)
+    #: slow-query threshold in ms: traces at/over it land in the slow
+    #: log (and are what the 'slow' sampler retains); <= 0 disables
+    SLOW_MS = SystemProperty("geomesa.obs.slow.ms", 500.0)
+    #: ring-buffer exporter capacity (traces)
+    TRACE_CAPACITY = SystemProperty("geomesa.obs.trace.capacity", 256)
+    #: slow-query log capacity (traces)
+    SLOW_CAPACITY = SystemProperty("geomesa.obs.slow.capacity", 64)
+    #: count XLA backend compiles via the jax.monitoring listener
+    #: (jax.compile.* metrics); the classic silent TPU perf cliff
+    RECOMPILE_TRACK = SystemProperty("geomesa.obs.recompile.track", True)
 
 
 #: default scan-ranges budget (import-time snapshot users can override per

@@ -1591,42 +1591,49 @@ class TpuDataStore:
 
     def query_result(self, name: str, query="INCLUDE",
                      explain: Explainer | None = None) -> QueryResult:
+        from .obs import span as obs_span
         store = self._store(name)
         q = query if isinstance(query, Query) else Query.of(query)
         q = self._intercept(store.sft, q)
-        if store.batch is None or len(store.batch) == 0:
-            if store.multihost:
-                # a locally-empty process must still ENTER the planner's
-                # collectives (other processes may hold rows); an empty
-                # local batch feeds zero rows to the sharded builds
-                if store.batch is None:
-                    store.batch = FeatureBatch.empty(store.sft)
-            else:
-                empty = FeatureBatch.empty(store.sft)
-                from .planning.strategy import FilterStrategy
-                result = QueryResult(empty, np.empty(0, dtype=np.int64),
-                                     FilterStrategy("none", 0), 0.0, 0.0)
-                self._audit(name, q, result)
-                return result
-        allowed = None
-        eval_store = store
-        if self._auth_provider is not None:
-            auths = self._auth_provider.get_authorizations()
-            allowed = store.vis_mask(auths)
-            masked = store.masked_batch(auths)
-            if masked is not store.batch:
-                # guarded values must be invisible to FILTERS too, not
-                # just results — evaluate over the masked view
-                eval_store = _MaskedStoreView(store, masked)
-        if store.tombstone is not None:
-            # deleted rows (lean tombstones) are invisible to every
-            # query, like any other row the caller cannot see
-            live = ~store.tombstone
-            allowed = live if allowed is None else (allowed & live)
-        result = QueryPlanner(store.sft, eval_store).run(
-            q, explain, allowed=allowed)
-        self._audit(name, q, result)
-        return result
+        with obs_span("query", schema=name) as sp:
+            if sp.recording:
+                sp.set_attr("filter", repr(q.filter))
+                sp.set_attr("lean", bool(store.lean))
+            if store.batch is None or len(store.batch) == 0:
+                if store.multihost:
+                    # a locally-empty process must still ENTER the
+                    # planner's collectives (other processes may hold
+                    # rows); an empty local batch feeds zero rows to the
+                    # sharded builds
+                    if store.batch is None:
+                        store.batch = FeatureBatch.empty(store.sft)
+                else:
+                    empty = FeatureBatch.empty(store.sft)
+                    from .planning.strategy import FilterStrategy
+                    result = QueryResult(empty, np.empty(0, dtype=np.int64),
+                                         FilterStrategy("none", 0), 0.0, 0.0)
+                    self._audit(name, q, result)
+                    return result
+            allowed = None
+            eval_store = store
+            if self._auth_provider is not None:
+                auths = self._auth_provider.get_authorizations()
+                allowed = store.vis_mask(auths)
+                masked = store.masked_batch(auths)
+                if masked is not store.batch:
+                    # guarded values must be invisible to FILTERS too, not
+                    # just results — evaluate over the masked view
+                    eval_store = _MaskedStoreView(store, masked)
+            if store.tombstone is not None:
+                # deleted rows (lean tombstones) are invisible to every
+                # query, like any other row the caller cannot see
+                live = ~store.tombstone
+                allowed = live if allowed is None else (allowed & live)
+            result = QueryPlanner(store.sft, eval_store).run(
+                q, explain, allowed=allowed)
+            sp.set_attr("hits", int(len(result.positions)))
+            self._audit(name, q, result)
+            return result
 
     def _intercept(self, sft: FeatureType, q: Query) -> Query:
         from .planning.interceptor import apply_interceptors, load_interceptors
@@ -1636,18 +1643,35 @@ class TpuDataStore:
         return apply_interceptors(self._interceptors[sft.name], sft, q)
 
     def _audit(self, name: str, q: Query, result: QueryResult) -> None:
+        self._audit_record(name, repr(q.filter), dict(q.hints),
+                           result.plan_time_ms, result.scan_time_ms,
+                           len(result.positions))
+
+    def _audit_record(self, name: str, filter_repr: str, hints: dict,
+                      plan_ms: float | None, scan_ms: float,
+                      hits: int) -> None:
+        """The ONE audit emission path — every query shape (planner,
+        batched-windows fast path) updates the same registry keys and
+        writes an identically-shaped QueryEvent stamped with the active
+        trace id, so readback/alerting never depends on which code path
+        served the query.  ``plan_ms=None`` means the planning phase
+        never ran (the fast paths plan inside the index): the event
+        records 0.0 but the plan_ms timer gets NO sample — phantom
+        zeros would drag its p50/min to 0 and mask real planner
+        regressions."""
         from .metrics import registry as _metrics
+        from .obs import current_trace_id
         _metrics.counter(f"query.{name}.count").inc()
-        _metrics.timer(f"query.{name}.plan_ms").update(result.plan_time_ms)
-        _metrics.timer(f"query.{name}.scan_ms").update(result.scan_time_ms)
+        if plan_ms is not None:
+            _metrics.timer(f"query.{name}.plan_ms").update(plan_ms)
+        _metrics.timer(f"query.{name}.scan_ms").update(scan_ms)
         if self._audit_writer is not None:
             from .audit import QueryEvent
             self._audit_writer.write_event(QueryEvent(
                 store="tpu", type_name=name, user=self._user,
-                filter=repr(q.filter), hints=dict(q.hints),
-                plan_time_ms=result.plan_time_ms,
-                scan_time_ms=result.scan_time_ms,
-                hits=len(result.positions)))
+                filter=filter_repr, hints=hints,
+                plan_time_ms=plan_ms or 0.0, scan_time_ms=scan_ms,
+                hits=hits, trace_id=current_trace_id()))
 
     def query_arrow(self, name: str, query="INCLUDE", *,
                     dictionary_fields: tuple[str, ...] = (),
@@ -1758,22 +1782,23 @@ class TpuDataStore:
             # through the lean index's single batched multi-window
             # program; non-point (xz2) lean schemas take the per-window
             # planner path below (review r5)
-            t0 = time.time()
-            hits = store.index("z3").query_many(
-                [(boxes, lo, hi) for boxes, lo, hi in windows])
-            allowed = self._effective_mask(store)
-            if allowed is not None:
-                hits = _apply_mask_global(store, hits, allowed)
-            from .metrics import registry as _metrics
-            _metrics.counter(f"query.{name}.windows").inc(len(windows))
-            if self._audit_writer is not None:
-                from .audit import QueryEvent
-                self._audit_writer.write_event(QueryEvent(
-                    store="tpu", type_name=name, user=self._user,
-                    filter=f"batched windows[{len(windows)}]",
-                    scan_time_ms=(time.time() - t0) * 1e3,
-                    hits=int(sum(len(h) for h in hits))))
-            return hits
+            from .obs import span as obs_span
+            with obs_span("query", schema=name,
+                          windows=len(windows), lean=True) as sp:
+                t0 = time.time()
+                hits = store.index("z3").query_many(
+                    [(boxes, lo, hi) for boxes, lo, hi in windows])
+                allowed = self._effective_mask(store)
+                if allowed is not None:
+                    hits = _apply_mask_global(store, hits, allowed)
+                from .metrics import registry as _metrics
+                _metrics.counter(f"query.{name}.windows").inc(len(windows))
+                n_hits = int(sum(len(h) for h in hits))
+                sp.set_attr("hits", n_hits)
+                self._audit_record(name, f"batched windows[{len(windows)}]",
+                                   {}, None, (time.time() - t0) * 1e3,
+                                   n_hits)
+                return hits
         enabled = sft.enabled_indices
         use_fast = (sft.is_points and sft.dtg_field
                     and not self._interceptors[sft.name]
@@ -1790,45 +1815,46 @@ class TpuDataStore:
                     f = And((f, During(sft.dtg_field, lo, hi)))
                 out.append(self.query_result(name, Query.of(f)).positions)
             return out
-        t0 = time.time()
-        # untimed windows (both bounds None) scan the Z2 index: with the
-        # time axis unconstrained, z3 covering ranges degrade to near
-        # full-bin scans, while z2 ranges stay tight
-        untimed = [i for i, (_, lo, hi) in enumerate(windows)
-                   if lo is None and hi is None]
-        if len(untimed) == len(windows):
-            hits = store.z2_index().query_many([w[0] for w in windows])
-        elif not untimed:
-            hits = store.z3_index().query_many(windows)
-        else:
-            uset = set(untimed)
-            timed_idx = [i for i in range(len(windows)) if i not in uset]
-            z2_hits = store.z2_index().query_many(
-                [windows[i][0] for i in untimed])
-            z3_hits = store.z3_index().query_many(
-                [windows[i] for i in timed_idx])
-            hits = [None] * len(windows)
-            for j, i in enumerate(untimed):
-                hits[i] = z2_hits[j]
-            for j, i in enumerate(timed_idx):
-                hits[i] = z3_hits[j]
-        # _effective_mask (restricted + tombstones), not vis_mask: the
-        # restricted decision is AGREED under multihost (per-process
-        # vis_mask may be None on one process and set on another — a
-        # divergent gate would strand peers in the allgather below)
-        allowed = self._effective_mask(store)
-        if allowed is not None:
-            hits = _apply_mask_global(store, hits, allowed)
-        from .metrics import registry as _metrics
-        _metrics.counter(f"query.{name}.windows").inc(len(windows))
-        if self._audit_writer is not None:
-            from .audit import QueryEvent
-            self._audit_writer.write_event(QueryEvent(
-                store="tpu", type_name=name, user=self._user,
-                filter=f"batched windows[{len(windows)}]",
-                scan_time_ms=(time.time() - t0) * 1e3,
-                hits=int(sum(len(h) for h in hits))))
-        return hits
+        from .obs import span as obs_span
+        with obs_span("query", schema=name, windows=len(windows)) as sp:
+            t0 = time.time()
+            # untimed windows (both bounds None) scan the Z2 index: with
+            # the time axis unconstrained, z3 covering ranges degrade to
+            # near full-bin scans, while z2 ranges stay tight
+            untimed = [i for i, (_, lo, hi) in enumerate(windows)
+                       if lo is None and hi is None]
+            if len(untimed) == len(windows):
+                hits = store.z2_index().query_many([w[0] for w in windows])
+            elif not untimed:
+                hits = store.z3_index().query_many(windows)
+            else:
+                uset = set(untimed)
+                timed_idx = [i for i in range(len(windows))
+                             if i not in uset]
+                z2_hits = store.z2_index().query_many(
+                    [windows[i][0] for i in untimed])
+                z3_hits = store.z3_index().query_many(
+                    [windows[i] for i in timed_idx])
+                hits = [None] * len(windows)
+                for j, i in enumerate(untimed):
+                    hits[i] = z2_hits[j]
+                for j, i in enumerate(timed_idx):
+                    hits[i] = z3_hits[j]
+            # _effective_mask (restricted + tombstones), not vis_mask:
+            # the restricted decision is AGREED under multihost
+            # (per-process vis_mask may be None on one process and set
+            # on another — a divergent gate would strand peers in the
+            # allgather below)
+            allowed = self._effective_mask(store)
+            if allowed is not None:
+                hits = _apply_mask_global(store, hits, allowed)
+            from .metrics import registry as _metrics
+            _metrics.counter(f"query.{name}.windows").inc(len(windows))
+            n_hits = int(sum(len(h) for h in hits))
+            sp.set_attr("hits", n_hits)
+            self._audit_record(name, f"batched windows[{len(windows)}]",
+                               {}, None, (time.time() - t0) * 1e3, n_hits)
+            return hits
 
     def explain(self, name: str, query="INCLUDE") -> str:
         from .planning.explain import ExplainString

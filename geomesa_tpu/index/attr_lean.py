@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import device_span, obs_count, span as obs_span
 from ..ops.search import (
     coded_pos_bits, expand_ranges, gather_capacity, pad_pow2,
     searchsorted2, wire_dtype,
@@ -603,9 +604,13 @@ class LeanAttrIndex:
         maps) are dict-valued and run host-side over the runs' key
         columns (device runs fetch once; the partial caches like any
         other)."""
+        with obs_span("lean.sketch", attr=self.attr,
+                      generations=len(self.generations)):
+            return self._sketch_scan(fold)
+
+    def _sketch_scan(self, fold) -> "RunSketch":
         from ..metrics import (
             LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
-            registry as _metrics,
         )
         from ..stats.sketch import RunSketch, fold_attr_runs
         merged = RunSketch()
@@ -618,7 +623,7 @@ class LeanAttrIndex:
         for g in self.generations:
             part = cache.get(g.gen_id) if g is not live else None
             if part is not None:
-                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                obs_count(LEAN_SKETCH_CACHE_HITS)
                 merged = merged + part
             elif g.tier == "device":
                 dev_scan.append(g)
@@ -637,12 +642,14 @@ class LeanAttrIndex:
                      else (g.keys, g.sec))
                 cols += [c[0], c[1]]
             self.dispatch_count += 1
-            cnt, kmin, kmax, vsum, vsumsq, hist, cms = [
-                np.asarray(a) for a in _attr_sketch_multi(
-                    jnp.int64(fold.slo), jnp.int64(fold.shi),
-                    jnp.float64(fold.hlo), jnp.float64(fold.hhi),
-                    *cols, bins=int(fold.bins), depth=int(fold.depth),
-                    width=int(fold.width), is_float=is_float)]
+            with device_span("query.scan.device", stage="sketch",
+                             runs=len(dev_scan)):
+                cnt, kmin, kmax, vsum, vsumsq, hist, cms = [
+                    np.asarray(a) for a in _attr_sketch_multi(
+                        jnp.int64(fold.slo), jnp.int64(fold.shi),
+                        jnp.float64(fold.hlo), jnp.float64(fold.hhi),
+                        *cols, bins=int(fold.bins), depth=int(fold.depth),
+                        width=int(fold.width), is_float=is_float)]
             for i, g in enumerate(dev_scan):
                 n = int(cnt[i])
                 new_parts[id(g)] = RunSketch(
@@ -668,7 +675,7 @@ class LeanAttrIndex:
             p = new_parts[id(g)]
             merged = merged + p
             if g is not live:
-                _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                obs_count(LEAN_SKETCH_CACHE_MISSES)
                 self._sketch_cache.add(cache, g.gen_id, p)
         return merged
 
@@ -712,8 +719,11 @@ class LeanAttrIndex:
                         else (gen.keys, gen.sec, gen.gid))
                 count_cols += [cols[0], cols[1]]
             self.dispatch_count += 1
-            totals = np.asarray(_attr_count_multi(
-                jklo, jkhi, jslo, jshi, *count_cols))
+            with device_span("query.scan.device", stage="probe",
+                             runs=len(dev_gens),
+                             rows=int(sum(g.n for g in dev_gens))):
+                totals = np.asarray(_attr_count_multi(
+                    jklo, jkhi, jslo, jshi, *count_cols))
             if int(totals.sum()):
                 capacity = gather_capacity(int(totals.max()),
                                            minimum=self.DEFAULT_CAPACITY)
@@ -732,19 +742,24 @@ class LeanAttrIndex:
                         cols += list(self._sentinel_cols() if gen is None
                                      else (gen.keys, gen.sec, gen.gid))
                     self.dispatch_count += 1
-                    packed = _attr_scan_coded(
-                        jklo, jkhi, jslo, jshi, jnp.asarray(qqid),
-                        *cols, capacity=cap, pos_bits=pos_bits)
-                    flat = np.asarray(packed).ravel()
+                    with device_span("query.scan.device", stage="gather",
+                                     runs=len(group)):
+                        packed = _attr_scan_coded(
+                            jklo, jkhi, jslo, jshi, jnp.asarray(qqid),
+                            *cols, capacity=cap, pos_bits=pos_bits)
+                        # the blocking device->host read belongs to the
+                        # dispatch; the host-side filtering does not
+                        flat = np.asarray(packed).ravel()
                     parts.append(flat[flat >= 0].astype(np.int64))
         if host_gens:
-            if self._host_stack is None:
-                self._host_stack = _HostAttrStack(
-                    [g.spilled for g in host_gens])
-            coded = self._host_stack.candidates(
-                qklo, qkhi, qslo, qshi, qqid, pos_bits)
-            if len(coded):
-                parts.append(coded)
+            with obs_span("query.scan.host", runs=len(host_gens)):
+                if self._host_stack is None:
+                    self._host_stack = _HostAttrStack(
+                        [g.spilled for g in host_gens])
+                coded = self._host_stack.candidates(
+                    qklo, qkhi, qslo, qshi, qqid, pos_bits)
+                if len(coded):
+                    parts.append(coded)
         if not parts:
             return np.empty(0, np.int64)
         merged = np.concatenate(parts)

@@ -63,19 +63,37 @@ def compact_incremental(plan, merge_one, budget_ms: float | None = None,
     opportunistic trigger's one-group cap).  Returns groups merged;
     interrupted compaction resumes on the next call because the plan
     recomputes from the surviving runs."""
+    from ..obs import span as obs_span
     t0 = time.perf_counter()
+    groups = plan()
+    if not groups:
+        # nothing eligible — the common opportunistic post-append case.
+        # No span and no timer sample: a bulk ingest calls this once per
+        # append, and hundreds of ~0ms no-op traces would evict every
+        # query trace from the ring and drive lean.compaction.ms's
+        # quantiles to zero
+        return 0
     merged = 0
-    while True:
-        groups = plan()
-        if not groups:
-            break
-        merge_one(groups[0])
-        merged += 1
-        if max_groups is not None and merged >= max_groups:
-            break
-        if (budget_ms is not None
-                and (time.perf_counter() - t0) * 1e3 >= budget_ms):
-            break
+    # ONE span for the whole merge-replan loop (this is the shared
+    # policy every index variant routes through, so compaction work is
+    # traced here exactly once): groups merged + wall ms, feeding the
+    # lean.compaction.ms rollup alongside the existing merge counters
+    with obs_span("lean.compaction") as sp:
+        while True:
+            merge_one(groups[0])
+            merged += 1
+            if max_groups is not None and merged >= max_groups:
+                break
+            if (budget_ms is not None
+                    and (time.perf_counter() - t0) * 1e3 >= budget_ms):
+                break
+            groups = plan()
+            if not groups:
+                break
+        sp.set_attr("merged_groups", merged)
+    from ..metrics import registry as _metrics
+    _metrics.timer("lean.compaction.ms").update(
+        (time.perf_counter() - t0) * 1e3)
     return merged
 
 

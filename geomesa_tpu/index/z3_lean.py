@@ -78,6 +78,7 @@ from ..metrics import (
     LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
     registry as _metrics,
 )
+from ..obs import device_span, obs_count, span as obs_span
 from ..ops.search import (
     coded_pos_bits, expand_ranges, gather_capacity, pad_boxes, pad_pow2,
     pad_ranges, searchsorted2, wire_dtype,
@@ -1259,26 +1260,29 @@ class LeanZ3Index:
         w_boxes: list = []
         qtlo = np.empty(n_q, dtype=np.int64)
         qthi = np.empty(n_q, dtype=np.int64)
-        for q, (bxs, lo, hi) in enumerate(windows):
-            lo, hi = self._clamp_time(lo, hi)
-            qtlo[q], qthi[q] = lo, hi
-            bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
-            w_boxes.append(bxs)
-            # per-BIN range budget: plan_z3_query splits its target
-            # across the interval's bins, so open/long intervals would
-            # starve each bin into hugely overcovering ranges (895k
-            # candidates for 23 hits measured) — scale by the bin count
-            # and let the hard cap bound plan cost
-            budget = min(max_ranges * _bins_spanned(lo, hi, self.period),
-                         _MAX_RANGES_PER_WINDOW)
-            plan = plan_z3_query(bxs, lo, hi, self.period, budget,
-                                 sfc=self.sfc)
-            if plan.num_ranges == 0:
-                continue
-            rbin.append(plan.rbin)
-            rzlo.append(plan.rzlo)
-            rzhi.append(plan.rzhi)
-            rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+        with obs_span("query.decompose", windows=n_q) as dsp:
+            for q, (bxs, lo, hi) in enumerate(windows):
+                lo, hi = self._clamp_time(lo, hi)
+                qtlo[q], qthi[q] = lo, hi
+                bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
+                w_boxes.append(bxs)
+                # per-BIN range budget: plan_z3_query splits its target
+                # across the interval's bins, so open/long intervals
+                # would starve each bin into hugely overcovering ranges
+                # (895k candidates for 23 hits measured) — scale by the
+                # bin count and let the hard cap bound plan cost
+                budget = min(max_ranges * _bins_spanned(lo, hi,
+                                                        self.period),
+                             _MAX_RANGES_PER_WINDOW)
+                plan = plan_z3_query(bxs, lo, hi, self.period, budget,
+                                     sfc=self.sfc)
+                if plan.num_ranges == 0:
+                    continue
+                rbin.append(plan.rbin)
+                rzlo.append(plan.rzlo)
+                rzhi.append(plan.rzhi)
+                rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+            dsp.set_attr("ranges", int(sum(len(r) for r in rbin)))
         if not rbin:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
         ra = pad_ranges(
@@ -1308,8 +1312,12 @@ class LeanZ3Index:
             if progress is not None:
                 progress(f"    probing {len(dev_gens)} generations")
             self.dispatch_count += 1
-            totals = np.asarray(_lean_count_multi(rb, rlo, rhi,
-                                                  *count_cols))
+            n_dev = int(sum(g.n for g in dev_gens))
+            with device_span("query.scan.device", stage="probe",
+                             runs=len(dev_gens), rows=n_dev,
+                             bytes=n_dev * KEYS_BYTES):
+                totals = np.asarray(_lean_count_multi(rb, rlo, rhi,
+                                                      *count_cols))
         coded_parts: list = []
         # full tier: fused exact mask on device — survivors only
         if full_gens:
@@ -1318,7 +1326,8 @@ class LeanZ3Index:
                 boxes_c, bqid_c = self._concat_boxes(w_boxes)
                 coded_parts += self._scan_tier(
                     full_gens, t_full, rb, rlo, rhi, rq, pos_bits,
-                    exact_args=(jnp.asarray(boxes_c), jnp.asarray(bqid_c),
+                    exact_args=(jnp.asarray(boxes_c),
+                                jnp.asarray(bqid_c),
                                 jnp.asarray(qtlo), jnp.asarray(qthi)))
         # keys tier: candidate gather — host exact mask below
         keys_cand: list = []
@@ -1331,14 +1340,16 @@ class LeanZ3Index:
         # host tier: stacked numpy seeks — flat in run count, and no
         # dispatch at all (round-4 VERDICT #9)
         if host_gens:
-            if self._host_stack is None:
-                self._host_stack = HostStack(
-                    [g.run for g in host_gens])
-            coded = self._host_stack.candidates(
-                ra["rbin"], ra["rzlo"], ra["rzhi"], ra["rqid"],
-                pos_bits)
-            if len(coded):
-                keys_cand.append(coded)
+            with obs_span("query.scan.host", stage="seek",
+                          runs=len(host_gens)):
+                if self._host_stack is None:
+                    self._host_stack = HostStack(
+                        [g.run for g in host_gens])
+                coded = self._host_stack.candidates(
+                    ra["rbin"], ra["rzlo"], ra["rzhi"], ra["rqid"],
+                    pos_bits)
+                if len(coded):
+                    keys_cand.append(coded)
 
         mask_bits = (np.int64(1) << pos_bits) - 1
         out = [np.empty(0, dtype=np.int64) for _ in range(n_q)]
@@ -1347,23 +1358,27 @@ class LeanZ3Index:
         cand_hits = (np.concatenate(keys_cand) if keys_cand
                      else np.empty(0, np.int64))
         if len(cand_hits):
-            # host exact mask on the payload (the client-side re-check)
-            x, y, t = self._payload_flat()
-            qids = (cand_hits >> pos_bits).astype(np.int64)
-            cand = (cand_hits & mask_bits).astype(np.int64)
-            cx, cy, ct = x[cand], y[cand], t[cand]
-            keep = np.zeros(len(cand), dtype=bool)
-            for q in range(n_q):
-                sel = qids == q
-                if not sel.any():
-                    continue
-                in_box = np.zeros(int(sel.sum()), dtype=bool)
-                for b in w_boxes[q]:
-                    in_box |= ((cx[sel] >= b[0]) & (cy[sel] >= b[1])
-                               & (cx[sel] <= b[2]) & (cy[sel] <= b[3]))
-                keep[sel] = (in_box & (ct[sel] >= qtlo[q])
-                             & (ct[sel] <= qthi[q]))
-            cand_hits = cand_hits[keep]
+            # host exact mask on the payload (the client-side re-check
+            # of keys/host-tier candidates) — its own scan.host stage
+            # so the trace separates spill seeks from verification
+            with obs_span("query.scan.host", stage="recheck",
+                          candidates=int(len(cand_hits))):
+                x, y, t = self._payload_flat()
+                qids = (cand_hits >> pos_bits).astype(np.int64)
+                cand = (cand_hits & mask_bits).astype(np.int64)
+                cx, cy, ct = x[cand], y[cand], t[cand]
+                keep = np.zeros(len(cand), dtype=bool)
+                for q in range(n_q):
+                    sel = qids == q
+                    if not sel.any():
+                        continue
+                    in_box = np.zeros(int(sel.sum()), dtype=bool)
+                    for b in w_boxes[q]:
+                        in_box |= ((cx[sel] >= b[0]) & (cy[sel] >= b[1])
+                                   & (cx[sel] <= b[2]) & (cy[sel] <= b[3]))
+                    keep[sel] = (in_box & (ct[sel] >= qtlo[q])
+                                 & (ct[sel] <= qthi[q]))
+                cand_hits = cand_hits[keep]
         merged = np.concatenate([exact_hits, cand_hits])
         qids = (merged >> pos_bits).astype(np.int64)
         positions = (merged & mask_bits).astype(np.int64)
@@ -1401,6 +1416,14 @@ class LeanZ3Index:
         1B rows ships ``height*width`` floats, not a billion hits
         (round-4 VERDICT #2; DensityScan.scala:31-59 +
         AggregatingScan.scala:80-102)."""
+        with obs_span("lean.density", grid=f"{width}x{height}",
+                      generations=len(self.generations)):
+            return self._density_scan(boxes, t_lo_ms, t_hi_ms, env,
+                                      width, height, max_ranges)
+
+    def _density_scan(self, boxes, t_lo_ms, t_hi_ms, env,
+                      width: int, height: int,
+                      max_ranges: int) -> np.ndarray:
         grid = np.zeros((height, width), np.float64)
         if self._n_rows == 0:
             return grid
@@ -1460,7 +1483,7 @@ class LeanZ3Index:
             if part is None:
                 keys_scan.append(g)
             else:
-                _metrics.counter(LEAN_DENSITY_CACHE_HITS).inc()
+                obs_count(LEAN_DENSITY_CACHE_HITS)
                 grid += part
         dev_gens = full_gens + keys_scan
         totals = np.empty(0)
@@ -1472,8 +1495,10 @@ class LeanZ3Index:
                         if gen is None else (gen.bins, gen.z))
                 count_cols += [cols[0], cols[1]]
             self.dispatch_count += 1
-            totals = np.asarray(_lean_count_multi(rb, rlo, rhi,
-                                                  *count_cols))
+            with device_span("query.scan.device", stage="probe",
+                             runs=len(dev_gens)):
+                totals = np.asarray(_lean_count_multi(rb, rlo, rhi,
+                                                      *count_cols))
 
         def _tier_groups(gens, tier_totals):
             cap = gather_capacity(int(tier_totals.max()),
@@ -1497,10 +1522,12 @@ class LeanZ3Index:
                                  (gen.bins, gen.z, gen.pos, gen.x,
                                   gen.y, gen.t, jnp.int32(gen.base)))
                 self.dispatch_count += 1
-                grid += np.asarray(_lean_density_full(
-                    self.sfc, rb, rlo, rhi, boxes_j, jnp.int64(lo),
-                    jnp.int64(hi), env_j, *cols, capacity=cap,
-                    width=width, height=height), np.float64)
+                with device_span("query.scan.device", tier="full",
+                                 runs=len(group)):
+                    grid += np.asarray(_lean_density_full(
+                        self.sfc, rb, rlo, rhi, boxes_j, jnp.int64(lo),
+                        jnp.int64(hi), env_j, *cols, capacity=cap,
+                        width=width, height=height), np.float64)
         if keys_scan:
             t_keys = totals[len(full_gens):len(dev_gens)]
             # zero-candidate generations contribute a zero grid — still
@@ -1516,10 +1543,12 @@ class LeanZ3Index:
                                 if gen is None else (gen.bins, gen.z))
                         cols += [base[0], base[1]]
                     self.dispatch_count += 1
-                    stacked = np.asarray(_lean_density_keys(
-                        self.sfc, rb, rlo, rhi, jnp.asarray(ixy),
-                        jnp.asarray(tb), env_j, *cols, capacity=cap,
-                        width=width, height=height), np.float64)
+                    with device_span("query.scan.device", tier="keys",
+                                     runs=len(group)):
+                        stacked = np.asarray(_lean_density_keys(
+                            self.sfc, rb, rlo, rhi, jnp.asarray(ixy),
+                            jnp.asarray(tb), env_j, *cols, capacity=cap,
+                            width=width, height=height), np.float64)
                     for i, gen in enumerate(group):
                         if gen is not None:
                             parts[id(gen)] = stacked[i]
@@ -1527,7 +1556,7 @@ class LeanZ3Index:
                 part = parts[id(g)]
                 grid += part
                 if g is not live:
-                    _metrics.counter(LEAN_DENSITY_CACHE_MISSES).inc()
+                    obs_count(LEAN_DENSITY_CACHE_MISSES)
                     self._cache_partial(cache, g.gen_id, part)
         # host tier: ONE stacked vectorized pass attributes hits to
         # their owning runs (flat in run count — the HostStack
@@ -1546,13 +1575,12 @@ class LeanZ3Index:
                     # stacked pass anyway — count neither a hit (no
                     # work was saved) nor a miss (nothing new cached)
                     if g.gen_id not in cache:
-                        _metrics.counter(
-                            LEAN_DENSITY_CACHE_MISSES).inc()
+                        obs_count(LEAN_DENSITY_CACHE_MISSES)
                         self._cache_partial(cache, g.gen_id, part)
                     grid += part
             else:
                 for g in host_gens:
-                    _metrics.counter(LEAN_DENSITY_CACHE_HITS).inc()
+                    obs_count(LEAN_DENSITY_CACHE_HITS)
                     grid += cache[g.gen_id]
         return grid
 
@@ -1580,7 +1608,7 @@ class LeanZ3Index:
             if part is None:
                 scan.append(g)
             else:
-                _metrics.counter(LEAN_DENSITY_CACHE_HITS).inc()
+                obs_count(LEAN_DENSITY_CACHE_HITS)
                 grid += part
         for s in range(0, len(scan), _GEN_BUCKET * 2):
             chunk = scan[s:s + _GEN_BUCKET * 2]
@@ -1595,19 +1623,19 @@ class LeanZ3Index:
                 part = stacked[i]
                 grid += part
                 if g is not live:
-                    _metrics.counter(LEAN_DENSITY_CACHE_MISSES).inc()
+                    obs_count(LEAN_DENSITY_CACHE_MISSES)
                     self._cache_partial(cache, g.gen_id, part)
         for g in self.generations:
             if g.tier != "host":
                 continue
             part = cache.get(g.gen_id)
             if part is None:
-                _metrics.counter(LEAN_DENSITY_CACHE_MISSES).inc()
+                obs_count(LEAN_DENSITY_CACHE_MISSES)
                 part = g.run.sweep_partial(self.sfc, env_t, width,
                                            height, world)
                 self._cache_partial(cache, g.gen_id, part)
             else:
-                _metrics.counter(LEAN_DENSITY_CACHE_HITS).inc()
+                obs_count(LEAN_DENSITY_CACHE_HITS)
             grid += part
         return grid
 
@@ -1650,7 +1678,7 @@ class LeanZ3Index:
             if part is None:
                 scan.append(g)
             else:
-                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                obs_count(LEAN_SKETCH_CACHE_HITS)
                 total += part
         for s in range(0, len(scan), _GEN_BUCKET * 2):
             chunk = scan[s:s + _GEN_BUCKET * 2]
@@ -1661,8 +1689,10 @@ class LeanZ3Index:
                      else (g.bins, g.z))
                 cols += [c[0], c[1]]
             self.dispatch_count += 1
-            stacked = np.asarray(_z3_cells_multi(
-                jnp.int64(b0), *cols, bits=int(bits), nb=nb))
+            with device_span("query.scan.device", stage="z3_cells",
+                             runs=len(chunk)):
+                stacked = np.asarray(_z3_cells_multi(
+                    jnp.int64(b0), *cols, bits=int(bits), nb=nb))
             for i, g in enumerate(chunk):
                 # copy, not a view: a cached view would pin the WHOLE
                 # stacked bucket (padding + live rows) in host RAM and
@@ -1670,18 +1700,18 @@ class LeanZ3Index:
                 part = np.array(stacked[i])
                 total += part
                 if g is not live:
-                    _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                    obs_count(LEAN_SKETCH_CACHE_MISSES)
                     self._sketch_cache.add(cache, g.gen_id, part)
         for g in self.generations:
             if g.tier != "host":
                 continue
             part = cache.get(g.gen_id)
             if part is None:
-                _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                obs_count(LEAN_SKETCH_CACHE_MISSES)
                 part = g.run.cell_counts(b0, nb, int(bits))
                 self._sketch_cache.add(cache, g.gen_id, part)
             else:
-                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                obs_count(LEAN_SKETCH_CACHE_HITS)
             total += part
         c_per_bin = 1 << bits
         for i in np.flatnonzero(total):
@@ -1739,37 +1769,45 @@ class LeanZ3Index:
             caps = [gather_capacity(int(t), minimum=self.DEFAULT_CAPACITY)
                     for t in totals if int(t)]
         parts = []
+        row_bytes = FULL_BYTES if tier == "full" else KEYS_BYTES
         for group, cap in zip(groups, caps):
-            cols: list = []
-            for gen in group:
-                if gen is None:
-                    cols += list(self._sentinel_cols(tier))
-                elif tier == "full":
-                    cols += [gen.bins, gen.z, gen.pos, gen.x, gen.y,
-                             gen.t, jnp.int32(gen.base)]
-                else:
-                    cols += [gen.bins, gen.z, gen.pos]
-            self.dispatch_count += 1
-            if tier == "full":
-                if len(group) * cap >= _TWO_PHASE_MIN_SLOTS:
-                    # survivors-only transfer: keep the coded buffer on
-                    # device, read the hit count, compact (full tier
-                    # already masked exactly on device)
+            rows = int(sum(g.n for g in group if g is not None))
+            with device_span("query.scan.device", tier=tier,
+                             runs=sum(1 for g in group
+                                      if g is not None),
+                             rows=rows, bytes=rows * row_bytes):
+                cols: list = []
+                for gen in group:
+                    if gen is None:
+                        cols += list(self._sentinel_cols(tier))
+                    elif tier == "full":
+                        cols += [gen.bins, gen.z, gen.pos, gen.x,
+                                 gen.y, gen.t, jnp.int32(gen.base)]
+                    else:
+                        cols += [gen.bins, gen.z, gen.pos]
+                self.dispatch_count += 1
+                if (tier == "full"
+                        and len(group) * cap >= _TWO_PHASE_MIN_SLOTS):
+                    # survivors-only transfer: keep the coded buffer
+                    # on device, read the hit count, compact (full
+                    # tier already masked exactly on device)
                     packed, nhits = _lean_scan_exact_keep(
                         rb, rlo, rhi, rq, *exact_args, *cols,
                         capacity=cap, pos_bits=pos_bits)
                     k = gather_capacity(max(int(nhits), 1), minimum=8)
                     self.dispatch_count += 1
                     flat = np.asarray(_compact_coded(packed, k=k))
-                    parts.append(flat[flat >= 0].astype(np.int64))
-                    continue
-                packed = _lean_scan_exact_coded(
-                    rb, rlo, rhi, rq, *exact_args, *cols,
-                    capacity=cap, pos_bits=pos_bits)
-            else:
-                packed = _lean_scan_coded(
-                    rb, rlo, rhi, rq, *cols,
-                    capacity=cap, pos_bits=pos_bits)
-            flat = np.asarray(packed).ravel()
+                else:
+                    if tier == "full":
+                        packed = _lean_scan_exact_coded(
+                            rb, rlo, rhi, rq, *exact_args, *cols,
+                            capacity=cap, pos_bits=pos_bits)
+                    else:
+                        packed = _lean_scan_coded(
+                            rb, rlo, rhi, rq, *cols,
+                            capacity=cap, pos_bits=pos_bits)
+                    flat = np.asarray(packed).ravel()
+            # host-side candidate filtering is NOT device time — it
+            # runs after the span so device_ms stays honest
             parts.append(flat[flat >= 0].astype(np.int64))
         return parts

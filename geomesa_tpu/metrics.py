@@ -5,22 +5,35 @@ MetricRegistry with config-driven reporters — Ganglia, Graphite, SLF4J,
 delimited file; geomesa-metrics/.../config/MetricsConfig.scala:15-17,
 reporters/*.scala).  Network reporters are out of scope in this image;
 provided sinks are logging and delimited-file, behind the same reporter
-protocol so others can be plugged in.
+protocol so others can be plugged in, plus a :class:`PeriodicReporter`
+daemon-thread scheduler (the dropwizard ScheduledReporter role).
+
+Histograms/timers keep log-bucketed value counts (~15%-wide buckets)
+alongside the streaming moments, so ``snapshot()`` serves p50/p95/p99
+— the quantile surface the Prometheus exposition (obs/prom.py) and the
+slow-query analysis need — at O(1) memory.  Bucket tables are mergeable
+(:func:`merge_snapshots`), which is how multihost scrapes aggregate one
+registry per process into one mesh-wide view (parallel/stats.
+allreduce_metrics_snapshot).
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 from dataclasses import dataclass, field
 
 __all__ = ["MetricRegistry", "Timer", "Counter", "HistogramMetric",
-           "LoggingReporter", "DelimitedFileReporter", "registry",
+           "LoggingReporter", "DelimitedFileReporter", "PeriodicReporter",
+           "merge_snapshots", "registry",
            "LEAN_COMPACTION_MERGES", "LEAN_COMPACTION_ROWS",
            "LEAN_DENSITY_CACHE_HITS", "LEAN_DENSITY_CACHE_MISSES",
            "LEAN_SKETCH_CACHE_HITS", "LEAN_SKETCH_CACHE_MISSES",
-           "LEAN_SKETCH_SCANS", "LEAN_STATS_MATERIALIZED"]
+           "LEAN_SKETCH_SCANS", "LEAN_STATS_MATERIALIZED",
+           "LEAN_DEVICE_DISPATCHES", "LEAN_DEVICE_MS",
+           "JAX_COMPILE_COUNT", "JAX_COMPILE_MS", "JAX_COMPILE_FALLBACK"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -39,6 +52,19 @@ LEAN_SKETCH_CACHE_HITS = "lean.sketch.cache.hits"
 LEAN_SKETCH_CACHE_MISSES = "lean.sketch.cache.misses"
 LEAN_SKETCH_SCANS = "lean.sketch.scans"
 LEAN_STATS_MATERIALIZED = "lean.sketch.materialized_fallbacks"
+#: device-dispatch attribution (obs.device_span): every lean device
+#: dispatch counts once (the full tier's pipelined two-phase
+#: survivors-transfer pair counts as ONE — it blocks as a unit) and
+#: its block-until-ready wall time feeds the timer — the "where does
+#: device time go" rollup (ISSUE 5)
+LEAN_DEVICE_DISPATCHES = "lean.device.dispatches"
+LEAN_DEVICE_MS = "lean.device.ms"
+#: XLA (re)compile tracking (obs/recompile.py): backend compiles seen
+#: by the jax.monitoring listener, their durations, and the wrapped-jit
+#: fallback counter for environments without the listener API
+JAX_COMPILE_COUNT = "jax.compile.count"
+JAX_COMPILE_MS = "jax.compile.ms"
+JAX_COMPILE_FALLBACK = "jax.compile.fallback_count"
 
 
 @dataclass
@@ -51,14 +77,47 @@ class Counter:
             self.count += n
 
 
+#: log-bucket geometry for the quantile tables: bucket b holds values in
+#: (BASE**(b-1), BASE**b], so a quantile estimate (the bucket's geometric
+#: midpoint) is within ~7% of the true value — plenty for p50/p95/p99
+#: reporting, at a handful of ints per decade of dynamic range
+_Q_BASE = 1.15
+_Q_LOG = math.log(_Q_BASE)
+
+
+def _quantile_from_buckets(q: float, count: int, zero: int,
+                           buckets: dict, vmin: float, vmax: float
+                           ) -> float:
+    """Quantile estimate from a log-bucket table (shared by the live
+    histogram and merged multihost snapshots).  ``zero`` counts values
+    <= 0 (they have no log bucket).  Estimates clamp into the observed
+    [min, max] so tiny histograms never report out-of-range values."""
+    if count <= 0:
+        return 0.0
+    rank = max(1, math.ceil(q * count))
+    seen = zero
+    if rank <= seen:
+        return min(0.0, vmax) if vmax < 0 else 0.0
+    est = vmax
+    for b in sorted(buckets):
+        seen += buckets[b]
+        if rank <= seen:
+            est = _Q_BASE ** (b - 0.5)
+            break
+    return max(min(est, vmax), vmin)
+
+
 @dataclass
 class HistogramMetric:
-    """Streaming count/mean/min/max (sufficient for reporting sinks)."""
+    """Streaming count/mean/min/max plus a log-bucket table serving
+    p50/p95/p99 (module doc)."""
 
     count: int = 0
     total: float = 0.0
     min: float = float("inf")
     max: float = float("-inf")
+    _zero: int = 0
+    _buckets: dict = field(default_factory=dict, repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def update(self, value: float):
@@ -67,10 +126,20 @@ class HistogramMetric:
             self.total += value
             self.min = min(self.min, value)
             self.max = max(self.max, value)
+            if value <= 0.0:
+                self._zero += 1
+            else:
+                b = int(math.ceil(math.log(value) / _Q_LOG))
+                self._buckets[b] = self._buckets.get(b, 0) + 1
 
     @property
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return _quantile_from_buckets(q, self.count, self._zero,
+                                          self._buckets, self.min, self.max)
 
 
 @dataclass
@@ -122,49 +191,173 @@ class MetricRegistry:
     def histogram(self, name: str) -> HistogramMetric:
         return self._get(name, HistogramMetric)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, buckets: bool = False) -> dict:
+        """Point-in-time view: counters as ``{"count"}``, histograms/
+        timers with moments + p50/p95/p99.  ``buckets=True`` adds the
+        raw log-bucket table (``total``/``zero``/``buckets``) — the
+        mergeable form :func:`merge_snapshots` consumes."""
         with self._lock:
-            out = {}
-            for name, m in sorted(self._metrics.items()):
-                if isinstance(m, Counter):
-                    out[name] = {"count": m.count}
-                else:
-                    out[name] = {"count": m.count, "mean": m.mean,
-                                 "min": m.min if m.count else 0.0,
-                                 "max": m.max if m.count else 0.0}
-            return out
+            items = sorted(self._metrics.items())
+        out = {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = {"count": m.count}
+                continue
+            with m._lock:
+                vals = {"count": m.count, "mean": m.mean,
+                        "min": m.min if m.count else 0.0,
+                        "max": m.max if m.count else 0.0}
+                for key, q in (("p50", 0.50), ("p95", 0.95),
+                               ("p99", 0.99)):
+                    vals[key] = _quantile_from_buckets(
+                        q, m.count, m._zero, m._buckets, m.min, m.max)
+                if buckets:
+                    vals["total"] = m.total
+                    vals["zero"] = m._zero
+                    vals["buckets"] = {str(b): n
+                                       for b, n in m._buckets.items()}
+            out[name] = vals
+        return out
 
 
-class LoggingReporter:
-    """SLF4J-reporter analog: dump the registry to a logger."""
+def merge_snapshots(snaps: list) -> dict:
+    """Monoid merge of per-process ``snapshot(buckets=True)`` dicts into
+    one plain snapshot (quantiles recomputed from the summed bucket
+    tables, bucket internals dropped) — the multihost scrape reducer
+    (parallel/stats.allreduce_metrics_snapshot)."""
+    merged: dict = {}
+    for snap in snaps:
+        for name, vals in snap.items():
+            cur = merged.setdefault(name, {
+                "count": 0, "total": 0.0, "zero": 0, "buckets": {},
+                "min": float("inf"), "max": float("-inf"),
+                "hist": "mean" in vals})
+            cur["count"] += int(vals.get("count", 0))
+            if "mean" in vals:
+                if "buckets" not in vals and vals.get("count", 0):
+                    # a bucket-less histogram entry means the caller
+                    # passed plain snapshot() output — quantiles would
+                    # silently degenerate to max; fail loudly instead
+                    raise ValueError(
+                        f"merge_snapshots needs snapshot(buckets=True) "
+                        f"input; {name!r} has no bucket table")
+                cur["hist"] = True
+                cur["total"] += float(
+                    vals.get("total", vals["mean"] * vals.get("count", 0)))
+                if vals.get("count"):
+                    cur["min"] = min(cur["min"], float(vals["min"]))
+                    cur["max"] = max(cur["max"], float(vals["max"]))
+                cur["zero"] += int(vals.get("zero", 0))
+                for b, n in (vals.get("buckets") or {}).items():
+                    cur["buckets"][int(b)] = (cur["buckets"].get(int(b), 0)
+                                              + int(n))
+    out = {}
+    for name, cur in sorted(merged.items()):
+        if not cur["hist"]:
+            out[name] = {"count": cur["count"]}
+            continue
+        n = cur["count"]
+        vmin = cur["min"] if n else 0.0
+        vmax = cur["max"] if n else 0.0
+        vals = {"count": n, "mean": cur["total"] / n if n else 0.0,
+                "min": vmin, "max": vmax}
+        for key, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            vals[key] = _quantile_from_buckets(
+                q, n, cur["zero"], cur["buckets"], vmin, vmax)
+        out[name] = vals
+    return out
+
+
+class _ReporterBase:
+    """Shared interval-delta tracking: each ``report()`` also emits the
+    per-metric count DELTA since the previous report (the dropwizard
+    one-minute-rate role, without the decay math) — cumulative-only
+    rows made rate regressions invisible in long-lived processes."""
+
+    def __init__(self, reg: MetricRegistry):
+        self.registry = reg
+        self._last_counts: dict = {}
+
+    def _rows(self):
+        for name, vals in self.registry.snapshot().items():
+            delta = vals["count"] - self._last_counts.get(name, 0)
+            self._last_counts[name] = vals["count"]
+            yield name, {**vals, "delta": delta}
+
+
+class LoggingReporter(_ReporterBase):
+    """SLF4J-reporter analog: dump the registry (with interval deltas)
+    to a logger."""
 
     def __init__(self, reg: MetricRegistry, logger=None,
                  level: int = logging.INFO):
-        self.registry = reg
+        super().__init__(reg)
         self.logger = logger or logging.getLogger("geomesa_tpu.metrics")
         self.level = level
 
     def report(self):
-        for name, vals in self.registry.snapshot().items():
+        for name, vals in self._rows():
             self.logger.log(self.level, "%s %s", name, vals)
 
 
-class DelimitedFileReporter:
-    """Delimited-file-reporter analog: append CSV rows per metric."""
+class DelimitedFileReporter(_ReporterBase):
+    """Delimited-file-reporter analog: append CSV rows per metric
+    (cumulative values plus the interval delta)."""
 
     def __init__(self, reg: MetricRegistry, path: str, delimiter: str = ","):
-        self.registry = reg
+        super().__init__(reg)
         self.path = path
         self.delimiter = delimiter
 
     def report(self):
         ts = time.time()
         with open(self.path, "a") as f:
-            for name, vals in self.registry.snapshot().items():
+            for name, vals in self._rows():
                 row = [f"{ts:.3f}", name] + [
                     f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
                     for k, v in vals.items()]
                 f.write(self.delimiter.join(row) + "\n")
+
+
+class PeriodicReporter:
+    """Daemon-thread scheduler driving any reporter on an interval —
+    the dropwizard ScheduledReporter.start() analog.  ``stop()`` wakes
+    the thread immediately, joins it, and (by default) flushes one
+    final report so shutdown never loses the tail interval."""
+
+    def __init__(self, reporter, interval_s: float = 60.0):
+        self.reporter = reporter
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "PeriodicReporter":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="geomesa-metrics-reporter",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.reporter.report()
+            except Exception:  # a broken sink must not kill the thread
+                logging.getLogger("geomesa_tpu.metrics").warning(
+                    "metrics reporter failed", exc_info=True)
+
+    def stop(self, final_report: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_report:
+            try:
+                self.reporter.report()
+            except Exception:
+                pass
 
 
 #: process-wide default registry (the reference's shared MetricRegistry)

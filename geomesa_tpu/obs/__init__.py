@@ -1,0 +1,44 @@
+"""Unified observability: tracing + quantile metrics + recompile watch.
+
+The cross-cutting layer ISSUE 5 adds so a slow query on a 1B-row lean
+store decomposes into plan / range-decomposition / device dispatch /
+host-spill scan / cache-miss time instead of one opaque number:
+
+* :mod:`.trace` — Dapper-style spans with contextvar propagation,
+  always/ratio/slow samplers, ring + JSONL exporters, a slow-query log,
+  and the :func:`device_span` helper that attributes block-until-ready
+  device time to the owning query;
+* :mod:`.recompile` — the XLA recompile tracker (jax.monitoring
+  listener + wrapped-jit fallback) that turns silent retraces into
+  ``jax.compile.*`` metrics and span attributes;
+* :mod:`.prom` — Prometheus text exposition over metric snapshots
+  (p50/p95/p99 from the log-bucketed histograms in metrics.py).
+
+Everything configures through the ``geomesa.obs.*`` system properties
+(config.ObsProperties); docs/observability.md is the operator contract.
+"""
+
+from __future__ import annotations
+
+from ..config import ObsProperties
+from .prom import prometheus_text
+from .recompile import compile_count, counting_jit, install as \
+    install_recompile_tracker
+from .trace import (
+    AlwaysSampler, JsonlExporter, NeverSampler, RatioSampler,
+    RingExporter, Sampler, SlowOnlySampler, Span, Trace, Tracer,
+    current_span, current_trace_id, device_span, obs_count, span, tracer,
+)
+
+__all__ = ["Span", "Trace", "Tracer", "Sampler", "AlwaysSampler",
+           "NeverSampler", "RatioSampler", "SlowOnlySampler",
+           "RingExporter", "JsonlExporter", "tracer", "span",
+           "device_span", "current_span", "current_trace_id", "obs_count",
+           "prometheus_text", "compile_count", "counting_jit",
+           "install_recompile_tracker"]
+
+# the recompile listener is process-global and effectively free — hook
+# it as soon as observability loads (gated by the option so fully
+# instrumentation-silent runs stay possible)
+if ObsProperties.RECOMPILE_TRACK.to_bool():
+    install_recompile_tracker()

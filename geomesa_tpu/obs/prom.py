@@ -1,0 +1,56 @@
+"""Prometheus text exposition of a metrics snapshot.
+
+Renders a ``MetricRegistry.snapshot()`` (or a multihost-merged one from
+``parallel/stats.allreduce_metrics_snapshot``) in the text exposition
+format (version 0.0.4): counters as ``<name>_total``, histograms/timers
+as summaries with p50/p95/p99 quantile samples plus ``_sum``/``_count``
+— what ``GET /metrics.prom`` serves (web/app.py).
+
+Metric names sanitize dot-separated registry keys into the Prometheus
+charset under a ``geomesa_`` prefix (``query.pts.plan_ms`` →
+``geomesa_query_pts_plan_ms``).  Empty histograms render with zero
+quantiles — never ``inf``/``nan``, which scrapers reject.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+__all__ = ["prometheus_text"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: quantile sample keys in the snapshot → Prometheus quantile labels
+_QUANTILES = (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99"))
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if math.isnan(f) or math.isinf(f):
+        f = 0.0
+    return repr(round(f, 6))
+
+
+def metric_name(key: str) -> str:
+    return "geomesa_" + _NAME_RE.sub("_", key)
+
+
+def prometheus_text(snapshot: dict) -> str:
+    lines: list[str] = []
+    for key in sorted(snapshot):
+        vals = snapshot[key]
+        name = metric_name(key)
+        if "mean" not in vals:           # plain counter
+            lines.append(f"# TYPE {name}_total counter")
+            lines.append(f"{name}_total {int(vals.get('count', 0))}")
+            continue
+        lines.append(f"# TYPE {name} summary")
+        for skey, label in _QUANTILES:
+            lines.append(f'{name}{{quantile="{label}"}} '
+                         f"{_fmt(vals.get(skey, 0.0))}")
+        count = int(vals.get("count", 0))
+        total = vals.get("total", float(vals.get("mean", 0.0)) * count)
+        lines.append(f"{name}_sum {_fmt(total)}")
+        lines.append(f"{name}_count {count}")
+    return "\n".join(lines) + "\n"

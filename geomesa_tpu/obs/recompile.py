@@ -1,0 +1,118 @@
+"""XLA recompile tracking: turn silent retraces into a metric.
+
+The classic TPU-stack performance cliff is the SILENT recompile: a
+shape/dtype/static-arg drift re-traces a jitted program and a query
+that ran in 5 ms suddenly takes 20 s, with nothing in any log.  This
+module hooks jax's monitoring stream
+(``jax.monitoring.register_event_duration_secs_listener``): every
+``.../backend_compile_duration`` event increments ``jax.compile.count``,
+feeds its duration to the ``jax.compile.ms`` timer, and — when a query
+trace is active — stamps ``jax.recompiles`` onto the current span, so
+a slow trace SHOWS that it paid a compile.
+
+For jax builds without the monitoring API there is a wrapped-jit
+fallback: :func:`counting_jit` wraps ``jax.jit`` and counts executable-
+cache growth per call into ``jax.compile.fallback_count`` — coarser
+(no durations), and OPT-IN: it only sees functions a caller wrapped
+with it, so on listener-less builds the recompile budget covers
+exactly the jits routed through ``counting_jit`` (the budget tests
+check :func:`installed` and skip rather than pass vacuously).
+
+Installation is idempotent and happens at ``geomesa_tpu.obs`` import
+when ``geomesa.obs.recompile.track`` is on (the default).  jax offers
+no listener deregistration, so the hook lives for the process — it is
+a few counter increments per compile, i.e. free.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..metrics import (
+    JAX_COMPILE_COUNT, JAX_COMPILE_FALLBACK, JAX_COMPILE_MS,
+    registry as _metrics,
+)
+
+__all__ = ["install", "installed", "compile_count", "counting_jit",
+           "CountingJit"]
+
+_installed = False
+_lock = threading.Lock()
+
+
+def _on_duration(event: str, duration_secs: float, **kw) -> None:
+    if not event.endswith("backend_compile_duration"):
+        return
+    _metrics.counter(JAX_COMPILE_COUNT).inc()
+    _metrics.timer(JAX_COMPILE_MS).update(duration_secs * 1e3)
+    from .trace import current_span
+    sp = current_span()
+    if sp is not None:
+        sp.add_attr("jax.recompiles", 1)
+
+
+def install() -> bool:
+    """Register the compile-event listener (idempotent).  Returns
+    whether the listener is active — False means this jax has no
+    monitoring API and callers should lean on :func:`counting_jit`."""
+    global _installed
+    with _lock:
+        if _installed:
+            return True
+        try:
+            import jax.monitoring as monitoring
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return False
+        _installed = True
+        return True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def compile_count() -> int:
+    """Backend compiles seen so far — diff two readings around a warm
+    region to assert a recompile budget.  With the listener installed
+    this covers EVERY XLA backend compile in the process; without it,
+    it falls back to ``jax.compile.fallback_count``, which only counts
+    functions explicitly wrapped with :func:`counting_jit` — check
+    :func:`installed` when the budget must be process-wide."""
+    n = _metrics.counter(JAX_COMPILE_COUNT).count
+    if n == 0 and not _installed:
+        return _metrics.counter(JAX_COMPILE_FALLBACK).count
+    return n
+
+
+class CountingJit:
+    """Wrapped-jit fallback counter: delegates to ``jax.jit(fn)`` and
+    counts executable-cache growth after each call (each growth step =
+    one trace+compile) into ``jax.compile.fallback_count``."""
+
+    def __init__(self, fn, **jit_kwargs):
+        import jax
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        self._last_cache = 0
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+    def __call__(self, *args, **kwargs):
+        out = self._jitted(*args, **kwargs)
+        try:
+            n = int(self._jitted._cache_size())
+        except Exception:
+            return out
+        if n > self._last_cache:
+            _metrics.counter(JAX_COMPILE_FALLBACK).inc(n - self._last_cache)
+            self._last_cache = n
+        return out
+
+
+def counting_jit(fn=None, **jit_kwargs):
+    """``jax.jit`` drop-in that also counts recompiles (usable bare or
+    with jit kwargs, like the decorator it wraps)."""
+    if fn is None:
+        return lambda f: CountingJit(f, **jit_kwargs)
+    return CountingJit(fn, **jit_kwargs)

@@ -44,6 +44,7 @@ from ..index.attr_lean import (
     _SENTINEL_KEY, _HostAttrStack, _I64_MAX, _I64_MIN, SLOT_BYTES,
     encode_attr_value, encode_attr_values, string_prefix_bounds,
 )
+from ..obs import device_span, obs_count, span as obs_span
 from ..ops.search import (
     expand_ranges, gather_capacity, pad_pow2, searchsorted2,
 )
@@ -525,9 +526,13 @@ class ShardedLeanAttrIndex:
         sealed runs' GLOBAL partials cache identically on every
         process (agreed cache hits — no process strands a
         collective)."""
+        with obs_span("lean.sketch", attr=self.attr, sharded=True,
+                      generations=len(self.generations)):
+            return self._sketch_scan(fold)
+
+    def _sketch_scan(self, fold):
         from ..metrics import (
             LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
-            registry as _metrics,
         )
         from ..stats.sketch import RunSketch, fold_attr_runs
         from .stats import allreduce_run_sketch
@@ -541,7 +546,7 @@ class ShardedLeanAttrIndex:
         for g in self.generations:
             part = cache.get(g.gen_id) if g is not live else None
             if part is not None:
-                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                obs_count(LEAN_SKETCH_CACHE_HITS)
                 merged = merged + part
             elif g.tier == "device":
                 dev_scan.append(g)
@@ -556,19 +561,21 @@ class ShardedLeanAttrIndex:
             for g in padded:
                 cols += [g.keys, g.sec]
             self.dispatch_count += 1
-            prog = _sketch_program(self.mesh, len(padded),
-                                   int(fold.bins), int(fold.depth),
-                                   int(fold.width), is_float)
-            outs = prog(jnp.int64(fold.slo), jnp.int64(fold.shi),
-                        jnp.float64(fold.hlo), jnp.float64(fold.hhi),
-                        *cols)
-            cnt = _fetch_global(outs[0]).sum(axis=0)
-            kmin = _fetch_global(outs[1]).min(axis=0)
-            kmax = _fetch_global(outs[2]).max(axis=0)
-            vsum = _fetch_global(outs[3]).sum(axis=0)
-            vsumsq = _fetch_global(outs[4]).sum(axis=0)
-            hist = np.asarray(outs[5])
-            cms = np.asarray(outs[6])
+            with device_span("query.scan.device", stage="sketch",
+                             runs=len(dev_scan)):
+                prog = _sketch_program(self.mesh, len(padded),
+                                       int(fold.bins), int(fold.depth),
+                                       int(fold.width), is_float)
+                outs = prog(jnp.int64(fold.slo), jnp.int64(fold.shi),
+                            jnp.float64(fold.hlo),
+                            jnp.float64(fold.hhi), *cols)
+                cnt = _fetch_global(outs[0]).sum(axis=0)
+                kmin = _fetch_global(outs[1]).min(axis=0)
+                kmax = _fetch_global(outs[2]).max(axis=0)
+                vsum = _fetch_global(outs[3]).sum(axis=0)
+                vsumsq = _fetch_global(outs[4]).sum(axis=0)
+                hist = np.asarray(outs[5])
+                cms = np.asarray(outs[6])
             for i, g in enumerate(dev_scan):
                 n = int(cnt[i])
                 new_parts[id(g)] = RunSketch(
@@ -598,7 +605,7 @@ class ShardedLeanAttrIndex:
             p = new_parts[id(g)]
             merged = merged + p
             if g is not live:
-                _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                obs_count(LEAN_SKETCH_CACHE_MISSES)
                 self._sketch_cache.add(cache, g.gen_id, p)
         return merged
 

@@ -58,6 +58,7 @@ except ImportError:  # pragma: no cover — older jax
 from ..curve.binnedtime import TimePeriod, to_binned_time
 from ..index.z3 import Z3_INDEX_VERSION, plan_z3_query, z3_sfc_for_version
 from ..index.z3_lean import HostRun
+from ..obs import device_span, obs_count, span as obs_span
 from ..ops.search import (
     expand_ranges, gather_capacity, pad_boxes, pad_pow2, pad_ranges,
     searchsorted2,
@@ -900,24 +901,27 @@ class ShardedLeanZ3Index:
         qtlo = np.empty(n_q, dtype=np.int64)
         qthi = np.empty(n_q, dtype=np.int64)
         from ..index.z3_lean import _MAX_RANGES_PER_WINDOW, _bins_spanned
-        for q, (bxs, lo, hi) in enumerate(windows):
-            lo, hi = self._clamp_time(lo, hi)
-            qtlo[q], qthi[q] = lo, hi
-            bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
-            w_boxes.append(bxs)
-            # per-BIN range budget (see index/z3_lean.query_many):
-            # open/long intervals must not starve each bin into
-            # overcovering ranges
-            budget = min(max_ranges * _bins_spanned(lo, hi, self.period),
-                         _MAX_RANGES_PER_WINDOW)
-            plan = plan_z3_query(bxs, lo, hi, self.period, budget,
-                                 sfc=self.sfc)
-            if plan.num_ranges == 0:
-                continue
-            rbin.append(plan.rbin)
-            rzlo.append(plan.rzlo)
-            rzhi.append(plan.rzhi)
-            rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+        with obs_span("query.decompose", windows=n_q) as dsp:
+            for q, (bxs, lo, hi) in enumerate(windows):
+                lo, hi = self._clamp_time(lo, hi)
+                qtlo[q], qthi[q] = lo, hi
+                bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
+                w_boxes.append(bxs)
+                # per-BIN range budget (see index/z3_lean.query_many):
+                # open/long intervals must not starve each bin into
+                # overcovering ranges
+                budget = min(max_ranges * _bins_spanned(lo, hi,
+                                                        self.period),
+                             _MAX_RANGES_PER_WINDOW)
+                plan = plan_z3_query(bxs, lo, hi, self.period, budget,
+                                     sfc=self.sfc)
+                if plan.num_ranges == 0:
+                    continue
+                rbin.append(plan.rbin)
+                rzlo.append(plan.rzlo)
+                rzhi.append(plan.rzhi)
+                rqid.append(np.full(plan.num_ranges, q, dtype=np.int32))
+            dsp.set_attr("ranges", int(sum(len(r) for r in rbin)))
         if not rbin:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
         ra = pad_ranges(
@@ -946,8 +950,11 @@ class ShardedLeanZ3Index:
             for gen in padded:
                 count_cols += [gen.bins, gen.z]
             self.dispatch_count += 1
-            totals = _fetch_global(_count_program(self.mesh, len(padded))(
-                rb, rlo, rhi, *count_cols))        # (n_shards, G_pad)
+            with device_span("query.scan.device", stage="probe",
+                             runs=len(dev_gens)):
+                totals = _fetch_global(
+                    _count_program(self.mesh, len(padded))(
+                        rb, rlo, rhi, *count_cols))    # (n_shards, G_pad)
 
         exact_parts: list = []      # full tier — true hits already
         cand_parts: list = []       # keys/host — need the host mask
@@ -970,11 +977,13 @@ class ShardedLeanZ3Index:
         # runs (its local rows) — flat in run count, no dispatch at all
         # (round-4 VERDICT #9)
         if host_gens:
-            coded = self._host_runs_stack(host_gens).candidates(
-                ra["rbin"], ra["rzlo"], ra["rzhi"], ra["rqid"],
-                pos_bits)
-            if len(coded):
-                cand_parts.append(coded)
+            with obs_span("query.scan.host", stage="seek",
+                          runs=len(host_gens)):
+                coded = self._host_runs_stack(host_gens).candidates(
+                    ra["rbin"], ra["rzlo"], ra["rzhi"], ra["rqid"],
+                    pos_bits)
+                if len(coded):
+                    cand_parts.append(coded)
 
         mask_bits = (np.int64(1) << pos_bits) - 1
         flat = (np.concatenate(cand_parts) if cand_parts
@@ -989,23 +998,25 @@ class ShardedLeanZ3Index:
         else:
             rows = gids
             mine = np.ones(len(gids), dtype=bool)
-        x, yv, t = self._payload_flat()
-        keep = np.zeros(len(gids), dtype=bool)
-        lrows = rows[mine]
-        cx, cy, ct = x[lrows], yv[lrows], t[lrows]
-        lq = qids[mine]
-        k_local = np.zeros(len(lrows), dtype=bool)
-        for q in range(n_q):
-            sel = lq == q
-            if not sel.any():
-                continue
-            in_box = np.zeros(int(sel.sum()), dtype=bool)
-            for b in w_boxes[q]:
-                in_box |= ((cx[sel] >= b[0]) & (cy[sel] >= b[1])
-                           & (cx[sel] <= b[2]) & (cy[sel] <= b[3]))
-            k_local[sel] = (in_box & (ct[sel] >= qtlo[q])
-                            & (ct[sel] <= qthi[q]))
-        keep[mine] = k_local
+        with obs_span("query.scan.host", stage="recheck",
+                      candidates=int(len(gids))):
+            x, yv, t = self._payload_flat()
+            keep = np.zeros(len(gids), dtype=bool)
+            lrows = rows[mine]
+            cx, cy, ct = x[lrows], yv[lrows], t[lrows]
+            lq = qids[mine]
+            k_local = np.zeros(len(lrows), dtype=bool)
+            for q in range(n_q):
+                sel = lq == q
+                if not sel.any():
+                    continue
+                in_box = np.zeros(int(sel.sum()), dtype=bool)
+                for b in w_boxes[q]:
+                    in_box |= ((cx[sel] >= b[0]) & (cy[sel] >= b[1])
+                               & (cx[sel] <= b[2]) & (cy[sel] <= b[3]))
+                k_local[sel] = (in_box & (ct[sel] >= qtlo[q])
+                                & (ct[sel] <= qthi[q]))
+            keep[mine] = k_local
         coded_hits = flat[keep]
         if self._multihost:
             from .multihost import allgather_concat
@@ -1072,8 +1083,10 @@ class ShardedLeanZ3Index:
             for gen in padded:
                 count_cols += [gen.bins, gen.z]
             self.dispatch_count += 1
-            totals = _fetch_global(_count_program(
-                self.mesh, len(padded))(rb, rlo, rhi, *count_cols))
+            with device_span("query.scan.device", stage="probe",
+                             runs=len(dev_gens)):
+                totals = _fetch_global(_count_program(
+                    self.mesh, len(padded))(rb, rlo, rhi, *count_cols))
 
         def _cap(tier_totals, n_padded):
             per_gen = gather_capacity(int(tier_totals.max()),
@@ -1089,10 +1102,13 @@ class ShardedLeanZ3Index:
             tenv = jnp.asarray(np.array(list(env_t) + [lo, hi],
                                         np.float64))
             self.dispatch_count += 1
-            grid += np.asarray(_density_program_full(
-                self.mesh, len(padded), cap, width, height, self.sfc)(
-                rb, rlo, rhi, jnp.asarray(bxs), tenv, *cols),
-                np.float64)
+            with device_span("query.scan.device", tier="full",
+                             runs=len(full_gens)):
+                grid += np.asarray(_density_program_full(
+                    self.mesh, len(padded), cap, width, height,
+                    self.sfc)(
+                    rb, rlo, rhi, jnp.asarray(bxs), tenv, *cols),
+                    np.float64)
         if keys_gens and int(totals[:, len(full_gens):len(dev_gens)]
                              .sum()):
             padded = self._pad_bucket(keys_gens, "keys")
@@ -1102,10 +1118,13 @@ class ShardedLeanZ3Index:
             for gen in padded:
                 cols += [gen.bins, gen.z]
             self.dispatch_count += 1
-            grid += np.asarray(_density_program_keys(
-                self.mesh, len(padded), cap, width, height, self.sfc)(
-                rb, rlo, rhi, jnp.asarray(ixy), jnp.asarray(tb),
-                jnp.asarray(np.asarray(env_t)), *cols), np.float64)
+            with device_span("query.scan.device", tier="keys",
+                             runs=len(keys_gens)):
+                grid += np.asarray(_density_program_keys(
+                    self.mesh, len(padded), cap, width, height,
+                    self.sfc)(
+                    rb, rlo, rhi, jnp.asarray(ixy), jnp.asarray(tb),
+                    jnp.asarray(np.asarray(env_t)), *cols), np.float64)
         host_part = np.zeros((height, width), np.float64)
         if host_gens:
             host_part = self._host_runs_stack(host_gens).density_partial(
@@ -1157,7 +1176,7 @@ class ShardedLeanZ3Index:
         for g in self.generations:
             part = cache.get(g.gen_id) if g is not live else None
             if part is not None:
-                _metrics.counter(LEAN_SKETCH_CACHE_HITS).inc()
+                obs_count(LEAN_SKETCH_CACHE_HITS)
                 total += part
             elif g.tier == "host":
                 host_scan.append(g)
@@ -1179,10 +1198,10 @@ class ShardedLeanZ3Index:
                 part = np.array(stacked[i])
                 total += part
                 if g is not live:
-                    _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+                    obs_count(LEAN_SKETCH_CACHE_MISSES)
                     self._sketch_cache.add(cache, g.gen_id, part)
         for g in host_scan:
-            _metrics.counter(LEAN_SKETCH_CACHE_MISSES).inc()
+            obs_count(LEAN_SKETCH_CACHE_MISSES)
             local = np.zeros(nb << bits, np.int64)
             for run in g.runs:
                 local += run.cell_counts(b0, nb, int(bits))
@@ -1262,22 +1281,26 @@ class ShardedLeanZ3Index:
                     for g in range(len(gens)) if int(gen_tot[g])]
         parts = []
         for group, cap in zip(groups, caps):
-            scan_cols: list = []
-            for gen in group:
+            with device_span("query.scan.device", tier=tier,
+                             runs=len(group)):
+                scan_cols: list = []
+                for gen in group:
+                    if tier == "full":
+                        scan_cols += [gen.bins, gen.z, gen.pos,
+                                      gen.x, gen.y, gen.t]
+                    else:
+                        scan_cols += [gen.bins, gen.z, gen.pos]
+                self.dispatch_count += 1
                 if tier == "full":
-                    scan_cols += [gen.bins, gen.z, gen.pos,
-                                  gen.x, gen.y, gen.t]
+                    packed = _fetch_global(_scan_program_exact(
+                        self.mesh, len(group), cap, pos_bits)(
+                        rb, rlo, rhi, rq, *exact_args, *scan_cols))
                 else:
-                    scan_cols += [gen.bins, gen.z, gen.pos]
-            self.dispatch_count += 1
-            if tier == "full":
-                packed = _fetch_global(_scan_program_exact(
-                    self.mesh, len(group), cap, pos_bits)(
-                    rb, rlo, rhi, rq, *exact_args, *scan_cols))
-            else:
-                packed = _fetch_global(_scan_program(
-                    self.mesh, len(group), cap, pos_bits)(
-                    rb, rlo, rhi, rq, *scan_cols))
+                    packed = _fetch_global(_scan_program(
+                        self.mesh, len(group), cap, pos_bits)(
+                        rb, rlo, rhi, rq, *scan_cols))
+            # host-side filtering after the span — device_ms must not
+            # absorb numpy post-processing (see z3_lean._scan_tier)
             part = packed.ravel()
             parts.append(part[part >= 0])
         return parts

@@ -33,7 +33,28 @@ from ..stats.stat import Stat, parse_stat
 
 __all__ = ["sharded_stats_scan", "sharded_frequency_scan",
            "merged_stats", "merged_arrow", "allreduce_run_sketch",
-           "allreduce_counts"]
+           "allreduce_counts", "allreduce_metrics_snapshot"]
+
+
+def allreduce_metrics_snapshot(reg=None) -> dict:
+    """One metrics snapshot for the WHOLE mesh: every process's
+    registry snapshot (bucket-bearing form) allgathers as JSON and
+    folds through :func:`~geomesa_tpu.metrics.merge_snapshots` —
+    counters sum, histogram moments and log-bucket tables merge, and
+    p50/p95/p99 recompute over the union, so one ``/metrics.prom``
+    scrape reflects every host (ISSUE 5).  Identity (modulo quantile
+    recompute) under one process.  COLLECTIVE under multihost — every
+    process must call it together, like the stat reducers above."""
+    from ..metrics import merge_snapshots, registry as _registry
+    local = (reg if reg is not None else _registry).snapshot(buckets=True)
+    if jax.process_count() == 1:
+        return merge_snapshots([local])
+    import json
+
+    from .multihost import allgather_strings
+    blobs = allgather_strings(
+        np.array([json.dumps(local)], dtype=object))
+    return merge_snapshots([json.loads(b) for b in blobs])
 
 
 def allreduce_run_sketch(part):

@@ -93,8 +93,10 @@ class QueryPlanner:
                     f"query on {self.sft.name!r} exceeded "
                     f"{timeout_s}s during {stage}")
 
+        from ..obs import span as obs_span
         from ..utils.profiling import profile
-        with profile("query.plan") as plan_span:
+        with profile("query.plan") as plan_span, \
+                obs_span("query.plan") as psp:
             # multihost: global count + merged stats — every process
             # must cost strategies identically or the collective
             # dispatches would diverge (deadlock)
@@ -110,29 +112,35 @@ class QueryPlanner:
                                 if lean else None))
             strategy = decider.decide(query.filter, explain,
                                       forced=query.hints.get("QUERY_INDEX"))
+            psp.set_attr("strategy", strategy.index)
         plan_ms = plan_span.ms
         check_deadline("planning")
 
         mh = getattr(store, "multihost", False)
         t1 = time.perf_counter()
-        with profile("query.scan"):
+        with profile("query.scan"), \
+                obs_span("query.scan", strategy=strategy.index) as ssp:
             candidates = self._scan(strategy, query, explain)
+            ssp.set_attr("candidates",
+                         -1 if candidates is None else int(len(candidates)))
         check_deadline("index scan")
-        if candidates is None:  # full scan (of this process's rows)
-            mask = evaluate_filter(query.filter, batch)
-            positions = np.flatnonzero(mask)
-        else:
-            # multihost: candidates are GLOBAL gids — each process
-            # residual-filters only ITS gid-decoded rows, next to the
-            # data (the server-side filter role; no global batch exists)
-            cand = (store.local_rows_of(candidates) if mh
-                    else candidates)
-            if len(cand):
-                sub = batch.take(cand)
-                mask = evaluate_filter(query.filter, sub)
-                positions = cand[mask]
+        with obs_span("query.post_filter") as fsp:
+            if candidates is None:  # full scan (of this process's rows)
+                mask = evaluate_filter(query.filter, batch)
+                positions = np.flatnonzero(mask)
             else:
-                positions = np.asarray(cand, dtype=np.int64)
+                # multihost: candidates are GLOBAL gids — each process
+                # residual-filters only ITS gid-decoded rows, next to the
+                # data (the server-side filter role; no global batch exists)
+                cand = (store.local_rows_of(candidates) if mh
+                        else candidates)
+                if len(cand):
+                    sub = batch.take(cand)
+                    mask = evaluate_filter(query.filter, sub)
+                    positions = cand[mask]
+                else:
+                    positions = np.asarray(cand, dtype=np.int64)
+            fsp.set_attr("hits", int(len(positions)))
         scan_ms = (time.perf_counter() - t1) * 1000
         check_deadline("filtering")
         explain(lambda: f"Scan: {len(positions)} hits "

@@ -23,9 +23,16 @@ Routes::
     GET    /api/stats/{name}/topk?attribute=
     GET    /api/audit/{name}?since=          query-event readback
     GET    /api/metrics                      request + store metrics dump
+    GET    /metrics.prom                     Prometheus text exposition
+    GET    /traces?slow=1                    recent (or slow-log) traces
+    GET    /traces/{trace_id}                full span tree of one trace
 
 Per-request metrics are recorded in the global registry (the reference's
-servlet-level ``AggregatedMetricsFilter``).
+servlet-level ``AggregatedMetricsFilter``).  The trace endpoints read
+the process tracer's ring buffer and slow-query log (obs/trace.py);
+``/metrics.prom`` serves p50/p95/p99 summaries from the log-bucketed
+histograms, merged across the whole mesh under multihost
+(parallel/stats.allreduce_metrics_snapshot).
 """
 
 from __future__ import annotations
@@ -70,6 +77,10 @@ class WebApp:
             (r"^/api/stats/([^/]+)/([a-z]+)$", self._stats),
             (r"^/api/audit/([^/]+)$", self._audit_events),
             (r"^/api/metrics$", self._metrics_dump),
+            (r"^/metrics\.prom$", self._metrics_prom),
+            (r"^/api/metrics\.prom$", self._metrics_prom),
+            (r"^/traces$", self._traces),
+            (r"^/traces/([^/]+)$", self._trace_item),
             (r"^/api/blob$", self._blob_index),
             (r"^/api/blob/([^/]+)$", self._blob_item),
             (r"^/wcs$", self._wcs),
@@ -247,6 +258,48 @@ class WebApp:
 
     def _metrics_dump(self, method, params, environ):
         return 200, _metrics.snapshot()
+
+    def _metrics_prom(self, method, params, environ):
+        """Prometheus text exposition (p50/p95/p99 summaries from the
+        log-bucketed histograms).  Serves THIS process's registry by
+        default — safe for a normal scraper that hits one host.  On a
+        multihost store, ``?mesh=1`` merges every process's registry so
+        one response reflects the whole mesh, but that path is a
+        blocking COLLECTIVE: it must be driven identically on every
+        process (an SPMD metrics job, not a single-endpoint scraper —
+        a lone scrape would strand the mesh in the allgather)."""
+        if method != "GET":
+            raise HttpError(405, method)
+        from ..obs import prometheus_text
+        if (params.get("mesh") in ("1", "true", "yes")
+                and getattr(self.store, "_multihost", False)):
+            from ..parallel.stats import allreduce_metrics_snapshot
+            snap = allreduce_metrics_snapshot()
+        else:
+            snap = _metrics.snapshot()
+        return 200, prometheus_text(snap), "text/plain; version=0.0.4"
+
+    def _traces(self, method, params, environ):
+        """Recent traces (ring buffer), or the slow-query log with
+        ``?slow=1`` — newest last, summaries only."""
+        if method != "GET":
+            raise HttpError(405, method)
+        from ..obs import tracer
+        if params.get("slow") in ("1", "true", "yes"):
+            traces = tracer.slow_log.traces()
+        else:
+            ring = tracer.ring
+            traces = ring.traces() if ring is not None else []
+        return 200, [t.summary() for t in traces]
+
+    def _trace_item(self, method, params, environ, trace_id):
+        if method != "GET":
+            raise HttpError(405, method)
+        from ..obs import tracer
+        t = tracer.find(trace_id)
+        if t is None:
+            raise HttpError(404, f"no such trace: {trace_id!r}")
+        return 200, t.to_json()
 
     # -- WCS-shaped raster serving (geomesa-accumulo-raster WCS role) -----
     def _wcs(self, method, params, environ):

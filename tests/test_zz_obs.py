@@ -1,0 +1,455 @@
+"""Observability layer (ISSUE 5): tracing through the query path,
+quantile metrics, Prometheus/trace web surface, recompile budget,
+unified audit, reporters.
+
+The lean-store trace test is the acceptance shape: one traced query
+yields ONE trace whose spans cover plan / decompose / scan-device /
+scan-host / post-filter with device-ms and cache attributes.
+"""
+
+import io
+import json
+import re
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import obs
+from geomesa_tpu.audit import InMemoryAuditWriter
+from geomesa_tpu.config import clear_property, set_property
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.metrics import (
+    DelimitedFileReporter, LoggingReporter, MetricRegistry,
+    PeriodicReporter, merge_snapshots, registry,
+)
+
+MS = 1514764800000
+DAY = 86_400_000
+
+LEAN_Q = ("BBOX(geom,-74.5,40.5,-73.5,41.5) AND dtg DURING "
+          "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+
+
+def _mk_lean_store(audit=None, n=40_000):
+    rng = np.random.default_rng(23)
+    ds = TpuDataStore(audit_writer=audit, user="obs-test")
+    # the tight HBM budget forces real tiering (live full-tier run +
+    # demoted host spills), so a traced query exercises device AND
+    # host scan phases — the acceptance trace shape
+    ds.create_schema(
+        "evt", "score:Double,dtg:Date,*geom:Point;"
+               "geomesa.index.profile=lean,"
+               "geomesa.lean.generation.slots=16384,"
+               "geomesa.lean.compaction.factor=0,"
+               "geomesa.lean.hbm.budget=700000")
+    for s in range(0, n, 16_000):    # several sealed generations
+        m = min(16_000, n - s)
+        ds.write("evt", {
+            "score": rng.uniform(0, 100, m),
+            "dtg": rng.integers(MS, MS + 14 * DAY, m),
+            "geom": (rng.uniform(-75, -73, m), rng.uniform(40, 42, m))})
+    return ds
+
+
+@pytest.fixture(scope="module")
+def lean_ds():
+    audit = InMemoryAuditWriter()
+    ds = _mk_lean_store(audit=audit)
+    ds._obs_audit = audit
+    return ds
+
+
+def _call(app, method, path):
+    cap = {}
+
+    def sr(status, headers):
+        cap["status"] = int(status.split()[0])
+        cap["headers"] = dict(headers)
+
+    qs = ""
+    if "?" in path:
+        path, qs = path.split("?", 1)
+    body = b"".join(app({
+        "REQUEST_METHOD": method, "PATH_INFO": path, "QUERY_STRING": qs,
+        "CONTENT_LENGTH": "0", "wsgi.input": io.BytesIO(b"")}, sr))
+    return cap["status"], cap["headers"], body.decode()
+
+
+# -- tracing through the query path ---------------------------------------
+
+def test_traced_lean_query_single_trace_covers_phases(lean_ds):
+    lean_ds.query("evt", LEAN_Q)           # warm/compile
+    audit = lean_ds._obs_audit
+    got = lean_ds.query_result("evt", LEAN_Q)
+    assert len(got.positions) > 0
+    ev = audit.events[-1]
+    assert ev.trace_id, "audit event must carry the trace id"
+    tr = obs.tracer.find(ev.trace_id)
+    assert tr is not None
+    # ONE trace: every span shares the trace id
+    assert {s.trace_id for s in tr.spans} == {ev.trace_id}
+    names = {s.name for s in tr.spans}
+    assert {"query", "query.plan", "query.decompose",
+            "query.scan.device", "query.scan.host",
+            "query.post_filter"} <= names
+    root = tr.root_span
+    assert root.name == "query"
+    assert root.attributes["schema"] == "evt"
+    assert root.attributes["hits"] == len(got.positions)
+    # device attribution rolled up onto the root
+    assert root.attributes.get("device_ms", 0) > 0
+    dev = [s for s in tr.spans if s.name == "query.scan.device"]
+    assert dev and all(s.attributes["device_ms"] >= 0 for s in dev)
+    assert any("runs" in s.attributes for s in dev)
+    # children nest under the root's tree (parent ids resolve in-trace)
+    ids = {s.span_id for s in tr.spans}
+    assert all(s.parent_id in ids for s in tr.spans
+               if s.parent_id is not None)
+
+
+def test_density_trace_carries_cache_attribution():
+    # keys-tier generations (payload_on_device=False): the tier whose
+    # sealed density partials cache — full-tier runs re-scan by design
+    from geomesa_tpu.index.z3_lean import LeanZ3Index
+    rng = np.random.default_rng(29)
+    idx = LeanZ3Index(period="week", generation_slots=8192,
+                      payload_on_device=False)
+    for _ in range(3):
+        m = 8192
+        idx.append(rng.uniform(-75, -73, m), rng.uniform(40, 42, m),
+                   rng.integers(MS, MS + 14 * DAY, m))
+    idx.block()
+    box = [(-74.5, 40.5, -73.5, 41.5)]
+    args = (box, MS + 2 * DAY, MS + 9 * DAY, (-180, -90, 180, 90), 64, 64)
+    cold = idx.density(*args)               # cold: seeds the cache
+    warm = idx.density(*args)
+    np.testing.assert_array_equal(cold, warm)
+    ring = obs.tracer.ring
+    traces = [t for t in ring.traces() if t.name == "lean.density"]
+    cold_tr, warm_tr = traces[-2], traces[-1]
+    assert cold_tr.root_span.attributes.get(
+        "lean.density.cache.misses", 0) > 0
+    assert warm_tr.root_span.attributes.get(
+        "lean.density.cache.hits", 0) > 0
+
+
+def test_windows_fast_path_audits_like_planner_path():
+    """Satellite: the batched-windows fast path routes through _audit —
+    same registry keys, same event shape, trace_id included."""
+    audit = InMemoryAuditWriter()
+    rng = np.random.default_rng(5)
+    ds = TpuDataStore(audit_writer=audit, user="w")
+    ds.create_schema("pts", "dtg:Date,*geom:Point")
+    n = 5_000
+    ds.write("pts", {
+        "dtg": rng.integers(MS, MS + 7 * DAY, n),
+        "geom": (rng.uniform(-75, -73, n), rng.uniform(40, 42, n))})
+    windows = [([(-74.5, 40.5, -73.5, 41.5)], MS, MS + 3 * DAY),
+               ([(-75.0, 40.0, -74.0, 41.0)], MS + DAY, MS + 5 * DAY)]
+    c0 = registry.counter("query.pts.count").count
+    t0 = registry.timer("query.pts.plan_ms").count
+    s0 = registry.timer("query.pts.scan_ms").count
+    hits = ds.query_windows("pts", windows)
+    assert registry.counter("query.pts.count").count == c0 + 1
+    # planning never ran (the fast path plans inside the index): the
+    # plan_ms timer must get NO phantom-zero sample
+    assert registry.timer("query.pts.plan_ms").count == t0
+    assert registry.timer("query.pts.scan_ms").count == s0 + 1
+    ev = audit.events[-1]
+    assert ev.filter == "batched windows[2]"
+    assert ev.hits == int(sum(len(h) for h in hits))
+    assert ev.trace_id and obs.tracer.find(ev.trace_id) is not None
+    # identical record shape as the planner path
+    planner_ev = None
+    ds.query("pts", "BBOX(geom,-74.5,40.5,-73.5,41.5)")
+    planner_ev = audit.events[-1]
+    assert set(json.loads(ev.to_json())) == set(
+        json.loads(planner_ev.to_json()))
+
+
+def test_slow_query_log_threshold_honored(lean_ds):
+    set_property("geomesa.obs.slow.ms", 1e9)
+    try:
+        n0 = len(lean_ds and obs.tracer.slow_log)
+        lean_ds.query("evt", LEAN_Q)
+        assert len(obs.tracer.slow_log) == n0
+        set_property("geomesa.obs.slow.ms", 0.0001)
+        lean_ds.query("evt", LEAN_Q)
+        assert len(obs.tracer.slow_log) == n0 + 1
+        slow = obs.tracer.slow_log.traces()[-1]
+        assert slow.name == "query" and len(slow.spans) > 1
+    finally:
+        clear_property("geomesa.obs.slow.ms")
+
+
+def test_ratio_declined_slow_query_still_logged(lean_ds):
+    """A slow query the ratio sampler head-declined must still be kept
+    in the slow log (records, but routes only there)."""
+    set_property("geomesa.obs.sampler", "ratio")
+    set_property("geomesa.obs.sample.ratio", 0.0)
+    set_property("geomesa.obs.slow.ms", 0.0001)
+    try:
+        n0 = len(obs.tracer.slow_log)
+        r0 = len(obs.tracer.ring)
+        lean_ds.query("evt", LEAN_Q)
+        assert len(obs.tracer.slow_log) == n0 + 1
+        assert len(obs.tracer.ring) == r0        # never exported
+        slow = obs.tracer.slow_log.traces()[-1]
+        assert slow.name == "query" and len(slow.spans) > 1
+    finally:
+        clear_property("geomesa.obs.sampler")
+        clear_property("geomesa.obs.sample.ratio")
+        clear_property("geomesa.obs.slow.ms")
+
+
+def test_sampler_knobs_live(lean_ds):
+    ring = obs.tracer.ring
+    set_property("geomesa.obs.sampler", "never")
+    try:
+        n0 = len(ring)
+        lean_ds.query("evt", LEAN_Q)
+        assert len(ring) == n0
+        set_property("geomesa.obs.sampler", "ratio")
+        set_property("geomesa.obs.sample.ratio", 0.0)
+        lean_ds.query("evt", LEAN_Q)
+        assert len(ring) == n0
+        set_property("geomesa.obs.sampler", "always")
+        lean_ds.query("evt", LEAN_Q)
+        assert len(ring) == n0 + 1
+    finally:
+        clear_property("geomesa.obs.sampler")
+        clear_property("geomesa.obs.sample.ratio")
+
+
+def test_obs_disabled_yields_noop_spans(lean_ds):
+    set_property("geomesa.obs.enabled", False)
+    try:
+        n0 = len(obs.tracer.ring)
+        with obs.span("query") as sp:
+            assert not sp.recording
+        lean_ds.query("evt", LEAN_Q)
+        assert len(obs.tracer.ring) == n0
+        assert obs.current_trace_id() == ""
+    finally:
+        clear_property("geomesa.obs.enabled")
+
+
+def test_compaction_traced_and_timed():
+    from geomesa_tpu.index.z3_lean import LeanZ3Index
+    rng = np.random.default_rng(31)
+    idx = LeanZ3Index(period="week", generation_slots=4096,
+                      payload_on_device=False)
+    for _ in range(5):
+        m = 4096
+        idx.append(rng.uniform(-180, 180, m), rng.uniform(-90, 90, m),
+                   rng.integers(MS, MS + 14 * DAY, m))
+    idx.block()
+    t0 = registry.timer("lean.compaction.ms").count
+    stats = idx.compact(factor=2)
+    assert stats["merged_groups"] >= 1
+    assert registry.timer("lean.compaction.ms").count > t0
+    traces = [t for t in obs.tracer.ring.traces()
+              if t.name == "lean.compaction"]
+    assert traces and traces[-1].root_span.attributes[
+        "merged_groups"] == stats["merged_groups"]
+
+
+# -- recompile tracking ----------------------------------------------------
+
+def test_recompile_budget_zero_across_warm_lean_queries(lean_ds):
+    from geomesa_tpu.obs import recompile
+    if not recompile.installed():           # listener-less jax build:
+        pytest.skip("jax.monitoring listener unavailable")  # no vacuous 0
+    lean_ds.query("evt", LEAN_Q)            # warm every compile bucket
+    lean_ds.query("evt", LEAN_Q)
+    c0 = obs.compile_count()
+    for _ in range(3):
+        lean_ds.query("evt", LEAN_Q)
+    assert obs.compile_count() - c0 == 0, \
+        "warm repeated lean queries must not retrace"
+
+
+def test_recompile_listener_counts_fresh_compiles():
+    import jax
+    import jax.numpy as jnp
+    c0 = obs.compile_count()
+    f = jax.jit(lambda x: x * 3 + 1)
+    f(jnp.arange(7))                        # fresh shape -> compile
+    assert obs.compile_count() > c0
+    c1 = obs.compile_count()
+    f(jnp.arange(7))                        # warm -> no compile
+    assert obs.compile_count() == c1
+
+
+def test_counting_jit_fallback_counter():
+    import jax.numpy as jnp
+    from geomesa_tpu.metrics import JAX_COMPILE_FALLBACK
+    f = obs.counting_jit(lambda x: x - 2)
+    c0 = registry.counter(JAX_COMPILE_FALLBACK).count
+    f(jnp.arange(5))
+    assert registry.counter(JAX_COMPILE_FALLBACK).count == c0 + 1
+    f(jnp.arange(5))                        # cache hit: no growth
+    assert registry.counter(JAX_COMPILE_FALLBACK).count == c0 + 1
+    f(jnp.arange(9))                        # new shape
+    assert registry.counter(JAX_COMPILE_FALLBACK).count == c0 + 2
+
+
+# -- quantile metrics ------------------------------------------------------
+
+def test_histogram_quantiles_within_bucket_error():
+    reg = MetricRegistry()
+    h = reg.histogram("h")
+    for v in range(1, 1001):
+        h.update(float(v))
+    assert abs(h.quantile(0.5) - 500) / 500 < 0.16
+    assert abs(h.quantile(0.95) - 950) / 950 < 0.16
+    assert abs(h.quantile(0.99) - 990) / 990 < 0.16
+    snap = reg.snapshot()["h"]
+    assert snap["p50"] == h.quantile(0.5)
+    assert snap["min"] == 1.0 and snap["max"] == 1000.0
+
+
+def test_empty_histogram_snapshot_is_finite():
+    reg = MetricRegistry()
+    reg.timer("t")                          # never updated
+    snap = reg.snapshot()["t"]
+    for v in snap.values():
+        assert np.isfinite(v)
+    assert snap["p50"] == 0.0 and snap["p99"] == 0.0
+
+
+def test_merge_snapshots_sums_and_requantiles():
+    a, b = MetricRegistry(), MetricRegistry()
+    a.counter("c").inc(3)
+    b.counter("c").inc(4)
+    for v in range(1, 501):
+        a.histogram("h").update(float(v))
+    for v in range(501, 1001):
+        b.histogram("h").update(float(v))
+    merged = merge_snapshots([a.snapshot(buckets=True),
+                              b.snapshot(buckets=True)])
+    assert merged["c"] == {"count": 7}
+    assert merged["h"]["count"] == 1000
+    assert merged["h"]["min"] == 1.0 and merged["h"]["max"] == 1000.0
+    assert abs(merged["h"]["p50"] - 500) / 500 < 0.16
+    # single-process identity path returns the same shape
+    lone = merge_snapshots([a.snapshot(buckets=True)])
+    assert lone["h"]["count"] == 500 and "buckets" not in lone["h"]
+
+
+def test_allreduce_metrics_snapshot_single_process():
+    from geomesa_tpu.parallel.stats import allreduce_metrics_snapshot
+    reg = MetricRegistry()
+    reg.counter("x").inc(2)
+    reg.timer("t").update(5.0)
+    snap = allreduce_metrics_snapshot(reg)
+    assert snap["x"]["count"] == 2
+    assert snap["t"]["count"] == 1 and "p95" in snap["t"]
+
+
+# -- reporters -------------------------------------------------------------
+
+def test_reporters_emit_interval_deltas(tmp_path, caplog):
+    reg = MetricRegistry()
+    reg.counter("c").inc(3)
+    path = tmp_path / "m.csv"
+    rep = DelimitedFileReporter(reg, str(path))
+    rep.report()
+    reg.counter("c").inc(2)
+    rep.report()
+    rows = [ln for ln in path.read_text().splitlines() if ",c," in ln]
+    assert "delta=3" in rows[0] and "count=3" in rows[0]
+    assert "delta=2" in rows[1] and "count=5" in rows[1]
+
+    import logging
+    lrep = LoggingReporter(reg)
+    with caplog.at_level(logging.INFO, logger="geomesa_tpu.metrics"):
+        lrep.report()
+        reg.counter("c").inc(1)
+        lrep.report()
+    msgs = [r.getMessage() for r in caplog.records if r.args
+            and r.args[0] == "c"]
+    assert "'delta': 5" in msgs[0] and "'delta': 1" in msgs[1]
+
+
+def test_periodic_reporter_runs_and_stops(tmp_path):
+    reg = MetricRegistry()
+    reg.counter("c").inc(1)
+    rep = DelimitedFileReporter(reg, str(tmp_path / "p.csv"))
+    per = PeriodicReporter(rep, interval_s=0.02).start()
+    time.sleep(0.1)
+    per.stop()
+    assert per._thread is None
+    lines = (tmp_path / "p.csv").read_text().splitlines()
+    assert len(lines) >= 2                   # ticked + final flush
+    n = len(lines)
+    time.sleep(0.06)                         # no further ticks after stop
+    assert len((tmp_path / "p.csv").read_text().splitlines()) == n
+
+
+# -- web surface -----------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{quantile=\"[0-9.]+\"\})? -?[0-9]"
+    r"[0-9.e+-]*$")
+
+
+def test_prometheus_exposition_parses(lean_ds):
+    from geomesa_tpu.web import WebApp
+    registry.timer("obs.test.empty_ms")      # empty histogram in the dump
+    lean_ds.query("evt", LEAN_Q)
+    app = WebApp(lean_ds)
+    status, headers, body = _call(app, "GET", "/metrics.prom")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "inf" not in body and "nan" not in body.lower()
+    for line in body.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(counter|summary)$", line), line
+        else:
+            assert _PROM_LINE.match(line), line
+    assert 'geomesa_query_evt_scan_ms{quantile="0.5"}' in body
+    assert 'geomesa_query_evt_scan_ms{quantile="0.99"}' in body
+    assert "geomesa_query_evt_count_total" in body
+    assert "geomesa_obs_test_empty_ms_count 0" in body
+
+
+def test_traces_endpoints_roundtrip(lean_ds):
+    from geomesa_tpu.web import WebApp
+    got = lean_ds.query_result("evt", LEAN_Q)
+    audit = lean_ds._obs_audit
+    tid = audit.events[-1].trace_id
+    app = WebApp(lean_ds)
+    status, _, body = _call(app, "GET", "/traces")
+    assert status == 200
+    summaries = json.loads(body)
+    assert any(s["trace_id"] == tid for s in summaries)
+    status, _, body = _call(app, "GET", f"/traces/{tid}")
+    assert status == 200
+    full = json.loads(body)
+    assert full["trace_id"] == tid
+    names = {s["name"] for s in full["spans"]}
+    assert {"query", "query.plan", "query.decompose",
+            "query.post_filter"} <= names
+    root = [s for s in full["spans"] if s["parent_id"] is None][0]
+    assert root["attributes"]["hits"] == len(got.positions)
+    status, _, _ = _call(app, "GET", "/traces/deadbeef")
+    assert status == 404
+    # slow listing stays a list
+    status, _, body = _call(app, "GET", "/traces?slow=1")
+    assert status == 200 and isinstance(json.loads(body), list)
+
+
+def test_jsonl_exporter_roundtrip(tmp_path):
+    exp = obs.JsonlExporter(str(tmp_path / "traces.jsonl"))
+    t = obs.Tracer(sampler=obs.AlwaysSampler(), exporters=[exp])
+    with t.span("query", schema="x"):
+        with t.span("query.plan"):
+            pass
+    exp.close()
+    lines = (tmp_path / "traces.jsonl").read_text().splitlines()
+    rec = json.loads(lines[-1])
+    assert rec["name"] == "query"
+    assert [s["name"] for s in rec["spans"]] == ["query.plan", "query"]
