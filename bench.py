@@ -64,6 +64,30 @@ def _median_time(fn, iters=5):
     return (times[mid - 1] + times[mid]) / 2
 
 
+def _mem_probe() -> dict:
+    """Memory footprint at stanza completion (ISSUE 9): the process
+    peak host RSS (a cumulative high-water mark — stanzas run in a
+    fixed order, so same-stanza comparisons across rounds are
+    apples-to-apples) and total live device-resident bytes.  Both feed
+    the regression gate's storage direction (lower is better), so a
+    memory regression fails as loudly as a perf one."""
+    out: dict = {}
+    try:
+        import resource
+        out["peak_rss_mb"] = round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+            1)   # linux ru_maxrss is KiB
+    except Exception:
+        pass
+    try:
+        import jax
+        out["device_resident_bytes"] = int(sum(
+            int(getattr(a, "nbytes", 0)) for a in jax.live_arrays()))
+    except Exception:
+        pass
+    return out
+
+
 def main():
     _enable_compile_cache()
     import jax
@@ -514,6 +538,13 @@ def _compact_summary(full: dict) -> dict:
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
+            # storage direction (ISSUE 9): peak RSS is a process
+            # high-water mark so the final probe covers every stanza,
+            # but device residency is a point sample — take the MAX
+            # across the per-stanza probes so a stanza that ballooned
+            # HBM and freed it before the end still gates; the FULL
+            # record keeps the per-stanza values for attribution
+            "mem": _mem_highwater(ex),
             "full_record": "BENCH_FULL.json",
             "device": ex["device"],
         },
@@ -573,6 +604,7 @@ def _scale_stanza() -> dict:
                 progress=lambda *_: None, record=False)
         except Exception as e:
             out["store_live_error"] = repr(e)
+    out.update(_mem_probe())
     return out
 
 
@@ -648,6 +680,7 @@ def _compaction_stanza() -> dict:
         out["recompiles"] = int(compile_count() - _c0)
     except Exception as e:  # never kill the bench over the stanza
         out["error"] = repr(e)
+    out.update(_mem_probe())
     return out
 
 
@@ -722,7 +755,22 @@ def _obs_stanza() -> dict:
             (traced_dt / max(untraced_dt, 1e-9) - 1.0) * 100.0, 2)
     except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
+    out.update(_mem_probe())
     return out
+
+
+def _mem_highwater(extra: dict) -> dict:
+    """The gated memory leaves: a fresh end-of-run probe, with
+    ``device_resident_bytes`` raised to the max across every stanza's
+    recorded probe (compact-summary comment)."""
+    mem = _mem_probe()
+    stanza_dev = [v.get("device_resident_bytes")
+                  for v in extra.values() if isinstance(v, dict)]
+    candidates = [int(x) for x in stanza_dev if x] + \
+        [int(mem.get("device_resident_bytes", 0))]
+    if any(candidates):
+        mem["device_resident_bytes"] = max(candidates)
+    return mem
 
 
 #: relative tolerance band for the regression gate — tunnel-noise-scale
@@ -730,9 +778,12 @@ def _obs_stanza() -> dict:
 REGRESSION_TOLERANCE = 0.20
 
 #: metric-name direction conventions: timings regress UP, rates/speedups
-#: regress DOWN; anything else (hit counts, row totals, booleans) is
-#: not a performance direction and is never flagged
-_LOWER_BETTER_SUFFIXES = ("_ms", "_s")
+#: regress DOWN; the STORAGE direction (ISSUE 9) treats the per-stanza
+#: memory leaves (`peak_rss_mb` host high-water mark,
+#: `device_resident_bytes` live HBM) as lower-better too, so a memory
+#: regression fails as loudly as a perf one; anything else (hit counts,
+#: row totals, booleans) is not a direction and is never flagged
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_rss_mb", "_resident_bytes")
 _HIGHER_BETTER_MARKS = ("per_sec", "speedup", "wins", "value")
 
 
@@ -907,6 +958,7 @@ def _xz3_scale_stanza() -> dict:
         out["recompiles"] = int(compile_count() - _c0)
     except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
+    out.update(_mem_probe())
     return out
 
 
@@ -978,6 +1030,7 @@ def _stats_pushdown_stanza() -> dict:
         out["recompiles"] = int(compile_count() - _c0)
     except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
+    out.update(_mem_probe())
     return out
 
 

@@ -123,6 +123,12 @@ class ObsProperties:
     SLOW_MS = SystemProperty("geomesa.obs.slow.ms", 500.0)
     #: ring-buffer exporter capacity (traces)
     TRACE_CAPACITY = SystemProperty("geomesa.obs.trace.capacity", 256)
+    #: JSONL trace-sink size cap in bytes: the exporter rotates so the
+    #: live file plus one predecessor stay within this total (long
+    #: bench runs must not grow the sink without bound); <= 0 disables
+    #: rotation.  Re-read per export, so it is live-tunable.
+    TRACE_MAX_BYTES = SystemProperty("geomesa.obs.trace.max_bytes",
+                                     128 * 2 ** 20)
     #: slow-query log capacity (traces)
     SLOW_CAPACITY = SystemProperty("geomesa.obs.slow.capacity", 64)
     #: count XLA backend compiles via the jax.monitoring listener

@@ -1862,6 +1862,27 @@ class TpuDataStore:
         self.query_result(name, query, ex)
         return str(ex)
 
+    def explain_analyze(self, name: str, query="INCLUDE"):
+        """EXPLAIN ANALYZE: run the query under forced trace capture
+        and return the merged plan + measured actuals (strategy options
+        with estimated costs, the chosen estimate, actual rows
+        scanned/matched, mispredict ratio, per-phase wall/device ms) —
+        the reference's ``explainQuery`` with real numbers (ISSUE 9).
+        Returns an :class:`~geomesa_tpu.obs.ExplainAnalyzeResult`
+        (``render()`` for text, ``to_json()`` for the web surface)."""
+        from .obs.explain_analyze import explain_analyze
+        return explain_analyze(self, name, query)
+
+    def storage_report(self) -> dict:
+        """Walk every schema's indexes/caches/column store, reconcile
+        the accounted byte totals against actual array nbytes, publish
+        the ``storage.*`` gauges, and return the report (obs/resource;
+        served at ``GET /debug/storage``)."""
+        from .obs.resource import publish_storage_gauges, storage_report
+        rep = storage_report(self)
+        publish_storage_gauges(self, rep)
+        return rep
+
     # -- stats (GeoMesaStats analog) --------------------------------------
     def _restricted_mask(self, store: _SchemaStore) -> np.ndarray | None:
         """Visibility mask when this caller cannot see every row (stats are

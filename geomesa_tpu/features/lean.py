@@ -140,6 +140,28 @@ class LeanBatch:
             self._fold_env(float(np.min(gx)), float(np.min(gy)),
                            float(np.max(gx)), float(np.max(gy)))
 
+    def host_bytes(self) -> int:
+        """Host RAM of the column store (the storage report's
+        ``storage.<schema>.batch_bytes`` source, obs/resource):
+        attribute/coordinate chunk arrays plus packed-geometry SoA
+        buffers, deduplicated by identity so the finalize step (which
+        keeps the flat array in BOTH ``_flat`` and ``_chunks``) never
+        double-counts.  Object-dtype columns count pointer width only
+        (their string payloads are Python-heap, not column store)."""
+        total, seen = 0, set()
+        for parts in self._chunks.values():
+            for a in parts:
+                if id(a) not in seen:
+                    seen.add(id(a))
+                    total += int(getattr(a, "nbytes", 0))
+        for g in self._geom_chunks:
+            for a in (g.kinds, g.coords, g.ring_offsets,
+                      g.part_ring_offsets, g.geom_part_offsets, g.bbox):
+                if id(a) not in seen:
+                    seen.add(id(a))
+                    total += int(getattr(a, "nbytes", 0))
+        return total
+
     def _fold_env(self, lo_x, lo_y, hi_x, hi_y):
         if self.envelope is None:
             self.envelope = (lo_x, lo_y, hi_x, hi_y)

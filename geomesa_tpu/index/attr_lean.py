@@ -445,11 +445,43 @@ class LeanAttrIndex:
     def device_bytes(self) -> int:
         return sum(g.device_bytes() for g in self.generations)
 
+    def host_key_bytes(self) -> int:
+        """Host RAM held by spilled (``host``-tier) runs — key + sec +
+        gid per valid row (no padding survives a spill)."""
+        return sum(g.n * SLOT_BYTES for g in self.generations
+                   if g.tier == "host")
+
+    def sentinel_bytes(self) -> int:
+        """HBM of the lazily-allocated padding sentinel columns."""
+        return (0 if self._sentinel is None
+                else self.generation_slots * SLOT_BYTES)
+
     def tier_counts(self) -> dict:
         out = {"device": 0, "host": 0}
         for g in self.generations:
             out[g.tier] += 1
         return out
+
+    def storage_stats(self) -> dict:
+        """Live byte accounting for the storage report (obs/resource,
+        ISSUE 9) — see LeanZ3Index.storage_stats; same contract over
+        the (key, sec, gid) runs."""
+        gens = [{"gen_id": g.gen_id, "tier": g.tier, "rows": int(g.n),
+                 "capacity": 0 if g.tier == "host" else g.capacity,
+                 "device_bytes": g.device_bytes(),
+                 "host_bytes": (g.n * SLOT_BYTES
+                                if g.tier == "host" else 0)}
+                for g in self.generations]
+        return {"kind": type(self).__name__, "rows": len(self),
+                "attr": self.attr,
+                "tiers": self.tier_counts(),
+                "device_bytes": self.device_bytes(),
+                "host_bytes": self.host_key_bytes(),
+                "sentinel_bytes": self.sentinel_bytes(),
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "generations": gens,
+                "caches": {"sketch": self._sketch_cache.stats()},
+                "dispatches": self.dispatch_count}
 
     def block(self) -> None:
         for gen in reversed(self.generations):

@@ -60,6 +60,16 @@ class PartialCache:
         return sum(p.nbytes for c in self._specs.values()
                    for p in c.values())
 
+    def stats(self) -> dict:
+        """Storage-accounting view (obs/resource.StorageReport): spec
+        count, total cached partials, resident bytes, and the policy
+        ceilings they are bounded by."""
+        return {"specs": len(self._specs),
+                "partials": sum(len(c) for c in self._specs.values()),
+                "bytes": self.cached_bytes(),
+                "max_specs": self.max_specs,
+                "max_bytes": self.max_bytes}
+
     def spec_cache(self, spec) -> dict:
         """The per-generation partial dict for one spec, LRU-touched;
         oldest OTHER specs evict past ``max_specs`` or the byte

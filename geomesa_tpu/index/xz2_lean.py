@@ -53,8 +53,19 @@ class LeanCoreFacade:
     def device_bytes(self) -> int:
         return self._core.device_bytes()
 
+    def host_key_bytes(self) -> int:
+        return self._core.host_key_bytes()
+
     def tier_counts(self) -> dict:
         return self._core.tier_counts()
+
+    def storage_stats(self) -> dict:
+        """Byte accounting of the underlying generational core, tagged
+        with the facade's own kind (obs/resource.StorageReport — the
+        XZ tiers must be distinguishable from raw attribute runs)."""
+        st = self._core.storage_stats()
+        st["kind"] = type(self).__name__
+        return st
 
     def block(self) -> None:
         self._core.block()

@@ -975,6 +975,38 @@ class LeanZ3Index:
             out[g.tier] += 1
         return out
 
+    def sentinel_bytes(self) -> int:
+        """HBM charged for the lazily-allocated bucket-padding sentinel
+        buffers (the budget's _budget_after_sentinels counterpart, but
+        for buffers that EXIST rather than will exist)."""
+        return sum(self.generation_slots
+                   * (FULL_BYTES if tier == "full" else KEYS_BYTES)
+                   for tier in self._sentinels)
+
+    def storage_stats(self) -> dict:
+        """Live byte accounting for the storage report (obs/resource,
+        ISSUE 9): where this index's bytes sit — device key/payload
+        runs vs host-spilled runs, per generation, plus the sealed-
+        partial caches — from the SAME per-slot constants the HBM
+        budget uses, so the report reconciling these against actual
+        array nbytes is exactly a budget-accounting audit."""
+        gens = [{"gen_id": g.gen_id, "tier": g.tier, "rows": int(g.n),
+                 "capacity": 0 if g.tier == "host" else g.capacity,
+                 "device_bytes": g.device_bytes(),
+                 "host_bytes": (g.n * KEYS_BYTES
+                                if g.tier == "host" else 0)}
+                for g in self.generations]
+        return {"kind": type(self).__name__, "rows": len(self),
+                "tiers": self.tier_counts(),
+                "device_bytes": self.device_bytes(),
+                "host_bytes": self.host_key_bytes(),
+                "sentinel_bytes": self.sentinel_bytes(),
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "generations": gens,
+                "caches": {"density": self._density_cache.stats(),
+                           "sketch": self._sketch_cache.stats()},
+                "dispatches": self.dispatch_count}
+
     # -- write path -------------------------------------------------------
     def _new_generation(self, base: int) -> _Generation:
         tier = "full" if self.payload_on_device else "keys"

@@ -21,19 +21,22 @@ from __future__ import annotations
 
 import logging
 import math
+import re
 import threading
 import time
 from dataclasses import dataclass, field
 
-__all__ = ["MetricRegistry", "Timer", "Counter", "HistogramMetric",
+__all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "LoggingReporter", "DelimitedFileReporter", "PeriodicReporter",
            "merge_snapshots", "registry",
+           "METRIC_NAMESPACES", "lint_metric_names",
            "LEAN_COMPACTION_MERGES", "LEAN_COMPACTION_ROWS",
            "LEAN_DENSITY_CACHE_HITS", "LEAN_DENSITY_CACHE_MISSES",
            "LEAN_SKETCH_CACHE_HITS", "LEAN_SKETCH_CACHE_MISSES",
            "LEAN_SKETCH_SCANS", "LEAN_STATS_MATERIALIZED",
            "LEAN_DEVICE_DISPATCHES", "LEAN_DEVICE_MS",
-           "JAX_COMPILE_COUNT", "JAX_COMPILE_MS", "JAX_COMPILE_FALLBACK"]
+           "JAX_COMPILE_COUNT", "JAX_COMPILE_MS", "JAX_COMPILE_FALLBACK",
+           "PLAN_ESTIMATE_RATIO"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -65,6 +68,28 @@ LEAN_DEVICE_MS = "lean.device.ms"
 JAX_COMPILE_COUNT = "jax.compile.count"
 JAX_COMPILE_MS = "jax.compile.ms"
 JAX_COMPILE_FALLBACK = "jax.compile.fallback_count"
+#: planner estimate audit (obs/explain_analyze, ISSUE 9): per planned
+#: query, chosen-estimate over actual-rows-scanned — a log-bucketed
+#: histogram whose p50/p95/p99 say how wrong the cost model runs (the
+#: baseline the item-4 sketch-driven planner has to beat)
+PLAN_ESTIMATE_RATIO = "plan.estimate.ratio"
+
+#: the metric naming contract (docs/observability.md): every registry
+#: key lives under one of these top-level namespaces, dot-separated,
+#: segments drawn from [A-Za-z0-9_:-] (attr-index keys like
+#: ``storage.evt.attr:score.device_bytes`` carry a colon).  The
+#: tier-1 lint test (tests/test_zzz_metric_lint.py) walks the full
+#: registry after the suite and fails on any drive-by key outside it.
+METRIC_NAMESPACES = ("query", "write", "lean", "jax", "web", "storage",
+                     "plan", "obs", "pallas")
+_METRIC_KEY_RE = re.compile(
+    r"^(?:" + "|".join(METRIC_NAMESPACES)
+    + r")(?:\.[A-Za-z0-9_:\-]+)+$")
+
+
+def lint_metric_names(names) -> list[str]:
+    """Names violating the metric naming contract (empty = clean)."""
+    return sorted(n for n in names if not _METRIC_KEY_RE.match(n))
 
 
 @dataclass
@@ -75,6 +100,24 @@ class Counter:
     def inc(self, n: int = 1):
         with self._lock:
             self.count += n
+
+
+@dataclass
+class Gauge:
+    """A point-in-time level (resident bytes, cache fill, queue depth)
+    — ``set`` replaces rather than accumulates.  Snapshots carry it as
+    ``{"value": v}``; :func:`merge_snapshots` SUMS gauges across
+    processes (the multihost uses are all byte/level totals where a
+    mesh-wide sum is the meaningful roll-up)."""
+
+    value: float = 0.0
+    updated_ts: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = float(v)
+            self.updated_ts = time.time()
 
 
 #: log-bucket geometry for the quantile tables: bucket b holds values in
@@ -185,21 +228,40 @@ class MetricRegistry:
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
 
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
     def timer(self, name: str) -> Timer:
         return self._get(name, Timer)
 
     def histogram(self, name: str) -> HistogramMetric:
         return self._get(name, HistogramMetric)
 
+    def names(self) -> list[str]:
+        """Every registered metric key (the naming-lint surface)."""
+        with self._lock:
+            return sorted(self._metrics)
+
+    def remove(self, name: str) -> None:
+        """Drop a metric (gauge republication uses this to retire keys
+        for deleted schemas/indexes — the registry key set must stay
+        bounded under schema churn)."""
+        with self._lock:
+            self._metrics.pop(name, None)
+
     def snapshot(self, buckets: bool = False) -> dict:
-        """Point-in-time view: counters as ``{"count"}``, histograms/
-        timers with moments + p50/p95/p99.  ``buckets=True`` adds the
-        raw log-bucket table (``total``/``zero``/``buckets``) — the
-        mergeable form :func:`merge_snapshots` consumes."""
+        """Point-in-time view: counters as ``{"count"}``, gauges as
+        ``{"value"}``, histograms/timers with moments + p50/p95/p99.
+        ``buckets=True`` adds the raw log-bucket table (``total``/
+        ``zero``/``buckets``) — the mergeable form
+        :func:`merge_snapshots` consumes."""
         with self._lock:
             items = sorted(self._metrics.items())
         out = {}
         for name, m in items:
+            if isinstance(m, Gauge):
+                out[name] = {"value": m.value}
+                continue
             if isinstance(m, Counter):
                 out[name] = {"count": m.count}
                 continue
@@ -226,8 +288,13 @@ def merge_snapshots(snaps: list) -> dict:
     tables, bucket internals dropped) — the multihost scrape reducer
     (parallel/stats.allreduce_metrics_snapshot)."""
     merged: dict = {}
+    gauges: dict = {}
     for snap in snaps:
         for name, vals in snap.items():
+            if "value" in vals and "mean" not in vals:
+                # gauge: mesh-wide SUM (byte/level totals per process)
+                gauges[name] = gauges.get(name, 0.0) + float(vals["value"])
+                continue
             cur = merged.setdefault(name, {
                 "count": 0, "total": 0.0, "zero": 0, "buckets": {},
                 "min": float("inf"), "max": float("-inf"),
@@ -265,7 +332,9 @@ def merge_snapshots(snaps: list) -> dict:
             vals[key] = _quantile_from_buckets(
                 q, n, cur["zero"], cur["buckets"], vmin, vmax)
         out[name] = vals
-    return out
+    for name, v in gauges.items():
+        out[name] = {"value": v}
+    return dict(sorted(out.items()))
 
 
 class _ReporterBase:
@@ -280,6 +349,9 @@ class _ReporterBase:
 
     def _rows(self):
         for name, vals in self.registry.snapshot().items():
+            if "count" not in vals:      # gauges carry levels, not counts
+                yield name, dict(vals)
+                continue
             delta = vals["count"] - self._last_counts.get(name, 0)
             self._last_counts[name] = vals["count"]
             yield name, {**vals, "delta": delta}

@@ -12,7 +12,13 @@ host-spill scan / cache-miss time instead of one opaque number:
   listener + wrapped-jit fallback) that turns silent retraces into
   ``jax.compile.*`` metrics and span attributes;
 * :mod:`.prom` — Prometheus text exposition over metric snapshots
-  (p50/p95/p99 from the log-bucketed histograms in metrics.py).
+  (p50/p95/p99 from the log-bucketed histograms in metrics.py);
+* :mod:`.resource` — storage/HBM accounting (ISSUE 9): the
+  ``storage.*`` gauges, the ``/debug/storage`` report, and the
+  accounted-vs-actual-nbytes reconciliation audit;
+* :mod:`.explain_analyze` — EXPLAIN ANALYZE: the plan narration
+  merged with measured actuals (estimate vs rows scanned/matched,
+  per-phase ms), served at ``/explain``.
 
 Everything configures through the ``geomesa.obs.*`` system properties
 (config.ObsProperties); docs/observability.md is the operator contract.
@@ -21,9 +27,13 @@ Everything configures through the ``geomesa.obs.*`` system properties
 from __future__ import annotations
 
 from ..config import ObsProperties
+from .explain_analyze import (
+    ExplainAnalyzeResult, explain_analyze, explain_analyze_sql,
+)
 from .prom import prometheus_text
 from .recompile import compile_count, counting_jit, install as \
     install_recompile_tracker
+from .resource import publish_storage_gauges, storage_report
 from .trace import (
     AlwaysSampler, JsonlExporter, NeverSampler, RatioSampler,
     RingExporter, Sampler, SlowOnlySampler, Span, Trace, Tracer,
@@ -35,7 +45,10 @@ __all__ = ["Span", "Trace", "Tracer", "Sampler", "AlwaysSampler",
            "RingExporter", "JsonlExporter", "tracer", "span",
            "device_span", "current_span", "current_trace_id", "obs_count",
            "prometheus_text", "compile_count", "counting_jit",
-           "install_recompile_tracker"]
+           "install_recompile_tracker",
+           "storage_report", "publish_storage_gauges",
+           "ExplainAnalyzeResult", "explain_analyze",
+           "explain_analyze_sql"]
 
 # the recompile listener is process-global and effectively free — hook
 # it as soon as observability loads (gated by the option so fully

@@ -2,7 +2,8 @@
 
 Renders a ``MetricRegistry.snapshot()`` (or a multihost-merged one from
 ``parallel/stats.allreduce_metrics_snapshot``) in the text exposition
-format (version 0.0.4): counters as ``<name>_total``, histograms/timers
+format (version 0.0.4): counters as ``<name>_total``, gauges (the
+``storage.*`` byte levels) as plain gauge samples, histograms/timers
 as summaries with p50/p95/p99 quantile samples plus ``_sum``/``_count``
 — what ``GET /metrics.prom`` serves (web/app.py).
 
@@ -41,6 +42,10 @@ def prometheus_text(snapshot: dict) -> str:
     for key in sorted(snapshot):
         vals = snapshot[key]
         name = metric_name(key)
+        if "value" in vals and "mean" not in vals:   # gauge (levels)
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(vals['value'])}")
+            continue
         if "mean" not in vals:           # plain counter
             lines.append(f"# TYPE {name}_total counter")
             lines.append(f"{name}_total {int(vals.get('count', 0))}")

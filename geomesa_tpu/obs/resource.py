@@ -1,0 +1,316 @@
+"""Storage/HBM accounting: where the bytes live, audited.
+
+The resource half of ISSUE 9.  Every lean index tier already *budgets*
+HBM from per-slot constants (``device_bytes``/``host_key_bytes`` and
+the ``hbm_budget_bytes`` rebalance); this module turns that accounting
+into an operator surface and — crucially — AUDITS it:
+
+* :func:`storage_report` walks a :class:`TpuDataStore` and collects
+  every index's ``storage_stats()`` (the accounted view: device runs
+  vs host-spilled runs, per generation, sentinel padding buffers, the
+  sealed-partial density/sketch caches) plus the column store's host
+  bytes, and then independently re-derives the SAME totals from
+  **actual array nbytes** (jax/numpy buffers walked generically).  The
+  two views reconcile per direction with a documented tolerance — a
+  drift means the budget constants no longer match the real dtypes,
+  i.e. the HBM budget itself is silently wrong (the failure mode that
+  busts "1B rows on fixed HBM").
+* :func:`publish_storage_gauges` folds the report into ``storage.*``
+  registry gauges so ``/metrics.prom`` scrapes resident bytes like any
+  other metric (mesh-wide views SUM per-process gauges through
+  ``metrics.merge_snapshots`` — host residency is per-process).
+* ``GET /debug/storage`` (web/app.py) serves the full report.
+
+Reconciliation tolerances (pinned by tests/test_zz_resource_obs.py):
+
+* **device**: exact (1% float slack).  Device runs are fixed-capacity
+  columns of the exact dtypes the constants describe.
+* **host**: accounted may OVERSTATE actual by up to 35%.  Spilled-run
+  accounting charges ``KEYS_BYTES`` per row, but once runs fold into
+  the stacked host store (z3 HostStack) the bin column is recovered
+  from the segment table instead of being stored — 4 of 16/20 bytes
+  per row evaporate.
+* **sentinel**: accounted may overstate by up to 25% — the full-tier
+  sentinel shares one zeros buffer between its x and y columns.
+* **caches**: exact (partials self-report ``nbytes``).
+
+Tolerances are ONE-directional: they excuse overstatement only.
+Accounting that UNDERSTATES actual residency beyond 1% float slack
+fails in every direction — real bytes exceeding what the budget
+believes is exactly the failure the audit exists to catch.
+
+Per-generation byte detail lives in the REPORT, not the registry —
+generation ids churn under compaction and gauges must stay a bounded
+key set (docs/observability.md naming contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..metrics import registry as _metrics
+
+__all__ = ["storage_report", "publish_storage_gauges",
+           "index_actual_nbytes"]
+
+#: documented reconciliation tolerances, percent of actual (module doc)
+TOLERANCE_PCT = {"device": 1.0, "host": 35.0, "sentinel": 25.0,
+                 "cache": 1.0}
+
+#: array attributes a generation may carry, across every lean variant
+#: (z3: bins/z/pos/x/y/t — attr/xz: keys/sec/gid)
+_GEN_ARRAYS = ("bins", "z", "pos", "x", "y", "t", "keys", "sec", "gid")
+#: array attributes of a spilled HostRun (z3 family)
+_RUN_ARRAYS = ("bins", "z", "pos")
+
+
+def _add_arrays(total: int, seen: set, *arrays) -> int:
+    """Sum ``nbytes`` over arrays, deduplicated by identity — sentinel
+    tuples alias one zeros buffer for x AND y, and re-pointed host-run
+    views must not double-count against their owning stack."""
+    for a in arrays:
+        if a is None or isinstance(a, (int, float)):
+            continue
+        if id(a) in seen:
+            continue
+        seen.add(id(a))
+        total += int(getattr(a, "nbytes", 0))
+    return total
+
+
+def _spilled_bytes(sp, seen: set) -> int:
+    """Bytes of an attr-core ``spilled`` payload: one ``[k, s, g]``
+    part (single-chip) or a list of parts (sharded)."""
+    if not sp:
+        return 0
+    if isinstance(sp[0], (list, tuple)):
+        return sum(_spilled_bytes(p, seen) for p in sp)
+    total = 0
+    for a in sp:
+        total = _add_arrays(total, seen, a)
+    return total
+
+
+def index_actual_nbytes(idx) -> dict:
+    """Independently re-derive one lean index's resident bytes from
+    ACTUAL array nbytes (device runs, host runs, sentinel buffers,
+    partial caches) — the audit side of the reconciliation.  Works
+    across all six lean variants by walking the generation/sentinel
+    shapes generically; facades are unwrapped via ``_core``."""
+    core = getattr(idx, "_core", idx)
+    seen: set = set()
+    dev = host = 0
+    for g in getattr(core, "generations", ()):
+        if getattr(g, "tier", None) == "host":
+            run = getattr(g, "run", None)
+            if run is not None:
+                host = _add_arrays(host, seen,
+                                   *(getattr(run, n, None)
+                                     for n in _RUN_ARRAYS))
+            for r in (getattr(g, "runs", None) or ()):
+                host = _add_arrays(host, seen,
+                                   *(getattr(r, n, None)
+                                     for n in _RUN_ARRAYS))
+            host += _spilled_bytes(getattr(g, "spilled", None), seen)
+        else:
+            dev = _add_arrays(dev, seen,
+                              *(getattr(g, n, None)
+                                for n in _GEN_ARRAYS))
+    sent = 0
+    sentinels = getattr(core, "_sentinels", None)
+    if isinstance(sentinels, dict):
+        for v in sentinels.values():
+            if isinstance(v, tuple):
+                sent = _add_arrays(sent, seen, *v)
+            else:   # a sharded sentinel generation object
+                sent = _add_arrays(sent, seen,
+                                   *(getattr(v, n, None)
+                                     for n in _GEN_ARRAYS))
+    tup = getattr(core, "_sentinel", None)
+    if isinstance(tup, tuple):
+        sent = _add_arrays(sent, seen, *tup)
+    gen = getattr(core, "_sentinel_gen", None)
+    if gen is not None:
+        sent = _add_arrays(sent, seen,
+                           *(getattr(gen, n, None) for n in _GEN_ARRAYS))
+    cache = 0
+    for name in ("_density_cache", "_sketch_cache"):
+        c = getattr(core, name, None)
+        if c is not None:
+            cache += int(c.cached_bytes())
+    return {"device_bytes": dev, "host_bytes": host,
+            "sentinel_bytes": sent, "cache_bytes": cache}
+
+
+def _accounted_cache_bytes(stats: dict) -> int:
+    return sum(int(c.get("bytes", 0))
+               for c in (stats.get("caches") or {}).values())
+
+
+def _batch_bytes(batch) -> int:
+    """Host bytes of a schema's column store: LeanBatch.host_bytes for
+    the lean profile, summed column nbytes for a plain FeatureBatch."""
+    if batch is None:
+        return 0
+    if hasattr(batch, "host_bytes"):
+        return int(batch.host_bytes())
+    total, seen = 0, set()
+    total = _add_arrays(total, seen, *getattr(batch, "columns", {}).values())
+    return total
+
+
+def _reconcile(accounted: int, actual: int, kind: str) -> dict:
+    """One-DIRECTIONAL verdict: the per-kind tolerance only excuses
+    OVERSTATEMENT (accounting charges bytes the arrays dropped — the
+    bins-recovered / shared-zeros cases in the module doc);
+    UNDERSTATEMENT beyond float slack means real residency exceeds
+    what the budget believes — the dangerous direction — and always
+    fails."""
+    tol_over = TOLERANCE_PCT[kind]
+    tol_under = TOLERANCE_PCT["device"]     # 1% slack, every kind
+    if actual:
+        delta_pct = (accounted - actual) / actual * 100.0
+    else:
+        delta_pct = 100.0 if accounted else 0.0
+    return {"accounted": int(accounted), "actual": int(actual),
+            "delta_pct": round(delta_pct, 2), "tolerance_pct": tol_over,
+            "ok": -tol_under <= delta_pct <= tol_over}
+
+
+def storage_report(store, audit: bool = True) -> dict:
+    """Walk a TpuDataStore: accounted storage per schema/index, actual
+    nbytes audit, and the reconciliation verdict (module doc).
+
+    ``audit=False`` skips the actual-nbytes walk and reconciliation —
+    the cheap accounted-only form the per-scrape gauge refresh uses
+    (the gauges publish accounted values; re-walking every resident
+    array on a 15-second scrape cadence would be pure waste)."""
+    schemas: dict = {}
+    acc = {"device_bytes": 0, "host_bytes": 0, "sentinel_bytes": 0,
+           "cache_bytes": 0, "batch_bytes": 0}
+    act = {"device_bytes": 0, "host_bytes": 0, "sentinel_bytes": 0,
+           "cache_bytes": 0}
+    for name, s in store._schemas.items():
+        batch_bytes = _batch_bytes(s.batch)
+        entry: dict = {
+            "rows": 0 if s.batch is None else len(s.batch),
+            "lean": bool(s.lean),
+            "batch_host_bytes": batch_bytes,
+            "indexes": {},
+        }
+        acc["batch_bytes"] += batch_bytes
+        for key, idx in s._indexes.items():
+            if hasattr(idx, "storage_stats"):
+                st = idx.storage_stats()
+                acc["device_bytes"] += int(st.get("device_bytes", 0))
+                acc["host_bytes"] += int(st.get("host_bytes", 0))
+                acc["sentinel_bytes"] += int(st.get("sentinel_bytes", 0))
+                acc["cache_bytes"] += _accounted_cache_bytes(st)
+                if audit:
+                    actual = index_actual_nbytes(idx)
+                    st["actual"] = actual
+                    act["device_bytes"] += actual["device_bytes"]
+                    act["host_bytes"] += actual["host_bytes"]
+                    act["sentinel_bytes"] += actual["sentinel_bytes"]
+                    act["cache_bytes"] += actual["cache_bytes"]
+            else:
+                # non-generational (full-fat) indexes: presence + rows
+                # only — their residency is the batch's columns, which
+                # batch_host_bytes already covers
+                st = {"kind": type(idx).__name__}
+                try:
+                    st["rows"] = len(idx)
+                except TypeError:
+                    pass
+            entry["indexes"][key] = st
+        schemas[name] = entry
+    out = {
+        "generated_ts": round(time.time(), 3),
+        "schemas": schemas,
+        "totals": dict(acc),
+    }
+    if audit:
+        recon = {
+            "device": _reconcile(acc["device_bytes"],
+                                 act["device_bytes"], "device"),
+            "host": _reconcile(acc["host_bytes"], act["host_bytes"],
+                               "host"),
+            "sentinel": _reconcile(acc["sentinel_bytes"],
+                                   act["sentinel_bytes"], "sentinel"),
+            "cache": _reconcile(acc["cache_bytes"], act["cache_bytes"],
+                                "cache"),
+        }
+        out["actual_totals"] = dict(act)
+        out["reconciliation"] = {
+            **recon,
+            "within_tolerance": all(v["ok"] for v in recon.values()),
+        }
+    return out
+
+
+#: serializes gauge publication — concurrent scrapes must not race the
+#: publish-then-retire sequence
+_publish_lock = threading.Lock()
+
+
+def publish_storage_gauges(store, report: dict | None = None) -> dict:
+    """Set the ``storage.*`` registry gauges from a (fresh or given)
+    storage report, so resident bytes scrape from ``/metrics.prom``
+    alongside every other metric.  Returns the report used (fresh
+    reports skip the nbytes audit — gauges only need accounted values).
+
+    Gauge taxonomy (docs/observability.md):
+
+    * ``storage.total.{device,host,sentinel,cache,batch}_bytes``
+    * ``storage.<schema>.batch_bytes``
+    * ``storage.<schema>.<index>.{device,host,cache}_bytes``
+
+    Under multihost, device/sentinel values are divided by the process
+    count before publishing: every process accounts the same mesh-wide
+    HBM, and the mesh scrape (``/metrics.prom?mesh=1``) SUMS gauges
+    across processes — publishing each process's SHARE makes the
+    merged total read true resident bytes, not N× them.  Host/batch/
+    cache bytes are genuinely per-process and publish unscaled.
+
+    The previously-published key set is tracked PER STORE (two stores
+    sharing one process registry must not retire each other's live
+    gauges); publishes serialize on a module lock so concurrent
+    scrapes cannot race the publish-then-retire sequence."""
+    report = (report if report is not None
+              else storage_report(store, audit=False))
+    procs = 1
+    if getattr(store, "_multihost", False):
+        import jax
+        procs = max(1, jax.process_count())
+
+    published: set = set()
+
+    def _set(key: str, value, shared: bool = False) -> None:
+        _metrics.gauge(key).set(value / procs if shared else value)
+        published.add(key)
+
+    with _publish_lock:
+        for schema, entry in report["schemas"].items():
+            _set(f"storage.{schema}.batch_bytes",
+                 entry["batch_host_bytes"])
+            for key, st in entry["indexes"].items():
+                if "device_bytes" not in st:
+                    continue
+                base = f"storage.{schema}.{key}"
+                _set(f"{base}.device_bytes", st["device_bytes"],
+                     shared=True)
+                _set(f"{base}.host_bytes", st["host_bytes"])
+                _set(f"{base}.cache_bytes", _accounted_cache_bytes(st))
+        # totals LAST so a schema literally named "total" can never
+        # leave its per-schema values in the process-total keys
+        tot = report["totals"]
+        for leaf in ("device_bytes", "sentinel_bytes"):
+            _set(f"storage.total.{leaf}", tot[leaf], shared=True)
+        for leaf in ("host_bytes", "cache_bytes", "batch_bytes"):
+            _set(f"storage.total.{leaf}", tot[leaf])
+        prev = getattr(store, "_storage_gauge_keys", set())
+        for stale in prev - published:
+            _metrics.remove(stale)
+        store._storage_gauge_keys = published
+    return report

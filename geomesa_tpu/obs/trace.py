@@ -32,6 +32,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import json
+import os
 import random
 import threading
 import time
@@ -240,19 +241,67 @@ class RingExporter:
 
 class JsonlExporter:
     """Append finished traces as JSON lines (the durable sink; same
-    line-buffered open-once discipline as audit.JsonlAuditWriter)."""
+    line-buffered open-once discipline as audit.JsonlAuditWriter).
 
-    def __init__(self, path: str):
+    The sink is size-capped: once the live file would pass HALF of
+    ``geomesa.obs.trace.max_bytes`` (or the explicit ``max_bytes``),
+    it rotates to ``<path>.1`` (replacing any previous rollover), so a
+    long bench run retains the newest ~N MB of traces across at most
+    two files instead of growing without bound.  A cap of <= 0
+    disables rotation."""
+
+    def __init__(self, path: str, max_bytes: int | None = None):
         self.path = path
+        self._max_override = max_bytes
         self._lock = threading.Lock()
         self._file = None
+        self._bytes = 0
+
+    def _max_bytes(self) -> int:
+        if self._max_override is not None:
+            return int(self._max_override)
+        return ObsProperties.TRACE_MAX_BYTES.to_int()
 
     def export(self, trace: Trace) -> None:
         line = json.dumps(trace.to_json(), default=str) + "\n"
         with self._lock:
             if self._file is None:
                 self._file = open(self.path, "a", buffering=1)
+                try:
+                    self._bytes = os.path.getsize(self.path)
+                except OSError:
+                    self._bytes = 0
+            cap = self._max_bytes()
+            if (cap > 0 and self._bytes
+                    and self._bytes + len(line) > cap // 2):
+                self._rotate()
             self._file.write(line)
+            self._bytes += len(line)
+
+    def _rotate(self) -> None:
+        """Roll the live file to ``<path>.1`` (lock held).  One rolled
+        predecessor is kept, so total retention is bounded by the cap
+        (half live + half rolled)."""
+        try:
+            self._file.close()
+        except OSError:
+            pass   # flush failure (e.g. ENOSPC) — fall through: the
+            #        replace/reopen below still bound the sink
+        # None while reopening: if open() raises, the next export
+        # retries from a clean slate instead of writing to a closed file
+        self._file = None
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass   # a lost rollover only loses history, never traces
+        self._file = open(self.path, "a", buffering=1)
+        # re-stat instead of assuming 0: if the replace failed, the old
+        # contents are still in the live file and must keep counting
+        # against the cap, or a persistent failure grows it unbounded
+        try:
+            self._bytes = os.path.getsize(self.path)
+        except OSError:
+            self._bytes = 0
 
     def close(self) -> None:
         with self._lock:
@@ -278,6 +327,13 @@ class _Ctx:
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "geomesa_obs_span", default=None)
 _DECLINED = _Ctx(None, NOOP_SPAN, _ALWAYS)
+#: active EXPLAIN ANALYZE collector (Tracer.capture): roots opened in
+#: this context RECORD regardless of sampler/enabled and their
+#: finished traces land in the collector — an explicit "explain this
+#: query" ask must never come back empty because the operator had
+#: sampling turned down
+_capture: contextvars.ContextVar = contextvars.ContextVar(
+    "geomesa_obs_capture", default=None)
 
 
 class Tracer:
@@ -337,7 +393,7 @@ class Tracer:
         span is active in this context, else a child of the current
         one.  Yields the :class:`Span` (or the shared no-op)."""
         self._refresh_config()
-        if not self._cfg_enabled:
+        if not self._cfg_enabled and _capture.get() is None:
             yield NOOP_SPAN
             return
         parent = _current.get()
@@ -345,11 +401,20 @@ class Tracer:
             yield NOOP_SPAN       # inside a declined trace
             return
         sampled = True
+        natural = True
         if parent is None:
             sampler = self._cfg_sampler
             sampled = sampler.sample(name)
-            if not sampled and (self._cfg_slow_ms <= 0
-                                or isinstance(sampler, NeverSampler)):
+            # would this root have recorded WITHOUT a capture in play?
+            # Capture-only roots must stay out of the shared ring and
+            # slow log — an operator who turned tracing off (or 'never')
+            # asked for those surfaces to stay silent
+            natural = self._cfg_enabled and (
+                sampled or (self._cfg_slow_ms > 0
+                            and not isinstance(sampler, NeverSampler)))
+            if not sampled and _capture.get() is None \
+                    and (self._cfg_slow_ms <= 0
+                         or isinstance(sampler, NeverSampler)):
                 # head-declined with the slow log off — or tracing
                 # explicitly 'never': the genuinely free path, no
                 # trace object at all
@@ -379,16 +444,49 @@ class Tracer:
             trace.spans.append(sp)
             _current.reset(token)
             if parent is None:
-                self._finish(trace, sampler, sampled)
+                self._finish(trace, sampler, sampled, natural)
 
     def _finish(self, trace: Trace, sampler: Sampler,
-                sampled: bool = True) -> None:
-        if sampled and sampler.retain(trace):
+                sampled: bool = True, natural: bool = True) -> None:
+        if natural and sampled and sampler.retain(trace):
             for e in self.exporters:
-                e.export(trace)
-        slow_ms = self._cfg_slow_ms
-        if slow_ms > 0 and trace.duration_ms >= slow_ms:
-            self.slow_log.export(trace)
+                try:
+                    e.export(trace)
+                except Exception:
+                    # a broken sink (ENOSPC in the JSONL file, a dead
+                    # disk) must never fail the QUERY whose trace this
+                    # is — same discipline as PeriodicReporter
+                    import logging
+                    logging.getLogger("geomesa_tpu.obs").warning(
+                        "trace exporter failed", exc_info=True)
+        cap = _capture.get()
+        if cap is not None:
+            # EXPLAIN ANALYZE collector: gets every root finished in
+            # its context, independent of the sampler's verdict
+            cap.export(trace)
+        if natural:
+            slow_ms = self._cfg_slow_ms
+            if slow_ms > 0 and trace.duration_ms >= slow_ms:
+                self.slow_log.export(trace)
+
+    @contextlib.contextmanager
+    def capture(self, capacity: int = 16):
+        """Force-record root spans opened in this context and collect
+        their finished traces locally (the EXPLAIN ANALYZE hook):
+        yields a :class:`RingExporter` that receives every root trace
+        finished inside the block, regardless of the configured
+        sampler — and even with ``geomesa.obs.enabled=false``, since
+        an explicit explain request IS the ask to trace.  The shared
+        ring and slow log receive a captured trace only when the root
+        would have recorded WITHOUT the capture (the ``natural`` gate
+        in ``_finish``), so capturing never makes tracing-off or
+        'never' surfaces non-silent."""
+        collector = RingExporter(capacity)
+        token = _capture.set(collector)
+        try:
+            yield collector
+        finally:
+            _capture.reset(token)
 
     def find(self, trace_id: str) -> Trace | None:
         """Look a trace up across the ring exporter and the slow log."""

@@ -311,11 +311,49 @@ class ShardedLeanAttrIndex:
             out[g.tier] += 1
         return out
 
+    #: per-slot device bytes (keys int64 + sec int64 + gid int64 — the
+    #: sharded gid column is int64, unlike the single-chip int32)
+    SLOT_BYTES = 8 + 8 + 8
+
     def device_bytes(self) -> int:
         """Total HBM across every shard's device generations."""
         shards = int(self.mesh.devices.size)
         return sum(g.per_shard_bytes() * shards
                    for g in self.generations)
+
+    def host_key_bytes(self) -> int:
+        """Host RAM THIS process holds in spilled (key, sec, gid)
+        runs (per-process residency; mesh-wide = sum over processes)."""
+        return sum(len(p[0]) * self.SLOT_BYTES
+                   for g in self.generations if g.spilled
+                   for p in g.spilled)
+
+    def sentinel_bytes(self) -> int:
+        return (0 if self._sentinel_gen is None
+                else self._sentinel_gen.per_shard_bytes()
+                * int(self.mesh.devices.size))
+
+    def storage_stats(self) -> dict:
+        """Live byte accounting for the storage report (obs/resource,
+        ISSUE 9) — the sharded twin of LeanAttrIndex.storage_stats."""
+        gens = [{"gen_id": g.gen_id, "tier": g.tier,
+                 "slots": int(g.n_slots), "capacity": g.slots,
+                 "device_bytes": (g.per_shard_bytes()
+                                  * int(self.mesh.devices.size)),
+                 "host_bytes": (sum(len(p[0]) * self.SLOT_BYTES
+                                    for p in g.spilled)
+                                if g.spilled else 0)}
+                for g in self.generations]
+        return {"kind": type(self).__name__, "rows": len(self),
+                "attr": self.attr,
+                "tiers": self.tier_counts(),
+                "device_bytes": self.device_bytes(),
+                "host_bytes": self.host_key_bytes(),
+                "sentinel_bytes": self.sentinel_bytes(),
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "generations": gens,
+                "caches": {"sketch": self._sketch_cache.stats()},
+                "dispatches": self.dispatch_count}
 
     def block(self) -> None:
         for gen in reversed(self.generations):

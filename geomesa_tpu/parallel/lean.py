@@ -595,6 +595,34 @@ class ShardedLeanZ3Index:
             out[g.tier] += 1
         return out
 
+    def sentinel_bytes(self) -> int:
+        """HBM (across every shard) of the allocated padding-sentinel
+        generations."""
+        return sum(g.device_bytes() for g in self._sentinels.values())
+
+    def storage_stats(self) -> dict:
+        """Live byte accounting for the storage report (obs/resource,
+        ISSUE 9) — the sharded twin of LeanZ3Index.storage_stats.
+        ``device_bytes`` spans every shard; ``host_bytes`` is THIS
+        process's spilled runs (host residency is per-process under
+        multihost, so the mesh-wide view is the gauge SUM across
+        processes — metrics.merge_snapshots)."""
+        gens = [{"gen_id": g.gen_id, "tier": g.tier,
+                 "slots": int(g.n_slots),
+                 "capacity": g.slots,
+                 "device_bytes": g.device_bytes(),
+                 "host_bytes": g.host_key_bytes()}
+                for g in self.generations]
+        return {"kind": type(self).__name__, "rows": len(self),
+                "tiers": self.tier_counts(),
+                "device_bytes": self.device_bytes(),
+                "host_bytes": self.host_key_bytes(),
+                "sentinel_bytes": self.sentinel_bytes(),
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "generations": gens,
+                "caches": {"sketch": self._sketch_cache.stats()},
+                "dispatches": self.dispatch_count}
+
     def block(self) -> None:
         for gen in reversed(self.generations):
             if gen.tier != "host":

@@ -109,6 +109,11 @@ class StrategyDecider:
         self.allowed_indices = allowed_indices
         self.attr_z3_tier = attr_z3_tier
         self.servable_attrs = servable_attrs
+        #: every option the last decide() costed (chosen included) —
+        #: the planner stamps these onto the query span so EXPLAIN
+        #: ANALYZE can show the estimates the decider threw away
+        #: (ISSUE 9; the reference narrates them via explainQuery only)
+        self.last_options: tuple = ()
 
     # -- cost estimates (StatsBasedEstimator spirit) ----------------------
     def _spatial_fraction(self, geometries) -> float:
@@ -284,6 +289,7 @@ class StrategyDecider:
         a requested index bypasses cost comparison)."""
         explain = explain or ExplainNull()
         chosen, options = self._decide(f)
+        self.last_options = tuple(options)
         explain.push("Strategy selection:")
         for o in options:
             explain(lambda o=o: f"option {o.index}: estimated cost {o.cost:.0f}")

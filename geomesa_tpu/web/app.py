@@ -26,6 +26,9 @@ Routes::
     GET    /metrics.prom                     Prometheus text exposition
     GET    /traces?slow=1                    recent (or slow-log) traces
     GET    /traces/{trace_id}                full span tree of one trace
+    GET    /debug/storage                    storage/HBM accounting report
+    GET    /explain?schema=&cql=             EXPLAIN ANALYZE (plan+actuals)
+    GET    /explain?sql=                     EXPLAIN ANALYZE of a SQL text
 
 Per-request metrics are recorded in the global registry (the reference's
 servlet-level ``AggregatedMetricsFilter``).  The trace endpoints read
@@ -81,6 +84,8 @@ class WebApp:
             (r"^/api/metrics\.prom$", self._metrics_prom),
             (r"^/traces$", self._traces),
             (r"^/traces/([^/]+)$", self._trace_item),
+            (r"^/debug/storage$", self._debug_storage),
+            (r"^/explain$", self._explain),
             (r"^/api/blob$", self._blob_index),
             (r"^/api/blob/([^/]+)$", self._blob_item),
             (r"^/wcs$", self._wcs),
@@ -270,7 +275,13 @@ class WebApp:
         a lone scrape would strand the mesh in the allgather)."""
         if method != "GET":
             raise HttpError(405, method)
-        from ..obs import prometheus_text
+        from ..obs import prometheus_text, publish_storage_gauges
+        try:
+            # refresh the storage.* gauges so every scrape carries
+            # CURRENT resident bytes, not the last /debug/storage hit
+            publish_storage_gauges(self.store)
+        except Exception:   # accounting must never break the scrape
+            pass
         if (params.get("mesh") in ("1", "true", "yes")
                 and getattr(self.store, "_multihost", False)):
             from ..parallel.stats import allreduce_metrics_snapshot
@@ -300,6 +311,39 @@ class WebApp:
         if t is None:
             raise HttpError(404, f"no such trace: {trace_id!r}")
         return 200, t.to_json()
+
+    def _debug_storage(self, method, params, environ):
+        """Storage/HBM accounting: per-schema/per-index byte residency
+        (device runs vs host spill vs caches, per generation) with the
+        accounted-vs-actual-nbytes reconciliation (obs/resource).  The
+        walk also refreshes the ``storage.*`` gauges."""
+        if method != "GET":
+            raise HttpError(405, method)
+        return 200, self.store.storage_report()
+
+    def _explain(self, method, params, environ):
+        """EXPLAIN ANALYZE: the plan narration merged with measured
+        actuals (obs/explain_analyze).  ``?schema=&cql=`` explains one
+        planner query; ``?sql=`` explains a SQL text (every store
+        query it runs is captured).  ``&format=text`` renders the
+        human tree instead of JSON."""
+        if method != "GET":
+            raise HttpError(405, method)
+        from ..obs import explain_analyze, explain_analyze_sql
+        sql = params.get("sql")
+        if sql:
+            res = explain_analyze_sql(self.store, sql)
+        else:
+            name = params.get("schema")
+            if not name:
+                raise HttpError(400,
+                                "need ?sql=... or ?schema=...[&cql=...]")
+            self._sft(name)
+            res = explain_analyze(self.store, name,
+                                  params.get("cql", "INCLUDE"))
+        if params.get("format") == "text":
+            return 200, res.render() + "\n", "text/plain"
+        return 200, res.to_json()
 
     # -- WCS-shaped raster serving (geomesa-accumulo-raster WCS role) -----
     def _wcs(self, method, params, environ):

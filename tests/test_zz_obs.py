@@ -407,7 +407,7 @@ def test_prometheus_exposition_parses(lean_ds):
     for line in body.strip().splitlines():
         if line.startswith("#"):
             assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
-                            r"(counter|summary)$", line), line
+                            r"(counter|summary|gauge)$", line), line
         else:
             assert _PROM_LINE.match(line), line
     assert 'geomesa_query_evt_scan_ms{quantile="0.5"}' in body

@@ -1,0 +1,453 @@
+"""Resource observability (ISSUE 9): storage/HBM accounting with the
+accounted-vs-actual reconciliation audit, EXPLAIN ANALYZE with
+estimate-vs-actual, the /debug/storage + /explain web surfaces, JSONL
+trace rotation, and the merge_snapshots edge cases.
+
+The storage acceptance shape: a warm multi-generation lean store
+(full + keys + host tiers, warmed caches) whose /debug/storage totals
+reconcile with independently summed array nbytes within the tolerances
+documented in obs/resource.py.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import obs
+from geomesa_tpu.audit import InMemoryAuditWriter
+from geomesa_tpu.config import clear_property, set_property
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.metrics import (
+    PLAN_ESTIMATE_RATIO, Gauge, MetricRegistry, merge_snapshots, registry,
+)
+from geomesa_tpu.obs.resource import (
+    index_actual_nbytes, publish_storage_gauges, storage_report,
+)
+
+MS = 1514764800000
+DAY = 86_400_000
+
+LEAN_Q = ("BBOX(geom,-74.5,40.5,-73.5,41.5) AND dtg DURING "
+          "2018-01-03T00:00:00Z/2018-01-10T00:00:00Z")
+
+
+def _mk_lean_store(audit=None, n=40_000):
+    rng = np.random.default_rng(31)
+    ds = TpuDataStore(audit_writer=audit, user="res-test")
+    # tight HBM budget => real tiering: live full-tier run, demoted
+    # keys runs, host spills — every residency class the storage
+    # report accounts for
+    ds.create_schema(
+        "evt", "score:Double,dtg:Date,*geom:Point;"
+               "geomesa.index.profile=lean,"
+               "geomesa.lean.generation.slots=16384,"
+               "geomesa.lean.compaction.factor=0,"
+               "geomesa.lean.hbm.budget=700000")
+    for s in range(0, n, 16_000):
+        m = min(16_000, n - s)
+        ds.write("evt", {
+            "score": rng.uniform(0, 100, m),
+            "dtg": rng.integers(MS, MS + 14 * DAY, m),
+            "geom": (rng.uniform(-75, -73, m), rng.uniform(40, 42, m))})
+    return ds
+
+
+@pytest.fixture(scope="module")
+def lean_ds():
+    audit = InMemoryAuditWriter()
+    ds = _mk_lean_store(audit=audit)
+    ds.query("evt", LEAN_Q)          # warm: builds + stacks host runs
+    ds._res_audit = audit
+    return ds
+
+
+def _call(app, method, path):
+    cap = {}
+
+    def sr(status, headers):
+        cap["status"] = int(status.split()[0])
+        cap["headers"] = dict(headers)
+
+    qs = ""
+    if "?" in path:
+        path, qs = path.split("?", 1)
+    body = b"".join(app({
+        "REQUEST_METHOD": method, "PATH_INFO": path, "QUERY_STRING": qs,
+        "CONTENT_LENGTH": "0", "wsgi.input": io.BytesIO(b"")}, sr))
+    return cap["status"], cap["headers"], body.decode()
+
+
+# -- storage accounting (tentpole a) ---------------------------------------
+
+def test_storage_report_reconciles_on_warm_multigeneration_store(lean_ds):
+    """ACCEPTANCE: byte totals reconcile with summed array nbytes on a
+    warm multi-generation store, within the documented tolerances."""
+    rep = storage_report(lean_ds)
+    recon = rep["reconciliation"]
+    assert recon["within_tolerance"], recon
+    # device accounting must be EXACT: constants vs actual dtypes
+    assert recon["device"]["accounted"] == recon["device"]["actual"] > 0
+    # host spill present (the tight budget forces it) and the
+    # accounted view never UNDERSTATES actual residency
+    assert recon["host"]["actual"] > 0
+    assert recon["host"]["accounted"] >= recon["host"]["actual"]
+    # the z3 index entry carries per-generation residency detail
+    z3 = rep["schemas"]["evt"]["indexes"]["z3"]
+    gens = z3["generations"]
+    assert len(gens) >= 3
+    assert {g["tier"] for g in gens} >= {"keys", "host"}
+    assert sum(g["device_bytes"] for g in gens) == z3["device_bytes"]
+    assert sum(g["host_bytes"] for g in gens) == z3["host_bytes"]
+    assert z3["rows"] == sum(g["rows"] for g in gens) == 40_000
+    # column store accounted: 40k rows x (score f64 + dtg i64 + x + y)
+    assert rep["schemas"]["evt"]["batch_host_bytes"] == 40_000 * 32
+
+
+def test_storage_report_audit_is_independent(lean_ds):
+    """The actual-nbytes walk re-derives device bytes from the arrays
+    themselves — agreeing with the constant-based accounting is the
+    audit (a dtype drift would break this, not slide by silently)."""
+    z3 = lean_ds._store("evt")._indexes["z3"]
+    audit = index_actual_nbytes(z3)
+    assert audit["device_bytes"] == z3.device_bytes()
+    st = z3.storage_stats()
+    assert st["device_bytes"] == audit["device_bytes"]
+    assert st["sentinel_bytes"] >= 0
+    assert st["hbm_budget_bytes"] == 700000
+
+
+def test_density_and_sketch_caches_report_bytes():
+    from geomesa_tpu.index.z3_lean import LeanZ3Index
+    rng = np.random.default_rng(37)
+    idx = LeanZ3Index(period="week", generation_slots=8192,
+                      payload_on_device=False)
+    for _ in range(3):
+        idx.append(rng.uniform(-75, -73, 8192), rng.uniform(40, 42, 8192),
+                   rng.integers(MS, MS + 14 * DAY, 8192))
+    idx.block()
+    box = [(-74.5, 40.5, -73.5, 41.5)]
+    args = (box, MS + 2 * DAY, MS + 9 * DAY, (-180, -90, 180, 90), 64, 64)
+    idx.density(*args)
+    idx.density(*args)                       # warm: sealed partials cached
+    st = idx.storage_stats()
+    assert st["caches"]["density"]["bytes"] > 0
+    assert st["caches"]["density"]["partials"] >= 2
+    audit = index_actual_nbytes(idx)
+    assert audit["cache_bytes"] == (st["caches"]["density"]["bytes"]
+                                    + st["caches"]["sketch"]["bytes"])
+
+
+def test_sharded_lean_storage_stats():
+    from geomesa_tpu.parallel import device_mesh
+    from geomesa_tpu.parallel.lean import ShardedLeanZ3Index
+    rng = np.random.default_rng(41)
+    idx = ShardedLeanZ3Index(period="week", mesh=device_mesh(),
+                             generation_slots=1024,
+                             payload_on_device=False)
+    for _ in range(2):
+        m = 8 * 1024
+        idx.append(rng.uniform(-75, -73, m), rng.uniform(40, 42, m),
+                   rng.integers(MS, MS + 14 * DAY, m))
+    idx.block()
+    st = idx.storage_stats()
+    assert st["device_bytes"] == idx.device_bytes() > 0
+    audit = index_actual_nbytes(idx)
+    assert audit["device_bytes"] == st["device_bytes"]
+    assert st["rows"] == len(idx) == 16 * 1024
+
+
+def test_debug_storage_endpoint_and_gauges(lean_ds):
+    from geomesa_tpu.web import WebApp
+    app = WebApp(lean_ds)
+    status, _, body = _call(app, "GET", "/debug/storage")
+    assert status == 200
+    rep = json.loads(body)
+    assert rep["reconciliation"]["within_tolerance"]
+    assert rep["totals"]["device_bytes"] > 0
+    # the walk refreshed the storage.* gauges → scrapeable from prom
+    status, _, prom = _call(app, "GET", "/metrics.prom")
+    assert status == 200
+    assert ("geomesa_storage_total_device_bytes "
+            f"{float(rep['totals']['device_bytes'])!r}") in prom \
+        or "geomesa_storage_total_device_bytes" in prom
+    assert "# TYPE geomesa_storage_total_device_bytes gauge" in prom
+    assert "geomesa_storage_evt_z3_device_bytes" in prom
+
+
+def test_stale_storage_gauges_retire_on_republish():
+    """A dropped schema's gauges must disappear on the next publish —
+    phantom resident bytes would outlive the memory they described."""
+    rng = np.random.default_rng(43)
+    ds = TpuDataStore(user="stale")
+    ds.create_schema("tmp", "dtg:Date,*geom:Point")
+    n = 2_000
+    ds.write("tmp", {"dtg": rng.integers(MS, MS + DAY, n),
+                     "geom": (rng.uniform(-75, -73, n),
+                              rng.uniform(40, 42, n))})
+    publish_storage_gauges(ds)
+    assert "storage.tmp.batch_bytes" in registry.names()
+    ds.remove_schema("tmp")
+    publish_storage_gauges(ds)
+    assert "storage.tmp.batch_bytes" not in registry.names()
+    assert "storage.total.device_bytes" in registry.names()
+
+
+def test_publish_tracks_gauges_per_store(lean_ds):
+    """A second store's publish must not retire the first store's live
+    gauges (per-store key tracking, not a module global)."""
+    rng = np.random.default_rng(47)
+    other = TpuDataStore(user="other")
+    other.create_schema("aux", "dtg:Date,*geom:Point")
+    n = 1_000
+    other.write("aux", {"dtg": rng.integers(MS, MS + DAY, n),
+                        "geom": (rng.uniform(-75, -73, n),
+                                 rng.uniform(40, 42, n))})
+    publish_storage_gauges(lean_ds)
+    assert "storage.evt.batch_bytes" in registry.names()
+    publish_storage_gauges(other)
+    assert "storage.evt.batch_bytes" in registry.names()
+    assert "storage.aux.batch_bytes" in registry.names()
+
+
+def test_reconciliation_tolerance_is_one_directional():
+    """Overstatement within tolerance passes; understatement beyond
+    float slack fails — real bytes exceeding the budget's belief is
+    the dangerous direction."""
+    from geomesa_tpu.obs.resource import _reconcile
+    assert _reconcile(130, 100, "host")["ok"]          # +30% < 35%
+    assert not _reconcile(140, 100, "host")["ok"]      # +40% > 35%
+    assert not _reconcile(70, 100, "host")["ok"]       # -30% understates
+    assert _reconcile(100, 100, "device")["ok"]
+    assert not _reconcile(95, 100, "device")["ok"]
+    assert _reconcile(0, 0, "cache")["ok"]
+
+
+def test_gauge_metric_snapshot_and_merge():
+    reg = MetricRegistry()
+    reg.gauge("storage.total.device_bytes").set(100)
+    assert isinstance(reg._metrics["storage.total.device_bytes"], Gauge)
+    snap = reg.snapshot()
+    assert snap["storage.total.device_bytes"] == {"value": 100.0}
+    other = {"storage.total.device_bytes": {"value": 28.0}}
+    merged = merge_snapshots([snap, other])
+    assert merged["storage.total.device_bytes"]["value"] == 128.0
+
+
+# -- EXPLAIN ANALYZE (tentpole b) ------------------------------------------
+
+def test_planned_query_span_carries_estimate_and_actuals(lean_ds):
+    """ACCEPTANCE: every planned query span carries the estimate,
+    actual scanned/matched, and the ratio feeds a scrapeable metric."""
+    h0 = registry.histogram(PLAN_ESTIMATE_RATIO).count
+    got = lean_ds.query_result("evt", LEAN_Q)
+    assert registry.histogram(PLAN_ESTIMATE_RATIO).count == h0 + 1
+    tr = obs.tracer.ring.traces()[-1]
+    assert tr.name == "query"
+    a = tr.root_span.attributes
+    assert a["plan.estimate.rows"] > 0
+    assert a["plan.actual.scanned"] >= a["plan.actual.matched"] > 0
+    assert a["plan.actual.matched"] == len(got.positions)
+    assert a["plan.estimate.ratio"] == pytest.approx(
+        (a["plan.estimate.rows"] + 1) / (a["plan.actual.scanned"] + 1),
+        rel=1e-3)
+    plan = [s for s in tr.spans if s.name == "query.plan"][-1]
+    assert plan.attributes["plan.estimate.rows"] == a["plan.estimate.rows"]
+    assert "full" in plan.attributes["plan.options"]
+    # scrapeable from /metrics.prom
+    from geomesa_tpu.web import WebApp
+    _, _, prom = _call(WebApp(lean_ds), "GET", "/metrics.prom")
+    assert 'geomesa_plan_estimate_ratio{quantile="0.5"}' in prom
+
+
+def test_explain_analyze_api(lean_ds):
+    res = lean_ds.explain_analyze("evt", LEAN_Q)
+    s = res.summary
+    assert s["strategy"] == "z3"
+    assert s["estimate_rows"] > 0
+    assert s["actual_scanned"] > 0
+    assert s["actual_matched"] == res.result_summary["hits"] > 0
+    assert s["estimate_ratio"] > 0
+    assert "full" in s["options"]
+    tree = res.tree()
+    assert tree["name"] == "query"
+    names = {c["name"] for c in tree["children"]}
+    assert {"query.plan", "query.scan", "query.post_filter"} <= names
+    text = res.render()
+    assert "strategy=z3" in text and "Plan narration:" in text
+    assert "Estimate audit" in text
+
+
+def test_explain_analyze_forces_capture_under_never_sampler(lean_ds):
+    """An explicit explain request must trace even with sampling off —
+    the capture path bypasses the sampler (but not the shared ring)."""
+    set_property("geomesa.obs.sampler", "never")
+    try:
+        r0 = len(obs.tracer.ring)
+        res = lean_ds.explain_analyze("evt", LEAN_Q)
+        assert res.trace is not None
+        assert res.summary["estimate_rows"] > 0
+        assert len(obs.tracer.ring) == r0       # never-sampled: not exported
+    finally:
+        clear_property("geomesa.obs.sampler")
+
+
+def test_capture_keeps_slow_log_silent_when_sampler_never(lean_ds):
+    """'never' is a true off switch (module doc): a captured slow query
+    must not leak into the shared slow log — and with tracing disabled
+    entirely, neither ring nor slow log may grow."""
+    set_property("geomesa.obs.sampler", "never")
+    set_property("geomesa.obs.slow.ms", 0.0001)   # everything is "slow"
+    try:
+        s0 = len(obs.tracer.slow_log)
+        res = lean_ds.explain_analyze("evt", LEAN_Q)
+        assert res.trace is not None              # capture still records
+        assert len(obs.tracer.slow_log) == s0
+    finally:
+        clear_property("geomesa.obs.sampler")
+        clear_property("geomesa.obs.slow.ms")
+    set_property("geomesa.obs.enabled", False)
+    set_property("geomesa.obs.slow.ms", 0.0001)
+    try:
+        r0, s0 = len(obs.tracer.ring), len(obs.tracer.slow_log)
+        res = lean_ds.explain_analyze("evt", LEAN_Q)
+        assert res.trace is not None
+        assert len(obs.tracer.ring) == r0
+        assert len(obs.tracer.slow_log) == s0
+    finally:
+        clear_property("geomesa.obs.enabled")
+        clear_property("geomesa.obs.slow.ms")
+
+
+def test_explain_endpoint(lean_ds):
+    from geomesa_tpu.web import WebApp
+    app = WebApp(lean_ds)
+    status, _, body = _call(
+        app, "GET", "/explain?schema=evt&cql=" + LEAN_Q.replace(" ", "%20"))
+    assert status == 200
+    out = json.loads(body)
+    assert out["summary"]["estimate_rows"] > 0
+    assert out["summary"]["actual_matched"] > 0
+    assert out["plans"][0]["name"] == "query"
+    status, headers, text = _call(
+        app, "GET", "/explain?schema=evt&format=text")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "EXPLAIN ANALYZE schema:evt" in text
+    status, _, _ = _call(app, "GET", "/explain")
+    assert status == 400
+    status, _, _ = _call(app, "GET", "/explain?schema=nope")
+    assert status == 404
+
+
+def test_explain_endpoint_sql(lean_ds):
+    from geomesa_tpu.web import WebApp
+    app = WebApp(lean_ds)
+    status, _, body = _call(
+        app, "GET",
+        "/explain?sql=SELECT%20count(*)%20FROM%20evt%20WHERE%20"
+        "score%20%3E%2050")
+    assert status == 200
+    out = json.loads(body)
+    assert out["target"] == "sql"
+    assert out["plans"], "the SQL's store queries must be captured"
+
+
+# -- satellite: JSONL trace rotation ---------------------------------------
+
+def test_jsonl_exporter_rotates_at_size_cap(tmp_path):
+    path = str(tmp_path / "traces.jsonl")
+    exp = obs.JsonlExporter(path, max_bytes=4096)
+    t = obs.Tracer(sampler=obs.AlwaysSampler(), exporters=[exp])
+    for i in range(200):
+        with t.span("query", schema="rot", i=i):
+            pass
+    exp.close()
+    import os
+    assert os.path.exists(path + ".1"), "rotation must have happened"
+    live = os.path.getsize(path)
+    rolled = os.path.getsize(path + ".1")
+    assert live + rolled <= 4096 + 512      # bounded by the cap (+1 line)
+    # both files still hold valid JSONL, newest trace last in the live
+    lines = open(path).read().splitlines()
+    assert json.loads(lines[-1])["name"] == "query"
+    assert json.loads(open(path + ".1").read().splitlines()[0])
+
+
+def test_jsonl_rotation_option_is_live(tmp_path):
+    path = str(tmp_path / "t2.jsonl")
+    set_property("geomesa.obs.trace.max_bytes", 2048)
+    try:
+        exp = obs.JsonlExporter(path)       # cap from the option
+        t = obs.Tracer(sampler=obs.AlwaysSampler(), exporters=[exp])
+        for i in range(100):
+            with t.span("query", i=i):
+                pass
+        exp.close()
+        import os
+        assert os.path.getsize(path) <= 2048
+    finally:
+        clear_property("geomesa.obs.trace.max_bytes")
+
+
+# -- satellite: merge_snapshots edge cases ---------------------------------
+
+def test_merge_snapshots_empty_inputs():
+    assert merge_snapshots([]) == {}
+    assert merge_snapshots([{}, {}]) == {}
+
+
+def test_merge_snapshots_disjoint_metrics_and_buckets():
+    a, b = MetricRegistry(), MetricRegistry()
+    a.counter("lean.only_a").inc(2)
+    b.counter("lean.only_b").inc(5)
+    # disjoint value ranges → disjoint bucket tables
+    for v in (0.01, 0.02, 0.03):
+        a.histogram("lean.h").update(v)
+    for v in (10_000.0, 20_000.0, 40_000.0):
+        b.histogram("lean.h").update(v)
+    merged = merge_snapshots([a.snapshot(buckets=True),
+                              b.snapshot(buckets=True)])
+    assert merged["lean.only_a"] == {"count": 2}
+    assert merged["lean.only_b"] == {"count": 5}
+    h = merged["lean.h"]
+    assert h["count"] == 6
+    assert h["min"] == 0.01 and h["max"] == 40_000.0
+    # p50 must sit between the two disjoint clusters' extremes
+    assert 0.01 <= h["p50"] <= 10_000.0
+    assert h["p99"] >= 10_000.0
+
+
+def test_merge_snapshots_one_sided_histogram():
+    """A metric present on one process only (e.g. host spill happened
+    on a single worker) must merge as itself."""
+    a, b = MetricRegistry(), MetricRegistry()
+    for v in (1.0, 2.0, 4.0):
+        a.timer("lean.t").update(v)
+    b.counter("lean.c").inc()
+    merged = merge_snapshots([a.snapshot(buckets=True),
+                              b.snapshot(buckets=True)])
+    assert merged["lean.t"]["count"] == 3
+    assert merged["lean.t"]["min"] == 1.0
+    assert merged["lean.t"]["max"] == 4.0
+    assert merged["lean.t"]["p50"] == pytest.approx(2.0, rel=0.16)
+
+
+def test_merge_snapshots_zero_only_histogram():
+    """All-zero updates live in the zero bucket (no log bucket) — the
+    merge must not divide by an empty table."""
+    a = MetricRegistry()
+    for _ in range(4):
+        a.histogram("lean.z").update(0.0)
+    merged = merge_snapshots([a.snapshot(buckets=True)])
+    assert merged["lean.z"]["count"] == 4
+    assert merged["lean.z"]["p50"] == 0.0
+    assert merged["lean.z"]["p99"] == 0.0
+
+
+def test_merge_snapshots_still_rejects_bucketless_histograms():
+    a = MetricRegistry()
+    a.timer("lean.t").update(3.0)
+    with pytest.raises(ValueError, match="buckets=True"):
+        merge_snapshots([a.snapshot()])     # plain snapshot: no tables

@@ -1,0 +1,38 @@
+"""Metric-naming lint (ISSUE 9 satellite): after the whole suite has
+run (this module collects LAST — 'zzz' sorts after every 'zz_'), walk
+the full process-global metric registry and assert every key matches
+the namespace contract documented in docs/observability.md.  A drive-by
+metric typo (``lena.compaction.merges``) lands a key outside the
+contract and fails here at tier-1 time instead of silently splitting a
+dashboard.
+"""
+
+from geomesa_tpu.metrics import (
+    METRIC_NAMESPACES, lint_metric_names, registry,
+)
+
+
+def test_registry_keys_match_naming_contract():
+    names = registry.names()
+    # the suite must have populated the registry — an empty walk would
+    # make this test vacuously green
+    assert names, "expected the suite to have recorded metrics"
+    violations = lint_metric_names(names)
+    assert violations == [], (
+        f"metric keys outside the documented namespaces "
+        f"{METRIC_NAMESPACES}: {violations} — fix the key or extend "
+        f"the contract in docs/observability.md AND metrics.py")
+
+
+def test_lint_catches_bad_keys():
+    bad = ["lena.compaction.merges",      # namespace typo
+           "query",                       # bare namespace, no leaf
+           "lean..double_dot",
+           "lean.spaced key",
+           "unknown.thing"]
+    good = ["query.evt.count", "lean.device.ms", "jax.compile.count",
+            "storage.evt.attr:score.device_bytes", "web.200",
+            "plan.estimate.ratio", "write.pts.features",
+            "pallas.density.fallback", "obs.test.empty_ms"]
+    assert lint_metric_names(good) == []
+    assert lint_metric_names(good + bad) == sorted(bad)
