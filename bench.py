@@ -416,6 +416,7 @@ def main():
     stats_pd = _stats_pushdown_stanza()
     xz3_scale = _xz3_scale_stanza()
     obs_stanza = _obs_stanza()
+    heat_stanza = _heat_stanza()
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -447,6 +448,7 @@ def main():
             "stats_pushdown": stats_pd,
             "xz3_scale": xz3_scale,
             "obs": obs_stanza,
+            "heat": heat_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -535,6 +537,11 @@ def _compact_summary(full: dict) -> dict:
                 for k in ("overhead_pct", "warm_recompiles",
                           "trace_spans")
                 if k in (ex.get("obs") or {})},
+            "heat": {
+                k: (ex.get("heat") or {}).get(k)
+                for k in ("ingest_overhead_pct", "query_overhead_pct",
+                          "tracked_entries")
+                if k in (ex.get("heat") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -759,6 +766,85 @@ def _obs_stanza() -> dict:
     return out
 
 
+def _heat_stanza() -> dict:
+    """Heat-tracking + write-span overhead (ISSUE 12): the warm lean
+    STORE ingest path (datastore writes — the full write-span tree:
+    encode / index append / seal / spill / observe) and the warm query
+    path, each measured with the workload instrumentation at defaults
+    (heat tracking + tracing on) vs fully off.  The acceptance budget
+    is ≤ 5% on both; the regression gate treats the ``*_overhead_pct``
+    leaves as lower-is-better.  ``HEAT_BENCH_N=0`` skips."""
+    import time
+
+    import numpy as np
+
+    n = int(os.environ.get("HEAT_BENCH_N", 2_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    try:
+        from geomesa_tpu.config import clear_property, set_property
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.obs import heat_tracker
+
+        ms0 = 1_514_764_800_000
+        day = 86_400_000
+        slots = 1 << 18
+        spec = ("dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+                f"geomesa.lean.generation.slots={slots},"
+                "geomesa.lean.compaction.factor=0")
+        q = [(-60.0, -30.0, 60.0, 30.0)]
+        windows = [(q, ms0 + i * day, ms0 + (i + 3) * day)
+                   for i in range(8)]
+
+        def build_and_query(name: str, rows: int):
+            rng = np.random.default_rng(23)
+            ds = TpuDataStore(user="heat-bench")
+            ds.create_schema(name, spec)
+            t0 = time.perf_counter()
+            for lo in range(0, rows, slots):
+                m = min(slots, rows - lo)
+                ds.write(name, {
+                    "dtg": rng.integers(ms0, ms0 + 14 * day, m),
+                    "geom": (rng.uniform(-180, 180, m),
+                             rng.uniform(-90, 90, m))})
+            idx = ds._store(name)._indexes["z3"]
+            idx.block()
+            ingest_s = time.perf_counter() - t0
+            idx.query_many(windows)         # warm/compile
+            q_ms = _median_time(lambda: idx.query_many(windows),
+                                iters=7) * 1e3
+            return ingest_s, q_ms, len(idx.generations)
+
+        # untimed warm-up: compile the append/scan programs once, so
+        # the on-vs-off comparison measures the instrumentation tax,
+        # not which run happened to pay the compiles
+        build_and_query("hb_warm", min(n, 2 * slots))
+        on_s, on_q_ms, gens = build_and_query("hb_on", n)
+        set_property("geomesa.obs.heat.enabled", False)
+        set_property("geomesa.obs.enabled", False)
+        try:
+            off_s, off_q_ms, _ = build_and_query("hb_off", n)
+        finally:
+            clear_property("geomesa.obs.heat.enabled")
+            clear_property("geomesa.obs.enabled")
+        out["rows"] = n
+        out["generations"] = gens
+        out["tracked_entries"] = len(heat_tracker)
+        out["ingest_on_s"] = round(on_s, 3)
+        out["ingest_off_s"] = round(off_s, 3)
+        out["ingest_overhead_pct"] = round(
+            (on_s / max(off_s, 1e-9) - 1.0) * 100.0, 2)
+        out["query_on_ms"] = round(on_q_ms, 2)
+        out["query_off_ms"] = round(off_q_ms, 2)
+        out["query_overhead_pct"] = round(
+            (on_q_ms / max(off_q_ms, 1e-9) - 1.0) * 100.0, 2)
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
+    out.update(_mem_probe())
+    return out
+
+
 def _mem_highwater(extra: dict) -> dict:
     """The gated memory leaves: a fresh end-of-run probe, with
     ``device_resident_bytes`` raised to the max across every stanza's
@@ -781,9 +867,13 @@ REGRESSION_TOLERANCE = 0.20
 #: regress DOWN; the STORAGE direction (ISSUE 9) treats the per-stanza
 #: memory leaves (`peak_rss_mb` host high-water mark,
 #: `device_resident_bytes` live HBM) as lower-better too, so a memory
-#: regression fails as loudly as a perf one; anything else (hit counts,
-#: row totals, booleans) is not a direction and is never flagged
-_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_rss_mb", "_resident_bytes")
+#: regression fails as loudly as a perf one; the OVERHEAD direction
+#: (ISSUE 12) does the same for the `*_overhead_pct` instrumentation-
+#: tax leaves (heat tracking + write spans must stay cheap); anything
+#: else (hit counts, row totals, booleans) is not a direction and is
+#: never flagged
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_rss_mb", "_resident_bytes",
+                          "_overhead_pct")
 _HIGHER_BETTER_MARKS = ("per_sec", "speedup", "wins", "value")
 
 

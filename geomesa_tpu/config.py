@@ -134,6 +134,30 @@ class ObsProperties:
     #: count XLA backend compiles via the jax.monitoring listener
     #: (jax.compile.* metrics); the classic silent TPU perf cliff
     RECOMPILE_TRACK = SystemProperty("geomesa.obs.recompile.track", True)
+    #: access-temperature tracking (obs/heat.py): per-(schema, index,
+    #: generation) touch counters folded into a decayed temperature
+    #: score — the workload data plane the tier autopilot consumes.
+    #: Off reduces every record site to one cached bool read.
+    HEAT_ENABLED = SystemProperty("geomesa.obs.heat.enabled", True)
+    #: temperature decay constant τ in seconds: each touch contributes
+    #: ``exp(-(now - t)/τ)`` to a generation's score, so a touch fades
+    #: to ~37% after τ seconds (half-life τ·ln 2 ≈ 0.69τ)
+    HEAT_TAU_S = SystemProperty("geomesa.obs.heat.tau.s", 600.0)
+    #: hard bound on tracked (schema, index, generation) entries —
+    #: beyond it the coldest entries evict (bounded memory under
+    #: generation churn)
+    HEAT_MAX_ENTRIES = SystemProperty("geomesa.obs.heat.max.entries",
+                                      8192)
+    #: write-path device attribution: when a write runs under a
+    #: RECORDING span, block on the live index generation at the end of
+    #: the write so the trace carries honest block-until-ready device
+    #: ms (the scan-span discipline).  Blocking only forces work that
+    #: must complete anyway; off keeps appends fully pipelined even
+    #: while traced
+    WRITE_BLOCK = SystemProperty("geomesa.obs.write.block", True)
+    #: background-job registry retention (obs/jobs.py): finished
+    #: IngestJob/CompactionJob records kept for /debug/jobs
+    JOBS_CAPACITY = SystemProperty("geomesa.obs.jobs.capacity", 128)
 
 
 #: default scan-ranges budget (import-time snapshot users can override per

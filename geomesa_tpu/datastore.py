@@ -349,6 +349,9 @@ class _SchemaStore:
                     lo = i * step
                     idx.append(x[lo:lo + step], y[lo:lo + step],
                                t[lo:lo + step])
+        # access-temperature attribution scope (obs/heat): the index's
+        # touches record under this schema + registry key
+        idx.heat_scope = (self.sft.name, kind)
         self._indexes[kind] = idx
         self._index_coverage[kind] = n
         self.build_counts[kind] = self.build_counts.get(kind, 0) + 1
@@ -467,6 +470,7 @@ class _SchemaStore:
                     idx.append(col[lo:lo + step],
                                np.asarray(dtg[lo:lo + step], np.int64),
                                base_gid=lo)
+            idx.heat_scope = (self.sft.name, key)
             self._indexes[key] = idx
             self._index_coverage[key] = n
             self.build_counts[key] = self.build_counts.get(key, 0) + 1
@@ -487,6 +491,8 @@ class _SchemaStore:
             self.visibilities = np.concatenate(
                 [self.visibilities,
                  np.full(n_new, visibility, dtype=object)])
+        from .config import ObsProperties
+        from .obs import current_span, device_span, span as obs_span
         from .stats.stat import observe_shared
         # stats observe runs on a worker thread OVERLAPPING the index
         # appends' host work (pad/encode/device_put below — numpy
@@ -508,30 +514,47 @@ class _SchemaStore:
             if self.tombstone is not None:
                 self.tombstone = np.concatenate(
                     [self.tombstone, np.zeros(n_new, dtype=bool)])
-            if self.lean_kind in ("xz2", "xz3"):
-                dtg = (np.asarray(chunk.column(self.sft.dtg_field),
-                                  np.int64)
-                       if self.sft.dtg_field else
-                       np.zeros(n_new, np.int64))
-                if self.lean_kind == "xz3":
-                    idx.append_bboxes(chunk.geoms.bbox, dtg,
-                                      base_gid=prior)
+            with obs_span("write.index", index=self.lean_kind,
+                          rows=n_new):
+                if self.lean_kind in ("xz2", "xz3"):
+                    dtg = (np.asarray(chunk.column(self.sft.dtg_field),
+                                      np.int64)
+                           if self.sft.dtg_field else
+                           np.zeros(n_new, np.int64))
+                    if self.lean_kind == "xz3":
+                        idx.append_bboxes(chunk.geoms.bbox, dtg,
+                                          base_gid=prior)
+                    else:
+                        idx.append_bboxes(chunk.geoms.bbox,
+                                          base_gid=prior)
                 else:
-                    idx.append_bboxes(chunk.geoms.bbox, base_gid=prior)
-            else:
-                x, y = chunk.geom_xy(self.sft.geom_field)
-                dtg = np.asarray(chunk.column(self.sft.dtg_field),
-                                 np.int64)
-                idx.append(np.asarray(x, np.float64),
-                           np.asarray(y, np.float64), dtg)
+                    x, y = chunk.geom_xy(self.sft.geom_field)
+                    dtg = np.asarray(chunk.column(self.sft.dtg_field),
+                                     np.int64)
+                    idx.append(np.asarray(x, np.float64),
+                               np.asarray(y, np.float64), dtg)
             self._index_coverage[self.lean_kind] = len(self.batch)
             for a, ai in attr_idx:
-                ai.append(chunk.column(a), dtg, base_gid=prior)
+                with obs_span("write.index", index=f"attr:{a}",
+                              rows=n_new):
+                    ai.append(chunk.column(a), dtg, base_gid=prior)
                 self._index_coverage[f"attr:{a}"] = len(self.batch)
+            if (current_span() is not None
+                    and ObsProperties.WRITE_BLOCK.to_bool()
+                    and hasattr(idx, "block")):
+                # device attribution for TRACED writes: appends are
+                # async by design, so block on the live run here and
+                # record honest block-until-ready ms (the scan-span
+                # discipline) — only when a recording trace asked for
+                # it, so untraced ingest stays fully pipelined
+                with device_span("write.device",
+                                 index=self.lean_kind):
+                    idx.block()
         finally:
             # joined on EVERY path: stats are consistent before any
             # caller (or exception handler) can read them
-            observe_fut.result()
+            with obs_span("write.observe", rows=n_new):
+                observe_fut.result()
 
     def _lean_observe_masked(self, proto, mask: np.ndarray | None):
         """Fold the (masked) rows into a fresh copy of ``proto`` in
@@ -653,11 +676,14 @@ class _SchemaStore:
         self._dev_xy = None
         self._dirty = False
         n_now = len(self.batch)
+        from .obs import span as obs_span
         if z3 is not None:
             if self.sft.is_points and self.sft.geom_field and self.sft.dtg_field:
                 x, y = batch.geom_xy(self.sft.geom_field)
-                self._indexes["z3"] = z3.append(
-                    x, y, batch.column(self.sft.dtg_field))
+                with obs_span("write.index", index="z3",
+                              rows=len(batch)):
+                    self._indexes["z3"] = z3.append(
+                        x, y, batch.column(self.sft.dtg_field))
                 self._index_coverage["z3"] = n_now
             else:
                 self._indexes.pop("z3", None)
@@ -666,7 +692,9 @@ class _SchemaStore:
             if self.sft.is_points and self.sft.geom_field and hasattr(
                     z2, "append"):
                 x, y = batch.geom_xy(self.sft.geom_field)
-                self._indexes["z2"] = z2.append(x, y)
+                with obs_span("write.index", index="z2",
+                              rows=len(batch)):
+                    self._indexes["z2"] = z2.append(x, y)
                 self._index_coverage["z2"] = n_now
             else:
                 self._indexes.pop("z2", None)
@@ -1358,7 +1386,24 @@ class TpuDataStore:
         reference's attribute-level visibility / KryoVisibilityRowEncoder):
         unauthorized callers see the row but the guarded values are
         nulled.
+
+        Every write is ONE trace (the query-span symmetry, ISSUE 12):
+        a root ``write`` span over ``write.encode`` (input → columns),
+        per-index ``write.index`` appends, ``write.seal``/
+        ``write.spill`` lifecycle events, ``write.observe`` (the stats
+        join), and — while the trace records — a ``write.device``
+        block-until-ready device attribution (docs/observability.md).
         """
+        from .obs import span as obs_span
+        with obs_span("write", schema=name) as wsp:
+            n = self._write_inner(name, data, ids, visibility,
+                                  attribute_visibilities)
+            wsp.set_attr("rows", int(n))
+            return n
+
+    def _write_inner(self, name: str, data, ids, visibility: str,
+                     attribute_visibilities: dict | None) -> int:
+        from .obs import span as obs_span
         from .security import parse_visibility
         if visibility:
             parse_visibility(visibility)  # validate eagerly
@@ -1393,14 +1438,17 @@ class TpuDataStore:
                 raise ValueError(
                     "lean-profile schemas use implicit feature ids "
                     "(row number); explicit ids are not supported")
-            if isinstance(data, FeatureBatch):
-                chunk = ChunkView(store.sft, dict(data.columns),
-                                  len(data), geoms=data.geoms)
-            else:
-                cols, geoms = build_columns(store.sft, data)
-                n_chunk = (len(next(iter(cols.values()))) if cols
-                           else (len(geoms) if geoms is not None else 0))
-                chunk = ChunkView(store.sft, cols, n_chunk, geoms=geoms)
+            with obs_span("write.encode", lean=True):
+                if isinstance(data, FeatureBatch):
+                    chunk = ChunkView(store.sft, dict(data.columns),
+                                      len(data), geoms=data.geoms)
+                else:
+                    cols, geoms = build_columns(store.sft, data)
+                    n_chunk = (len(next(iter(cols.values()))) if cols
+                               else (len(geoms) if geoms is not None
+                                     else 0))
+                    chunk = ChunkView(store.sft, cols, n_chunk,
+                                      geoms=geoms)
             store.write(chunk, visibility=visibility)
             store.next_fid = len(store.batch)
             from .metrics import registry as _metrics
@@ -1414,8 +1462,10 @@ class TpuDataStore:
                     f"dtg field ({attr!r}): indexes scan them unmasked")
             if expr:
                 parse_visibility(expr)
-        batch = (data if isinstance(data, FeatureBatch)
-                 else FeatureBatch.from_dict(store.sft, data, ids=ids))
+        with obs_span("write.encode", lean=False):
+            batch = (data if isinstance(data, FeatureBatch)
+                     else FeatureBatch.from_dict(store.sft, data,
+                                                 ids=ids))
         auto_ids = not batch.ids_explicit
         if auto_ids:
             # feature ids must be unique across writes: re-base auto ids on
@@ -1881,6 +1931,21 @@ class TpuDataStore:
         from .obs.resource import publish_storage_gauges, storage_report
         rep = storage_report(self)
         publish_storage_gauges(self, rep)
+        return rep
+
+    def heat_report(self, limit: int | None = None) -> dict:
+        """Access-temperature report (obs/heat, ISSUE 12): every lean
+        generation ranked hot→cold by decayed touch temperature,
+        joined with its current device/host placement from the storage
+        accounting, plus per-(schema, index) aggregates — the workload
+        picture the tier autopilot (ROADMAP item 6) consumes.  Also
+        publishes the ``heat.*`` gauges.  Served at
+        ``GET /debug/heat``."""
+        from .obs.heat import heat_report, publish_heat_gauges
+        rep = heat_report(self)
+        publish_heat_gauges(self, rep)   # gauges see the FULL report
+        if limit is not None:
+            rep["generations"] = rep["generations"][:limit]
         return rep
 
     # -- stats (GeoMesaStats analog) --------------------------------------

@@ -50,7 +50,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..metrics import WRITE_SEALS, WRITE_SPILLS
 from ..obs import device_span, obs_count, span as obs_span
+from ..obs.heat import (
+    heat_enabled, merge_index_generations, record_index_scan,
+)
 from ..ops.search import (
     coded_pos_bits, expand_ranges, gather_capacity, pad_pow2,
     searchsorted2, wire_dtype,
@@ -390,6 +394,10 @@ class LeanAttrIndex:
     per-range sec windows; results are CANDIDATE gids (the planner's
     residual filter makes them exact, as for every index here)."""
 
+    #: ``(schema, index_key)`` for access-temperature attribution
+    #: (obs/heat) — stamped by the datastore / the owning XZ facade
+    heat_scope: tuple | None = None
+
     GENERATION_SLOTS = 1 << 24
     DEFAULT_CAPACITY = 1 << 15
     BATCH_SCAN_BUDGET = 1 << 26
@@ -438,6 +446,15 @@ class LeanAttrIndex:
     def _next_gen_id(self) -> int:
         self._gen_counter += 1
         return self._gen_counter
+
+    def _roll_generation(self) -> "_AttrGeneration":
+        """Open a fresh live generation and rebalance (the append
+        rollover body, factored so the seal span wraps it once)."""
+        gen = _AttrGeneration(self.generation_slots)
+        gen.gen_id = self._next_gen_id()
+        self.generations.append(gen)
+        self._rebalance()
+        return self.generations[-1]
 
     def __len__(self) -> int:
         return self._n_rows
@@ -511,7 +528,12 @@ class LeanAttrIndex:
             if self.device_bytes() <= self._budget_after_sentinels():
                 return
             if gen.tier == "device":
-                gen.spill_to_host()
+                # blocking device→host transfer — traced with honest
+                # block-until-ready ms (the write-span taxonomy)
+                with device_span("write.spill", gen_id=gen.gen_id,
+                                 rows=int(gen.n)):
+                    obs_count(WRITE_SPILLS)
+                    gen.spill_to_host()
                 self._host_stack = None
         if self.device_bytes() > self._budget_after_sentinels():
             raise MemoryError(
@@ -535,11 +557,14 @@ class LeanAttrIndex:
         while done < m_total:
             gen = (self.generations[-1] if self.generations else None)
             if gen is None or gen.tier == "host" or gen.n >= gen.capacity:
-                gen = _AttrGeneration(self.generation_slots)
-                gen.gen_id = self._next_gen_id()
-                self.generations.append(gen)
-                self._rebalance()
-                gen = self.generations[-1]
+                if gen is not None and gen.tier != "host":
+                    # live run seals on rollover (write-span taxonomy)
+                    with obs_span("write.seal", gen_id=gen.gen_id,
+                                  tier=gen.tier, rows=int(gen.n)):
+                        obs_count(WRITE_SEALS)
+                        gen = self._roll_generation()
+                else:
+                    gen = self._roll_generation()
             room = gen.capacity - gen.n
             take = min(room, m_total - done)
             m_pad = min(gather_capacity(take, minimum=8), room)
@@ -589,7 +614,13 @@ class LeanAttrIndex:
         merged.gen_id = self._next_gen_id()
         # stale sketch partials must never double-count (the density
         # cache's compaction-mints-new-generation invalidation)
-        self._sketch_cache.drop_generations([g.gen_id for g in group])
+        dead_ids = [g.gen_id for g in group]
+        self._sketch_cache.drop_generations(dead_ids)
+        # merged run inherits its sources' access temperature —
+        # BEFORE the swap, so a racing heat report's stale-entry
+        # prune sees the fresh merged entry (grace window), never
+        # the long-cold dead ids
+        merge_index_generations(self, dead_ids, merged.gen_id)
         self.generations = replace_group(self.generations, group,
                                          merged)
         self.compactions += 1
@@ -652,6 +683,7 @@ class LeanAttrIndex:
         cache = self._sketch_cache.spec_cache(fold)
         dev_scan: list = []
         host_scan: list = []
+        _ht: list | None = [] if heat_enabled() else None
         for g in self.generations:
             part = cache.get(g.gen_id) if g is not live else None
             if part is not None:
@@ -661,6 +693,12 @@ class LeanAttrIndex:
                 dev_scan.append(g)
             else:
                 host_scan.append(g)
+            if _ht is not None:
+                _ht.append((g.gen_id, g.tier, int(g.n),
+                            0 if part is not None
+                            else int(g.n) * SLOT_BYTES, None))
+        if _ht:
+            record_index_scan(self, _ht)
         is_float = self.attr_type in ("float", "double")
         new_parts: dict[int, object] = {}
         if dev_scan and not fold.want_values:
@@ -783,6 +821,7 @@ class LeanAttrIndex:
                         # dispatch; the host-side filtering does not
                         flat = np.asarray(packed).ravel()
                     parts.append(flat[flat >= 0].astype(np.int64))
+        host_cand_n = 0
         if host_gens:
             with obs_span("query.scan.host", runs=len(host_gens)):
                 if self._host_stack is None:
@@ -790,8 +829,23 @@ class LeanAttrIndex:
                         [g.spilled for g in host_gens])
                 coded = self._host_stack.candidates(
                     qklo, qkhi, qslo, qshi, qqid, pos_bits)
+                host_cand_n = int(len(coded))
                 if len(coded):
                     parts.append(coded)
+        if heat_enabled():
+            # heat touches: device runs attribute candidates exactly
+            # from the probe totals; host candidates split
+            # proportionally to run size (obs/heat module doc)
+            touches = [(g.gen_id, g.tier, int(g.n),
+                        int(g.n) * SLOT_BYTES,
+                        int(totals[i]) if len(totals) else 0)
+                       for i, g in enumerate(dev_gens)]
+            n_host = sum(g.n for g in host_gens)
+            touches += [(g.gen_id, "host", int(g.n),
+                         int(g.n) * SLOT_BYTES,
+                         int(round(host_cand_n * g.n / n_host)))
+                        for g in host_gens]
+            record_index_scan(self, touches)
         if not parts:
             return np.empty(0, np.int64)
         merged = np.concatenate(parts)

@@ -43,6 +43,16 @@ class LeanCoreFacade:
         return len(self._core)
 
     @property
+    def heat_scope(self):
+        """Access-temperature scope (obs/heat) — held by the CORE,
+        where the scans that record touches actually run."""
+        return self._core.heat_scope
+
+    @heat_scope.setter
+    def heat_scope(self, scope) -> None:
+        self._core.heat_scope = scope
+
+    @property
     def generations(self):
         return self._core.generations
 

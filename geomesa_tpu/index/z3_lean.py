@@ -76,9 +76,12 @@ from ..metrics import (
     LEAN_COMPACTION_MERGES, LEAN_COMPACTION_ROWS,
     LEAN_DENSITY_CACHE_HITS, LEAN_DENSITY_CACHE_MISSES,
     LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
-    registry as _metrics,
+    WRITE_SEALS, WRITE_SPILLS, registry as _metrics,
 )
 from ..obs import device_span, obs_count, span as obs_span
+from ..obs.heat import (
+    heat_enabled, merge_index_generations, record_index_scan,
+)
 from ..ops.search import (
     coded_pos_bits, expand_ranges, gather_capacity, pad_boxes, pad_pow2,
     pad_ranges, searchsorted2, wire_dtype,
@@ -850,6 +853,11 @@ class _Generation:
 class LeanZ3Index:
     """Tiered generational keys-on-device Z3 index (see module doc)."""
 
+    #: ``(schema, index_key)`` for access-temperature attribution
+    #: (obs/heat) — stamped by the datastore; directly-built indexes
+    #: record under a class-name fallback scope
+    heat_scope: tuple | None = None
+
     #: slots per generation.  Each append re-sorts its generation, so
     #: generation size trades sort cost per slice against run count per
     #: query: slice-sized generations (the scale-proof setting) sort
@@ -1052,7 +1060,12 @@ class LeanZ3Index:
         return self.device_bytes() <= self._budget_after_sentinels()
 
     def _spill(self, gen: _Generation) -> None:
-        gen.spill_to_host()
+        # the spill IS a blocking device→host transfer — a device span
+        # so ingest traces carry its block-until-ready ms (ISSUE 12)
+        with device_span("write.spill", gen_id=gen.gen_id,
+                         rows=int(gen.n)):
+            obs_count(WRITE_SPILLS)
+            gen.spill_to_host()
         self._host_stack = None   # restacked lazily on the next query
 
     def _rebalance(self) -> None:
@@ -1115,7 +1128,15 @@ class LeanZ3Index:
             if gen is None or gen.tier == "host" or gen.n >= gen.capacity:
                 # base = global row id of the generation's first row —
                 # mid-append rollovers account for rows already consumed
-                gen = self._new_generation(self._n_rows + done)
+                if gen is not None and gen.tier != "host":
+                    # the live generation SEALS on rollover; the span
+                    # covers the rebalance (demote/spill) it triggers
+                    with obs_span("write.seal", gen_id=gen.gen_id,
+                                  tier=gen.tier, rows=int(gen.n)):
+                        obs_count(WRITE_SEALS)
+                        gen = self._new_generation(self._n_rows + done)
+                else:
+                    gen = self._new_generation(self._n_rows + done)
             room = gen.capacity - gen.n
             take = min(room, m_total - done)
             m_pad = min(gather_capacity(take, minimum=8), room)
@@ -1194,6 +1215,13 @@ class LeanZ3Index:
             self._host_stack = None   # restacked lazily
         merged.gen_id = self._next_gen_id()
         dead_ids = [g.gen_id for g in group]
+        # the merged run inherits its sources' access temperature —
+        # hot data must not read cold because maintenance renamed it.
+        # Credited BEFORE the swap: a concurrent heat report prunes
+        # tracker entries absent from its placement snapshot, and the
+        # freshly-stamped merged entry rides the prune grace window
+        # while dead ids may be long-cold
+        merge_index_generations(self, dead_ids, merged.gen_id)
         self.generations = replace_group(self.generations, group,
                                          merged)
         self._drop_cached_partials(dead_ids)
@@ -1371,6 +1399,7 @@ class LeanZ3Index:
                     exact_args=None)
         # host tier: stacked numpy seeks — flat in run count, and no
         # dispatch at all (round-4 VERDICT #9)
+        host_cand_n = 0
         if host_gens:
             with obs_span("query.scan.host", stage="seek",
                           runs=len(host_gens)):
@@ -1380,8 +1409,26 @@ class LeanZ3Index:
                 coded = self._host_stack.candidates(
                     ra["rbin"], ra["rzlo"], ra["rzhi"], ra["rqid"],
                     pos_bits)
+                host_cand_n = int(len(coded))
                 if len(coded):
                     keys_cand.append(coded)
+        if heat_enabled():
+            # per-generation access temperature (obs/heat): device
+            # generations attribute candidates exactly (the probe's
+            # per-generation totals); the stacked host seek loses
+            # per-run attribution, so host candidates split
+            # proportionally to run size
+            touches = [(g.gen_id, g.tier, int(g.n),
+                        int(g.n) * (FULL_BYTES if g.tier == "full"
+                                    else KEYS_BYTES),
+                        int(totals[i]))
+                       for i, g in enumerate(dev_gens)]
+            n_host = sum(g.n for g in host_gens)
+            touches += [(g.gen_id, "host", int(g.n),
+                         int(g.n) * KEYS_BYTES,
+                         int(round(host_cand_n * g.n / n_host)))
+                        for g in host_gens]
+            record_index_scan(self, touches)
 
         mask_bits = (np.int64(1) << pos_bits) - 1
         out = [np.empty(0, dtype=np.int64) for _ in range(n_q)]
@@ -1509,6 +1556,13 @@ class LeanZ3Index:
         spec = ("scan", tuple(map(tuple, bxs.tolist())), int(lo),
                 int(hi), env_t, width, height, int(max_ranges))
         cache = self._density_spec_cache(spec)
+        # heat touches (obs/heat): density reads every generation —
+        # match counts are unattributable (grids, not rows), so every
+        # touch is a full-weight access; cache hits read zero bytes
+        _ht: list | None = [] if heat_enabled() else None
+        if _ht is not None:
+            _ht += [(g.gen_id, "full", int(g.n),
+                     int(g.n) * FULL_BYTES, None) for g in full_gens]
         keys_scan: list = []
         for g in keys_gens:
             part = cache.get(g.gen_id) if g is not live else None
@@ -1517,6 +1571,10 @@ class LeanZ3Index:
             else:
                 obs_count(LEAN_DENSITY_CACHE_HITS)
                 grid += part
+            if _ht is not None:
+                _ht.append((g.gen_id, g.tier, int(g.n),
+                            0 if part is not None
+                            else int(g.n) * KEYS_BYTES, None))
         dev_gens = full_gens + keys_scan
         totals = np.empty(0)
         if dev_gens:
@@ -1610,10 +1668,19 @@ class LeanZ3Index:
                         obs_count(LEAN_DENSITY_CACHE_MISSES)
                         self._cache_partial(cache, g.gen_id, part)
                     grid += part
+                if _ht is not None:
+                    _ht += [(g.gen_id, "host", int(g.n),
+                             int(g.n) * KEYS_BYTES, None)
+                            for g in host_gens]
             else:
                 for g in host_gens:
                     obs_count(LEAN_DENSITY_CACHE_HITS)
                     grid += cache[g.gen_id]
+                if _ht is not None:
+                    _ht += [(g.gen_id, "host", int(g.n), 0, None)
+                            for g in host_gens]
+        if _ht:
+            record_index_scan(self, _ht)
         return grid
 
     def _density_sweep(self, env, width: int, height: int) -> np.ndarray:
@@ -1657,18 +1724,26 @@ class LeanZ3Index:
                 if g is not live:
                     obs_count(LEAN_DENSITY_CACHE_MISSES)
                     self._cache_partial(cache, g.gen_id, part)
+        scanned = {id(g) for g in scan}
         for g in self.generations:
             if g.tier != "host":
                 continue
             part = cache.get(g.gen_id)
             if part is None:
                 obs_count(LEAN_DENSITY_CACHE_MISSES)
+                scanned.add(id(g))
                 part = g.run.sweep_partial(self.sfc, env_t, width,
                                            height, world)
                 self._cache_partial(cache, g.gen_id, part)
             else:
                 obs_count(LEAN_DENSITY_CACHE_HITS)
             grid += part
+        if heat_enabled() and self.generations:
+            record_index_scan(self, [
+                (g.gen_id, g.tier, int(g.n),
+                 int(g.n) * KEYS_BYTES if id(g) in scanned else 0,
+                 None)
+                for g in self.generations])
         return grid
 
     def range_count(self, boxes, t_lo_ms, t_hi_ms,
@@ -1734,17 +1809,25 @@ class LeanZ3Index:
                 if g is not live:
                     obs_count(LEAN_SKETCH_CACHE_MISSES)
                     self._sketch_cache.add(cache, g.gen_id, part)
+        scanned = {id(g) for g in scan}
         for g in self.generations:
             if g.tier != "host":
                 continue
             part = cache.get(g.gen_id)
             if part is None:
                 obs_count(LEAN_SKETCH_CACHE_MISSES)
+                scanned.add(id(g))
                 part = g.run.cell_counts(b0, nb, int(bits))
                 self._sketch_cache.add(cache, g.gen_id, part)
             else:
                 obs_count(LEAN_SKETCH_CACHE_HITS)
             total += part
+        if heat_enabled() and self.generations:
+            record_index_scan(self, [
+                (g.gen_id, g.tier, int(g.n),
+                 int(g.n) * KEYS_BYTES if id(g) in scanned else 0,
+                 None)
+                for g in self.generations])
         c_per_bin = 1 << bits
         for i in np.flatnonzero(total):
             out[(b0 + int(i) // c_per_bin, int(i) % c_per_bin)] = \

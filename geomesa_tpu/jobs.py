@@ -46,14 +46,27 @@ class IngestJob:
     workers: int = 4
 
     def run(self, paths: list[str]) -> IngestResult:
+        """Run the ingest, registered in the background-job registry
+        (obs/jobs, ISSUE 12): the run appears in ``/debug/jobs`` with
+        ``setup``/``ingest`` phase spans, live per-file progress, and
+        a terminal outcome — including ``failed`` when setup or a
+        write raises (per-file parse errors still only count)."""
+        from .obs.jobs import jobs_registry
+        with jobs_registry.run("ingest", schema=self.type_name,
+                               files=len(paths),
+                               workers=self.workers) as job:
+            return self._run(job, paths)
+
+    def _run(self, job, paths: list[str]) -> IngestResult:
         from .io.converters import EvaluationContext, converter_from_config
 
-        sft = self.store.get_schema(self.type_name)
         result = IngestResult()
-        # one converter for the whole job: construction loads enrichment
-        # caches (CSV parses), and convert() itself is stateless, so the
-        # worker threads can share it safely
-        conv = converter_from_config(sft, self.converter_config)
+        with job.phase("setup"):
+            sft = self.store.get_schema(self.type_name)
+            # one converter for the whole job: construction loads
+            # enrichment caches (CSV parses), and convert() itself is
+            # stateless, so the worker threads can share it safely
+            conv = converter_from_config(sft, self.converter_config)
 
         def parse(path: str):
             ec = EvaluationContext()
@@ -64,7 +77,8 @@ class IngestJob:
                     batch = conv.convert(f.read(), ec)
             return batch, ec
 
-        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+        with job.phase("ingest", files=len(paths)), \
+                ThreadPoolExecutor(max_workers=self.workers) as pool:
             futures = {pool.submit(parse, p): p for p in paths}
             for fut in as_completed(futures):
                 path = futures[fut]
@@ -80,6 +94,9 @@ class IngestJob:
                 if len(batch):
                     # single-writer append (BatchWriter role)
                     result.ingested += self.store.write(self.type_name, batch)
+                job.progress(files=result.files,
+                             ingested=result.ingested,
+                             failed=result.failed)
         return result
 
 
@@ -108,8 +125,24 @@ class CompactionJob:
     budget_ms: float | None = None
 
     def run(self) -> dict:
-        return self.store.compact(self.type_name,
-                                  budget_ms=self.budget_ms)
+        """Run one compaction pass, registered in the background-job
+        registry (obs/jobs): the run appears in ``/debug/jobs`` with a
+        ``compact`` phase span, per-index merged-group progress, and a
+        terminal outcome — ``failed`` (with the error) when the store
+        raises, so a compaction storm or a crashed pass is traceable
+        instead of invisible."""
+        from .obs.jobs import jobs_registry
+        with jobs_registry.run("compaction", schema=self.type_name,
+                               budget_ms=self.budget_ms) as job:
+            with job.phase("compact"):
+                out = self.store.compact(self.type_name,
+                                         budget_ms=self.budget_ms)
+            job.progress(
+                merged_groups=sum(int(v.get("merged_groups", 0))
+                                  for v in out.values()
+                                  if isinstance(v, dict)),
+                indexes=len(out))
+            return out
 
 
 def run_compaction(store, type_name: str,

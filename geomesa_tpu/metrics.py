@@ -36,7 +36,7 @@ __all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "LEAN_SKETCH_SCANS", "LEAN_STATS_MATERIALIZED",
            "LEAN_DEVICE_DISPATCHES", "LEAN_DEVICE_MS",
            "JAX_COMPILE_COUNT", "JAX_COMPILE_MS", "JAX_COMPILE_FALLBACK",
-           "PLAN_ESTIMATE_RATIO"]
+           "PLAN_ESTIMATE_RATIO", "WRITE_SEALS", "WRITE_SPILLS"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -73,6 +73,13 @@ JAX_COMPILE_FALLBACK = "jax.compile.fallback_count"
 #: histogram whose p50/p95/p99 say how wrong the cost model runs (the
 #: baseline the item-4 sketch-driven planner has to beat)
 PLAN_ESTIMATE_RATIO = "plan.estimate.ratio"
+#: write-path lifecycle events (ISSUE 12): generations sealed by a
+#: rollover and key runs spilled device → host under budget pressure —
+#: counted once per event and mirrored onto the active write span via
+#: obs_count, so an ingest stall attributes to the seal/spill that
+#: caused it
+WRITE_SEALS = "write.seals"
+WRITE_SPILLS = "write.spills"
 
 #: the metric naming contract (docs/observability.md): every registry
 #: key lives under one of these top-level namespaces, dot-separated,
@@ -81,7 +88,7 @@ PLAN_ESTIMATE_RATIO = "plan.estimate.ratio"
 #: tier-1 lint test (tests/test_zzz_metric_lint.py) walks the full
 #: registry after the suite and fails on any drive-by key outside it.
 METRIC_NAMESPACES = ("query", "write", "lean", "jax", "web", "storage",
-                     "plan", "obs", "pallas")
+                     "plan", "obs", "pallas", "heat", "job")
 _METRIC_KEY_RE = re.compile(
     r"^(?:" + "|".join(METRIC_NAMESPACES)
     + r")(?:\.[A-Za-z0-9_:\-]+)+$")

@@ -18,7 +18,15 @@ host-spill scan / cache-miss time instead of one opaque number:
   accounted-vs-actual-nbytes reconciliation audit;
 * :mod:`.explain_analyze` — EXPLAIN ANALYZE: the plan narration
   merged with measured actuals (estimate vs rows scanned/matched,
-  per-phase ms), served at ``/explain``.
+  per-phase ms), served at ``/explain``;
+* :mod:`.heat` — access-temperature tracking (ISSUE 12): per-(schema,
+  index, generation) touch counters decayed into a temperature score,
+  the ranked hot→cold ``/debug/heat`` report joined with storage
+  placement, and the ``heat.*`` gauges — the workload data plane the
+  tier autopilot consumes;
+* :mod:`.jobs` — the background-job registry (ISSUE 12):
+  ingest/compaction runs with phase spans, progress, and terminal
+  outcomes, served at ``/debug/jobs``.
 
 Everything configures through the ``geomesa.obs.*`` system properties
 (config.ObsProperties); docs/observability.md is the operator contract.
@@ -30,6 +38,11 @@ from ..config import ObsProperties
 from .explain_analyze import (
     ExplainAnalyzeResult, explain_analyze, explain_analyze_sql,
 )
+from .heat import (
+    HeatTracker, heat_enabled, heat_report, heat_tracker,
+    merge_index_generations, publish_heat_gauges, record_index_scan,
+)
+from .jobs import JobRecord, JobRegistry, jobs_registry
 from .prom import prometheus_text
 from .recompile import compile_count, counting_jit, install as \
     install_recompile_tracker
@@ -48,7 +61,11 @@ __all__ = ["Span", "Trace", "Tracer", "Sampler", "AlwaysSampler",
            "install_recompile_tracker",
            "storage_report", "publish_storage_gauges",
            "ExplainAnalyzeResult", "explain_analyze",
-           "explain_analyze_sql"]
+           "explain_analyze_sql",
+           "HeatTracker", "heat_tracker", "heat_enabled",
+           "record_index_scan", "merge_index_generations",
+           "heat_report", "publish_heat_gauges",
+           "JobRecord", "JobRegistry", "jobs_registry"]
 
 # the recompile listener is process-global and effectively free — hook
 # it as soon as observability loads (gated by the option so fully

@@ -44,7 +44,11 @@ from ..index.attr_lean import (
     _SENTINEL_KEY, _HostAttrStack, _I64_MAX, _I64_MIN, SLOT_BYTES,
     encode_attr_value, encode_attr_values, string_prefix_bounds,
 )
+from ..metrics import WRITE_SEALS, WRITE_SPILLS
 from ..obs import device_span, obs_count, span as obs_span
+from ..obs.heat import (
+    heat_enabled, merge_index_generations, record_index_scan,
+)
 from ..ops.search import (
     expand_ranges, gather_capacity, pad_pow2, searchsorted2,
 )
@@ -259,6 +263,10 @@ class _ShardedAttrGen:
 class ShardedLeanAttrIndex:
     """Sharded tiered generational attribute index (module doc)."""
 
+    #: ``(schema, index_key)`` for access-temperature attribution
+    #: (obs/heat) — stamped by the datastore / the owning XZ facade
+    heat_scope: tuple | None = None
+
     #: slots per generation PER SHARD
     GENERATION_SLOTS = 1 << 22
     DEFAULT_CAPACITY = 1 << 15
@@ -374,6 +382,15 @@ class ShardedLeanAttrIndex:
                                                  self.generation_slots)
         return self._sentinel_gen
 
+    def _roll_generation(self) -> "_ShardedAttrGen":
+        """Open a fresh live generation and rebalance (the append
+        rollover body, factored so the seal span wraps it once)."""
+        gen = _ShardedAttrGen(self.mesh, self.generation_slots)
+        gen.gen_id = self._next_gen_id()
+        self.generations.append(gen)
+        self._rebalance()
+        return self.generations[-1]
+
     def _per_shard_resident(self) -> int:
         per = sum(g.per_shard_bytes() for g in self.generations)
         return per + self.generation_slots * (8 + 8 + 8)  # sentinel
@@ -383,7 +400,11 @@ class ShardedLeanAttrIndex:
             if self._per_shard_resident() <= self.hbm_budget_bytes:
                 return
             if gen.tier == "device":
-                gen.spill_to_host()
+                # blocking device→host fetch (write-span taxonomy)
+                with device_span("write.spill", gen_id=gen.gen_id,
+                                 slots=int(gen.n_slots)):
+                    obs_count(WRITE_SPILLS)
+                    gen.spill_to_host()
                 self._host_stack = None
         if self._per_shard_resident() > self.hbm_budget_bytes:
             raise MemoryError(
@@ -416,11 +437,15 @@ class ShardedLeanAttrIndex:
             gen = self.generations[-1] if self.generations else None
             if gen is None or gen.tier == "host" \
                     or gen.n_slots + m_pad > gen.slots:
-                gen = _ShardedAttrGen(self.mesh, self.generation_slots)
-                gen.gen_id = self._next_gen_id()
-                self.generations.append(gen)
-                self._rebalance()
-                gen = self.generations[-1]
+                if gen is not None and gen.tier != "host":
+                    # live run seals on rollover (write-span taxonomy)
+                    with obs_span("write.seal", gen_id=gen.gen_id,
+                                  tier=gen.tier,
+                                  slots=int(gen.n_slots)):
+                        obs_count(WRITE_SEALS)
+                        gen = self._roll_generation()
+                else:
+                    gen = self._roll_generation()
             if gen.fill is None:
                 gen.fill = np.zeros(local_shards, np.int64)
             take_all = min(m_pad * local_shards, max(0, m_local - done))
@@ -502,7 +527,13 @@ class ShardedLeanAttrIndex:
                 n_slots=n_slots)
             self._host_stack = None
         merged.gen_id = self._next_gen_id()
-        self._sketch_cache.drop_generations([g.gen_id for g in group])
+        dead_ids = [g.gen_id for g in group]
+        self._sketch_cache.drop_generations(dead_ids)
+        # merged run inherits its sources' access temperature —
+        # BEFORE the swap, so a racing heat report's stale-entry
+        # prune sees the fresh merged entry (grace window), never
+        # the long-cold dead ids
+        merge_index_generations(self, dead_ids, merged.gen_id)
         self.generations = replace_group(self.generations, group,
                                          merged)
         self.compactions += 1
@@ -581,6 +612,7 @@ class ShardedLeanAttrIndex:
         cache = self._sketch_cache.spec_cache(fold)
         dev_scan: list = []
         host_scan: list = []
+        _ht: list | None = [] if heat_enabled() else None
         for g in self.generations:
             part = cache.get(g.gen_id) if g is not live else None
             if part is not None:
@@ -590,6 +622,13 @@ class ShardedLeanAttrIndex:
                 dev_scan.append(g)
             else:
                 host_scan.append(g)
+            if _ht is not None:
+                _ht.append((g.gen_id, g.tier, int(g.n_slots),
+                            0 if part is not None
+                            else g.per_shard_bytes()
+                            * int(self.mesh.devices.size), None))
+        if _ht:
+            record_index_scan(self, _ht)
         is_float = self.attr_type in ("float", "double")
         new_parts: dict[int, object] = {}
         if dev_scan and not fold.want_values:
@@ -710,6 +749,7 @@ class ShardedLeanAttrIndex:
                         *jk, jnp.asarray(qqid), *cols))
                     flat = packed.ravel()
                     parts.append(flat[flat >= 0])
+        host_cand_n = 0
         if host_gens:
             if self._host_stack is None:
                 runs: list = []
@@ -721,8 +761,27 @@ class ShardedLeanAttrIndex:
             if self._multihost:
                 from .multihost import allgather_concat
                 coded = allgather_concat(coded)
+            host_cand_n = int(len(coded))
             if len(coded):
                 parts.append(coded)
+        if heat_enabled():
+            # per-generation heat (obs/heat; process-local): device
+            # runs attribute candidates exactly from the probe totals;
+            # host candidates split proportionally to consumed slots
+            touches = []
+            if dev_gens:
+                touches += [(g.gen_id, g.tier, int(g.n_slots),
+                             g.per_shard_bytes()
+                             * int(self.mesh.devices.size),
+                             int(totals[:, i].sum()))
+                            for i, g in enumerate(dev_gens)]
+            n_host = sum(g.n_slots for g in host_gens)
+            touches += [(g.gen_id, "host", int(g.n_slots),
+                         (sum(int(a.nbytes) for p in g.spilled
+                              for a in p) if g.spilled else 0),
+                         int(round(host_cand_n * g.n_slots / n_host)))
+                        for g in host_gens]
+            record_index_scan(self, touches)
         if not parts:
             return np.empty(0, np.int64)
         merged = np.concatenate(parts)

@@ -24,11 +24,17 @@ Routes::
     GET    /api/audit/{name}?since=          query-event readback
     GET    /api/metrics                      request + store metrics dump
     GET    /metrics.prom                     Prometheus text exposition
-    GET    /traces?slow=1                    recent (or slow-log) traces
+    GET    /traces?slow=1&limit=N            recent (or slow-log) traces
     GET    /traces/{trace_id}                full span tree of one trace
-    GET    /debug/storage                    storage/HBM accounting report
+    GET    /debug/storage?audit=0            storage/HBM accounting report
+    GET    /debug/heat?limit=N               access-temperature ranking
+    GET    /debug/jobs?kind=&state=&limit=N  background-job registry
     GET    /explain?schema=&cql=             EXPLAIN ANALYZE (plan+actuals)
     GET    /explain?sql=                     EXPLAIN ANALYZE of a SQL text
+
+Malformed query-string parameters (a non-numeric ``limit``, an
+unrecognized flag value, an unknown ``state``) are a **400** with the
+offending parameter named — never a 500 or a silently-empty 200.
 
 Per-request metrics are recorded in the global registry (the reference's
 servlet-level ``AggregatedMetricsFilter``).  The trace endpoints read
@@ -46,7 +52,9 @@ import time
 import numpy as np
 
 from ..metrics import registry as _metrics
-from .wsgi import HttpError, Router, float_param, int_param, read_json_body
+from .wsgi import (
+    HttpError, Router, bool_param, float_param, int_param, read_json_body,
+)
 
 __all__ = ["WebApp", "serve"]
 
@@ -85,6 +93,8 @@ class WebApp:
             (r"^/traces$", self._traces),
             (r"^/traces/([^/]+)$", self._trace_item),
             (r"^/debug/storage$", self._debug_storage),
+            (r"^/debug/heat$", self._debug_heat),
+            (r"^/debug/jobs$", self._debug_jobs),
             (r"^/explain$", self._explain),
             (r"^/api/blob$", self._blob_index),
             (r"^/api/blob/([^/]+)$", self._blob_item),
@@ -275,12 +285,24 @@ class WebApp:
         a lone scrape would strand the mesh in the allgather)."""
         if method != "GET":
             raise HttpError(405, method)
-        from ..obs import prometheus_text, publish_storage_gauges
+        from ..obs import (
+            prometheus_text, publish_heat_gauges, publish_storage_gauges,
+            storage_report,
+        )
+        rep = None
         try:
             # refresh the storage.* gauges so every scrape carries
             # CURRENT resident bytes, not the last /debug/storage hit
-            publish_storage_gauges(self.store)
+            rep = storage_report(self.store, audit=False)
+            publish_storage_gauges(self.store, rep)
         except Exception:   # accounting must never break the scrape
+            pass
+        try:
+            # heat.* likewise: every scrape carries the CURRENT decayed
+            # workload temperatures (obs/heat), reusing the one store
+            # walk above for the placement join
+            publish_heat_gauges(self.store, storage=rep)
+        except Exception:
             pass
         if (params.get("mesh") in ("1", "true", "yes")
                 and getattr(self.store, "_multihost", False)):
@@ -292,15 +314,21 @@ class WebApp:
 
     def _traces(self, method, params, environ):
         """Recent traces (ring buffer), or the slow-query log with
-        ``?slow=1`` — newest last, summaries only."""
+        ``?slow=1`` — newest last, summaries only.  ``?limit=N`` pages
+        to the NEWEST N; malformed params are a 400."""
         if method != "GET":
             raise HttpError(405, method)
         from ..obs import tracer
-        if params.get("slow") in ("1", "true", "yes"):
+        limit = int_param(params, "limit")
+        if limit is not None and limit < 0:
+            raise HttpError(400, f"bad 'limit' parameter: {limit}")
+        if bool_param(params, "slow"):
             traces = tracer.slow_log.traces()
         else:
             ring = tracer.ring
             traces = ring.traces() if ring is not None else []
+        if limit is not None:
+            traces = traces[len(traces) - min(limit, len(traces)):]
         return 200, [t.summary() for t in traces]
 
     def _trace_item(self, method, params, environ, trace_id):
@@ -316,10 +344,57 @@ class WebApp:
         """Storage/HBM accounting: per-schema/per-index byte residency
         (device runs vs host spill vs caches, per generation) with the
         accounted-vs-actual-nbytes reconciliation (obs/resource).  The
-        walk also refreshes the ``storage.*`` gauges."""
+        walk also refreshes the ``storage.*`` gauges.  ``?audit=0``
+        skips the actual-nbytes walk (the cheap accounted-only form);
+        an unrecognized value is a 400."""
         if method != "GET":
             raise HttpError(405, method)
+        if not bool_param(params, "audit", default=True):
+            from ..obs import publish_storage_gauges, storage_report
+            rep = storage_report(self.store, audit=False)
+            publish_storage_gauges(self.store, rep)
+            return 200, rep
         return 200, self.store.storage_report()
+
+    def _debug_heat(self, method, params, environ):
+        """Access-temperature ranking (obs/heat): every lean
+        generation hot→cold by decayed touch temperature, joined with
+        its current device/host placement from the storage accounting.
+        ``?limit=N`` truncates the ranked list; also refreshes the
+        ``heat.*`` gauges."""
+        if method != "GET":
+            raise HttpError(405, method)
+        limit = int_param(params, "limit")
+        if limit is not None and limit < 0:
+            raise HttpError(400, f"bad 'limit' parameter: {limit}")
+        return 200, self.store.heat_report(limit=limit)
+
+    def _debug_jobs(self, method, params, environ):
+        """Background-job registry (obs/jobs): active + recent
+        ingest/compaction runs, newest first, with phase spans,
+        progress, and terminal outcomes.  Filters: ``?kind=``,
+        ``?state=running|succeeded|failed``, ``?limit=N``."""
+        if method != "GET":
+            raise HttpError(405, method)
+        from ..obs import jobs_registry
+        limit = int_param(params, "limit")
+        if limit is not None and limit < 0:
+            raise HttpError(400, f"bad 'limit' parameter: {limit}")
+        state = params.get("state")
+        if state is not None and state not in ("running", "succeeded",
+                                               "failed"):
+            raise HttpError(400, f"bad 'state' parameter: {state!r}")
+        jobs = self.store_jobs().jobs(kind=params.get("kind"),
+                                      state=state, limit=limit)
+        return 200, {"jobs": [j.to_json() for j in jobs]}
+
+    def store_jobs(self):
+        """The registry /debug/jobs serves — the process-wide one
+        unless a test/app injected ``self.jobs_registry``."""
+        reg = getattr(self, "jobs_registry", None)
+        if reg is None:
+            from ..obs import jobs_registry as reg
+        return reg
 
     def _explain(self, method, params, environ):
         """EXPLAIN ANALYZE: the plan narration merged with measured

@@ -7,7 +7,8 @@ import json
 import re
 from urllib.parse import parse_qs, unquote
 
-__all__ = ["HttpError", "STATUS", "read_json_body", "Router"]
+__all__ = ["HttpError", "STATUS", "read_json_body", "Router",
+           "int_param", "float_param", "bool_param"]
 
 STATUS = {200: "200 OK", 201: "201 Created", 204: "204 No Content",
           400: "400 Bad Request", 404: "404 Not Found",
@@ -48,6 +49,20 @@ def float_param(params: dict, name: str, default=None) -> float | None:
         return float(params[name])
     except ValueError:
         raise HttpError(400, f"bad {name!r} parameter: {params[name]!r}")
+
+
+def bool_param(params: dict, name: str, default: bool = False) -> bool:
+    """Strict flag parsing: unrecognized values are a 400, not a
+    silent false (a typoed ``?slow=ture`` must not quietly serve the
+    wrong surface)."""
+    if name not in params:
+        return default
+    v = str(params[name]).strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off", ""):
+        return False
+    raise HttpError(400, f"bad {name!r} parameter: {params[name]!r}")
 
 
 class Router:

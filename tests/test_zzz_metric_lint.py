@@ -5,11 +5,57 @@ the namespace contract documented in docs/observability.md.  A drive-by
 metric typo (``lena.compaction.merges``) lands a key outside the
 contract and fails here at tier-1 time instead of silently splitting a
 dashboard.
+
+ISSUE 12 extends the walk: the suite's registry snapshot only covers
+keys some earlier test happened to emit, and the ``heat.*``/``job.*``
+gauges exist only after a write/compaction cycle has been OBSERVED and
+published — so this module drives one explicitly (write → query →
+compaction job → heat + storage gauge publication) before linting,
+guaranteeing the write/heat/job namespaces are present in the walk
+rather than vacuously absent.
 """
+
+import numpy as np
 
 from geomesa_tpu.metrics import (
     METRIC_NAMESPACES, lint_metric_names, registry,
 )
+
+MS = 1514764800000
+DAY = 86_400_000
+
+
+def test_registry_covers_write_and_job_cycle_gauges():
+    """Drive a full write → query → compaction-job → publish cycle so
+    the gauges that exist ONLY after it (heat.*, job.*, write seal
+    counters) are registered for the final lint walk."""
+    from geomesa_tpu.datastore import TpuDataStore
+    from geomesa_tpu.jobs import run_compaction
+
+    rng = np.random.default_rng(77)
+    ds = TpuDataStore(user="lint-cycle")
+    ds.create_schema(
+        "lintcyc", "dtg:Date,*geom:Point;"
+                   "geomesa.index.profile=lean,"
+                   "geomesa.lean.generation.slots=4096,"
+                   "geomesa.lean.compaction.factor=0")
+    for _ in range(3):
+        ds.write("lintcyc", {
+            "dtg": rng.integers(MS, MS + 14 * DAY, 4096),
+            "geom": (rng.uniform(-75, -73, 4096),
+                     rng.uniform(40, 42, 4096))})
+    ds.query("lintcyc", "BBOX(geom,-75,40,-73,42)")
+    run_compaction(ds, "lintcyc")
+    rep = ds.heat_report()         # publishes the heat.* gauges
+    assert rep["generations"], "expected tracked generations"
+    ds.storage_report()            # publishes the storage.* gauges
+    names = registry.names()
+    # the cycle-only namespaces are PRESENT, so the lint below is not
+    # vacuous over them
+    assert any(n.startswith("heat.") for n in names)
+    assert any(n.startswith("job.compaction.") for n in names)
+    assert "write.seals" in names
+    assert "write.lintcyc.features" in names
 
 
 def test_registry_keys_match_naming_contract():
@@ -29,10 +75,14 @@ def test_lint_catches_bad_keys():
            "query",                       # bare namespace, no leaf
            "lean..double_dot",
            "lean.spaced key",
+           "heta.evt.z3.temperature",     # heat namespace typo
            "unknown.thing"]
     good = ["query.evt.count", "lean.device.ms", "jax.compile.count",
             "storage.evt.attr:score.device_bytes", "web.200",
             "plan.estimate.ratio", "write.pts.features",
-            "pallas.density.fallback", "obs.test.empty_ms"]
+            "pallas.density.fallback", "obs.test.empty_ms",
+            "heat.evt.z3.temperature", "heat.total.temperature",
+            "job.ingest.runs", "job.compaction.ms",
+            "write.seals", "write.spills"]
     assert lint_metric_names(good) == []
     assert lint_metric_names(good + bad) == sorted(bad)
