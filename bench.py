@@ -417,6 +417,7 @@ def main():
     xz3_scale = _xz3_scale_stanza()
     obs_stanza = _obs_stanza()
     heat_stanza = _heat_stanza()
+    lint_stanza = _lint_stanza()
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -449,6 +450,7 @@ def main():
             "xz3_scale": xz3_scale,
             "obs": obs_stanza,
             "heat": heat_stanza,
+            "lint": lint_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -842,6 +844,39 @@ def _heat_stanza() -> dict:
     except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
     out.update(_mem_probe())
+    return out
+
+
+def _lint_stanza() -> dict:
+    """gm-lint no-op guard (ISSUE 13 satellite): the static-analysis
+    gate must pass on the benched tree AND stay importable with NO jax
+    in the interpreter (cold CI shards run it without the accelerator
+    stack) — verified in a subprocess so neither property can perturb
+    the bench process, and cheap enough (~3 s, pure AST) to run every
+    round."""
+    import subprocess
+    import sys
+    out: dict = {}
+    code = ("import sys\n"
+            "from geomesa_tpu.analysis.__main__ import main\n"
+            "rc = main(['--fail-on-new'])\n"
+            "assert 'jax' not in sys.modules, 'analyzer imported jax'\n"
+            "print('JAXFREE_OK')\n"
+            "sys.exit(rc)\n")
+    try:
+        t0 = time.perf_counter()
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=120)
+        out["clean"] = proc.returncode == 0
+        # positive sentinel: a crash BEFORE the assert must not read
+        # as the property having been verified
+        out["jax_free"] = "JAXFREE_OK" in proc.stdout
+        out["wall_s"] = round(time.perf_counter() - t0, 2)
+        if proc.returncode != 0:
+            out["tail"] = (proc.stdout + proc.stderr)[-500:]
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
     return out
 
 

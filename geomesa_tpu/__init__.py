@@ -16,11 +16,29 @@ over ICI with `jax.lax.psum`.
 The library requires 64-bit integer support (z-values are 62/63-bit morton
 codes, matching the reference's key layout, e.g.
 geomesa-z3/.../curve/Z3SFC.scala:21 — 21 bits/dim × 3 dims); x64 mode is
-enabled at import.
+enabled here for whenever jax loads — WITHOUT importing jax: the package
+``__init__`` must stay pure-stdlib so that jax-free subpackages (the
+``analysis`` static analyzer, which cold CI shards run with no
+accelerator stack) import without dragging in the device runtime
+(pinned by a subprocess test in tests/test_zzzz_static_analysis.py).
+``JAX_ENABLE_X64`` is read by jax's config at its own import; if some
+embedder imported jax *first*, the live config is updated instead —
+both paths land exactly where the old eager ``jax.config.update``
+did.
+
+One DELIBERATE difference from the old in-process update: the env var
+is inherited by child processes, so jax workers an embedder spawns
+after importing this package also run x64.  For this library that is
+the correct default — its multihost/benchmark subprocesses need the
+same 64-bit keys — but an embedder spawning unrelated jax children
+can override by clearing ``JAX_ENABLE_X64`` in the child env.
 """
 
-from jax import config as _jax_config
+import os as _os
+import sys as _sys
 
-_jax_config.update("jax_enable_x64", True)
+_os.environ["JAX_ENABLE_X64"] = "1"
+if "jax" in _sys.modules:  # jax beat us here: flip the live config too
+    _sys.modules["jax"].config.update("jax_enable_x64", True)
 
 __version__ = "0.1.0"

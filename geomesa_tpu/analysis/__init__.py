@@ -1,0 +1,49 @@
+"""gm-lint: AST-based invariant analysis for the geomesa_tpu tree.
+
+GeoMesa's JVM reference holds its correctness invariants with the
+compiler plus scalastyle (PAPER.md layer 0/3); a JAX/Python
+reproduction has no compiler to lean on, and the runtime lints
+(test_zzz_metric_lint, the warm-recompile budget in test_zz_obs) only
+see what one test cycle happens to execute.  This package is the
+compile-time replacement: an error-prone-style AST pass over the whole
+tree encoding the codebase's OWN invariants as checks (ISSUE 13):
+
+* ``host-sync`` — no silent device→host synchronizations in the hot
+  scan paths outside sanctioned ``device_span`` sites;
+* ``recompile-hazard`` — ``jax.jit``/``shard_map``/``pallas_call``
+  sites free of unhashable or per-call-varying static arguments and of
+  closures over mutable module globals;
+* ``guarded-by`` — attributes declared ``#: guarded-by: self._lock``
+  are only touched under a matching ``with self._lock:`` scope;
+* ``config-option`` — every ``"geomesa.*"`` option literal resolves to
+  a declaration in ``config.py`` and is documented under ``docs/``;
+* ``taxonomy`` — metric and span name literals obey the
+  ``METRIC_NAMESPACES`` contract and the ``docs/observability.md``
+  span taxonomy.
+
+The analyzer is **pure stdlib** (``ast`` + ``tokenize`` + ``json``):
+importing or running it must never pull in ``jax``/``numpy``, so it
+works in cold CI shards with no accelerator stack (pinned by a
+subprocess test).  Findings suppress via inline pragmas
+(``# gm-lint: disable=<check>[ reason]``) or via the committed
+``baseline.json`` whose every entry carries a written justification.
+
+CLI: ``python -m geomesa_tpu.analysis [--fail-on-new] [--list-checks]
+[--check <id>] [--format json] [paths...]`` — see ``__main__.py`` and
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from .model import Finding, findings_to_json
+from .baseline import Baseline, BaselineError
+from .walker import Project, analyze, iter_python_files
+
+__all__ = ["Finding", "findings_to_json", "Baseline", "BaselineError",
+           "Project", "analyze", "iter_python_files", "all_checks"]
+
+
+def all_checks():
+    """The registered check instances, in documented order."""
+    from .checks import CHECKS
+    return list(CHECKS)

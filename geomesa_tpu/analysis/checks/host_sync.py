@@ -1,0 +1,157 @@
+"""Check ``host-sync``: no silent device→host synchronizations in hot
+scan paths outside sanctioned ``device_span`` sites.
+
+The store's observability contract (docs/observability.md) is that
+every device round-trip in a scan path is wrapped in
+``obs.device_span`` — the block-until-ready wall time IS the span's
+``device_ms`` and rolls up to the root span, so "where does device
+time go" is answerable.  A bare ``np.asarray(jitted_fn(...))`` in a
+hot path is a silent sync: it blocks the query on the device without
+attributing a microsecond anywhere (exactly the class of gap the
+density-sweep path shipped with before this check existed).
+
+Flagged, in hot-path modules (``index/``, ``ops/``, ``curve/``,
+``parallel/``), lexically OUTSIDE any ``with device_span(...):``
+block:
+
+* ``x.item()`` — always a transfer;
+* ``jax.block_until_ready(...)`` / ``x.block_until_ready()``;
+* ``np.asarray(E)`` / ``np.array(E)`` where ``E`` contains a call to
+  a known device dispatch — a jit-wrapped function, a call through a
+  jit-builder (the ``shard_map`` program idiom ``_program(...)(args)``)
+  — or mentions ``jnp``;
+* ``int(E)`` / ``float(E)`` / ``bool(E)`` over the same device
+  expressions (implicit ``__int__``/``__bool__`` syncs).
+
+Device-ness is resolved cross-module (the walker's jit registry +
+import edges), so ``from ..ops.density import density_grid`` is known
+jitted at its index-side call site.  Attribute reads
+(``np.asarray(run.z)``) are deliberately NOT flagged — spilled host
+runs hold numpy columns under the same attribute names, and a
+type-blind flag there would drown the signal in false positives; the
+call-rooted rule is the precision/recall trade this codebase needs.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..walker import _dotted
+
+__all__ = ["HostSyncCheck"]
+
+_CAST_FNS = {"int", "float", "bool"}
+_NP_SYNC_FNS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "jax.device_get"}
+
+
+def _device_span_ranges(tree) -> list[tuple[int, int]]:
+    """(start, end) line ranges of ``with device_span(...):`` bodies —
+    the sanctioned sync sites."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call) \
+                        and _dotted(ce.func).endswith("device_span"):
+                    out.append((node.lineno,
+                                node.end_lineno or node.lineno))
+                    break
+    return out
+
+
+def _in_ranges(line: int, ranges) -> bool:
+    return any(lo <= line <= hi for lo, hi in ranges)
+
+
+def _function_spans(tree) -> list[tuple[int, int, str]]:
+    """``(start, end, name)`` for every def — innermost match names a
+    finding's site so the line-independent baseline key stays UNIQUE
+    per violation (a new identical sync in another function of a
+    baselined file must NOT match the old entry)."""
+    return [(n.lineno, n.end_lineno or n.lineno, n.name)
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _site_of(line: int, spans) -> str:
+    name, width = "<module>", None
+    for lo, hi, fn in spans:
+        if lo <= line <= hi and (width is None or hi - lo < width):
+            name, width = fn, hi - lo
+    return name
+
+
+def _mentions_device(node, fns: set, builders: set) -> bool:
+    """Does the expression contain a device-producing call (module
+    doc)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            callee = sub.func
+            if isinstance(callee, ast.Name) and callee.id in fns:
+                return True
+            if isinstance(callee, ast.Call) \
+                    and isinstance(callee.func, ast.Name) \
+                    and callee.func.id in builders:
+                return True
+        elif isinstance(sub, ast.Name) and sub.id == "jnp":
+            return True
+    return False
+
+
+class HostSyncCheck:
+    id = "host-sync"
+    description = ("device→host syncs (.item(), int()/float()/bool() on "
+                   "device values, np.asarray on jitted results, "
+                   "block_until_ready) in hot scan paths outside "
+                   "device_span")
+
+    def run(self, mod, project):
+        if not project.is_hot_path(mod):
+            return
+        fns, builders = project.device_names(mod)
+        sanctioned = _device_span_ranges(mod.tree)
+        spans = _function_spans(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or _in_ranges(node.lineno, sanctioned):
+                continue
+            site = f" (in `{_site_of(node.lineno, spans)}`)"
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args:
+                yield mod.finding(
+                    self.id, node,
+                    "`.item()` forces a device→host transfer in a hot "
+                    "path — materialize under obs.device_span (or keep "
+                    "the value on device)" + site)
+                continue
+            dotted = _dotted(f)
+            if dotted == "jax.block_until_ready" \
+                    or (isinstance(f, ast.Attribute)
+                        and f.attr == "block_until_ready"):
+                yield mod.finding(
+                    self.id, node,
+                    "`block_until_ready` outside obs.device_span — the "
+                    "blocked wall time is invisible to trace "
+                    "attribution" + site)
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if dotted in _NP_SYNC_FNS \
+                    and _mentions_device(arg, fns, builders):
+                yield mod.finding(
+                    self.id, node,
+                    f"`{dotted}(...)` materializes a device dispatch "
+                    f"outside obs.device_span — the sync is real but "
+                    f"unattributed; wrap the dispatch in "
+                    f"device_span{site}")
+            elif isinstance(f, ast.Name) and f.id in _CAST_FNS \
+                    and _mentions_device(arg, fns, builders):
+                yield mod.finding(
+                    self.id, node,
+                    f"`{f.id}()` on a device value implicitly syncs in "
+                    f"a hot path — materialize under obs.device_span "
+                    f"first{site}")

@@ -1,0 +1,416 @@
+"""Shared project walker: parse every module once, build the
+cross-module context the checks share, run the checks.
+
+Two passes, because several checks need whole-tree knowledge before
+any file can be judged:
+
+* **pass 1** parses each ``.py`` into a :class:`SourceModule` (AST +
+  raw lines + pragmas) and harvests per-module facts — jit-wrapped
+  function names, jit-builder functions (defs whose return value is a
+  ``jax.jit(...)`` call, the ``shard_map`` program-builder idiom),
+  per-function static-argument names, and option declarations from
+  ``config.py``;
+* **pass 2** resolves ``from X import y`` edges so a module knows
+  which of its imported names are device dispatches, then runs every
+  check over every module.
+
+Contract sources (the metric-namespace tuple, the span taxonomy
+table, option declarations, docs text) load from the ANALYZED root
+when present and fall back to this package's own tree — so fixture
+directories in tests are judged against the real contracts while the
+real tree stays self-describing.  Everything here is stdlib-only:
+``ast`` is the entire front end.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .model import Finding, _comment_tokens, parse_pragmas
+
+__all__ = ["SourceModule", "Project", "analyze", "iter_python_files",
+           "PACKAGE_ROOT", "REPO_ROOT"]
+
+#: this package's parent (the geomesa_tpu package dir) and the repo
+#: root above it — the contract-source fallbacks
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent
+REPO_ROOT = PACKAGE_ROOT.parent
+
+#: never walked: bytecode caches (by name) and THE ANALYZER'S OWN
+#: package (by resolved path — a bare-name skip would silently exempt
+#: any future subpackage that happens to be called analysis/)
+_SKIP_DIRS = {"__pycache__"}
+ANALYSIS_DIR = Path(__file__).resolve().parent
+
+#: the hot-path subtrees check host-sync guards (ISSUE 13): the lean
+#: index families, the device kernels, the curve encoders, and the
+#: sharded scan variants
+HOT_PATH_PARTS = ("index", "ops", "curve", "parallel")
+
+
+def _in_analysis_dir(path: Path) -> bool:
+    try:
+        Path(path).resolve().relative_to(ANALYSIS_DIR)
+        return True
+    except ValueError:
+        return False
+
+
+def iter_python_files(root: Path):
+    root = Path(root)
+    if root.is_file():
+        yield root
+        return
+    for p in sorted(root.rglob("*.py")):
+        if any(part in _SKIP_DIRS for part in p.relative_to(root).parts) \
+                or _in_analysis_dir(p):
+            continue
+        yield p
+
+
+class SourceModule:
+    """One parsed file plus everything checks ask of it repeatedly."""
+
+    def __init__(self, path: Path, root: Path):
+        self.path = Path(path)
+        self.root = Path(root)
+        try:
+            self.rel = self.path.relative_to(self.root).as_posix()
+        except ValueError:
+            self.rel = self.path.name
+        self.text = self.path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(self.path))
+        # ONE tokenize pass feeds both the pragma map and the comment
+        # map (tokenizing is ~13% of analyzer wall time)
+        tokens = _comment_tokens(self.lines)
+        self.pragmas = parse_pragmas(self.lines, tokens=tokens)
+        #: {line: comment text} — REAL comment tokens only, so grammar
+        #: quoted in docstrings never reads as an annotation
+        self.comments = {i: text for i, text, _ in tokens}
+        # dotted module name rooted at the package (import resolution)
+        stem = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        parts = stem.split("/")
+        self.is_package = parts[-1] == "__init__"
+        if self.is_package:
+            parts = parts[:-1]
+        prefix = [self.root.name] if self.root.name else []
+        self.modname = ".".join(prefix + parts) if parts else self.root.name
+        # facts pass 1 fills in (walker-owned, check-shared)
+        self.jitted_fns: dict[str, set[str]] = {}   # name -> static names
+        self.jitted_params: dict[str, list[str]] = {}  # name -> pos params
+        self.builder_fns: set[str] = set()
+        self.imports: dict[str, tuple[str, str]] = {}  # local -> (mod, name)
+
+    def finding(self, check_id: str, node_or_line, message: str
+                ) -> Finding | None:
+        """A finding unless a pragma suppresses it."""
+        line = getattr(node_or_line, "lineno", node_or_line)
+        if self.pragmas.suppresses(check_id, line):
+            return None
+        return Finding(self.rel, int(line), check_id, message)
+
+
+# -- jit-site recognition (shared by host-sync and recompile-hazard) ------
+def _dotted(node) -> str:
+    """``a.b.c`` for a Name/Attribute chain, else ''."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def jit_call_info(node) -> dict | None:
+    """If ``node`` is a ``jax.jit``-family call or a
+    ``partial(jax.jit, ...)`` wrapper, its keyword map; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = _dotted(node.func)
+    kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+    if fn in _JIT_NAMES:
+        return kwargs
+    if fn in ("partial", "functools.partial") and node.args \
+            and _dotted(node.args[0]) in _JIT_NAMES:
+        return kwargs
+    return None
+
+
+def static_arg_names(kwargs: dict, fn_def=None) -> set[str]:
+    """Static argument NAMES a jit site declares — from
+    ``static_argnames`` literals, plus ``static_argnums`` resolved
+    through the wrapped def's positional parameters when available."""
+    out: set[str] = set()
+    names = kwargs.get("static_argnames")
+    if isinstance(names, ast.Constant) and isinstance(names.value, str):
+        out.add(names.value)
+    elif isinstance(names, (ast.Tuple, ast.List)):
+        out |= {e.value for e in names.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    nums = kwargs.get("static_argnums")
+    idxs: list[int] = []
+    if isinstance(nums, ast.Constant) and isinstance(nums.value, int):
+        idxs = [nums.value]
+    elif isinstance(nums, (ast.Tuple, ast.List)):
+        idxs = [e.value for e in nums.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    if idxs and fn_def is not None:
+        pos = [a.arg for a in fn_def.args.posonlyargs + fn_def.args.args]
+        out |= {pos[i] for i in idxs if 0 <= i < len(pos)}
+    return out
+
+
+def _harvest_module_facts(mod: SourceModule) -> None:
+    """Pass 1: jitted defs, builder defs, jit-assigned names, import
+    edges."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                kwargs = jit_call_info(dec)
+                if kwargs is None and _dotted(dec) in _JIT_NAMES:
+                    kwargs = {}
+                if kwargs is not None:
+                    mod.jitted_fns[node.name] = static_arg_names(
+                        kwargs, node)
+                    mod.jitted_params[node.name] = [
+                        a.arg for a in (node.args.posonlyargs
+                                        + node.args.args)]
+                    break
+            # builder idiom: def f(...): ... return jax.jit(...)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) \
+                        and jit_call_info(sub.value) is not None:
+                    mod.builder_fns.add(node.name)
+                    break
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            kwargs = jit_call_info(node.value)
+            if kwargs is not None:
+                mod.jitted_fns[node.targets[0].id] = static_arg_names(
+                    kwargs)
+        elif isinstance(node, ast.ImportFrom) and node.module is not None \
+                or isinstance(node, ast.ImportFrom) and node.level:
+            base = _resolve_relative(mod.modname, node.module, node.level,
+                                     mod.is_package)
+            for alias in node.names:
+                if alias.name != "*":
+                    mod.imports[alias.asname or alias.name] = (
+                        base, alias.name)
+
+
+def _resolve_relative(modname: str, module: str | None, level: int,
+                      is_package: bool = False) -> str:
+    """Absolute dotted target of a (possibly relative) import-from.
+
+    A regular module's one-dot base is its parent package; a package
+    ``__init__`` (whose modname IS the package) climbs one level less —
+    ``from .x import y`` there stays inside the package itself."""
+    if not level:
+        return module or ""
+    parts = modname.split(".")
+    drop = level - 1 if is_package else level
+    base = parts[:len(parts) - drop] if drop <= len(parts) else []
+    return ".".join(base + ([module] if module else []))
+
+
+# -- the project ----------------------------------------------------------
+class Project:
+    """Everything the checks share: parsed modules plus the
+    cross-module fact tables (module doc)."""
+
+    def __init__(self, root: Path, files=None):
+        self.root = Path(root).resolve()
+        self.package_mode = (self.root / "config.py").exists() \
+            and (self.root / "metrics.py").exists()
+        paths = list(files) if files is not None \
+            else list(iter_python_files(self.root))
+        self.modules = [SourceModule(p, self.root) for p in paths]
+        self.by_modname = {m.modname: m for m in self.modules}
+        for m in self.modules:
+            _harvest_module_facts(m)
+        self.declared_options = self._collect_options()
+        self.docs_text = self._read_docs()
+        self.metric_namespaces = self._metric_namespaces()
+        self.span_patterns = self._span_patterns()
+
+    # -- device-dispatch resolution (host-sync) ----------------------
+    def device_names(self, mod: SourceModule) -> tuple[set, set]:
+        """``(dispatch_names, builder_names)`` visible in ``mod`` —
+        its own plus imported ones resolved across the walked set."""
+        fns = set(mod.jitted_fns)
+        builders = set(mod.builder_fns)
+        for local, (src, name) in mod.imports.items():
+            src_mod = self.by_modname.get(src)
+            if src_mod is None:
+                continue
+            if name in src_mod.jitted_fns:
+                fns.add(local)
+            if name in src_mod.builder_fns:
+                builders.add(local)
+        return fns, builders
+
+    def static_args_of(self, mod: SourceModule, name: str) -> set[str]:
+        if name in mod.jitted_fns:
+            return mod.jitted_fns[name]
+        edge = mod.imports.get(name)
+        if edge is not None:
+            src_mod = self.by_modname.get(edge[0])
+            if src_mod is not None:
+                return src_mod.jitted_fns.get(edge[1], set())
+        return set()
+
+    def params_of(self, mod: SourceModule, name: str) -> list[str]:
+        """Positional parameter names of a jitted def (for mapping
+        call-site POSITIONAL arguments onto static names)."""
+        if name in mod.jitted_params:
+            return mod.jitted_params[name]
+        edge = mod.imports.get(name)
+        if edge is not None:
+            src_mod = self.by_modname.get(edge[0])
+            if src_mod is not None:
+                return src_mod.jitted_params.get(edge[1], [])
+        return []
+
+    def is_hot_path(self, mod: SourceModule) -> bool:
+        """Hot-path scope for host-sync: the named subtrees inside the
+        package; every file when analyzing an explicit fixture dir."""
+        if not self.package_mode:
+            return True
+        return any(part in HOT_PATH_PARTS
+                   for part in mod.rel.split("/")[:-1])
+
+    # -- contract sources --------------------------------------------
+    def _contract_file(self, rel: str) -> Path | None:
+        for base in (self.root, PACKAGE_ROOT):
+            p = base / rel
+            if p.exists():
+                return p
+        return None
+
+    def _collect_options(self) -> set[str]:
+        """Names declared ``SystemProperty("...", ...)`` or
+        ``SchemaOption("...", ...)`` in the analyzed tree's config.py
+        (no fallback: fixture trees DECLARE nothing, so their option
+        literals are judged undeclared — deliberately)."""
+        cfg = next((m for m in self.modules if m.rel == "config.py"), None)
+        if cfg is None and not self.package_mode:
+            return set()
+        out: set[str] = set()
+        if cfg is not None:
+            tree = cfg.tree
+        else:
+            tree = ast.parse((self.root / "config.py")
+                             .read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _dotted(node.func) in ("SystemProperty",
+                                               "SchemaOption") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                out.add(node.args[0].value)
+        return out
+
+    def _read_docs(self) -> str:
+        for base in (self.root.parent, REPO_ROOT):
+            docs = base / "docs"
+            if docs.is_dir():
+                return "\n".join(p.read_text(encoding="utf-8")
+                                 for p in sorted(docs.glob("*.md")))
+        return ""
+
+    def _metric_namespaces(self) -> tuple:
+        mod = next((m for m in self.modules if m.rel == "metrics.py"),
+                   None)
+        if mod is not None:  # already parsed — reuse the AST
+            tree = mod.tree
+        else:
+            p = self._contract_file("metrics.py")
+            if p is None:
+                return ()
+            tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "METRIC_NAMESPACES"
+                            for t in node.targets) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant))
+        return ()
+
+    def _span_patterns(self) -> list[str]:
+        """Span names from the docs/observability.md taxonomy table
+        (first backticked cell of each row in the Span taxonomy
+        section); ``<x>`` placeholders become one-segment wildcards at
+        match time."""
+        for base in (self.root.parent, REPO_ROOT):
+            doc = base / "docs" / "observability.md"
+            if doc.exists():
+                break
+        else:
+            return []
+        out: list[str] = []
+        in_section = False
+        for line in doc.read_text(encoding="utf-8").splitlines():
+            if line.startswith("## "):
+                in_section = line.strip() == "## Span taxonomy"
+                continue
+            if in_section and line.startswith("|"):
+                m = re.match(r"\|\s*`([^`]+)`\s*\|", line)
+                if m:
+                    out.append(m.group(1))
+        return out
+
+
+def package_root_of(path: Path) -> Path:
+    """The topmost enclosing package directory of a file (the dir
+    findings and baseline keys are relative to), else its parent."""
+    base = Path(path).resolve().parent
+    while (base / "__init__.py").exists() \
+            and (base.parent / "__init__.py").exists():
+        base = base.parent
+    return base
+
+
+def analyze(root: Path | str, checks=None, files=None,
+            select=None) -> list[Finding]:
+    """Run ``checks`` (default: all registered) over ``root``; returns
+    findings sorted by (file, line, check).  ``files`` restricts which
+    files are PARSED (self-contained fixture sets); ``select``
+    restricts which modules are JUDGED while the whole root still
+    parses for cross-module context (the CLI's single-file mode)."""
+    from .checks import CHECKS
+    root = Path(root)
+    if root.is_file():
+        # a bare file must still report paths relative to its package
+        # root — else baseline keys like index/z3_lean.py never match
+        select = {root.resolve()}
+        root = package_root_of(root)
+    elif root.is_dir() and (root / "__init__.py").exists() \
+            and files is None and select is None:
+        # same re-rooting for a SUBPACKAGE directory: judge its files,
+        # but parse (and key against) the whole enclosing package
+        top = package_root_of(root / "__init__.py")
+        if top != root.resolve():
+            select = {p.resolve() for p in iter_python_files(root)}
+            root = top
+    project = Project(root, files=files)
+    use = list(CHECKS) if checks is None else list(checks)
+    judged = project.modules if select is None else \
+        [m for m in project.modules if m.path.resolve() in select]
+    findings: list[Finding] = []
+    for mod in judged:
+        for check in use:
+            findings.extend(f for f in check.run(mod, project)
+                            if f is not None)
+    findings.sort(key=lambda f: (f.file, f.line, f.check_id, f.message))
+    return findings

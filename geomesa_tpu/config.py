@@ -14,11 +14,15 @@ from __future__ import annotations
 
 import os
 import threading
+import warnings
 from dataclasses import dataclass
 from typing import Any
 
-__all__ = ["SystemProperty", "QueryProperties", "ObsProperties",
-           "set_property", "clear_property", "config_generation"]
+__all__ = ["SystemProperty", "SchemaOption", "QueryProperties",
+           "ObsProperties", "SchemaProperties", "ConfigProperties",
+           "set_property", "clear_property", "config_generation",
+           "known_option_names", "check_option_name",
+           "UnknownOptionWarning"]
 
 _overrides: dict[str, Any] = {}
 _lock = threading.Lock()
@@ -28,6 +32,50 @@ _lock = threading.Lock()
 #: ``set_property`` still takes effect immediately
 _generation = 0
 
+#: the option registry (ISSUE 13): every declared knob — tier-1
+#: SystemProperty AND tier-2 SchemaOption — keyed by name.  Filled by
+#: ``_register_declarations`` at the bottom of this module; the static
+#: analyzer (geomesa_tpu/analysis, check ``config-option``) reads the
+#: SAME declarations off this file's AST, so the static and runtime
+#: halves cannot drift.
+_REGISTRY: dict[str, Any] = {}
+#: names already warned about (one warning per unknown name, not one
+#: per lookup)
+_warned: set[str] = set()
+
+
+class UnknownOptionWarning(UserWarning):
+    """A ``geomesa.*`` option name nobody declared — almost always a
+    typo that would otherwise silently read the default forever."""
+
+
+def known_option_names() -> frozenset:
+    """Every declared option name (system properties + schema
+    options)."""
+    return frozenset(_REGISTRY)
+
+
+def check_option_name(name: str, *, raise_in_strict: bool = True) -> None:
+    """Strict-option gate (ISSUE 13 satellite): a ``geomesa.*`` name
+    that is not declared in this module warns — and RAISES under
+    ``geomesa.config.strict`` — so a typo'd option fails loudly
+    instead of silently defaulting.  Non-``geomesa.`` names pass
+    untouched (embedders may ride the override store).
+    ``raise_in_strict=False`` demotes strict mode to the warning
+    (``clear_property``: removing a stale override is inherently safe
+    and must stay possible WHILE strict is on)."""
+    if not name.startswith("geomesa.") or name in _REGISTRY \
+            or not _REGISTRY:
+        return
+    msg = (f"unregistered option {name!r}: not declared in "
+           f"geomesa_tpu/config.py (typo?) — known names: "
+           f"docs/configuration.md")
+    if raise_in_strict and ConfigProperties.STRICT.to_bool():
+        raise ValueError(msg)
+    if name not in _warned:
+        _warned.add(name)
+        warnings.warn(msg, UnknownOptionWarning, stacklevel=3)
+
 
 def config_generation() -> int:
     return _generation
@@ -35,6 +83,7 @@ def config_generation() -> int:
 
 def set_property(name: str, value) -> None:
     global _generation
+    check_option_name(name)
     with _lock:
         _overrides[name] = value
         _generation += 1
@@ -42,6 +91,7 @@ def set_property(name: str, value) -> None:
 
 def clear_property(name: str) -> None:
     global _generation
+    check_option_name(name, raise_in_strict=False)
     with _lock:
         _overrides.pop(name, None)
         _generation += 1
@@ -59,6 +109,7 @@ class SystemProperty:
         return self.name.replace(".", "_").upper()
 
     def get(self):
+        check_option_name(self.name)
         with _lock:
             if self.name in _overrides:
                 return _overrides[self.name]
@@ -78,6 +129,73 @@ class SystemProperty:
 
     def to_bool(self) -> bool:
         return bool(self.get())
+
+
+@dataclass(frozen=True)
+class SchemaOption:
+    """A declared tier-2 option: a ``geomesa.*`` key read from a
+    schema's user data (``features/feature_type.py``) rather than the
+    process environment.  Declared here purely so the option REGISTRY
+    is complete — both the runtime strict mode and the static
+    ``config-option`` check resolve every ``"geomesa.*"`` literal in
+    the tree against these declarations; resolution itself stays where
+    it always was (``sft.user_data.get(...)``)."""
+
+    name: str
+    default: Any = None
+    doc: str = ""
+
+
+class ConfigProperties:
+    """The config system's own knobs."""
+
+    #: strict option mode: unregistered ``geomesa.*`` names RAISE at
+    #: ``set_property``/lookup instead of warning (CI wants typos
+    #: fatal; interactive embedders may prefer the warning)
+    STRICT = SystemProperty("geomesa.config.strict", False)
+
+
+class SchemaProperties:
+    """Tier-2 per-schema option declarations (the user-data keys the
+    datastore and feature types honor — docs/configuration.md)."""
+
+    #: index layout profile: ``lean`` selects the tiered SoA lean
+    #: index families (docs/design.md)
+    INDEX_PROFILE = SchemaOption("geomesa.index.profile", "",
+                                 "index layout profile ('lean')")
+    #: explicit index-version pin list, or 'current'
+    INDEX_VERSIONS = SchemaOption("geomesa.index.versions", "",
+                                  "pin index versions")
+    #: which attribute is THE temporal axis (else first Date attr)
+    INDEX_DTG = SchemaOption("geomesa.index.dtg", "",
+                             "temporal attribute override")
+    #: comma list restricting which index kinds build
+    INDICES_ENABLED = SchemaOption("geomesa.indices.enabled", "",
+                                   "restrict built indexes")
+    #: z3 time-bin interval: 'day' | 'week' | 'month' | 'year'
+    Z3_INTERVAL = SchemaOption("geomesa.z3.interval", "week",
+                               "z3 time-bin period")
+    #: xz curve resolution (g in the XZ-ordering papers)
+    XZ_PRECISION = SchemaOption("geomesa.xz.precision", 12,
+                                "xz curve precision")
+    #: feature-id minting strategy ('z3' = locality-preserving)
+    FID_STRATEGY = SchemaOption("geomesa.fid.strategy", "",
+                                "feature-id strategy")
+    #: age-off retention expression (age_off.py)
+    AGE_OFF = SchemaOption("geomesa.age.off", "",
+                           "age-off retention window")
+    #: registered query interceptors (planning/interceptor.py)
+    QUERY_INTERCEPTORS = SchemaOption("geomesa.query.interceptors", "",
+                                      "query interceptor chain")
+    #: lean-profile HBM budget in bytes for this schema's device tiers
+    LEAN_HBM_BUDGET = SchemaOption("geomesa.lean.hbm.budget", 0,
+                                   "lean device-tier byte budget")
+    #: lean LSM size-tier factor (0 disables auto-compaction)
+    LEAN_COMPACTION_FACTOR = SchemaOption(
+        "geomesa.lean.compaction.factor", 4, "LSM size-tier factor")
+    #: lean generation capacity in slots (rollover threshold)
+    LEAN_GENERATION_SLOTS = SchemaOption(
+        "geomesa.lean.generation.slots", 0, "generation slot capacity")
 
 
 class QueryProperties:
@@ -159,6 +277,18 @@ class ObsProperties:
     #: IngestJob/CompactionJob records kept for /debug/jobs
     JOBS_CAPACITY = SystemProperty("geomesa.obs.jobs.capacity", 128)
 
+
+def _register_declarations() -> None:
+    """Fill the option registry from the declaration classes above —
+    the one place a knob becomes 'known' to the strict mode."""
+    for cls in (QueryProperties, ObsProperties, SchemaProperties,
+                ConfigProperties):
+        for value in vars(cls).values():
+            if isinstance(value, (SystemProperty, SchemaOption)):
+                _REGISTRY[value.name] = value
+
+
+_register_declarations()
 
 #: default scan-ranges budget (import-time snapshot users can override per
 #: call; the live knob is QueryProperties.SCAN_RANGES_TARGET)

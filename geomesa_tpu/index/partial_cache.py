@@ -20,9 +20,20 @@ lives here instead of being hand-copied per aggregate kind:
 
 Only SEALED generations may cache: the live run mutates under appends,
 so callers never insert it (the caller owns that gate — it knows which
-generation is live)."""
+generation is live).
+
+The SPEC MAP is lock-guarded (ISSUE 13): scrape threads walk
+:meth:`stats` while query threads touch/evict specs, and an unlocked
+LRU reorder racing an eviction corrupts the dict order that IS the
+policy.  The per-spec inner dicts handed out by :meth:`spec_cache`
+stay caller-owned — a spec's partials are only populated from the
+scan path that owns the index, and reads of immutable partials are
+safe; the lock's job is the cross-thread map structure.
+"""
 
 from __future__ import annotations
+
+import threading
 
 __all__ = ["PartialCache"]
 
@@ -36,66 +47,82 @@ class PartialCache:
     def __init__(self, max_specs: int, max_bytes: int):
         self.max_specs = int(max_specs)
         self.max_bytes = int(max_bytes)
-        #: spec -> {gen_id: partial}; dict order IS the LRU order
+        #: guarded-by: self._lock — spec -> {gen_id: partial}; dict
+        #: order IS the LRU order, and scrapers race queries on it
         self._specs: dict = {}
+        self._lock = threading.Lock()
 
     # -- dict-like inspection surface ---------------------------------
     def __len__(self) -> int:
-        return len(self._specs)
+        with self._lock:
+            return len(self._specs)
 
     def __iter__(self):
-        return iter(self._specs)
+        with self._lock:
+            return iter(list(self._specs))
 
     def values(self):
-        return self._specs.values()
+        with self._lock:
+            return list(self._specs.values())
 
     def items(self):
-        return self._specs.items()
+        with self._lock:
+            return list(self._specs.items())
 
     def clear(self) -> None:
-        self._specs.clear()
+        with self._lock:
+            self._specs.clear()
 
     # -- policy --------------------------------------------------------
-    def cached_bytes(self) -> int:
+    # gm-lint: holds: self._lock (internal sum; public paths lock first)
+    def _cached_bytes(self) -> int:
         return sum(p.nbytes for c in self._specs.values()
                    for p in c.values())
+
+    def cached_bytes(self) -> int:
+        with self._lock:
+            return self._cached_bytes()
 
     def stats(self) -> dict:
         """Storage-accounting view (obs/resource.StorageReport): spec
         count, total cached partials, resident bytes, and the policy
         ceilings they are bounded by."""
-        return {"specs": len(self._specs),
-                "partials": sum(len(c) for c in self._specs.values()),
-                "bytes": self.cached_bytes(),
-                "max_specs": self.max_specs,
-                "max_bytes": self.max_bytes}
+        with self._lock:
+            return {"specs": len(self._specs),
+                    "partials": sum(len(c) for c in self._specs.values()),
+                    "bytes": self._cached_bytes(),
+                    "max_specs": self.max_specs,
+                    "max_bytes": self.max_bytes}
 
     def spec_cache(self, spec) -> dict:
         """The per-generation partial dict for one spec, LRU-touched;
         oldest OTHER specs evict past ``max_specs`` or the byte
         ceiling (inserts enforce the ceiling against the active spec
         too — :meth:`add`)."""
-        cache = self._specs.pop(spec, None)
-        if cache is None:
-            cache = {}
-            while len(self._specs) >= self.max_specs:
+        with self._lock:
+            cache = self._specs.pop(spec, None)
+            if cache is None:
+                cache = {}
+                while len(self._specs) >= self.max_specs:
+                    self._specs.pop(next(iter(self._specs)))
+            self._specs[spec] = cache
+            while (len(self._specs) > 1
+                   and self._cached_bytes() > self.max_bytes):
                 self._specs.pop(next(iter(self._specs)))
-        self._specs[spec] = cache
-        while (len(self._specs) > 1
-               and self.cached_bytes() > self.max_bytes):
-            self._specs.pop(next(iter(self._specs)))
-        return cache
+            return cache
 
     def add(self, cache: dict, gen_id: int, part) -> None:
         """Insert one sealed-generation partial unless it would push
         the TOTAL cached bytes — every spec, including the active one —
         past the ceiling."""
-        if self.cached_bytes() + part.nbytes <= self.max_bytes:
-            cache[gen_id] = part
+        with self._lock:
+            if self._cached_bytes() + part.nbytes <= self.max_bytes:
+                cache[gen_id] = part
 
     def drop_generations(self, gen_ids) -> None:
         """Invalidate every partial of the given (compacted-away)
         generations across all specs."""
-        for cache in self._specs.values():
-            for gid in gen_ids:
-                cache.pop(gid, None)
+        with self._lock:
+            for cache in self._specs.values():
+                for gid in gen_ids:
+                    cache.pop(gid, None)

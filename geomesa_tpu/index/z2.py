@@ -19,6 +19,7 @@ import numpy as np
 from ..curve.sfc import Z2SFC, z2_sfc
 from ..curve.zorder import deinterleave2
 from ..config import DEFAULT_MAX_RANGES
+from ..obs import device_span
 from ..ops.search import (
     coded_pos_bits, expand_ranges, gather_capacity, pack_coded,
     pack_wire, pad_boxes, pad_pow2, pad_ranges, run_packed_query,
@@ -304,12 +305,17 @@ class Z2PointIndex:
         def dispatch(capacity):
             from ..ops.pallas_kernels import GATES
             from .z3 import _use_pallas_scan
-            return GATES["z2_scan"].run(
-                lambda: np.asarray(_query_packed(
-                    *args, capacity=capacity, use_pallas=True)),
-                lambda: _query_packed(*args, capacity=capacity,
-                                      use_pallas=False),
-                enabled=_use_pallas_scan())
+            with device_span("query.scan.device", stage="packed",
+                             capacity=capacity):
+                # BOTH branches materialize inside the span: the XLA
+                # thunk returns a lazy array, and an asarray deferred
+                # to run_packed_query would block outside attribution
+                return GATES["z2_scan"].run(
+                    lambda: np.asarray(_query_packed(
+                        *args, capacity=capacity, use_pallas=True)),
+                    lambda: np.asarray(_query_packed(
+                        *args, capacity=capacity, use_pallas=False)),
+                    enabled=_use_pallas_scan())
 
         hits, self._capacity = run_packed_query(dispatch, self._capacity)
         return hits
@@ -377,13 +383,15 @@ class Z2PointIndex:
         pos_bits = coded_pos_bits(len(self), n_q)
 
         def dispatch(capacity):
-            return _query_many_packed(
-                self.z, self.pos, self.x, self.y,
-                jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
-                jnp.asarray(r["rqid"]), jnp.asarray(ixy_c),
-                jnp.asarray(boxes_c), jnp.asarray(bqid_c),
-                capacity=capacity, pos_bits=pos_bits,
-            )
+            with device_span("query.scan.device", stage="packed_many",
+                             capacity=capacity):
+                return np.asarray(_query_many_packed(
+                    self.z, self.pos, self.x, self.y,
+                    jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
+                    jnp.asarray(r["rqid"]), jnp.asarray(ixy_c),
+                    jnp.asarray(boxes_c), jnp.asarray(bqid_c),
+                    capacity=capacity, pos_bits=pos_bits,
+                ))
 
         coded, self._capacity = run_packed_query(dispatch, self._capacity)
         qids = coded >> pos_bits

@@ -37,6 +37,7 @@ from ..curve.binnedtime import TimePeriod, max_date_ms, max_offset, to_binned_ti
 from ..curve.sfc import Z3SFC, z3_sfc
 from ..curve.zorder import deinterleave3
 from ..config import DEFAULT_MAX_RANGES, QueryProperties
+from ..obs import device_span
 from ..ops.search import (
     coded_pos_bits, expand_ranges, gather_capacity, pack_coded,
     pack_wire, pad_boxes, pad_pow2, pad_ranges, run_packed_query,
@@ -552,12 +553,15 @@ class Z3PointIndex:
         )
         def dispatch(capacity):
             from ..ops.pallas_kernels import GATES
-            return GATES["z3_scan"].run(
-                lambda: np.asarray(_query_packed(
-                    *args, capacity=capacity, use_pallas=True)),
-                lambda: _query_packed(*args, capacity=capacity,
-                                      use_pallas=False),
-                enabled=_use_pallas_scan())
+            with device_span("query.scan.device", stage="packed",
+                             capacity=capacity):
+                # BOTH branches materialize inside the span (z2.py)
+                return GATES["z3_scan"].run(
+                    lambda: np.asarray(_query_packed(
+                        *args, capacity=capacity, use_pallas=True)),
+                    lambda: np.asarray(_query_packed(
+                        *args, capacity=capacity, use_pallas=False)),
+                    enabled=_use_pallas_scan())
 
         if self._capacity >= TWO_PHASE_MIN_CAPACITY:
             return self._query_two_phase(args)
@@ -573,22 +577,24 @@ class Z3PointIndex:
         totals round trip was extra)."""
         capacity = self._capacity
         while True:
-            packed, totals = _scan_keep_device(
-                *args, capacity=capacity, use_pallas=False)
-            total, nhits = (int(v) for v in np.asarray(totals))
-            if total > capacity:
-                capacity = gather_capacity(total)
-                continue
-            # decay toward the observed candidate volume so one huge
-            # query doesn't tax every later small one (re-growth costs a
-            # single cheap retry dispatch)
-            self._capacity = max(self.DEFAULT_CAPACITY,
-                                 gather_capacity(total))
-            k = gather_capacity(max(nhits, 1), minimum=8)
-            if k >= capacity:  # dense result: compacting wouldn't shrink
-                out = np.asarray(packed)
-            else:
-                out = np.asarray(_compact_hits(packed, k=k))
+            with device_span("query.scan.device", stage="two_phase",
+                             capacity=capacity):
+                packed, totals = _scan_keep_device(
+                    *args, capacity=capacity, use_pallas=False)
+                total, nhits = (int(v) for v in np.asarray(totals))
+                if total > capacity:
+                    capacity = gather_capacity(total)
+                    continue
+                # decay toward the observed candidate volume so one huge
+                # query doesn't tax every later small one (re-growth
+                # costs a single cheap retry dispatch)
+                self._capacity = max(self.DEFAULT_CAPACITY,
+                                     gather_capacity(total))
+                k = gather_capacity(max(nhits, 1), minimum=8)
+                if k >= capacity:  # dense result: compact can't shrink
+                    out = np.asarray(packed)
+                else:
+                    out = np.asarray(_compact_hits(packed, k=k))
             return np.sort(out[out >= 0]).astype(np.int64)
 
     def query_many(self, windows,
@@ -648,8 +654,10 @@ class Z3PointIndex:
         pos_bits = coded_pos_bits(len(self), n_q)
 
         def dispatch(capacity):
-            return _query_many_packed(*args, capacity=capacity,
-                                      pos_bits=pos_bits)
+            with device_span("query.scan.device", stage="packed_many",
+                             capacity=capacity):
+                return np.asarray(_query_many_packed(
+                    *args, capacity=capacity, pos_bits=pos_bits))
 
         coded, self._capacity = run_packed_query(dispatch, self._capacity)
         qids = coded >> pos_bits

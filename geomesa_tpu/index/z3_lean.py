@@ -1715,9 +1715,11 @@ class LeanZ3Index:
             zs = [(self._sentinel_cols("keys")[1] if g is None
                    else g.z) for g in group]
             self.dispatch_count += 1
-            stacked = np.asarray(_lean_density_sweep(
-                self.sfc, env_j, *zs, width=width, height=height,
-                world=world), np.float64)
+            with device_span("query.scan.device", stage="sweep",
+                             runs=len(chunk)):
+                stacked = np.asarray(_lean_density_sweep(
+                    self.sfc, env_j, *zs, width=width, height=height,
+                    world=world), np.float64)
             for i, g in enumerate(chunk):
                 part = stacked[i]
                 grid += part

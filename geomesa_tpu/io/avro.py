@@ -47,6 +47,7 @@ def avro_schema(sft: FeatureType) -> dict:
             t = _AVRO_TYPES.get(a.type, "string")
         fields.append({"name": a.name, "type": [t, "null"]})
     return {"type": "record", "name": sft.name or "feature",
+            # gm-lint: disable=config-option Avro record namespace, not an option name
             "namespace": "geomesa.tpu", "fields": fields}
 
 

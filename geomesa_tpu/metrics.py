@@ -222,6 +222,8 @@ class Timer(HistogramMetric):
 
 class MetricRegistry:
     def __init__(self):
+        #: guarded-by: self._lock — every thread in the process
+        #: (queries, writers, scrapers, reporters) hits this map
         self._metrics: dict = {}
         self._lock = threading.Lock()
 
@@ -408,15 +410,20 @@ class PeriodicReporter:
         self.reporter = reporter
         self.interval_s = float(interval_s)
         self._stop = threading.Event()
+        self._lock = threading.Lock()
+        #: guarded-by: self._lock — concurrent start()/stop() (an
+        #: embedder's lifecycle hooks racing a test teardown) must
+        #: never double-start the daemon or join a replaced thread
         self._thread: threading.Thread | None = None
 
     def start(self) -> "PeriodicReporter":
-        if self._thread is None:
-            self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._loop, name="geomesa-metrics-reporter",
-                daemon=True)
-            self._thread.start()
+        with self._lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, name="geomesa-metrics-reporter",
+                    daemon=True)
+                self._thread.start()
         return self
 
     def _loop(self):
@@ -428,10 +435,14 @@ class PeriodicReporter:
                     "metrics reporter failed", exc_info=True)
 
     def stop(self, final_report: bool = True) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        with self._lock:
+            # set INSIDE the lock: a set racing ahead of it lets a
+            # concurrent start() clear the event between set and join,
+            # orphaning the old daemon while _thread resets to None
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
         if final_report:
             try:
                 self.reporter.report()

@@ -118,6 +118,8 @@ class HeatTracker:
                  max_entries: int | None = None):
         self._tau_override = tau_s
         self._max_override = max_entries
+        #: guarded-by: self._lock — scans, compaction merges, report
+        #: snapshots and eviction all race on this map
         self._entries: dict[tuple, _HeatEntry] = {}
         self._lock = threading.Lock()
 
@@ -152,6 +154,7 @@ class HeatTracker:
             if len(self._entries) > self._max_entries():
                 self._evict_coldest(now, tau)
 
+    # gm-lint: holds: self._lock (record() evicts inside its fold)
     def _evict_coldest(self, now: float, tau: float) -> None:
         """Drop the coldest ~10% (lock held) — amortized so a store
         with churning generations never grows the table unbounded."""

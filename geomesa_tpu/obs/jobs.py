@@ -107,8 +107,11 @@ class JobRegistry:
 
     def __init__(self, capacity: int | None = None):
         self._capacity_override = capacity
+        #: guarded-by: self._lock — concurrent jobs register/retire here
         self._active: dict[str, JobRecord] = {}
+        #: guarded-by: self._lock — bounded finished-record ring
         self._recent: deque[JobRecord] = deque()
+        #: guarded-by: self._lock — the process-unique id source
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
 

@@ -1269,9 +1269,11 @@ class ShardedLeanZ3Index:
             for g in padded:
                 cols += [g.bins, g.z]
             self.dispatch_count += 1
-            stacked = np.asarray(_cells_program(
-                self.mesh, len(padded), int(bits), nb)(
-                jnp.int64(b0), *cols))
+            with device_span("query.scan.device", stage="z3_cells",
+                             runs=len(scan)):
+                stacked = np.asarray(_cells_program(
+                    self.mesh, len(padded), int(bits), nb)(
+                    jnp.int64(b0), *cols))
             for i, g in enumerate(scan):
                 # copy, not a view: a cached view would pin the whole
                 # stacked bucket and break the byte accounting

@@ -107,6 +107,7 @@ def _hist1d_probe():
     before any process enters the collective program (pallas_kernels.
     PallasGate._agree_multihost)."""
     from ..ops.pallas_kernels import hist1d_pallas
+    # gm-lint: disable=host-sync one-shot lowering probe at gate init, not a query path
     np.asarray(hist1d_pallas(jnp.zeros(8, jnp.int32),
                              jnp.ones(8, jnp.float32),
                              jnp.ones(8, bool), 8))

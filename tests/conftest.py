@@ -50,3 +50,19 @@ ShardedLeanAttrIndex.GENERATION_SLOTS = 1 << 13
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(574)
+
+
+@pytest.fixture(scope="session")
+def gm_lint_tree():
+    """ONE timed gm-lint full-tree pass shared by every in-process
+    analyzer assertion (the zzzz clean-tree gate, the metric-lint
+    delegation test) — the pass is pure ast but still ~3 s, so tier-1
+    pays it once."""
+    import time
+
+    from geomesa_tpu.analysis import analyze
+    from geomesa_tpu.analysis.walker import PACKAGE_ROOT
+
+    t0 = time.perf_counter()
+    findings = analyze(PACKAGE_ROOT)
+    return findings, time.perf_counter() - t0

@@ -1,0 +1,26 @@
+"""gm-lint fixture: known-bad lock-discipline snippets (parsed, never
+imported; line numbers asserted exactly)."""
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: guarded-by: self._lock
+        self._entries = {}
+
+    def good(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+
+    def bad_read(self, key):
+        return self._entries.get(key)              # line 17: unlocked
+
+    # gm-lint: holds: self._lock
+    def evict(self):
+        self._entries.clear()
+
+    def bad_after_block(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+        return len(self._entries)                  # line 26: unlocked

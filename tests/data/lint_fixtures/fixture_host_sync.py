@@ -1,0 +1,48 @@
+"""gm-lint fixture: known-bad host-sync snippets.  PARSED by the
+analyzer tests, never imported — line numbers are asserted exactly, so
+edits here must update tests/test_zzzz_static_analysis.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from geomesa_tpu.obs import device_span
+
+
+@jax.jit
+def _probe(z):
+    return z + 1
+
+
+def builder(n):
+    def app(x):
+        return x * n
+    return jax.jit(app)
+
+
+def bad_item(values):
+    return values.sum().item()                     # line 23: .item()
+
+
+def bad_block(z):
+    jax.block_until_ready(_probe(z))               # line 27: block
+
+
+def bad_asarray(z):
+    return np.asarray(_probe(z))                   # line 31: np.asarray
+
+
+def bad_builder_dispatch(z):
+    return np.asarray(builder(3)(z))               # line 35: builder
+
+
+def bad_cast(z):
+    return int(jnp.sum(z))                         # line 39: int()
+
+
+def good_sanctioned(z):
+    with device_span("query.scan.device", stage="probe"):
+        return np.asarray(_probe(z))
+
+
+def good_pragma(z):
+    return np.asarray(_probe(z))  # gm-lint: disable=host-sync fixture-sanctioned sync

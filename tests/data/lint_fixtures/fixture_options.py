@@ -1,0 +1,12 @@
+"""gm-lint fixture: known-bad config-option snippets (parsed, never
+imported; line numbers asserted exactly)."""
+
+OPTION = "geomesa.made.up.option"                  # line 4: undeclared
+
+
+def read(user_data):
+    return user_data.get("geomesa.also.unknown")   # line 8: undeclared
+
+
+def pragma_ok(user_data):
+    return user_data.get("geomesa.sanctioned.name")  # gm-lint: disable=config-option fixture

@@ -1,0 +1,35 @@
+"""gm-lint fixture: known-bad recompile-hazard snippets (parsed, never
+imported; line numbers asserted exactly)."""
+import functools
+import time
+
+import jax
+
+_MUTABLE_TABLE = {"cap": 8}
+
+
+def _tweak():
+    _MUTABLE_TABLE.update(cap=16)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def fold(x, cap=8):
+    return x[: _MUTABLE_TABLE["cap"]] + cap        # line 17: capture
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def pad(x, shape=[8, 8]):                          # line 21: default
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scale(x, k):
+    return x * k
+
+
+def callers(x):
+    fold(x, cap=[1, 2])                            # line 31: unhashable
+    fold(x, cap=time.time())                       # line 32: varying
+    scale(x, [1, 2])                               # line 33: positional
+    scale(x, time.time())                          # line 34: positional
+    return scale(x, k=2)                           # fine: constant

@@ -1,0 +1,14 @@
+"""gm-lint fixture: known-bad metric/span taxonomy snippets (parsed,
+never imported; line numbers asserted exactly)."""
+from geomesa_tpu.metrics import registry
+from geomesa_tpu.obs import device_span, obs_count, span
+
+
+def emit(schema):
+    registry.counter("lena.compaction.merges").inc()   # line 8: typo
+    registry.timer(f"query.{schema}.plan_ms")          # fine
+    obs_count("heta.touch")                            # line 10: typo
+    with span("query.scan.warp"):                      # line 11: span
+        pass
+    with device_span("query.scan.device", stage="probe"):
+        pass                                           # fine

@@ -1,18 +1,17 @@
-"""Metric-naming lint (ISSUE 9 satellite): after the whole suite has
-run (this module collects LAST — 'zzz' sorts after every 'zz_'), walk
-the full process-global metric registry and assert every key matches
-the namespace contract documented in docs/observability.md.  A drive-by
-metric typo (``lena.compaction.merges``) lands a key outside the
-contract and fails here at tier-1 time instead of silently splitting a
-dashboard.
+"""Metric-naming lint (ISSUE 9 satellite): the runtime
+gauge-PRESENCE half of the metric contract — drive a full write →
+query → compaction-job → publish cycle so the gauges that only exist
+after it are registered, then walk the registry ('zzz' collects after
+every 'zz_' so the walk covers the whole suite).
 
-ISSUE 12 extends the walk: the suite's registry snapshot only covers
-keys some earlier test happened to emit, and the ``heat.*``/``job.*``
-gauges exist only after a write/compaction cycle has been OBSERVED and
-published — so this module drives one explicitly (write → query →
-compaction job → heat + storage gauge publication) before linting,
-guaranteeing the write/heat/job namespaces are present in the walk
-rather than vacuously absent.
+ISSUE 13 moved the name-CONTRACT half to the static analyzer: every
+metric/span name LITERAL in the tree is validated by the ``taxonomy``
+check of ``python -m geomesa_tpu.analysis`` (tests/
+test_zzzz_static_analysis.py runs it tier-1), independent of which
+keys a test cycle happens to emit.  The delegation test below pins
+that coverage; the registry walk stays as the backstop for
+dynamically-BUILT keys (f-string schema/kind segments) that no static
+pass can see.
 """
 
 import numpy as np
@@ -58,7 +57,21 @@ def test_registry_covers_write_and_job_cycle_gauges():
     assert "write.lintcyc.features" in names
 
 
+def test_name_contract_delegated_to_static_check(gm_lint_tree):
+    """The name-contract half is the static ``taxonomy`` check now
+    (module doc): zero unbaselined taxonomy findings over the tree —
+    cycle-INDEPENDENT, so a typo'd literal fails even when no test
+    ever executes it.  Filters the session-shared full pass rather
+    than re-parsing the package."""
+    from geomesa_tpu.analysis import Baseline
+    findings = [f for f in gm_lint_tree[0] if f.check_id == "taxonomy"]
+    new, _, _ = Baseline.load().split(findings)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
 def test_registry_keys_match_naming_contract():
+    """Backstop for dynamically-built keys (module doc): the runtime
+    walk over whatever the suite emitted."""
     names = registry.names()
     # the suite must have populated the registry — an empty walk would
     # make this test vacuously green

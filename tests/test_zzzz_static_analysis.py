@@ -1,0 +1,607 @@
+"""gm-lint static analysis (ISSUE 13): the analyzer framework, its
+five checks against known-bad fixtures (findings asserted exactly),
+pragma/baseline round trips, the CLEAN-TREE gate over geomesa_tpu/
+(this file IS the tier-1 wiring — 'zzzz' collects after everything),
+the jax-free import contract, the strict-option runtime mode, and
+pinned regression tests for the genuine violations the checks
+surfaced (missing device_span wrappers, unlocked shared obs state).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.analysis import (
+    Baseline, BaselineError, all_checks, analyze,
+)
+from geomesa_tpu.analysis.baseline import DEFAULT_BASELINE_PATH
+from geomesa_tpu.analysis.checks import check_by_id
+from geomesa_tpu.analysis.walker import PACKAGE_ROOT
+
+FIXTURES = Path(__file__).parent / "data" / "lint_fixtures"
+
+MS = 1514764800000
+DAY = 86_400_000
+WORLD = (-180.0, -90.0, 180.0, 90.0)
+
+
+def _fixture_findings(name: str, check_id: str):
+    return analyze(FIXTURES, checks=[check_by_id(check_id)],
+                   files=[FIXTURES / name])
+
+
+# -- per-check fixture exactness ------------------------------------------
+def test_host_sync_fixture_exact():
+    got = _fixture_findings("fixture_host_sync.py", "host-sync")
+    assert [(f.line, f.check_id) for f in got] == [
+        (23, "host-sync"),   # .item()
+        (27, "host-sync"),   # block_until_ready
+        (31, "host-sync"),   # np.asarray on jitted call
+        (35, "host-sync"),   # np.asarray through a jit-builder
+        (39, "host-sync"),   # int() on a jnp expression
+    ]
+    msgs = "\n".join(f.message for f in got)
+    assert ".item()" in msgs and "block_until_ready" in msgs
+    # messages name the enclosing function — the line-independent
+    # baseline key must be unique per violation site
+    assert "(in `bad_item`)" in got[0].message
+    assert "(in `bad_block`)" in got[1].message
+    # the device_span block and the pragma'd line stayed silent
+    assert not [f for f in got if f.line > 41]
+
+
+def test_recompile_hazard_fixture_exact():
+    got = _fixture_findings("fixture_recompile.py", "recompile-hazard")
+    assert [(f.line, f.check_id) for f in got] == [
+        (17, "recompile-hazard"),   # mutable-global capture
+        (21, "recompile-hazard"),   # unhashable static default
+        (31, "recompile-hazard"),   # unhashable static call value
+        (32, "recompile-hazard"),   # per-call-varying static value
+        (33, "recompile-hazard"),   # unhashable POSITIONAL static
+        (34, "recompile-hazard"),   # varying POSITIONAL static
+    ]
+    assert "closes over module global `_MUTABLE_TABLE`" in got[0].message
+    assert "varies per call" in got[3].message
+    # positional args map through static_argnums-resolved names
+    assert "static argument `k`" in got[4].message
+    assert "varies per call" in got[5].message
+
+
+def test_guarded_by_fixture_exact():
+    got = _fixture_findings("fixture_guarded.py", "guarded-by")
+    assert [(f.line, f.check_id) for f in got] == [
+        (17, "guarded-by"),   # unlocked read
+        (26, "guarded-by"),   # touch after the with block closed
+    ]
+    # the locked write, __init__, and the `holds:` method stayed silent
+    assert all("bad_" in f.message for f in got)
+
+
+def test_config_option_fixture_exact():
+    got = _fixture_findings("fixture_options.py", "config-option")
+    assert [(f.line, f.check_id) for f in got] == [
+        (4, "config-option"), (8, "config-option"),
+    ]
+    assert all("not declared in config.py" in f.message for f in got)
+
+
+def test_taxonomy_fixture_exact():
+    got = _fixture_findings("fixture_taxonomy.py", "taxonomy")
+    assert [(f.line, f.check_id) for f in got] == [
+        (8, "taxonomy"),    # metric namespace typo
+        (10, "taxonomy"),   # obs_count namespace typo
+        (11, "taxonomy"),   # span outside the documented taxonomy
+    ]
+    assert "lena.compaction.merges" in got[0].message
+    assert "span taxonomy" in got[2].message
+
+
+def test_taxonomy_skips_dynamic_prefix(tmp_path):
+    """A metric name whose FIRST segment is an unresolvable f-string
+    hole (f"{prefix}.hits") is out of static reach — skipped, not
+    flagged as a namespace violation (the runtime walk covers it)."""
+    (tmp_path / "dyn.py").write_text(
+        "from geomesa_tpu.metrics import registry\n"
+        "\n"
+        "\n"
+        "def emit(prefix):\n"
+        '    registry.counter(f"{prefix}.hits").inc()\n')
+    got = analyze(tmp_path, checks=[check_by_id("taxonomy")],
+                  files=[tmp_path / "dyn.py"])
+    assert got == [], [f.render() for f in got]
+
+
+# -- pragmas --------------------------------------------------------------
+def test_pragma_same_line_standalone_and_file(tmp_path):
+    bad = 'OPTION = "geomesa.not.a.real.option"\n'
+    (tmp_path / "plain.py").write_text(bad)
+    (tmp_path / "sameline.py").write_text(
+        'OPTION = "geomesa.not.a.real.option"'
+        "  # gm-lint: disable=config-option fixture reason\n")
+    (tmp_path / "above.py").write_text(
+        "# gm-lint: disable=config-option fixture reason\n" + bad)
+    (tmp_path / "whole.py").write_text(
+        "# gm-lint: disable-file=config-option fixture reason\n"
+        + bad + bad)
+    check = [check_by_id("config-option")]
+    assert len(analyze(tmp_path, checks=check,
+                       files=[tmp_path / "plain.py"])) == 1
+    for name in ("sameline.py", "above.py", "whole.py"):
+        assert analyze(tmp_path, checks=check,
+                       files=[tmp_path / name]) == [], name
+    # a pragma for a DIFFERENT check suppresses nothing
+    (tmp_path / "wrong.py").write_text(
+        'OPTION = "geomesa.not.a.real.option"'
+        "  # gm-lint: disable=host-sync wrong check\n")
+    assert len(analyze(tmp_path, checks=check,
+                       files=[tmp_path / "wrong.py"])) == 1
+
+
+def test_pragma_in_docstring_is_not_a_pragma(tmp_path):
+    """Pragma syntax QUOTED in a docstring (e.g. documentation of the
+    pragma grammar itself) must suppress nothing — only real comment
+    tokens are pragmas."""
+    (tmp_path / "doc.py").write_text(
+        '"""Suppress with `# gm-lint: disable-file=config-option`.\n'
+        '"""\n'
+        'OPTION = "geomesa.not.a.real.option"\n')
+    findings = analyze(tmp_path, checks=[check_by_id("config-option")],
+                       files=[tmp_path / "doc.py"])
+    assert [f.line for f in findings] == [3]
+
+
+def test_import_edges_resolve_through_package_init(tmp_path):
+    """Relative imports inside a package ``__init__`` resolve to the
+    package's OWN submodules (``from .kern import fast``), so device
+    dispatches re-exported there are known to host-sync — a package
+    __init__'s modname is the package, not a sibling module."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "kern.py").write_text(
+        "import jax\n\n@jax.jit\ndef fast(z):\n    return z\n")
+    (pkg / "__init__.py").write_text(
+        "import numpy as np\n"
+        "from .kern import fast\n\n\n"
+        "def use(z):\n"
+        "    return np.asarray(fast(z))\n")
+    findings = analyze(tmp_path, checks=[check_by_id("host-sync")])
+    assert [(f.file, f.line) for f in findings] == [("pkg/__init__.py", 6)]
+
+
+# -- baseline -------------------------------------------------------------
+def test_baseline_round_trip(tmp_path):
+    findings = _fixture_findings("fixture_options.py", "config-option")
+    assert findings
+    ledger = Baseline.from_findings(findings, "fixture debt, tracked")
+    path = tmp_path / "baseline.json"
+    ledger.save(path)
+    loaded = Baseline.load(path)
+    new, baselined, stale = loaded.split(findings)
+    assert new == [] and len(baselined) == len(findings) and stale == []
+    # baselines match on (check, file, message) — line drift is fine
+    drifted = [type(f)(f.file, f.line + 40, f.check_id, f.message)
+               for f in findings]
+    assert loaded.split(drifted)[0] == []
+
+
+def test_baseline_rejects_missing_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 1, "entries": [
+        {"check": "host-sync", "file": "x.py", "message": "m",
+         "justification": "  "}]}))
+    with pytest.raises(BaselineError, match="justification"):
+        Baseline.load(path)
+
+
+@pytest.fixture()
+def tree_findings(gm_lint_tree):
+    """The session-scoped full-tree pass (tests/conftest.py) — shared
+    with the metric-lint delegation test; the CLI tests still run
+    their own subprocess passes, that IS what they test."""
+    return gm_lint_tree
+
+
+def test_baseline_does_not_absorb_new_identical_violation(tmp_path):
+    """The line-independent key must not grandfather a NEW violation
+    of the same class in the same file: site-qualified messages keep
+    each key unique, so only the baselined function stays quiet."""
+    (tmp_path / "m.py").write_text(
+        "import jax\n\n\ndef a(x):\n    jax.block_until_ready(x)\n\n\n"
+        "def b(x):\n    jax.block_until_ready(x)\n")
+    found = analyze(tmp_path, checks=[check_by_id("host-sync")],
+                    files=[tmp_path / "m.py"])
+    assert len(found) == 2 and found[0].message != found[1].message
+    ledger = Baseline.from_findings([found[0]], "tracked fixture debt")
+    new, baselined, _ = ledger.split(found)
+    assert new == [found[1]] and baselined == [found[0]]
+
+
+def test_guarded_by_decl_is_comment_token_and_binds_by_ast(tmp_path):
+    """A docstring QUOTING the guarded-by grammar declares nothing,
+    and a real declaration binds to the next self-assignment however
+    long its comment block runs (the old 4-line window dropped it)."""
+    (tmp_path / "t.py").write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        '    """Docs quote `#: guarded-by: self._lock` harmlessly."""\n'
+        "\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        #: guarded-by: self._lock — a long explanation that\n"
+        "        #: runs across several comment lines before the\n"
+        "        #: attribute assignment, more than the old four-line\n"
+        "        #: window ever allowed, and still binds\n"
+        "        self._entries = {}\n"
+        "        self._other = {}\n"
+        "\n"
+        "    def bad(self):\n"
+        "        return len(self._entries)\n"
+        "\n"
+        "    def fine(self):\n"
+        "        return len(self._other)\n")
+    got = analyze(tmp_path, checks=[check_by_id("guarded-by")],
+                  files=[tmp_path / "t.py"])
+    assert [f.line for f in got] == [17], [f.render() for f in got]
+
+
+def test_committed_baseline_entries_all_justified_and_live(tree_findings):
+    ledger = Baseline.load()          # raises on unjustified entries
+    for (check, file, _msg), just in ledger.entries.items():
+        assert len(just) > 20, (check, file)
+    # no stale debt: every committed entry still matches a finding
+    assert ledger.split(tree_findings[0])[2] == []
+
+
+# -- the clean-tree tier-1 gate -------------------------------------------
+def test_tree_clean_and_fast(tree_findings):
+    """Zero unbaselined findings over geomesa_tpu/ — and the analyzer
+    stays well under the 10 s budget so tier-1 wall time is safe."""
+    findings, elapsed = tree_findings
+    new, baselined, _stale = Baseline.load().split(findings)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert baselined, "expected the documented block() baseline entries"
+    print(f"\ngm-lint: {len(findings)} finding(s) "
+          f"({len(baselined)} baselined) over geomesa_tpu/ "
+          f"in {elapsed:.2f}s")
+    assert elapsed < 10.0, f"analyzer took {elapsed:.2f}s (budget 10s)"
+
+
+# -- CLI ------------------------------------------------------------------
+def _cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "geomesa_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=cwd,
+        timeout=120)
+
+
+def test_cli_fail_on_new_clean_tree_exits_zero():
+    proc = _cli("--fail-on-new")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_single_file_matches_baseline():
+    """A bare file argument reports package-root-relative paths (the
+    baseline key space): `--fail-on-new` on a file whose only finding
+    is baselined exits 0, and the finding file is index/z3_lean.py,
+    not '.' — the single-file CLI regression."""
+    target = PACKAGE_ROOT / "index" / "z3_lean.py"
+    proc = _cli("--check", "host-sync", "--format", "json", str(target))
+    out = json.loads(proc.stdout)
+    reported = {f["file"] for f in out["findings"]}
+    assert reported <= {"index/z3_lean.py"}, reported
+    proc = _cli("--fail-on-new", "--check", "host-sync", str(target))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # a SUBPACKAGE directory re-roots the same way
+    proc = _cli("--fail-on-new", str(PACKAGE_ROOT / "index"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # a subset run must not call unmatched ledger entries stale
+    assert "stale" not in proc.stdout
+
+
+def test_cli_analyzer_own_tree_is_loudly_excluded():
+    """Pointing the CLI at the analyzer's own package is a usage
+    error (exit 2 + message), never a silent 0-finding 'clean'."""
+    target = PACKAGE_ROOT / "analysis" / "walker.py"
+    proc = _cli(str(target))
+    assert proc.returncode == 2
+    assert "excluded" in proc.stderr
+
+
+def test_cli_findings_exit_one_and_json_format():
+    proc = _cli("--check", "config-option", "--format", "json",
+                str(FIXTURES / "fixture_options.py"))
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out["checks"] == ["config-option"]
+    assert [f["line"] for f in out["findings"]] == [4, 8]
+    assert all(f["check"] == "config-option" for f in out["findings"])
+    assert out["elapsed_s"] >= 0
+
+
+def test_cli_list_checks_and_unknown_check():
+    proc = _cli("--list-checks")
+    assert proc.returncode == 0
+    for check in all_checks():
+        assert check.id in proc.stdout
+    assert _cli("--check", "nope").returncode == 2
+
+
+def test_cli_survives_ascii_locale():
+    """Cold-CI shards may run under LC_ALL=C: every analyzer file read
+    pins encoding='utf-8', so non-ASCII in sources/baseline (em
+    dashes) must not crash the gate."""
+    import os
+    env = dict(os.environ, LC_ALL="C", LANG="C",
+               PYTHONCOERCECLOCALE="0", PYTHONUTF8="0")
+    proc = subprocess.run(
+        [sys.executable, "-m", "geomesa_tpu.analysis", "--fail-on-new"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_analyzer_import_is_jax_free():
+    """The cold-CI contract (ISSUE 13 satellite): importing and
+    running the analyzer never pulls in jax or numpy — pure ast."""
+    code = ("import sys; import geomesa_tpu.analysis as a; "
+            "from geomesa_tpu.analysis.checks import CHECKS; "
+            "assert len(CHECKS) == 5; "
+            "assert 'jax' not in sys.modules, 'jax imported'; "
+            "assert 'numpy' not in sys.modules, 'numpy imported'; "
+            "print('ok')")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "ok"
+
+
+# -- strict-option runtime mode (ISSUE 13 satellite) ----------------------
+def test_set_property_warns_on_unregistered_name():
+    from geomesa_tpu import config
+    config._warned.discard("geomesa.lean.compactoin.factor")
+    with pytest.warns(config.UnknownOptionWarning, match="compactoin"):
+        config.set_property("geomesa.lean.compactoin.factor", 2)
+    config.clear_property("geomesa.lean.compactoin.factor")
+    # registered names and non-geomesa names stay silent
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        config.set_property("geomesa.scan.ranges.target", 2000)
+        config.clear_property("geomesa.scan.ranges.target")
+        config.set_property("myapp.private.knob", 1)
+        config.clear_property("myapp.private.knob")
+
+
+def test_strict_mode_raises_on_typo():
+    from geomesa_tpu import config
+    config.set_property("geomesa.config.strict", True)
+    try:
+        with pytest.raises(ValueError, match="unregistered option"):
+            config.set_property("geomesa.lean.compaction.factr", 0)
+        # ad-hoc SystemProperty lookup hits the same gate
+        with pytest.raises(ValueError, match="unregistered option"):
+            config.SystemProperty("geomesa.nope.nope", 1).get()
+        # clearing is inherently safe: a stale typo'd override must be
+        # removable WHILE strict is on (warns, never raises)
+        config._warned.discard("geomesa.lean.compaction.factr")
+        with pytest.warns(config.UnknownOptionWarning):
+            config.clear_property("geomesa.lean.compaction.factr")
+    finally:
+        config.clear_property("geomesa.config.strict")
+
+
+def test_known_option_names_cover_declarations():
+    from geomesa_tpu import config
+    names = config.known_option_names()
+    assert {"geomesa.scan.ranges.target", "geomesa.obs.enabled",
+            "geomesa.index.profile", "geomesa.lean.hbm.budget",
+            "geomesa.config.strict"} <= names
+
+
+# -- pinned regressions for the violations the checks surfaced ------------
+def test_density_sweep_dispatch_is_traced_device_span():
+    """The whole-extent density sweep used to materialize its device
+    dispatch OUTSIDE device_span (unattributed sync — the exact
+    host-sync class).  Pin: the sweep emits a query.scan.device span
+    with stage=sweep and real device_ms, rolled up to the root."""
+    from geomesa_tpu import obs
+    from geomesa_tpu.index.z3_lean import LeanZ3Index
+    rng = np.random.default_rng(31)
+    idx = LeanZ3Index(period="week", generation_slots=4096,
+                      payload_on_device=False)
+    idx.append(rng.uniform(-75, -73, 4096), rng.uniform(40, 42, 4096),
+               rng.integers(MS, MS + 14 * DAY, 4096))
+    idx.block()
+    with obs.tracer.capture() as cap:
+        with obs.span("query"):
+            idx.density([WORLD], None, None, WORLD, 64, 32)
+    traces = cap.traces()
+    assert traces
+    sweep = [s for t in traces for s in t.spans
+             if s.name == "query.scan.device"
+             and s.attributes.get("stage") == "sweep"]
+    assert sweep, "sweep dispatch lost its device_span again"
+    assert all(s.attributes["device_ms"] >= 0 for s in sweep)
+    root = traces[-1].root_span
+    assert root.attributes.get("device_ms", 0) > 0
+
+
+def test_sharded_cells_dispatch_is_traced_device_span():
+    """Same class of fix in the sharded z3_cell_counts fold: the
+    _cells_program dispatch now runs under device_span."""
+    from geomesa_tpu import obs
+    from geomesa_tpu.parallel import device_mesh
+    from geomesa_tpu.parallel.lean import ShardedLeanZ3Index
+    rng = np.random.default_rng(32)
+    idx = ShardedLeanZ3Index(period="week", mesh=device_mesh(),
+                             generation_slots=1 << 13)
+    idx.append(rng.uniform(-75, -73, 8192), rng.uniform(40, 42, 8192),
+               rng.integers(MS, MS + 14 * DAY, 8192))
+    with obs.tracer.capture() as cap:
+        with obs.span("query"):
+            counts = idx.z3_cell_counts(4)
+    assert counts
+    cells = [s for t in cap.traces() for s in t.spans
+             if s.name == "query.scan.device"
+             and s.attributes.get("stage") == "z3_cells"]
+    assert cells, "sharded z3_cells dispatch lost its device_span again"
+
+
+def test_full_fat_packed_scan_is_traced_device_span():
+    """The full-fat z2/z3 packed scans dispatched outside device_span
+    too (the lean families were instrumented in PR 3, these were not).
+    Pin: a Z3PointIndex query emits a query.scan.device span with
+    stage=packed."""
+    from geomesa_tpu import obs
+    from geomesa_tpu.curve import TimePeriod
+    from geomesa_tpu.index import Z3PointIndex
+    rng = np.random.default_rng(33)
+    idx = Z3PointIndex.build(
+        rng.uniform(-75, -73, 4096), rng.uniform(40, 42, 4096),
+        rng.integers(MS, MS + 14 * DAY, 4096), period=TimePeriod.WEEK)
+    with obs.tracer.capture() as cap:
+        with obs.span("query"):
+            idx.query([(-74.5, 40.5, -73.5, 41.5)],
+                      MS + 2 * DAY, MS + 9 * DAY)
+    packed = [s for t in cap.traces() for s in t.spans
+              if s.name == "query.scan.device"
+              and s.attributes.get("stage") in ("packed", "two_phase")]
+    assert packed, "full-fat scan dispatch lost its device_span again"
+    # device_ms must be REAL: the XLA-fallback thunk materializes
+    # inside the span (a lazy return would attribute ~0 and block in
+    # run_packed_query instead)
+    assert all(s.attributes.get("device_ms", -1) >= 0 for s in packed)
+    # the batched-windows dispatch is instrumented too (stage
+    # packed_many — it was the one un-instrumented full-fat site)
+    with obs.tracer.capture() as cap:
+        with obs.span("query"):
+            idx.query_many([([(-74.5, 40.5, -73.5, 41.5)],
+                             MS + 2 * DAY, MS + 9 * DAY),
+                            ([(-74.2, 40.8, -73.8, 41.2)],
+                             MS, MS + 5 * DAY)])
+    many = [s for t in cap.traces() for s in t.spans
+            if s.name == "query.scan.device"
+            and s.attributes.get("stage") == "packed_many"]
+    assert many, "query_many dispatch lost its device_span again"
+
+
+def test_periodic_reporter_start_stop_race_safe():
+    """PeriodicReporter._thread is guarded now: concurrent
+    start()/stop() storms must end with the reporter fully stopped
+    and at most one daemon ever live."""
+    from geomesa_tpu.metrics import MetricRegistry, PeriodicReporter
+
+    class Sink:
+        def report(self):
+            pass
+
+    rep = PeriodicReporter(Sink(), interval_s=30.0)
+    errors = []
+
+    def storm():
+        try:
+            for _ in range(50):
+                rep.start()
+                rep.stop(final_report=False)
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=storm) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep.stop(final_report=False)
+    assert errors == []
+    assert rep._thread is None
+
+
+def test_partial_cache_concurrent_access_safe():
+    """PartialCache._specs is lock-guarded now: query threads touching
+    specs while a scraper walks stats() must never corrupt the LRU or
+    raise (dict-changed-size — the pre-fix failure mode)."""
+    from geomesa_tpu.index.partial_cache import PartialCache
+
+    class Part:
+        nbytes = 64
+
+    pc = PartialCache(max_specs=4, max_bytes=4096)
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for i in range(400):
+                spec = ("spec", int(rng.integers(0, 8)))
+                cache = pc.spec_cache(spec)
+                pc.add(cache, i, Part())
+                pc.stats()
+                pc.cached_bytes()
+                if i % 50 == 0:
+                    pc.drop_generations(range(i))
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert len(pc) <= 4
+    assert pc.stats()["bytes"] <= 4096 + 64 * 6  # ceiling, ± in-flight
+
+
+def test_write_baseline_refuses_subsets_and_keeps_justifications(tmp_path):
+    """--write-baseline on a --check/path subset is a usage error (it
+    would silently drop every entry the subset cannot see); a full-run
+    rewrite preserves each existing entry's written justification."""
+    import shutil
+    path = tmp_path / "b.json"
+    proc = _cli("--check", "taxonomy", "--write-baseline", "r",
+                "--baseline", str(path))
+    assert proc.returncode == 2 and not path.exists()
+    shutil.copy(DEFAULT_BASELINE_PATH, path)
+    proc = _cli("--write-baseline", "generic new-entry reason",
+                "--baseline", str(path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = json.loads(path.read_text())["entries"]
+    assert entries, "full run lost the block() entries"
+    assert all("ingest-timing barrier" in e["justification"]
+               for e in entries), "justifications were flattened"
+
+
+def test_recompile_positional_mapping_stops_at_star(tmp_path):
+    """Positions past a *splat are statically unknowable — they must
+    not be mis-mapped onto parameter names (false positives on calls
+    like `f(*args, capacity=...)`)."""
+    (tmp_path / "s.py").write_text(
+        "import functools\n"
+        "\n"
+        "import jax\n"
+        "\n"
+        "\n"
+        "@functools.partial(jax.jit, static_argnums=(1,))\n"
+        "def scale(x, k):\n"
+        "    return x * k\n"
+        "\n"
+        "\n"
+        "def caller(xs, x):\n"
+        "    scale(*xs, [1, 2])\n"
+        "    return scale(x, [1, 2])\n")
+    got = analyze(tmp_path, checks=[check_by_id("recompile-hazard")],
+                  files=[tmp_path / "s.py"])
+    assert [f.line for f in got] == [13], [f.render() for f in got]
+
+
+def test_default_baseline_path_is_committed():
+    assert DEFAULT_BASELINE_PATH.exists()
+    data = json.loads(DEFAULT_BASELINE_PATH.read_text())
+    assert data["version"] == 1
