@@ -80,7 +80,7 @@ def main():
     from geomesa_tpu.io.export import to_geojson
     fc = to_geojson(ds.query("gdelt", q, ))
     print(f"geojson export: {len(fc):,} bytes")
-    table = ds.query_arrow("gdelt", q, dictionary_fields=("actor",))
+    table = ds.query_arrow_table("gdelt", q, dictionary_fields=("actor",))
     print(f"arrow export: {table.num_rows:,} rows, "
           f"{len(table.column_names)} columns")
 

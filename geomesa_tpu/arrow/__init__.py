@@ -21,10 +21,12 @@ assignment is a vectorized ``np.searchsorted`` rather than a per-row map.
 
 from .delta import DeltaWriter
 from .reader import merge_deltas, read_feature_batch
-from .schema import encode_record_batch, sft_to_arrow_schema
+from .schema import encode_columns, encode_record_batch, sft_to_arrow_schema
 from .store import ArrowDataStore
+from .stream import ArrowStream, ipc_chunks, stream_batches
 
 __all__ = [
-    "ArrowDataStore", "DeltaWriter", "encode_record_batch",
-    "merge_deltas", "read_feature_batch", "sft_to_arrow_schema",
+    "ArrowDataStore", "ArrowStream", "DeltaWriter", "encode_columns",
+    "encode_record_batch", "ipc_chunks", "merge_deltas",
+    "read_feature_batch", "sft_to_arrow_schema", "stream_batches",
 ]

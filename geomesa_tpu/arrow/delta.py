@@ -42,7 +42,8 @@ class DeltaWriter:
                  sort_field: str | None = None,
                  reverse: bool = False,
                  sink: BinaryIO | None = None):
-        import pyarrow as pa
+        from .schema import _pa
+        pa = _pa()
 
         self.sft = sft
         self.dictionary_fields = tuple(dictionary_fields)

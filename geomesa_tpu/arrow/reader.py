@@ -19,8 +19,8 @@ __all__ = ["read_feature_batch", "read_table", "merge_deltas"]
 
 
 def _pa():
-    import pyarrow as pa
-    return pa
+    from .schema import _pa as _schema_pa
+    return _schema_pa()
 
 
 def read_table(source):

@@ -20,13 +20,26 @@ from ..features.batch import FeatureBatch
 from ..features.feature_type import FeatureType
 from ..geometry.wkb import wkb_encode
 
-__all__ = ["sft_to_arrow_schema", "encode_record_batch", "FID_FIELD"]
+__all__ = ["sft_to_arrow_schema", "encode_record_batch",
+           "encode_columns", "FID_FIELD"]
 
 FID_FIELD = "__fid__"
 
 
 def _pa():
-    import pyarrow as pa
+    """The pyarrow module, or an actionable error: pyarrow is an
+    OPTIONAL dependency (the ``[arrow]`` extra) — everything outside
+    ``geomesa_tpu/arrow`` works without it, and every entry into the
+    Arrow subsystem resolves the import through here so the failure
+    mode is one clear message instead of a deep traceback."""
+    try:
+        import pyarrow as pa
+    except ImportError as e:
+        raise ImportError(
+            "pyarrow is not installed — the Arrow result path "
+            "(geomesa_tpu.arrow, store.query_arrow, /query?format="
+            "arrow) needs the optional extra: pip install "
+            "'geomesa-tpu[arrow]'") from e
     return pa
 
 
@@ -77,6 +90,33 @@ class DictionaryState:
         self._index: dict = {}
 
     def codes_for(self, col: np.ndarray) -> np.ndarray:
+        """Codes into the accumulated dictionary for one column chunk.
+
+        Vectorized (ISSUE 14): ``np.unique`` collapses the chunk to its
+        distinct values and the Python-level dictionary bookkeeping
+        runs once PER DISTINCT VALUE, not per row — the streaming
+        result path's zero-per-row-object contract.  Columns that mix
+        ``None`` with comparables cannot sort and fall back to the
+        row-wise loop (they are the sparse-attribute edge case, never
+        the hot path)."""
+        col = np.asarray(col)
+        try:
+            uniq, inv = np.unique(col, return_inverse=True)
+        except TypeError:
+            return self._codes_for_rows(col)
+        mapping = np.empty(len(uniq), dtype=np.int32)
+        index = self._index
+        for j, v in enumerate(uniq):
+            v = v.item() if isinstance(v, np.generic) else v
+            code = index.get(v)
+            if code is None:
+                code = len(self.values)
+                index[v] = code
+                self.values.append(v)
+            mapping[j] = code
+        return mapping[inv.ravel()].astype(np.int32)
+
+    def _codes_for_rows(self, col: np.ndarray) -> np.ndarray:
         codes = np.empty(len(col), dtype=np.int32)
         index = self._index
         for i, v in enumerate(col):
@@ -90,42 +130,68 @@ class DictionaryState:
         return codes
 
 
-def _geom_arrays(pa, batch: FeatureBatch, attr):
+def _geom_arrays(pa, sft: FeatureType, attr, columns: dict, n: int,
+                 geoms):
     """Geometry column → arrow array (fixed-size-list points, WKB else)."""
-    n = len(batch)
-    if attr.type == "point" and f"{attr.name}_x" in batch.columns:
-        x, y = batch.geom_xy(attr.name)
+    if attr.type == "point" and f"{attr.name}_x" in columns:
         flat = np.empty(2 * n, dtype=np.float64)
-        flat[0::2] = x
-        flat[1::2] = y
+        flat[0::2] = columns[f"{attr.name}_x"]
+        flat[1::2] = columns[f"{attr.name}_y"]
         return pa.FixedSizeListArray.from_arrays(pa.array(flat), 2)
-    if attr.name == batch.sft.default_geom and batch.geoms is not None:
-        return pa.array([wkb_encode(batch.geoms.geometry(i))
+    if attr.name == sft.default_geom and geoms is not None:
+        # the one per-row loop in the subsystem: WKB is inherently a
+        # per-geometry byte string.  Point schemas (the lean scale
+        # profile) never take this branch — their geometry is the
+        # interleaved x/y fast path above.
+        return pa.array([wkb_encode(geoms.geometry(i))
                          for i in range(n)], type=pa.binary())
     return pa.nulls(n, pa.binary() if attr.type != "point"
                     else pa.list_(pa.float64(), 2))
 
 
-def encode_record_batch(batch: FeatureBatch, schema,
-                        dictionaries: dict[str, DictionaryState] | None = None):
-    """FeatureBatch → pa.RecordBatch under ``schema``.
+def encode_columns(sft: FeatureType, schema, columns: dict, n: int,
+                   fids=None, geoms=None,
+                   dictionaries: dict[str, DictionaryState] | None = None):
+    """Raw numpy columns → pa.RecordBatch under ``schema`` — the
+    columnar encoder core (ISSUE 14).
+
+    Every conversion is a vectorized buffer handoff: interleaved x/y
+    for point geometries, int64→timestamp cast for dates, direct
+    ``pa.array`` over numpy buffers elsewhere, and ``fids`` as a
+    fixed-width unicode (or object) string array.  With a point schema
+    the whole encode creates ZERO per-row Python objects; both the
+    row-wise :func:`encode_record_batch` and the streaming result path
+    (arrow/stream.py) funnel through here, so the two paths are
+    byte-identical by construction.
 
     ``dictionaries`` maps attribute name → DictionaryState for
-    dictionary-encoded fields (shared across batches by DeltaWriter)."""
+    dictionary-encoded fields (shared across batches — the delta
+    protocol of DeltaWriter)."""
     pa = _pa()
     dictionaries = dictionaries or {}
     arrays = []
     for field in schema:
         if field.name == FID_FIELD:
-            arrays.append(pa.array(batch.ids.astype(str), type=pa.utf8()))
+            fid = (np.empty(0, dtype=object) if fids is None else fids)
+            if isinstance(fid, pa.Array):
+                # already an arrow utf8 array (the streaming path's
+                # int64→utf8 compute cast) — pass the buffers through
+                arrays.append(fid)
+            elif getattr(fid, "dtype", None) is not None \
+                    and fid.dtype.kind == "U":
+                # fixed-width unicode (row_ids_vec): no astype copy
+                arrays.append(pa.array(fid, type=pa.utf8()))
+            else:
+                arrays.append(pa.array(np.asarray(fid).astype(str),
+                                       type=pa.utf8()))
             continue
-        attr = batch.sft.attribute(field.name)
+        attr = sft.attribute(field.name)
         if attr.is_geometry:
-            arrays.append(_geom_arrays(pa, batch, attr))
+            arrays.append(_geom_arrays(pa, sft, attr, columns, n, geoms))
             continue
-        col = batch.columns.get(attr.name)
+        col = columns.get(attr.name)
         if col is None:
-            arrays.append(pa.nulls(len(batch), field.type))
+            arrays.append(pa.nulls(n, field.type))
             continue
         if isinstance(field.type, pa.DictionaryType):
             state = dictionaries.setdefault(attr.name, DictionaryState())
@@ -139,3 +205,15 @@ def encode_record_batch(batch: FeatureBatch, schema,
         else:
             arrays.append(pa.array(col, type=field.type))
     return pa.RecordBatch.from_arrays(arrays, schema=schema)
+
+
+def encode_record_batch(batch: FeatureBatch, schema,
+                        dictionaries: dict[str, DictionaryState] | None = None):
+    """FeatureBatch → pa.RecordBatch under ``schema`` (the row-wise
+    entry over :func:`encode_columns`).
+
+    ``dictionaries`` maps attribute name → DictionaryState for
+    dictionary-encoded fields (shared across batches by DeltaWriter)."""
+    return encode_columns(batch.sft, schema, batch.columns, len(batch),
+                          fids=batch.ids, geoms=batch.geoms,
+                          dictionaries=dictionaries)

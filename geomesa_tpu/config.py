@@ -19,7 +19,8 @@ from dataclasses import dataclass
 from typing import Any
 
 __all__ = ["SystemProperty", "SchemaOption", "QueryProperties",
-           "ObsProperties", "SchemaProperties", "ConfigProperties",
+           "ObsProperties", "ArrowProperties", "SchemaProperties",
+           "ConfigProperties",
            "set_property", "clear_property", "config_generation",
            "known_option_names", "check_option_name",
            "UnknownOptionWarning"]
@@ -278,11 +279,36 @@ class ObsProperties:
     JOBS_CAPACITY = SystemProperty("geomesa.obs.jobs.capacity", 128)
 
 
+class ArrowProperties:
+    """Arrow-native streaming result path knobs (the ``geomesa.arrow.*``
+    option family — docs/arrow.md, ISSUE 14).  All three are re-read
+    per stream, so operators can tune a live serving process."""
+
+    #: rows per emitted Arrow record batch on the streaming result path
+    #: (``store.query_arrow`` default when ``chunk_rows`` is not passed;
+    #: the reference's ArrowScan batch-size hint)
+    CHUNK_ROWS = SystemProperty("geomesa.arrow.chunk.rows", 65536)
+    #: distinct-value ceiling for AUTO dictionary encoding: a string
+    #: attribute dictionary-encodes only while its observed cardinality
+    #: (sampled on the first chunk) stays at/below this — past it the
+    #: column ships as plain utf8 (a dictionary bigger than the data
+    #: saves nothing and bloats every delta message)
+    DICTIONARY_THRESHOLD = SystemProperty(
+        "geomesa.arrow.dictionary.threshold", 1024)
+    #: streaming-response flush threshold in bytes: the chunked
+    #: Arrow-IPC web response (``/query?format=arrow``) coalesces
+    #: encoded IPC messages until at least this many bytes are buffered
+    #: before handing a chunk to the WSGI layer (tiny record batches
+    #: must not become tiny socket writes); <= 0 flushes per batch
+    STREAM_BUFFER_BYTES = SystemProperty(
+        "geomesa.arrow.stream.buffer.bytes", 1 << 20)
+
+
 def _register_declarations() -> None:
     """Fill the option registry from the declaration classes above —
     the one place a knob becomes 'known' to the strict mode."""
-    for cls in (QueryProperties, ObsProperties, SchemaProperties,
-                ConfigProperties):
+    for cls in (QueryProperties, ObsProperties, ArrowProperties,
+                SchemaProperties, ConfigProperties):
         for value in vars(cls).values():
             if isinstance(value, (SystemProperty, SchemaOption)):
                 _REGISTRY[value.name] = value

@@ -1641,6 +1641,15 @@ class TpuDataStore:
 
     def query_result(self, name: str, query="INCLUDE",
                      explain: Explainer | None = None) -> QueryResult:
+        return self._query_result_ex(name, query, explain)[0]
+
+    def _query_result_ex(self, name: str, query="INCLUDE",
+                         explain: Explainer | None = None,
+                         materialize: bool = True):
+        """The shared query executor: returns ``(result, eval_store)``
+        so the Arrow streaming path (``materialize=False``) can gather
+        its columns from the SAME (possibly visibility-masked) batch
+        the residual filter evaluated over."""
         from .obs import span as obs_span
         store = self._store(name)
         q = query if isinstance(query, Query) else Query.of(query)
@@ -1661,9 +1670,10 @@ class TpuDataStore:
                     empty = FeatureBatch.empty(store.sft)
                     from .planning.strategy import FilterStrategy
                     result = QueryResult(empty, np.empty(0, dtype=np.int64),
-                                         FilterStrategy("none", 0), 0.0, 0.0)
+                                         FilterStrategy("none", 0), 0.0, 0.0,
+                                         local_rows=np.empty(0, np.int64))
                     self._audit(name, q, result)
-                    return result
+                    return result, store
             allowed = None
             eval_store = store
             if self._auth_provider is not None:
@@ -1680,10 +1690,10 @@ class TpuDataStore:
                 live = ~store.tombstone
                 allowed = live if allowed is None else (allowed & live)
             result = QueryPlanner(store.sft, eval_store).run(
-                q, explain, allowed=allowed)
+                q, explain, allowed=allowed, materialize=materialize)
             sp.set_attr("hits", int(len(result.positions)))
             self._audit(name, q, result)
-            return result
+            return result, eval_store
 
     def _intercept(self, sft: FeatureType, q: Query) -> Query:
         from .planning.interceptor import apply_interceptors, load_interceptors
@@ -1724,15 +1734,93 @@ class TpuDataStore:
                 hits=hits, trace_id=current_trace_id()))
 
     def query_arrow(self, name: str, query="INCLUDE", *,
-                    dictionary_fields: tuple[str, ...] = (),
-                    sort_field: str | None = None, reverse: bool = False,
-                    batch_size: int = 65536):
+                    chunk_rows: int | None = None,
+                    dictionary_fields="auto"):
+        """Streaming Arrow results (ISSUE 14): run the query to hit
+        POSITIONS only — no per-row feature objects ever exist — and
+        return an :class:`~geomesa_tpu.arrow.stream.ArrowStream`
+        generator of ``pa.RecordBatch`` chunks of ``chunk_rows`` rows
+        (default ``geomesa.arrow.chunk.rows``), encoded lazily as the
+        caller pulls: device hit positions → one batched on-device
+        column gather per full-tier generation (the lean scale index's
+        ``gather_payload``), vectorized host takes for everything else,
+        vectorized feature ids, and delta-dictionary record batches
+        (``dictionary_fields`` names attributes to dictionary-encode;
+        the default ``"auto"`` encodes string attributes whose sampled
+        cardinality stays under ``geomesa.arrow.dictionary.threshold``).
+
+        Byte-for-byte equal to encoding the row-wise
+        ``query_result().batch`` chunk-by-chunk — pinned by bench and
+        tests — at zero per-row Python object cost (the ~88k feats/sec
+        materialization wall of BENCH_r05).  Projections/reprojections
+        (``properties``/``crs``) fall back to encoding the materialized
+        row-wise batch.  Under multihost each process streams ITS local
+        hit slice (per-shard delta streams; clients k-way merge via
+        ``arrow.reader.merge_deltas``).  For the one-shot in-process
+        Table API with the mesh residency reduce, see
+        :meth:`query_arrow_table`."""
+        from .arrow.schema import sft_to_arrow_schema
+        from .arrow.stream import (
+            ArrowStream, auto_dictionary_fields, stream_batches,
+        )
+        store = self._store(name)
+        q = query if isinstance(query, Query) else Query.of(query)
+        needs_rows = (q.properties is not None or bool(q.crs)
+                      or "COLUMN_GROUP" in q.hints)
+        if needs_rows:
+            result = self.query_result(name, q)
+            source = result.batch
+            sft = source.sft
+            rows = np.arange(len(source), dtype=np.int64)
+            eval_store = store
+        else:
+            result, eval_store = self._query_result_ex(
+                name, q, materialize=False)
+            source = eval_store.batch
+            sft = store.sft
+            rows = (result.local_rows if result.local_rows is not None
+                    else result.positions)
+        if dictionary_fields == "auto":
+            dictionary_fields = auto_dictionary_fields(sft, source, rows)
+        schema = sft_to_arrow_schema(sft, tuple(dictionary_fields))
+        payload_gather = None
+        payload_cols: tuple = ()
+        if not needs_rows and eval_store is store and store.lean:
+            idx = store._indexes.get(store.lean_kind)
+            gather = getattr(idx, "gather_payload", None)
+            # the protocol probe: index families without a
+            # row-addressable device payload (attr lexicodes, XZ
+            # envelope codes) answer None and every column takes the
+            # vectorized host path instead
+            if (gather is not None and len(idx) == len(store.batch)
+                    and gather(np.empty(0, np.int64)) is not None):
+                g, dtg = sft.geom_field, sft.dtg_field
+                payload_cols = (f"{g}_x", f"{g}_y", dtg)
+
+                def payload_gather(chunk, _gather=gather,
+                                   _cols=payload_cols):
+                    x, y, t = _gather(chunk)
+                    return {_cols[0]: x, _cols[1]: y, _cols[2]: t}
+
+        batches = stream_batches(
+            sft, schema, source, rows, chunk_rows=chunk_rows,
+            payload_gather=payload_gather, payload_columns=payload_cols,
+            schema_name=name)
+        return ArrowStream(schema, batches, sft)
+
+    def query_arrow_table(self, name: str, query="INCLUDE", *,
+                          dictionary_fields: tuple[str, ...] = (),
+                          sort_field: str | None = None,
+                          reverse: bool = False,
+                          batch_size: int = 65536):
         """Run a query and return a pyarrow Table via the Arrow scan
         protocol (the reference's ArrowScan, index/iterators/
         ArrowScan.scala:35): sorted dictionary-encoded record batches of
         ``batch_size`` rows — the per-device shard chunk analog — built
         in-process (no IPC round trip; serialize with
-        process.arrow_conversion_process for the wire format)."""
+        process.arrow_conversion_process for the wire format).  This is
+        the one-shot ROW-WISE materializing form; the serving plane
+        streams through :meth:`query_arrow` instead (ISSUE 14)."""
         import pyarrow as pa
 
         from .arrow.schema import (

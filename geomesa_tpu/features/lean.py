@@ -70,7 +70,9 @@ class ChunkView:
         return ChunkView(self.sft,
                          {k: v[positions] for k, v in self.columns.items()
                           if columns is None or k in columns},
-                         len(positions))
+                         len(positions),
+                         geoms=(self.geoms.take(positions)
+                                if self.geoms is not None else None))
 
 
 class LeanBatch:
@@ -210,6 +212,35 @@ class LeanBatch:
         """Feature ids of the given rows (hits-sized)."""
         p = self.id_prefix
         return np.array([f"{p}{int(r)}" for r in rows], dtype=object)
+
+    def row_ids_vec(self, rows: np.ndarray) -> np.ndarray:
+        """Feature ids of the given rows as a fixed-width unicode
+        array — the vectorized twin of :meth:`row_ids` (identical
+        strings, ZERO per-row Python objects: int→str conversion runs
+        inside numpy, and the Arrow encoder consumes the U-dtype
+        buffer directly).  The streaming result path (arrow/stream,
+        ISSUE 14) mints every feature id this way."""
+        ids = np.asarray(rows, dtype=np.int64).astype("U20")
+        if self.id_prefix:
+            ids = np.char.add(self.id_prefix, ids)
+        return ids
+
+    def take_view(self, positions: np.ndarray,
+                  columns=None) -> ChunkView:
+        """Hit-row gather WITHOUT feature-id materialization: one
+        vectorized numpy take per requested column (+ packed
+        geometries), returning a :class:`ChunkView`.  This is the
+        row-gather of the Arrow-native result path and of the
+        planner's residual re-check — the two places the O(hits)
+        id-string cost of :meth:`take` used to dominate result
+        construction (ISSUE 14)."""
+        positions = np.asarray(positions, dtype=np.int64)
+        names = (self._chunks if columns is None
+                 else [k for k in self._chunks if k in columns])
+        cols = {k: self.column(k)[positions] for k in names}
+        geoms = (self.geoms.take(positions)
+                 if self.geoms is not None else None)
+        return ChunkView(self.sft, cols, len(positions), geoms=geoms)
 
     def take(self, positions: np.ndarray,
              columns=None) -> FeatureBatch:

@@ -398,6 +398,17 @@ class LeanAttrIndex:
     #: (obs/heat) — stamped by the datastore / the owning XZ facade
     heat_scope: tuple | None = None
 
+    @staticmethod
+    def gather_payload(positions):
+        """Result-materialization protocol hook (ISSUE 14, uniform
+        across the lean index families): the attribute runs hold
+        LEXICODED keys — not a row-addressable payload — so there is
+        nothing to gather on device; ``None`` tells the Arrow result
+        path to take every column from the host column store (one
+        vectorized numpy take per column).  The schema's SCALE index
+        (z3) still device-gathers x/y/t for attr-strategy queries."""
+        return None
+
     GENERATION_SLOTS = 1 << 24
     DEFAULT_CAPACITY = 1 << 15
     BATCH_SCAN_BUDGET = 1 << 26

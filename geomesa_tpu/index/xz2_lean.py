@@ -80,6 +80,15 @@ class LeanCoreFacade:
     def block(self) -> None:
         self._core.block()
 
+    @staticmethod
+    def gather_payload(positions):
+        """Result-materialization protocol hook (ISSUE 14): XZ runs
+        key envelopes, and the packed polygon/line payload lives only
+        in the host column store — ``None`` routes the Arrow result
+        path to the column store's vectorized take (WKB encoding is
+        the one inherently per-row step, arrow/schema._geom_arrays)."""
+        return None
+
     @property
     def compactions(self) -> int:
         return self._core.compactions

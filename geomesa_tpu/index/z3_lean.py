@@ -238,6 +238,15 @@ def _compact_coded(packed, k: int):
     return -jnp.sort(-packed.ravel())[:k]
 
 
+@jax.jit
+def _lean_gather_payload(idx, xp, yp, tp):
+    """Result-materialization column gather (ISSUE 14): ONE batched
+    take of a full-tier generation's (x, y, t) payload for a chunk of
+    hit offsets.  ``idx`` is padded to a gather_capacity bucket so warm
+    repeats of the same result shape reuse the compiled program."""
+    return xp[idx], yp[idx], tp[idx]
+
+
 #: combined (G_pad × capacity) slot count at which the exact tier's
 #: two-phase read (device compaction + survivors-sized transfer) beats
 #: shipping the full coded buffer: an extra ~100ms round trip vs
@@ -1465,6 +1474,72 @@ class LeanZ3Index:
             # unique: overlapping covering ranges can duplicate a row
             out[q] = np.unique(positions[qids == q])
         return out
+
+    # -- result materialization (ISSUE 14) --------------------------------
+    def gather_payload(self, positions: np.ndarray):
+        """(x, y, t) columns for the given global row positions — the
+        Arrow result path's column gather (arrow/stream.py).
+
+        Rows living in a ``full``-tier generation gather ON DEVICE:
+        one batched take per generation (:func:`_lean_gather_payload`
+        over the payload columns the fused exact mask already keeps
+        resident), so for the hot all-full store the geometry/time
+        columns of a result never round-trip through the host column
+        store at all.  Rows in ``keys``/``host``-tier generations
+        gather from the host payload via one vectorized numpy take —
+        the stacked-host-run half of the materialize contract.  Values
+        are bit-identical to the host payload either way (the device
+        copy was written from the same arrays), which is what makes
+        the Arrow path byte-exact against the row-wise one."""
+        positions = np.asarray(positions, dtype=np.int64)
+        n = len(positions)
+        if n == 0:
+            return (np.empty(0, np.float64), np.empty(0, np.float64),
+                    np.empty(0, np.int64))
+        order = None
+        sorted_pos = positions
+        if n > 1 and not bool(np.all(positions[1:] >= positions[:-1])):
+            # sorted segments per generation need sorted positions; a
+            # sort-by query hands them in result order — gather sorted,
+            # then scatter back through the inverse permutation
+            order = np.argsort(positions, kind="stable")
+            sorted_pos = positions[order]
+        x = np.empty(n, np.float64)
+        y = np.empty(n, np.float64)
+        t = np.empty(n, np.int64)
+        covered = np.zeros(n, dtype=bool)
+        for gen in self.generations:
+            if gen.tier != "full" or gen.n == 0:
+                continue
+            lo = int(np.searchsorted(sorted_pos, gen.base, side="left"))
+            hi = int(np.searchsorted(sorted_pos, gen.base + gen.n,
+                                     side="left"))
+            if hi <= lo:
+                continue
+            m = hi - lo
+            cap = gather_capacity(m, minimum=8)
+            idx = np.zeros(cap, np.int32)
+            idx[:m] = (sorted_pos[lo:hi] - gen.base).astype(np.int32)
+            self.dispatch_count += 1
+            with device_span("query.materialize", stage="gather",
+                             runs=1, rows=m, bytes=m * PAYLOAD_BYTES):
+                gx, gy, gt = _lean_gather_payload(jnp.asarray(idx),
+                                                  gen.x, gen.y, gen.t)
+                x[lo:hi] = np.asarray(gx)[:m]
+                y[lo:hi] = np.asarray(gy)[:m]
+                t[lo:hi] = np.asarray(gt)[:m]
+            covered[lo:hi] = True
+        if not covered.all():
+            hx, hy, ht = self._payload_flat()
+            rest = sorted_pos[~covered]
+            x[~covered] = hx[rest]
+            y[~covered] = hy[rest]
+            t[~covered] = ht[rest]
+        if order is not None:
+            inv = np.empty(n, np.int64)
+            inv[order] = np.arange(n)
+            x, y, t = x[inv], y[inv], t[inv]
+        return x, y, t
 
     # -- aggregation push-down (round-4 VERDICT #2) -----------------------
     def _plan_one(self, boxes, t_lo_ms, t_hi_ms, max_ranges: int):

@@ -36,7 +36,8 @@ __all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "LEAN_SKETCH_SCANS", "LEAN_STATS_MATERIALIZED",
            "LEAN_DEVICE_DISPATCHES", "LEAN_DEVICE_MS",
            "JAX_COMPILE_COUNT", "JAX_COMPILE_MS", "JAX_COMPILE_FALLBACK",
-           "PLAN_ESTIMATE_RATIO", "WRITE_SEALS", "WRITE_SPILLS"]
+           "PLAN_ESTIMATE_RATIO", "WRITE_SEALS", "WRITE_SPILLS",
+           "ARROW_CHUNKS", "ARROW_ROWS", "ARROW_BYTES"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -80,6 +81,14 @@ PLAN_ESTIMATE_RATIO = "plan.estimate.ratio"
 #: caused it
 WRITE_SEALS = "write.seals"
 WRITE_SPILLS = "write.spills"
+#: Arrow-native streaming result path (ISSUE 14, arrow/stream.py):
+#: record batches emitted, rows materialized through the columnar
+#: (zero per-row-object) encoder, and IPC bytes flushed to streaming
+#: responses — the serving-plane counters next to the per-schema
+#: ``query.<schema>.materialize_ms`` timer
+ARROW_CHUNKS = "arrow.chunks"
+ARROW_ROWS = "arrow.rows"
+ARROW_BYTES = "arrow.ipc_bytes"
 
 #: the metric naming contract (docs/observability.md): every registry
 #: key lives under one of these top-level namespaces, dot-separated,
@@ -88,7 +97,7 @@ WRITE_SPILLS = "write.spills"
 #: tier-1 lint test (tests/test_zzz_metric_lint.py) walks the full
 #: registry after the suite and fails on any drive-by key outside it.
 METRIC_NAMESPACES = ("query", "write", "lean", "jax", "web", "storage",
-                     "plan", "obs", "pallas", "heat", "job")
+                     "plan", "obs", "pallas", "heat", "job", "arrow")
 _METRIC_KEY_RE = re.compile(
     r"^(?:" + "|".join(METRIC_NAMESPACES)
     + r")(?:\.[A-Za-z0-9_:\-]+)+$")

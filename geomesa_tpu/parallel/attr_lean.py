@@ -267,6 +267,14 @@ class ShardedLeanAttrIndex:
     #: (obs/heat) — stamped by the datastore / the owning XZ facade
     heat_scope: tuple | None = None
 
+    @staticmethod
+    def gather_payload(positions):
+        """Result-materialization protocol hook (ISSUE 14): sharded
+        attribute runs key lexicodes, not a row-addressable payload —
+        ``None`` routes the Arrow result path to the host column
+        store's vectorized take (index/attr_lean.LeanAttrIndex)."""
+        return None
+
     #: slots per generation PER SHARD
     GENERATION_SLOTS = 1 << 22
     DEFAULT_CAPACITY = 1 << 15

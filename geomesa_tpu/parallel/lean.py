@@ -935,6 +935,25 @@ class ShardedLeanZ3Index:
             t_hi_ms = min(t_hi_ms, self.t_max_ms)
         return t_lo_ms, t_hi_ms
 
+    # -- result materialization (ISSUE 14) --------------------------------
+    def gather_payload(self, positions: np.ndarray):
+        """(x, y, t) for the given LOCAL row positions — the sharded
+        twin of :meth:`LeanZ3Index.gather_payload`.
+
+        The sharded full tier stores its payload KEY-SORTED per shard
+        (appends sort payload alongside keys under shard_map), so a
+        row-id-addressed device take would need a per-row key search;
+        rows gather instead from this process's host payload in ONE
+        vectorized numpy take — the stacked-host-run half of the
+        materialize contract.  Under multihost the caller decodes gids
+        to local rows first (each process streams its own slice, the
+        per-shard delta-stream protocol of ``parallel/stats.
+        merged_arrow``)."""
+        positions = np.asarray(positions, dtype=np.int64)
+        x, y, t = self._payload_flat()
+        return (np.asarray(x)[positions], np.asarray(y)[positions],
+                np.asarray(t, np.int64)[positions])
+
     # -- query path -------------------------------------------------------
     def query(self, boxes, t_lo_ms, t_hi_ms,
               max_ranges: int = 2000) -> np.ndarray:

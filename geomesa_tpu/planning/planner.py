@@ -21,7 +21,7 @@ import numpy as np
 
 from ..features.batch import FeatureBatch
 from ..features.feature_type import FeatureType
-from ..filters.ast import Filter, Include, _Include
+from ..filters.ast import And, Filter, IdFilter, Include, Not, Or, _Include
 from ..filters.ecql import parse_ecql
 from ..filters.evaluate import evaluate_filter
 from .explain import Explainer, ExplainNull
@@ -51,11 +51,18 @@ class Query:
 
 @dataclass
 class QueryResult:
-    batch: FeatureBatch
+    #: materialized hit rows — ``None`` when the caller asked for
+    #: positions only (``materialize=False``: the Arrow-native result
+    #: path encodes columns straight from the store, ISSUE 14)
+    batch: FeatureBatch | None
     positions: np.ndarray
     strategy: FilterStrategy
     plan_time_ms: float
     scan_time_ms: float
+    #: this process's rows in final result order — equal to
+    #: ``positions`` single-host; under multihost ``positions`` are
+    #: global gids and this is the local slice
+    local_rows: np.ndarray | None = None
 
 
 class QueryTimeoutError(TimeoutError):
@@ -72,10 +79,16 @@ class QueryPlanner:
         self.store = store  # _SchemaStore (datastore.py)
 
     def run(self, query: Query, explain: Explainer | None = None,
-            allowed: np.ndarray | None = None) -> QueryResult:
+            allowed: np.ndarray | None = None,
+            materialize: bool = True) -> QueryResult:
         """Plan and execute.  ``allowed`` is an optional per-feature bool
         mask (row-level security) applied before sort/limit so that
-        ``max_features`` fills from authorized rows only."""
+        ``max_features`` fills from authorized rows only.
+
+        ``materialize=False`` skips the result-batch gather entirely
+        (positions/local_rows only — no per-row feature ids, no column
+        copies): the Arrow streaming path (ISSUE 14) encodes its
+        record batches straight from the store's columns instead."""
         explain = explain or ExplainNull()
         store = self.store
         batch = store.batch
@@ -144,7 +157,16 @@ class QueryPlanner:
                 cand = (store.local_rows_of(candidates) if mh
                         else candidates)
                 if len(cand):
-                    sub = batch.take(cand)
+                    # lean column stores re-check through an id-free
+                    # ChunkView: a full take() would mint O(candidates)
+                    # feature-id strings just to throw them away — the
+                    # cost class ISSUE 14 removes from the serving path.
+                    # Id-predicated filters still need real ids.
+                    if (hasattr(batch, "take_view")
+                            and not _filter_needs_ids(query.filter)):
+                        sub = batch.take_view(cand)
+                    else:
+                        sub = batch.take(cand)
                     mask = evaluate_filter(query.filter, sub)
                     positions = cand[mask]
                 else:
@@ -200,6 +222,9 @@ class QueryPlanner:
         else:
             positions = self._sort_limit(positions, batch, query)
             local_rows = positions
+        if not materialize:
+            return QueryResult(None, positions, strategy, plan_ms,
+                               scan_ms, local_rows=local_rows)
         properties = query.properties
         if properties is None and "COLUMN_GROUP" in query.hints:
             group = query.hints["COLUMN_GROUP"]
@@ -230,7 +255,8 @@ class QueryPlanner:
             result_batch = reproject_batch(result_batch, query.crs)
             explain(lambda: f"Reprojected to {query.crs}")
         explain.pop()
-        return QueryResult(result_batch, positions, strategy, plan_ms, scan_ms)
+        return QueryResult(result_batch, positions, strategy, plan_ms,
+                           scan_ms, local_rows=local_rows)
 
     # -- strategy execution ----------------------------------------------
     def _scan(self, strategy: FilterStrategy, query: Query,
@@ -517,6 +543,19 @@ class QueryPlanner:
         if query.max_features is not None:
             positions = positions[: query.max_features]
         return positions
+
+
+def _filter_needs_ids(f: Filter) -> bool:
+    """Does any node of the filter read feature ids?  (IdFilter is the
+    one evaluate_filter branch touching ``batch.ids`` — id-free filters
+    may re-check over an id-less ChunkView.)"""
+    if isinstance(f, IdFilter):
+        return True
+    if isinstance(f, (And, Or)):
+        return any(_filter_needs_ids(p) for p in f.filters)
+    if isinstance(f, Not):
+        return _filter_needs_ids(f.filter)
+    return False
 
 
 def _union(parts: list[np.ndarray]) -> np.ndarray:

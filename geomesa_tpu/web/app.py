@@ -23,6 +23,7 @@ Routes::
     GET    /api/stats/{name}/topk?attribute=
     GET    /api/audit/{name}?since=          query-event readback
     GET    /api/metrics                      request + store metrics dump
+    GET    /query?schema=&cql=&format=arrow  chunked Arrow-IPC result stream
     GET    /metrics.prom                     Prometheus text exposition
     GET    /traces?slow=1&limit=N            recent (or slow-log) traces
     GET    /traces/{trace_id}                full span tree of one trace
@@ -34,7 +35,10 @@ Routes::
 
 Malformed query-string parameters (a non-numeric ``limit``, an
 unrecognized flag value, an unknown ``state``) are a **400** with the
-offending parameter named — never a 500 or a silently-empty 200.
+offending parameter named — never a 500 or a silently-empty 200; the
+same contract covers malformed CQL/SQL on the query endpoints
+(``/api/data``, ``/query``, ``/explain``), which answer a 400 with the
+parse error instead of a traceback (ISSUE 14 satellite).
 
 Per-request metrics are recorded in the global registry (the reference's
 servlet-level ``AggregatedMetricsFilter``).  The trace endpoints read
@@ -53,7 +57,8 @@ import numpy as np
 
 from ..metrics import registry as _metrics
 from .wsgi import (
-    HttpError, Router, bool_param, float_param, int_param, read_json_body,
+    HttpError, Router, StreamingBody, bool_param, float_param, int_param,
+    read_json_body,
 )
 
 __all__ = ["WebApp", "serve"]
@@ -90,6 +95,7 @@ class WebApp:
             (r"^/api/metrics$", self._metrics_dump),
             (r"^/metrics\.prom$", self._metrics_prom),
             (r"^/api/metrics\.prom$", self._metrics_prom),
+            (r"^/query$", self._query_stream),
             (r"^/traces$", self._traces),
             (r"^/traces/([^/]+)$", self._trace_item),
             (r"^/debug/storage$", self._debug_storage),
@@ -108,8 +114,14 @@ class WebApp:
             return self.geojson_app(environ, start_response)
         t0 = time.perf_counter()
 
-        def on_metrics(status: int):
+        def on_metrics(status: int, aborted: bool = False):
             _metrics.counter(f"web.{status}").inc()
+            if aborted:
+                # a streaming body died after the status line went out
+                # — the status counter alone would read as a clean
+                # response (wsgi.Router streams call this from the
+                # body generator's except path)
+                _metrics.counter("web.stream_aborted").inc()
             _metrics.timer("web.request_ms").update(
                 (time.perf_counter() - t0) * 1e3)
 
@@ -122,15 +134,25 @@ class WebApp:
         except KeyError:
             raise HttpError(404, f"no such schema: {name!r}")
 
-    def _query(self, name: str, params: dict):
+    def _parse_cql(self, cql: str, **kw):
+        """CQL text → Query, or a strict 400 naming the parse failure —
+        a malformed filter on a query endpoint must never surface as a
+        500 traceback (the PR-5 hardening pattern on the debug
+        endpoints, applied to the query plane)."""
         from ..planning.planner import Query
+        try:
+            return Query.of(cql, **kw)
+        except Exception as e:
+            raise HttpError(400, f"CQL parse error in {cql!r}: {e}")
+
+    def _query(self, name: str, params: dict):
         self._sft(name)
         cql = params.get("cql", "INCLUDE")
         kw = {}
         max_features = int_param(params, "max")
         if max_features is not None:
             kw["max_features"] = max_features
-        return self.store.query(name, Query.of(cql, **kw))
+        return self.store.query(name, self._parse_cql(cql, **kw))
 
     def _visible_batch(self, name: str):
         """The schema's batch restricted to rows this caller may see
@@ -312,6 +334,55 @@ class WebApp:
             snap = _metrics.snapshot()
         return 200, prometheus_text(snap), "text/plain; version=0.0.4"
 
+    def _query_stream(self, method, params, environ):
+        """Chunked Arrow-IPC query results (ISSUE 14):
+        ``/query?schema=&cql=&format=arrow[&chunk_rows=N][&dicts=a,b]``
+        streams delta-dictionary record batches AS THE STORE
+        MATERIALIZES THEM — a client renders the first chunk while the
+        scan-side gather is still running, and no full result is ever
+        buffered server-side.  ``dicts`` names the attributes to
+        dictionary-encode (default: auto by
+        ``geomesa.arrow.dictionary.threshold``; ``dicts=none`` disables);
+        flush granularity is ``geomesa.arrow.stream.buffer.bytes``.
+        Malformed CQL is a strict 400."""
+        if method != "GET":
+            raise HttpError(405, method)
+        name = params.get("schema")
+        if not name:
+            raise HttpError(400, "need ?schema=...[&cql=...]")
+        self._sft(name)
+        fmt = params.get("format", "arrow")
+        if fmt != "arrow":
+            raise HttpError(400, f"unsupported stream format {fmt!r} "
+                                 "(only 'arrow')")
+        kw = {}
+        max_features = int_param(params, "max")
+        if max_features is not None:
+            kw["max_features"] = max_features
+        q = self._parse_cql(params.get("cql", "INCLUDE"), **kw)
+        chunk_rows = int_param(params, "chunk_rows")
+        if chunk_rows is not None and chunk_rows <= 0:
+            raise HttpError(400,
+                            f"bad 'chunk_rows' parameter: {chunk_rows}")
+        dicts = params.get("dicts")
+        if dicts is None:
+            dictionary_fields = "auto"
+        elif dicts.strip().lower() == "none":
+            dictionary_fields = ()
+        else:
+            dictionary_fields = tuple(d for d in dicts.split(",") if d)
+            sft = self.store.get_schema(name)
+            for d in dictionary_fields:
+                if d not in sft.attribute_names:
+                    raise HttpError(400, f"bad 'dicts' parameter: "
+                                         f"no attribute {d!r}")
+        from ..arrow.stream import ipc_chunks
+        stream = self.store.query_arrow(
+            name, q, chunk_rows=chunk_rows,
+            dictionary_fields=dictionary_fields)
+        return (200, StreamingBody(ipc_chunks(stream)),
+                "application/vnd.apache.arrow.stream")
+
     def _traces(self, method, params, environ):
         """Recent traces (ring buffer), or the slow-query log with
         ``?slow=1`` — newest last, summaries only.  ``?limit=N`` pages
@@ -407,6 +478,13 @@ class WebApp:
         from ..obs import explain_analyze, explain_analyze_sql
         sql = params.get("sql")
         if sql:
+            # parse-validate BEFORE executing: malformed SQL is a
+            # strict 400 naming the parse failure, never a 500
+            from ..sql import parse_sql
+            try:
+                parse_sql(sql)
+            except Exception as e:
+                raise HttpError(400, f"SQL parse error in {sql!r}: {e}")
             res = explain_analyze_sql(self.store, sql)
         else:
             name = params.get("schema")
@@ -414,8 +492,9 @@ class WebApp:
                 raise HttpError(400,
                                 "need ?sql=... or ?schema=...[&cql=...]")
             self._sft(name)
-            res = explain_analyze(self.store, name,
-                                  params.get("cql", "INCLUDE"))
+            cql = params.get("cql", "INCLUDE")
+            self._parse_cql(cql)
+            res = explain_analyze(self.store, name, cql)
         if params.get("format") == "text":
             return 200, res.render() + "\n", "text/plain"
         return 200, res.to_json()

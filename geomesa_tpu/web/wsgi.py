@@ -8,7 +8,7 @@ import re
 from urllib.parse import parse_qs, unquote
 
 __all__ = ["HttpError", "STATUS", "read_json_body", "Router",
-           "int_param", "float_param", "bool_param"]
+           "StreamingBody", "int_param", "float_param", "bool_param"]
 
 STATUS = {200: "200 OK", 201: "201 Created", 204: "204 No Content",
           400: "400 Bad Request", 404: "404 Not Found",
@@ -65,12 +65,29 @@ def bool_param(params: dict, name: str, default: bool = False) -> bool:
     raise HttpError(400, f"bad {name!r} parameter: {params[name]!r}")
 
 
+class StreamingBody:
+    """A chunked response body: the handler returns an ITERABLE of
+    byte chunks and the dispatcher streams them to the WSGI server as
+    they are produced (no Content-Length — the server closes or
+    chunk-encodes), instead of buffering the whole payload.  The
+    Arrow-IPC result stream (``/query?format=arrow``) emits record
+    batches this way as the store materializes them (ISSUE 14)."""
+
+    def __init__(self, chunks):
+        self.chunks = chunks
+
+    def __iter__(self):
+        for c in self.chunks:
+            yield c if isinstance(c, bytes) else bytes(c)
+
+
 class Router:
     """Regex-route table with shared dispatch/error handling.
 
     Handlers receive ``(method, params, environ, *groups)`` and return
-    ``(status, body, content_type)`` — body str/bytes/None, or any
-    JSON-serializable object when content_type is omitted.
+    ``(status, body, content_type)`` — body str/bytes/None/
+    :class:`StreamingBody`, or any JSON-serializable object when
+    content_type is omitted.
     """
 
     def __init__(self, routes):
@@ -102,12 +119,35 @@ class Router:
             status, body = 404, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — no internals in the response
             status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        if isinstance(body, StreamingBody):
+            # chunked path: the body generates as the store produces
+            # it, so there is no Content-Length to announce, and the
+            # request metrics must cover the WHOLE drain (most of a
+            # streamed query's wall time is the stream), firing from
+            # the generator's finally — including client disconnects
+            # and mid-stream failures (counted separately: the 200
+            # status line is already on the wire by then)
+            start_response(STATUS.get(status, f"{status} Error"),
+                           [("Content-Type", ctype)])
+
+            def _stream():
+                try:
+                    yield from body
+                except Exception:
+                    if on_metrics is not None:
+                        on_metrics(status, aborted=True)
+                    raise
+                else:
+                    if on_metrics is not None:
+                        on_metrics(status)
+
+            return _stream()
+        if on_metrics is not None:
+            on_metrics(status)
         if not isinstance(body, (str, bytes, type(None))):
             body = json.dumps(body)
         payload = (body.encode() if isinstance(body, str)
                    else (body or b""))
-        if on_metrics is not None:
-            on_metrics(status)
         start_response(STATUS.get(status, f"{status} Error"), [
             ("Content-Type", ctype),
             ("Content-Length", str(len(payload)))])

@@ -4,8 +4,10 @@ round trips, sorted merge, ArrowDataStore, datastore query_arrow."""
 import io
 
 import numpy as np
-import pyarrow as pa
 import pytest
+
+pa = pytest.importorskip(
+    "pyarrow", reason="arrow tests need the optional [arrow] extra")
 
 from geomesa_tpu.arrow import (
     ArrowDataStore, DeltaWriter, merge_deltas, read_feature_batch,
@@ -150,17 +152,17 @@ def test_arrow_datastore_roundtrip(tmp_path):
     assert ds2.type_names == []
 
 
-def test_datastore_query_arrow():
+def test_datastore_query_arrow_table():
     ds = TpuDataStore()
     sft = ds.create_schema("t", "name:String,age:Int,dtg:Date,*geom:Point")
     ds.write("t", _batch(sft, 200, seed=4))
-    table = ds.query_arrow("t", "bbox(geom, -74.9, 40.1, -74.1, 40.9)",
-                           dictionary_fields=("name",), sort_field="dtg",
-                           batch_size=64)
+    table = ds.query_arrow_table(
+        "t", "bbox(geom, -74.9, 40.1, -74.1, 40.9)",
+        dictionary_fields=("name",), sort_field="dtg", batch_size=64)
     assert table.num_rows > 0
     dtg = table.column("dtg").cast(pa.int64()).to_numpy()
     assert (np.diff(dtg) >= 0).all()
     # empty result returns an empty table with the right schema
-    empty = ds.query_arrow("t", "bbox(geom, 10, 10, 11, 11)")
+    empty = ds.query_arrow_table("t", "bbox(geom, 10, 10, 11, 11)")
     assert empty.num_rows == 0
     assert "geom" in empty.schema.names

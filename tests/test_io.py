@@ -131,6 +131,7 @@ def batch(sft):
 
 
 def test_arrow_roundtrip(batch, tmp_path):
+    pytest.importorskip("pyarrow")
     table = to_arrow(batch)
     assert table.num_rows == 2
     assert b"geomesa_tpu.sft" in (table.schema.metadata or {})

@@ -247,7 +247,7 @@ np.testing.assert_allclose(np.sort(kdist), all_d[:10], rtol=1e-12)
 # query_arrow with zero LOCAL hits (ADVICE r4): proc 1 holds none of
 # the 'p0.0' hits but must still enter the mesh reduce with its empty
 # local group and return the schema'd empty table, not None
-tbl = ds.query_arrow("evt", "IN ('p0.0')")
+tbl = ds.query_arrow_table("evt", "IN ('p0.0')")
 assert tbl is not None and tbl.num_rows == (1 if proc == 0 else 0), tbl
 assert "name" in tbl.schema.names
 

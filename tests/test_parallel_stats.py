@@ -119,6 +119,7 @@ def test_merged_stats_topk_sane(batch):
 
 
 def test_merged_arrow_equals_single_writer(batch):
+    pytest.importorskip("pyarrow")
     merged = merged_arrow(batch, batch.sft, 8,
                           dictionary_fields=("name",), sort_field="score")
     assert merged.num_rows == len(batch)
@@ -130,6 +131,7 @@ def test_merged_arrow_equals_single_writer(batch):
 
 
 def test_mesh_store_query_arrow_matches_plain():
+    pytest.importorskip("pyarrow")
     rng = np.random.default_rng(47)
     n = 5_003
     data = {
@@ -146,9 +148,9 @@ def test_mesh_store_query_arrow_matches_plain():
         ds.create_schema("obs", spec)
         ds.write("obs", data)
     ecql = "BBOX(geom, -74.5, 40.5, -73.5, 41.5)"
-    ta = plain.query_arrow("obs", ecql, dictionary_fields=("name",),
+    ta = plain.query_arrow_table("obs", ecql, dictionary_fields=("name",),
                            sort_field="score")
-    tb = mesh.query_arrow("obs", ecql, dictionary_fields=("name",),
+    tb = mesh.query_arrow_table("obs", ecql, dictionary_fields=("name",),
                           sort_field="score")
     assert ta.num_rows == tb.num_rows
     np.testing.assert_allclose(np.asarray(ta.column("score")),
@@ -201,6 +203,7 @@ def test_shard_of_gids_residency_after_append():
 
 
 def test_mesh_arrow_unsorted_row_order_parity():
+    pytest.importorskip("pyarrow")
     """Without a sort field the merged arrow table restores the exact
     single-chip row order (positions order), even though streams are
     residency-grouped."""
@@ -224,8 +227,8 @@ def test_mesh_arrow_unsorted_row_order_parity():
                          if not isinstance(v, np.ndarray) else v[:100]
                          for k, v in data.items()})  # append block
     ecql = "BBOX(geom, -74.5, 40.5, -73.5, 41.5)"
-    ta = plain.query_arrow("obs", ecql, dictionary_fields=("name",))
-    tb = mesh.query_arrow("obs", ecql, dictionary_fields=("name",))
+    ta = plain.query_arrow_table("obs", ecql, dictionary_fields=("name",))
+    tb = mesh.query_arrow_table("obs", ecql, dictionary_fields=("name",))
     assert ta.num_rows == tb.num_rows
     np.testing.assert_allclose(np.asarray(ta.column("score")),
                                np.asarray(tb.column("score")))

@@ -139,7 +139,7 @@ def test_sampling():
 def test_arrow_conversion_process(store):
     import io as _io
 
-    import pyarrow as pa
+    pa = pytest.importorskip("pyarrow")
 
     from geomesa_tpu.process import arrow_conversion_process
 

@@ -159,6 +159,7 @@ def test_spatial_frame_pushdown_and_aggregation():
     assert groups["n"].sum() == n
     assert np.all(groups["hi"] <= 10.0)
 
+    pytest.importorskip("pyarrow")
     tbl = frame.to_arrow()
     assert tbl.num_rows == len(out)
 
