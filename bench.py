@@ -411,14 +411,15 @@ def main():
                             and pallas.get("hist1d_ok") is not False
                             and pallas["on_tpu"])
 
-    scale = _scale_stanza()
-    compaction = _compaction_stanza()
-    stats_pd = _stats_pushdown_stanza()
-    xz3_scale = _xz3_scale_stanza()
-    obs_stanza = _obs_stanza()
-    heat_stanza = _heat_stanza()
-    arrow_stanza = _arrow_stanza()
-    lint_stanza = _lint_stanza()
+    scale = _guarded_stanza(_scale_stanza)
+    compaction = _guarded_stanza(_compaction_stanza)
+    stats_pd = _guarded_stanza(_stats_pushdown_stanza)
+    xz3_scale = _guarded_stanza(_xz3_scale_stanza)
+    obs_stanza = _guarded_stanza(_obs_stanza)
+    heat_stanza = _guarded_stanza(_heat_stanza)
+    arrow_stanza = _guarded_stanza(_arrow_stanza)
+    lint_stanza = _guarded_stanza(_lint_stanza)
+    resilience_stanza = _guarded_stanza(_resilience_stanza)
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -453,6 +454,7 @@ def main():
             "heat": heat_stanza,
             "arrow": arrow_stanza,
             "lint": lint_stanza,
+            "resilience": resilience_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -473,6 +475,12 @@ def main():
     # run, and here they become part of the failure signal
     for f in (arrow_stanza or {}).get("gate_failures", ()):
         regressions.append({"metric": "arrow.gate", "prior": None,
+                            "current": None, "ratio": None,
+                            "detail": f})
+    # resilience acceptance-gate failures (deadline-overshoot pin,
+    # shed behavior) fail the run the same way (ISSUE 16)
+    for f in (resilience_stanza or {}).get("gate_failures", ()):
+        regressions.append({"metric": "resilience.gate", "prior": None,
                             "current": None, "ratio": None,
                             "detail": f})
     full["regressions"] = regressions
@@ -559,6 +567,11 @@ def _compact_summary(full: dict) -> dict:
                           "materialize_feats_per_sec", "lift_vs_r05",
                           "byte_exact", "warm_recompiles")
                 if k in (ex.get("arrow") or {})},
+            "resilience": {
+                k: (ex.get("resilience") or {}).get(k)
+                for k in ("overshoot_p99", "shed_ms",
+                          "timeout_gate_ok", "warm_recompiles")
+                if k in (ex.get("resilience") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -1031,6 +1044,151 @@ def _arrow_stanza() -> dict:
         out["gate_failures"] = failures
         for f in failures:
             print(f"BENCH ARROW GATE FAILED: {f}", flush=True)
+    out.update(_mem_probe())
+    return out
+
+
+def _guarded_stanza(fn) -> dict:
+    """Every stanza RECORDS its failure rather than killing the bench:
+    the stanzas' inner try/excepts cover their measured sections, but
+    an exception before them (import, setup, env parsing) previously
+    propagated and took the whole record with it (ISSUE 16
+    satellite)."""
+    try:
+        out = fn()
+    except Exception as e:  # noqa: BLE001 — the record IS the signal
+        return {"error": repr(e)}
+    if not isinstance(out, dict):
+        return {"error": f"stanza returned {type(out).__name__}"}
+    return out
+
+
+def _resilience_stanza() -> dict:
+    """Deadline + admission acceptance gate (ISSUE 16): a warm lean
+    query given a timeout below its runtime must terminate within
+    1.25x the deadline (the cooperative-cancellation pin documented in
+    docs/resilience.md — yield points between generation scans bound
+    the overshoot to one dispatch), and an over-budget request must
+    shed as Backpressure after about the configured queue wait, never
+    hang.  ``RESILIENCE_BENCH_N=0`` skips."""
+    import numpy as np
+
+    n = int(os.environ.get("RESILIENCE_BENCH_N", 2_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    try:
+        from geomesa_tpu import config as gm_config
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.obs import compile_count
+        from geomesa_tpu.resilience import Backpressure, admission_gate
+
+        ms0 = 1_514_764_800_000
+        day = 86_400_000
+        slots = 1 << 16
+        rng = np.random.default_rng(31)
+        spec = ("dtg:Date,*geom:Point;"
+                "geomesa.index.profile=lean,"
+                f"geomesa.lean.generation.slots={slots},"
+                "geomesa.lean.compaction.factor=0")
+        ds = TpuDataStore(user="resilience-bench")
+        ds.create_schema("rb", spec)
+        for lo in range(0, n, slots):
+            m = min(slots, n - lo)
+            ds.write("rb", {
+                "dtg": rng.integers(ms0, ms0 + 14 * day, m),
+                "geom": (rng.uniform(-180, 180, m),
+                         rng.uniform(-90, 90, m))})
+        idx = ds._store("rb")._indexes["z3"]
+        idx.block()
+        # per-generation dispatch granularity: production fuses the
+        # same-size generations into one batched program (good for
+        # throughput, but then the whole scan is a single
+        # uninterruptible dispatch and the cooperative pin is
+        # unmeasurable); the gate measures the cancellation machinery,
+        # so force one dispatch per generation (~31 yield points)
+        idx.BATCH_SCAN_BUDGET = 1
+        # a SELECTIVE query keeps the scan the long pole: the host
+        # recheck over already-gathered candidates must finish for
+        # exactness (docs/resilience.md), so a low-selectivity query's
+        # overshoot is dominated by that unskippable post-work, not by
+        # the dispatch granularity the pin is about
+        sel = "BBOX(geom,-170,-80,-150,-60)"
+        ds.query_result("rb", sel)          # warm the scan
+        warm_ms = _median_time(
+            lambda: ds.query_result("rb", sel), iters=3) * 1e3
+        out["query_warm_ms"] = round(warm_ms, 2)
+        # deadline at half the warm runtime: the query WILL expire
+        # mid-scan, and every iteration must still return (partial)
+        # within the overshoot pin
+        deadline_ms = max(1.0, warm_ms / 2.0)
+        out["deadline_ms"] = round(deadline_ms, 2)
+        overshoots = []
+        c0 = compile_count()
+        for _ in range(20):
+            t0 = time.perf_counter()
+            res = ds.query_result("rb", sel, timeout_ms=deadline_ms,
+                                  partial_results=True)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            if res.timed_out:
+                overshoots.append(dt_ms / deadline_ms)
+        out["warm_recompiles"] = int(compile_count() - c0)
+        out["timed_out_runs"] = len(overshoots)
+        if overshoots:
+            overshoots.sort()
+            out["overshoot_p99"] = round(
+                overshoots[min(len(overshoots) - 1,
+                               int(0.99 * len(overshoots)))], 3)
+        # shed latency: with the single admission slot held and a
+        # short queue wait, the next query must come back Backpressure
+        # in roughly queue_ms — a shed that takes seconds is a hang
+        # with extra steps
+        gm_config.set_property(
+            "geomesa.resilience.admission.max.concurrent", 1)
+        gm_config.set_property(
+            "geomesa.resilience.admission.queue.ms", 20.0)
+        try:
+            tok = admission_gate.acquire("rb")
+            t0 = time.perf_counter()
+            try:
+                ds.query_result("rb", sel)
+                out["shed_error"] = "no Backpressure under overload"
+            except Backpressure:
+                out["shed_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 2)
+            finally:
+                tok.release()
+        finally:
+            gm_config.clear_property(
+                "geomesa.resilience.admission.max.concurrent")
+            gm_config.clear_property(
+                "geomesa.resilience.admission.queue.ms")
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
+    # the acceptance gate runs OUTSIDE the try (arrow-stanza
+    # precedent: an assert swallowed by the stanza's blanket except
+    # could never fail a run)
+    failures = []
+    if "error" not in out and not out.get("skipped"):
+        p99 = out.get("overshoot_p99")
+        ok = (p99 is not None and p99 <= 1.25
+              and out.get("timed_out_runs", 0) > 0)
+        out["timeout_gate_ok"] = bool(ok)
+        if not ok:
+            failures.append(
+                f"deadline overshoot p99 {p99} exceeds the 1.25x pin "
+                f"(timed_out_runs={out.get('timed_out_runs')})")
+        if "shed_ms" not in out:
+            failures.append(out.get("shed_error",
+                                    "admission shed did not happen"))
+        elif out["shed_ms"] > 1000.0:
+            failures.append(
+                f"shed latency {out['shed_ms']}ms — the queue wait is "
+                "not bounded")
+    if failures:
+        out["gate_failures"] = failures
+        for f in failures:
+            print(f"BENCH RESILIENCE GATE FAILED: {f}", flush=True)
     out.update(_mem_probe())
     return out
 

@@ -1,4 +1,4 @@
-"""Check registry: the five invariant analyzers, in catalog order
+"""Check registry: the six invariant analyzers, in catalog order
 (docs/static_analysis.md).  Each check exposes ``id``,
 ``description``, and ``run(module, project) -> iterator[Finding]``;
 adding a check means adding a module here and a catalog row there.
@@ -11,11 +11,13 @@ from .recompile_hazard import RecompileHazardCheck
 from .lock_discipline import LockDisciplineCheck
 from .config_options import ConfigOptionCheck
 from .taxonomy import TaxonomyCheck
+from .fault_points import FaultPointCheck
 
 __all__ = ["CHECKS", "check_by_id"]
 
 CHECKS = (HostSyncCheck(), RecompileHazardCheck(),
-          LockDisciplineCheck(), ConfigOptionCheck(), TaxonomyCheck())
+          LockDisciplineCheck(), ConfigOptionCheck(), TaxonomyCheck(),
+          FaultPointCheck())
 
 
 def check_by_id(check_id: str):

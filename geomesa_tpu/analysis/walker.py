@@ -240,6 +240,7 @@ class Project:
         self.docs_text = self._read_docs()
         self.metric_namespaces = self._metric_namespaces()
         self.span_patterns = self._span_patterns()
+        self.fault_points = self._fault_points()
 
     # -- device-dispatch resolution (host-sync) ----------------------
     def device_names(self, mod: SourceModule) -> tuple[set, set]:
@@ -363,6 +364,30 @@ class Project:
         for line in doc.read_text(encoding="utf-8").splitlines():
             if line.startswith("## "):
                 in_section = line.strip() == "## Span taxonomy"
+                continue
+            if in_section and line.startswith("|"):
+                m = re.match(r"\|\s*`([^`]+)`\s*\|", line)
+                if m:
+                    out.append(m.group(1))
+        return out
+
+    def _fault_points(self) -> list[str]:
+        """Fault-point names from the docs/resilience.md catalog table
+        (first backticked cell of each row in the Fault-point catalog
+        section) — the ground truth the fault-points check holds
+        ``fault_point()`` call literals and the ``FAULT_POINTS``
+        declaration to (ISSUE 16)."""
+        for base in (self.root.parent, REPO_ROOT):
+            doc = base / "docs" / "resilience.md"
+            if doc.exists():
+                break
+        else:
+            return []
+        out: list[str] = []
+        in_section = False
+        for line in doc.read_text(encoding="utf-8").splitlines():
+            if line.startswith("## "):
+                in_section = line.strip() == "## Fault-point catalog"
                 continue
             if in_section and line.startswith("|"):
                 m = re.match(r"\|\s*`([^`]+)`\s*\|", line)

@@ -61,18 +61,46 @@ class ArrowStream:
     pull), or call :meth:`to_table` / :meth:`to_ipc_bytes` to drain it
     whole.  A stream is single-use, like any generator."""
 
-    def __init__(self, schema, batches: Iterator, sft: FeatureType):
+    def __init__(self, schema, batches: Iterator, sft: FeatureType,
+                 on_close=None):
         #: the pa.Schema every yielded batch conforms to (available
         #: BEFORE the first batch — empty results still have a schema)
         self.schema = schema
         self.sft = sft
         self._batches = iter(batches)
+        # a generator's finally only runs once its body has been
+        # ENTERED — a stream created but never iterated would leak
+        # whatever the finally was meant to release (the admission
+        # token).  on_close must be idempotent; close()/__del__ call it
+        # even for never-started streams.
+        self._on_close = on_close
 
     def __iter__(self):
-        return self._batches
+        # returns self (not the inner generator) so a bare
+        # `for rb in store.query_arrow(...)` keeps THIS object alive
+        # for the whole drain — handing out self._batches would let
+        # refcounting collect the wrapper mid-loop, and __del__ would
+        # close the generator out from under the iteration
+        return self
 
     def __next__(self):
         return next(self._batches)
+
+    def close(self) -> None:
+        """Release the stream without draining it: closes the
+        underlying generator and fires ``on_close`` (idempotent)."""
+        closer = getattr(self._batches, "close", None)
+        if closer is not None:
+            closer()
+        if self._on_close is not None:
+            cb, self._on_close = self._on_close, None
+            cb()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def to_table(self):
         """Drain into one ``pa.Table`` (dictionary columns keep their
@@ -138,7 +166,8 @@ def stream_batches(sft: FeatureType, schema, batch, positions,
                    payload_gather: Callable | None = None,
                    payload_columns: tuple[str, ...] = (),
                    schema_name: str | None = None,
-                   dictionaries: dict | None = None):
+                   dictionaries: dict | None = None,
+                   deadline=None):
     """Generator of ``pa.RecordBatch`` over the hit ``positions`` of
     one query — the streaming encode loop (module doc).
 
@@ -148,7 +177,14 @@ def stream_batches(sft: FeatureType, schema, batch, positions,
     scale index's on-device gather); every other needed column gathers
     host-side via one vectorized take.  ``dictionaries`` carries the
     shared per-attribute :class:`DictionaryState` accumulations across
-    chunks (the delta protocol)."""
+    chunks (the delta protocol).
+
+    ``deadline`` is an EXPLICIT resilience CancelScope (not the ambient
+    contextvar — this generator's body runs long after the creating
+    call's scope exited): polled between chunks, and on expiry or
+    cancellation the stream simply ENDS — ipc_chunks still closes the
+    IPC writer, so the client sees a well-formed (truncated) Arrow
+    stream, never a mid-message cut (ISSUE 16)."""
     if chunk_rows is None:
         chunk_rows = ArrowProperties.CHUNK_ROWS.to_int()
     chunk_rows = max(1, int(chunk_rows))
@@ -161,6 +197,8 @@ def stream_batches(sft: FeatureType, schema, batch, positions,
     name = schema_name or sft.name or "unknown"
     timer = _metrics.timer(f"query.{name}.materialize_ms")
     for s in range(0, len(positions), chunk_rows):
+        if deadline is not None and deadline.poll():
+            break
         chunk = positions[s:s + chunk_rows]
         m = len(chunk)
         t0 = time.perf_counter()
@@ -247,12 +285,15 @@ def ipc_chunks(stream: ArrowStream,
     writer = pa.ipc.new_stream(
         sink, stream.schema,
         options=pa.ipc.IpcWriteOptions(emit_dictionary_deltas=True))
+    from ..resilience import fault_point
     for rb in stream:
         writer.write_batch(rb)
         if sink.size >= buffer_bytes:
+            fault_point("arrow.flush")
             obs_count(ARROW_BYTES, sink.size)
             yield sink.drain()
     writer.close()
     if sink.size:
+        fault_point("arrow.flush")
         obs_count(ARROW_BYTES, sink.size)
         yield sink.drain()

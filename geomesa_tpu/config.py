@@ -20,7 +20,7 @@ from typing import Any
 
 __all__ = ["SystemProperty", "SchemaOption", "QueryProperties",
            "ObsProperties", "ArrowProperties", "SchemaProperties",
-           "ConfigProperties",
+           "ConfigProperties", "ResilienceProperties",
            "set_property", "clear_property", "config_generation",
            "known_option_names", "check_option_name",
            "UnknownOptionWarning"]
@@ -304,11 +304,48 @@ class ArrowProperties:
         "geomesa.arrow.stream.buffer.bytes", 1 << 20)
 
 
+class ResilienceProperties:
+    """Resilience-layer knobs (ISSUE 16, geomesa_tpu/resilience):
+    admission gating, degraded execution, and the deterministic
+    fault-injection harness.  Everything defaults OFF — an unconfigured
+    store behaves exactly as before this layer existed."""
+
+    #: HBM admission ceiling in bytes: new queries shed (Backpressure)
+    #: while the live ``storage.total.device_bytes`` gauge exceeds this;
+    #: 0 disables the HBM check
+    HBM_HEADROOM = SystemProperty("geomesa.resilience.hbm.headroom", 0)
+    #: max concurrently-admitted queries per process; 0 = unbounded
+    ADMISSION_MAX_CONCURRENT = SystemProperty(
+        "geomesa.resilience.admission.max.concurrent", 0)
+    #: how long an over-budget request may queue (ms) before shedding
+    ADMISSION_QUEUE_MS = SystemProperty(
+        "geomesa.resilience.admission.queue.ms", 50.0)
+    #: bounded retries after a transient (RESOURCE_EXHAUSTED) device
+    #: failure demotes the offending generation's payload to host
+    RETRY_MAX = SystemProperty("geomesa.resilience.retry.max", 1)
+    #: consecutive transient failures before a generation's device
+    #: dispatch circuit opens (host-tier routing until cooldown)
+    BREAKER_THRESHOLD = SystemProperty(
+        "geomesa.resilience.breaker.threshold", 3)
+    #: seconds an open breaker refuses device dispatch before half-open
+    BREAKER_COOLDOWN_S = SystemProperty(
+        "geomesa.resilience.breaker.cooldown.s", 30.0)
+    #: armed fault points (resilience/faults.py): comma-separated
+    #: ``point[:trigger][=kind]`` — bare point fires every hit, integer
+    #: trigger fires on exactly the Nth hit, float < 1 fires with that
+    #: seeded probability; kind is ``error`` (poison) or ``oom``
+    #: (classified transient).  Empty disables injection entirely.
+    FAULT_POINTS = SystemProperty("geomesa.resilience.fault.points", "")
+    #: RNG seed for probabilistic fault triggers — same seed + same hit
+    #: order = same injected failures (deterministic chaos runs)
+    FAULT_SEED = SystemProperty("geomesa.resilience.fault.seed", 0)
+
+
 def _register_declarations() -> None:
     """Fill the option registry from the declaration classes above —
     the one place a knob becomes 'known' to the strict mode."""
     for cls in (QueryProperties, ObsProperties, ArrowProperties,
-                SchemaProperties, ConfigProperties):
+                SchemaProperties, ConfigProperties, ResilienceProperties):
         for value in vars(cls).values():
             if isinstance(value, (SystemProperty, SchemaOption)):
                 _REGISTRY[value.name] = value

@@ -1236,8 +1236,16 @@ class TpuDataStore:
                     "(created by another process)")
             self._schemas[sft.name] = _SchemaStore(sft, mesh=self._mesh,
                                          multihost=self._multihost)
+            # interceptors resolve EAGERLY at schema load (ISSUE 16): a
+            # typoed ``geomesa.query.interceptors`` dotted path fails
+            # create_schema, not the first query hours later
+            self._resolve_interceptors(sft)
             self._persist_schema(sft)
         return sft
+
+    def _resolve_interceptors(self, sft: FeatureType) -> None:
+        from .planning.interceptor import load_interceptors
+        self._interceptors[sft.name] = load_interceptors(sft)
 
     def get_schema(self, name: str) -> FeatureType:
         return self._store(name).sft
@@ -1326,6 +1334,10 @@ class TpuDataStore:
                         # collision is already rejected above
                         shutil.rmtree(target, ignore_errors=True)
                         os.replace(d, target)
+            # eager re-resolution (see create_schema): a bad interceptor
+            # path in the UPDATED user data fails at update time, not on
+            # the first query against the new user data
+            self._resolve_interceptors(sft)
             self._persist_schema(sft)
 
     def remove_schema(self, name: str) -> None:
@@ -1640,16 +1652,56 @@ class TpuDataStore:
         return self.query_result(name, query, explain).batch
 
     def query_result(self, name: str, query="INCLUDE",
-                     explain: Explainer | None = None) -> QueryResult:
-        return self._query_result_ex(name, query, explain)[0]
+                     explain: Explainer | None = None, *,
+                     timeout_ms: float | None = None,
+                     partial_results: bool = False) -> QueryResult:
+        """Run a query.  ``timeout_ms`` arms a cooperative deadline
+        (resilience/deadline.py) checked at every scan yield point:
+        expiry raises :class:`~geomesa_tpu.resilience.QueryTimeout`, or
+        — with ``partial_results=True`` — returns the exact hits over
+        what WAS scanned, flagged ``result.timed_out`` (ISSUE 16)."""
+        return self._query_result_ex(
+            name, query, explain, timeout_ms=timeout_ms,
+            partial_results=partial_results)[0]
 
     def _query_result_ex(self, name: str, query="INCLUDE",
                          explain: Explainer | None = None,
-                         materialize: bool = True):
+                         materialize: bool = True,
+                         timeout_ms: float | None = None,
+                         partial_results: bool = False,
+                         _token=None):
         """The shared query executor: returns ``(result, eval_store)``
         so the Arrow streaming path (``materialize=False``) can gather
         its columns from the SAME (possibly visibility-masked) batch
-        the residual filter evaluated over."""
+        the residual filter evaluated over.
+
+        Admission (ISSUE 16): every query holds one gate token for its
+        whole execution; ``_token`` hands in a token the CALLER already
+        acquired (query_arrow holds its token until the streamed drain
+        completes, long past this method's return)."""
+        from .resilience import admission_gate, current_scope, deadline_scope
+        own_token = _token is None
+        token = _token if _token is not None else admission_gate.acquire(name)
+        try:
+            if timeout_ms is not None:
+                with deadline_scope(timeout_ms, partial_results) as scope:
+                    result, eval_store = self._run_query(
+                        name, query, explain, materialize)
+                result.timed_out = scope.timed_out
+            else:
+                result, eval_store = self._run_query(
+                    name, query, explain, materialize)
+                ambient = current_scope()
+                if ambient is not None and ambient.timed_out:
+                    result.timed_out = True
+            return result, eval_store
+        finally:
+            if own_token:
+                token.release()
+
+    def _run_query(self, name: str, query="INCLUDE",
+                   explain: Explainer | None = None,
+                   materialize: bool = True):
         from .obs import span as obs_span
         store = self._store(name)
         q = query if isinstance(query, Query) else Query.of(query)
@@ -1735,7 +1787,9 @@ class TpuDataStore:
 
     def query_arrow(self, name: str, query="INCLUDE", *,
                     chunk_rows: int | None = None,
-                    dictionary_fields="auto"):
+                    dictionary_fields="auto",
+                    timeout_ms: float | None = None,
+                    partial_results: bool = False):
         """Streaming Arrow results (ISSUE 14): run the query to hit
         POSITIONS only — no per-row feature objects ever exist — and
         return an :class:`~geomesa_tpu.arrow.stream.ArrowStream`
@@ -1759,23 +1813,55 @@ class TpuDataStore:
         ``arrow.reader.merge_deltas``).  For the one-shot in-process
         Table API with the mesh residency reduce, see
         :meth:`query_arrow_table`."""
+        from .resilience import admission_gate
+        # the admission token spans the WHOLE streamed response: it
+        # releases when the last chunk drains (or the drain aborts),
+        # not when this method returns the lazy stream (ISSUE 16)
+        token = admission_gate.acquire(name)
+        try:
+            return self._query_arrow_under_token(
+                name, query, chunk_rows, dictionary_fields,
+                timeout_ms, partial_results, token)
+        except BaseException:
+            token.release()
+            raise
+
+    def _query_arrow_under_token(self, name, query, chunk_rows,
+                                 dictionary_fields, timeout_ms,
+                                 partial_results, token):
         from .arrow.schema import sft_to_arrow_schema
         from .arrow.stream import (
             ArrowStream, auto_dictionary_fields, stream_batches,
         )
+        from .resilience import CancelScope
+
+        # one scope covers scan AND drain.  The scan phase honors
+        # ``partial_results`` (False -> QueryTimeout before any bytes
+        # hit the wire, the 504 path); the drain NEVER raises on expiry
+        # — stream_batches polls the scope between chunks and ends
+        # early with a well-formed Arrow EOS (the 200 status line is
+        # long gone by then)
+        scope = (CancelScope(timeout_ms, partial_results)
+                 if timeout_ms is not None else None)
         store = self._store(name)
         q = query if isinstance(query, Query) else Query.of(query)
         needs_rows = (q.properties is not None or bool(q.crs)
                       or "COLUMN_GROUP" in q.hints)
         if needs_rows:
-            result = self.query_result(name, q)
+            result = self._scoped_query_result(name, q, scope, token)
             source = result.batch
             sft = source.sft
             rows = np.arange(len(source), dtype=np.int64)
             eval_store = store
         else:
-            result, eval_store = self._query_result_ex(
-                name, q, materialize=False)
+            from .resilience import deadline_scope
+            if scope is not None:
+                with deadline_scope(scope=scope):
+                    result, eval_store = self._query_result_ex(
+                        name, q, materialize=False, _token=token)
+            else:
+                result, eval_store = self._query_result_ex(
+                    name, q, materialize=False, _token=token)
             source = eval_store.batch
             sft = store.sft
             rows = (result.local_rows if result.local_rows is not None
@@ -1805,8 +1891,28 @@ class TpuDataStore:
         batches = stream_batches(
             sft, schema, source, rows, chunk_rows=chunk_rows,
             payload_gather=payload_gather, payload_columns=payload_cols,
-            schema_name=name)
-        return ArrowStream(schema, batches, sft)
+            schema_name=name, deadline=scope)
+
+        def _released(gen=batches, _token=token):
+            # the token's lifetime IS the drain's: normal exhaustion,
+            # a mid-stream failure, and a client abort (generator
+            # close) all land in this finally exactly once
+            try:
+                yield from gen
+            finally:
+                _token.release()
+
+        # on_close covers the stream-abandoned-before-first-next case:
+        # _released's finally cannot run if its body was never entered
+        return ArrowStream(schema, _released(), sft,
+                           on_close=token.release)
+
+    def _scoped_query_result(self, name, q, scope, token):
+        from .resilience import deadline_scope
+        if scope is None:
+            return self._query_result_ex(name, q, _token=token)[0]
+        with deadline_scope(scope=scope):
+            return self._query_result_ex(name, q, _token=token)[0]
 
     def query_arrow_table(self, name: str, query="INCLUDE", *,
                           dictionary_fields: tuple[str, ...] = (),
@@ -1890,12 +1996,30 @@ class TpuDataStore:
                 procs == jax.process_index()]
         return self._residency_shards(store, positions)
 
-    def query_windows(self, name: str, windows) -> list[np.ndarray]:
+    def query_windows(self, name: str, windows, *,
+                      timeout_ms: float | None = None,
+                      partial_results: bool = False) -> list[np.ndarray]:
         """Batched bbox+time window queries: one device dispatch for ALL
         windows (``[(boxes, t_lo_ms, t_hi_ms), …]``), returning a position
         array per window — the BatchScanner-over-many-range-sets pattern
         the analytics processes (tube-select, kNN rings) are built on.
-        Falls back to per-window planner queries for non-point schemas."""
+        Falls back to per-window planner queries for non-point schemas.
+
+        ``timeout_ms`` arms a cooperative deadline (ISSUE 16): expiry
+        raises QueryTimeout, or with ``partial_results=True`` the
+        windows scanned before expiry keep their exact hits and the
+        remainder come back empty."""
+        from .resilience import admission_gate, deadline_scope
+        token = admission_gate.acquire(name)
+        try:
+            if timeout_ms is not None:
+                with deadline_scope(timeout_ms, partial_results):
+                    return self._query_windows_body(name, windows)
+            return self._query_windows_body(name, windows)
+        finally:
+            token.release()
+
+    def _query_windows_body(self, name: str, windows) -> list[np.ndarray]:
         store = self._store(name)
         if store.batch is None or len(store.batch) == 0:
             if store.multihost:
@@ -1945,13 +2069,24 @@ class TpuDataStore:
                          or {"z2", "z3"} <= set(enabled)))
         if not use_fast:
             from .filters.ast import And, BBox, During, Or
+            from .resilience import AdmissionToken, check_cancel
             out = []
             for boxes, lo, hi in windows:
+                # partial expiry: remaining windows answer empty (the
+                # caller flagged partial; scanned windows stay exact).
+                # The inner query reuses the admission slot the
+                # query_windows entry point already holds (a nested
+                # acquire would self-deadlock a 1-slot gate).
+                if check_cancel("query_windows"):
+                    out.append(np.empty(0, dtype=np.int64))
+                    continue
                 parts = [BBox(sft.geom_field, *b) for b in boxes]
                 f = parts[0] if len(parts) == 1 else Or(tuple(parts))
                 if sft.dtg_field and not (lo is None and hi is None):
                     f = And((f, During(sft.dtg_field, lo, hi)))
-                out.append(self.query_result(name, Query.of(f)).positions)
+                out.append(self._query_result_ex(
+                    name, Query.of(f),
+                    _token=AdmissionToken(None))[0].positions)
             return out
         from .obs import span as obs_span
         with obs_span("query", schema=name, windows=len(windows)) as sp:
@@ -2748,4 +2883,8 @@ class TpuDataStore:
                         **{k: int(v) for k, v in
                            meta["index_versions"].items()}}
                 self._schemas[sft.name] = store
+                # same eager resolution create_schema does: a catalog
+                # whose interceptor chain no longer imports fails at
+                # open, where the operator is looking, not mid-query
+                self._resolve_interceptors(sft)
                 self._load_data(sft.name)

@@ -50,7 +50,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..metrics import WRITE_SEALS, WRITE_SPILLS
+from ..metrics import (RESILIENCE_DEGRADED, RESILIENCE_RETRIES,
+                       WRITE_SEALS, WRITE_SPILLS)
 from ..obs import device_span, obs_count, span as obs_span
 from ..obs.heat import (
     heat_enabled, merge_index_generations, record_index_scan,
@@ -817,20 +818,41 @@ class LeanAttrIndex:
                     caps = [gather_capacity(int(t),
                                             minimum=self.DEFAULT_CAPACITY)
                             for t in totals if int(t)]
+                from ..resilience import check_cancel, fault_point
                 for group, cap in zip(groups, caps):
-                    cols = []
-                    for gen in group:
-                        cols += list(self._sentinel_cols() if gen is None
-                                     else (gen.keys, gen.sec, gen.gid))
-                    self.dispatch_count += 1
-                    with device_span("query.scan.device", stage="gather",
-                                     runs=len(group)):
-                        packed = _attr_scan_coded(
-                            jklo, jkhi, jslo, jshi, jnp.asarray(qqid),
-                            *cols, capacity=cap, pos_bits=pos_bits)
-                        # the blocking device->host read belongs to the
-                        # dispatch; the host-side filtering does not
-                        flat = np.asarray(packed).ravel()
+                    # deadline yield point between group dispatches
+                    # (partial mode: unscanned groups' rows are simply
+                    # absent — candidates are a subset either way)
+                    if check_cancel("query.scan.device"):
+                        break
+                    try:
+                        fault_point("device.dispatch")
+                        cols = []
+                        for gen in group:
+                            cols += list(self._sentinel_cols()
+                                         if gen is None
+                                         else (gen.keys, gen.sec,
+                                               gen.gid))
+                        self.dispatch_count += 1
+                        with device_span("query.scan.device",
+                                         stage="gather",
+                                         runs=len(group)):
+                            packed = _attr_scan_coded(
+                                jklo, jkhi, jslo, jshi,
+                                jnp.asarray(qqid),
+                                *cols, capacity=cap, pos_bits=pos_bits)
+                            # the blocking device->host read belongs to
+                            # the dispatch; host-side filtering does not
+                            flat = np.asarray(packed).ravel()
+                    except Exception as e:  # noqa: BLE001
+                        coded = self._dispatch_failed(
+                            group, e, qklo, qkhi, qslo, qshi, qqid,
+                            pos_bits)
+                        if coded is None:
+                            raise
+                        if len(coded):
+                            parts.append(coded)
+                        continue
                     parts.append(flat[flat >= 0].astype(np.int64))
         host_cand_n = 0
         if host_gens:
@@ -864,6 +886,38 @@ class LeanAttrIndex:
             return merged
         mask = (np.int64(1) << pos_bits) - 1
         return np.unique(merged & mask)
+
+    def _dispatch_failed(self, group, exc, qklo, qkhi, qslo, qshi, qqid,
+                         pos_bits):
+        """Degraded execution at the dispatch boundary (ISSUE 16):
+        transient (memory-pressure) failures spill the failed group to
+        host and answer via host-seek candidates — the planner's
+        residual filter restores exactness; poison propagates (returns
+        None).  Mirrors z3_lean's contract."""
+        from ..resilience import (breaker, classify_device_failure,
+                                  retry_budget)
+        if classify_device_failure(exc) != "transient":
+            return None
+        gens = [g for g in group if g is not None]
+        for g in gens:
+            breaker.record_failure((id(self), g.gen_id))
+        if retry_budget() <= 0:
+            return None
+        with obs_span("query.scan.degraded", tier="attr",
+                      reason="transient", runs=len(gens)) as sp:
+            sp.set_attr("resilience.degraded", True)
+            obs_count(RESILIENCE_DEGRADED, len(gens))
+            obs_count(RESILIENCE_RETRIES)
+            for g in gens:
+                if g.tier == "device":
+                    with device_span("write.spill", gen_id=g.gen_id,
+                                     rows=int(g.n)):
+                        obs_count(WRITE_SPILLS)
+                        g.spill_to_host()
+            self._host_stack = None
+            stack = _HostAttrStack([g.spilled for g in gens])
+            return stack.candidates(qklo, qkhi, qslo, qshi, qqid,
+                                    pos_bits)
 
     # planner-facing surface (mirrors index/attribute.AttributeIndex) --
     #: date-tier marker: equality/IN narrow by a dtg window

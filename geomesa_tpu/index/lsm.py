@@ -78,14 +78,22 @@ def compact_incremental(plan, merge_one, budget_ms: float | None = None,
     # policy every index variant routes through, so compaction work is
     # traced here exactly once): groups merged + wall ms, feeding the
     # lean.compaction.ms rollup alongside the existing merge counters
+    from ..resilience import check_cancel, fault_point
     with obs_span("lean.compaction") as sp:
         while True:
+            # an armed fault or an expired deadline interrupts BETWEEN
+            # merges, where the store is always consistent: merge_one
+            # swaps a fully-built merged run in atomically, and the
+            # next compact() replans from whatever runs survive
+            fault_point("compaction.merge_step")
             merge_one(groups[0])
             merged += 1
             if max_groups is not None and merged >= max_groups:
                 break
             if (budget_ms is not None
                     and (time.perf_counter() - t0) * 1e3 >= budget_ms):
+                break
+            if check_cancel("compaction.merge_step"):
                 break
             groups = plan()
             if not groups:

@@ -358,7 +358,12 @@ class Z2PointIndex:
         if n_q == 0 or len(self) == 0:
             return [np.empty(0, dtype=np.int64) for _ in range(n_q)]
         rzlo, rzhi, rqid, ixy, bxs, bqid = [], [], [], [], [], []
+        from ..resilience import check_cancel
         for q, boxes in enumerate(boxes_list):
+            # deadline yield point between range decompositions (ISSUE
+            # 16): see z3.query_many
+            if check_cancel("query.decompose"):
+                break
             # per-window scan-ranges budget (see z3.query_many)
             plan = plan_z2_query(boxes, max_ranges, sfc=self.sfc)
             if plan.num_ranges == 0:

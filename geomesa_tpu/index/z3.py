@@ -610,7 +610,13 @@ class Z3PointIndex:
         ixy, boxes, bqid = [], [], []
         qtlo = np.empty(n_q, dtype=np.int64)
         qthi = np.empty(n_q, dtype=np.int64)
+        from ..resilience import check_cancel
         for q, (bxs, lo, hi) in enumerate(windows):
+            # deadline yield point between range decompositions (ISSUE
+            # 16): a partial break leaves the remaining windows with no
+            # ranges — they simply return empty hit lists
+            if check_cancel("query.decompose"):
+                break
             lo, hi = self._clamp_time(lo, hi)
             # the scan-ranges target applies PER window, as in the
             # reference (each window is an independent scan): finer

@@ -76,6 +76,7 @@ from ..metrics import (
     LEAN_COMPACTION_MERGES, LEAN_COMPACTION_ROWS,
     LEAN_DENSITY_CACHE_HITS, LEAN_DENSITY_CACHE_MISSES,
     LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
+    RESILIENCE_DEGRADED, RESILIENCE_RETRIES,
     WRITE_SEALS, WRITE_SPILLS, registry as _metrics,
 )
 from ..obs import device_span, obs_count, span as obs_span
@@ -1069,6 +1070,10 @@ class LeanZ3Index:
         return self.device_bytes() <= self._budget_after_sentinels()
 
     def _spill(self, gen: _Generation) -> None:
+        # injected BEFORE the transfer: a faulted spill leaves the
+        # generation on device, fully queryable (resilience chaos tests)
+        from ..resilience import fault_point
+        fault_point("host.spill")
         # the spill IS a blocking device→host transfer — a device span
         # so ingest traces carry its block-until-ready ms (ISSUE 12)
         with device_span("write.spill", gen_id=gen.gen_id,
@@ -1119,6 +1124,11 @@ class LeanZ3Index:
         if self._n_rows + len(x) > np.iinfo(np.int32).max:
             raise ValueError("LeanZ3Index positions are int32: "
                              "2,147M rows max per index/shard")
+        # injected at ENTRY, before any state mutates: a faulted append
+        # loses the whole slice atomically — rows are either fully
+        # indexed or absent, never half-ingested (resilience chaos tests)
+        from ..resilience import fault_point
+        fault_point("ingest.append")
         x = np.ascontiguousarray(x, dtype=np.float64)
         y = np.ascontiguousarray(y, dtype=np.float64)
         dtg_ms = np.ascontiguousarray(dtg_ms, dtype=np.int64)
@@ -1329,8 +1339,14 @@ class LeanZ3Index:
         w_boxes: list = []
         qtlo = np.empty(n_q, dtype=np.int64)
         qthi = np.empty(n_q, dtype=np.int64)
+        from ..resilience import check_cancel
         with obs_span("query.decompose", windows=n_q) as dsp:
             for q, (bxs, lo, hi) in enumerate(windows):
+                # yield point between range decompositions: a window
+                # not yet planned scans nothing (partial mode), so the
+                # planned windows' results stay exact
+                if check_cancel("query.decompose"):
+                    break
                 lo, hi = self._clamp_time(lo, hi)
                 qtlo[q], qthi[q] = lo, hi
                 bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
@@ -1388,8 +1404,11 @@ class LeanZ3Index:
                 totals = np.asarray(_lean_count_multi(rb, rlo, rhi,
                                                       *count_cols))
         coded_parts: list = []
+        # keys_cand also collects DEGRADED candidates from either
+        # device tier (ISSUE 16): the recheck below restores exactness
+        keys_cand: list = []
         # full tier: fused exact mask on device — survivors only
-        if full_gens:
+        if full_gens and not check_cancel("query.scan.full"):
             t_full = totals[:len(full_gens)]
             if int(t_full.sum()):
                 boxes_c, bqid_c = self._concat_boxes(w_boxes)
@@ -1397,19 +1416,19 @@ class LeanZ3Index:
                     full_gens, t_full, rb, rlo, rhi, rq, pos_bits,
                     exact_args=(jnp.asarray(boxes_c),
                                 jnp.asarray(bqid_c),
-                                jnp.asarray(qtlo), jnp.asarray(qthi)))
+                                jnp.asarray(qtlo), jnp.asarray(qthi)),
+                    ra=ra, degraded_out=keys_cand)
         # keys tier: candidate gather — host exact mask below
-        keys_cand: list = []
-        if keys_gens:
+        if keys_gens and not check_cancel("query.scan.keys"):
             t_keys = totals[len(full_gens):len(dev_gens)]
             if int(t_keys.sum()):
                 keys_cand += self._scan_tier(
                     keys_gens, t_keys, rb, rlo, rhi, rq, pos_bits,
-                    exact_args=None)
+                    exact_args=None, ra=ra, degraded_out=keys_cand)
         # host tier: stacked numpy seeks — flat in run count, and no
         # dispatch at all (round-4 VERDICT #9)
         host_cand_n = 0
-        if host_gens:
+        if host_gens and not check_cancel("query.scan.host"):
             with obs_span("query.scan.host", stage="seek",
                           runs=len(host_gens)):
                 if self._host_stack is None:
@@ -1934,7 +1953,7 @@ class LeanZ3Index:
         return boxes_c, bqid_c
 
     def _scan_tier(self, gens, totals, rb, rlo, rhi, rq, pos_bits,
-                   exact_args) -> list:
+                   exact_args, ra=None, degraded_out=None) -> list:
         """Run one tier's batched scan, falling back to per-generation
         dispatches (each sized by its OWN total) when the shared-
         capacity batched buffer would exceed BATCH_SCAN_BUDGET slots.
@@ -1943,11 +1962,36 @@ class LeanZ3Index:
         generations, and carrying the other 50 at the shared capacity
         tripled warm queries at 1B (measured; the probe already knows
         the per-generation totals).  Returns flat coded arrays
-        (padding stripped)."""
+        (padding stripped).
+
+        Degraded execution (ISSUE 16): with ``ra`` (the HOST range
+        dict) and ``degraded_out`` given, a transient device failure
+        (RESOURCE_EXHAUSTED) demotes the failed group to the host tier
+        and answers it via host-seek CANDIDATES appended to
+        ``degraded_out`` — the caller's host recheck keeps the result
+        exact.  Generations whose circuit breaker is open skip device
+        dispatch the same way.  Poison failures propagate."""
+        from ..resilience import breaker, check_cancel, fault_point
         tier = "full" if exact_args is not None else "keys"
         live = [(g, t) for g, t in zip(gens, totals) if int(t)]
         if not live:
             return []
+        can_degrade = ra is not None and degraded_out is not None
+        if can_degrade:
+            tripped = [g for g, _ in live
+                       if not breaker.allows((id(self), g.gen_id))]
+            if tripped:
+                # open circuit: this generation's device dispatch keeps
+                # tripping — route it through the host tier until the
+                # breaker cools down (no device attempt at all)
+                coded = self._degrade_to_host(tripped, ra, pos_bits,
+                                              tier, reason="breaker")
+                if len(coded):
+                    degraded_out.append(coded)
+                skip = set(id(g) for g in tripped)
+                live = [(g, t) for g, t in live if id(g) not in skip]
+                if not live:
+                    return []
         gens = [g for g, _ in live]
         totals = np.asarray([t for _, t in live])
         capacity = gather_capacity(int(totals.max()),
@@ -1963,43 +2007,99 @@ class LeanZ3Index:
         parts = []
         row_bytes = FULL_BYTES if tier == "full" else KEYS_BYTES
         for group, cap in zip(groups, caps):
-            rows = int(sum(g.n for g in group if g is not None))
-            with device_span("query.scan.device", tier=tier,
-                             runs=sum(1 for g in group
-                                      if g is not None),
-                             rows=rows, bytes=rows * row_bytes):
-                cols: list = []
-                for gen in group:
-                    if gen is None:
-                        cols += list(self._sentinel_cols(tier))
-                    elif tier == "full":
-                        cols += [gen.bins, gen.z, gen.pos, gen.x,
-                                 gen.y, gen.t, jnp.int32(gen.base)]
-                    else:
-                        cols += [gen.bins, gen.z, gen.pos]
-                self.dispatch_count += 1
-                if (tier == "full"
-                        and len(group) * cap >= _TWO_PHASE_MIN_SLOTS):
-                    # survivors-only transfer: keep the coded buffer
-                    # on device, read the hit count, compact (full
-                    # tier already masked exactly on device)
-                    packed, nhits = _lean_scan_exact_keep(
-                        rb, rlo, rhi, rq, *exact_args, *cols,
-                        capacity=cap, pos_bits=pos_bits)
-                    k = gather_capacity(max(int(nhits), 1), minimum=8)
+            # deadline yield point between group dispatches: partial
+            # mode stops STARTING groups (scanned ones stay exact)
+            if check_cancel("query.scan.device"):
+                break
+            try:
+                fault_point("device.dispatch")
+                rows = int(sum(g.n for g in group if g is not None))
+                with device_span("query.scan.device", tier=tier,
+                                 runs=sum(1 for g in group
+                                          if g is not None),
+                                 rows=rows, bytes=rows * row_bytes):
+                    cols: list = []
+                    for gen in group:
+                        if gen is None:
+                            cols += list(self._sentinel_cols(tier))
+                        elif tier == "full":
+                            cols += [gen.bins, gen.z, gen.pos, gen.x,
+                                     gen.y, gen.t, jnp.int32(gen.base)]
+                        else:
+                            cols += [gen.bins, gen.z, gen.pos]
                     self.dispatch_count += 1
-                    flat = np.asarray(_compact_coded(packed, k=k))
-                else:
-                    if tier == "full":
-                        packed = _lean_scan_exact_coded(
+                    if (tier == "full"
+                            and len(group) * cap >= _TWO_PHASE_MIN_SLOTS):
+                        # survivors-only transfer: keep the coded buffer
+                        # on device, read the hit count, compact (full
+                        # tier already masked exactly on device)
+                        packed, nhits = _lean_scan_exact_keep(
                             rb, rlo, rhi, rq, *exact_args, *cols,
                             capacity=cap, pos_bits=pos_bits)
+                        k = gather_capacity(max(int(nhits), 1), minimum=8)
+                        self.dispatch_count += 1
+                        flat = np.asarray(_compact_coded(packed, k=k))
                     else:
-                        packed = _lean_scan_coded(
-                            rb, rlo, rhi, rq, *cols,
-                            capacity=cap, pos_bits=pos_bits)
-                    flat = np.asarray(packed).ravel()
+                        if tier == "full":
+                            packed = _lean_scan_exact_coded(
+                                rb, rlo, rhi, rq, *exact_args, *cols,
+                                capacity=cap, pos_bits=pos_bits)
+                        else:
+                            packed = _lean_scan_coded(
+                                rb, rlo, rhi, rq, *cols,
+                                capacity=cap, pos_bits=pos_bits)
+                        flat = np.asarray(packed).ravel()
+            except Exception as e:  # noqa: BLE001 — classified below
+                coded = self._dispatch_failed(group, e, ra, pos_bits,
+                                              tier, can_degrade)
+                if coded is None:
+                    raise
+                if len(coded):
+                    degraded_out.append(coded)
+                continue
+            for g in group:
+                if g is not None:
+                    breaker.record_success((id(self), g.gen_id))
             # host-side candidate filtering is NOT device time — it
             # runs after the span so device_ms stays honest
             parts.append(flat[flat >= 0].astype(np.int64))
         return parts
+
+    def _dispatch_failed(self, group, exc, ra, pos_bits, tier,
+                         can_degrade):
+        """Classify a failed device dispatch.  Transient (memory
+        pressure) failures demote the group's generations to the host
+        tier and return host-seek candidates — one bounded retry, off
+        device, guaranteed not to re-OOM; returns None when the failure
+        must propagate (poison input, degradation unavailable, or a
+        zero retry budget)."""
+        from ..resilience import (breaker, classify_device_failure,
+                                  retry_budget)
+        if (not can_degrade
+                or classify_device_failure(exc) != "transient"):
+            return None
+        gens = [g for g in group if g is not None]
+        for g in gens:
+            breaker.record_failure((id(self), g.gen_id))
+        if retry_budget() <= 0:
+            return None
+        obs_count(RESILIENCE_RETRIES)
+        return self._degrade_to_host(gens, ra, pos_bits, tier,
+                                     reason="transient")
+
+    def _degrade_to_host(self, gens, ra, pos_bits, tier, reason):
+        """Demote ``gens`` to the host tier (the PR 4 spill path) and
+        answer their share of the scan as host-seek CANDIDATES — the
+        caller's payload recheck restores exactness.  Recorded as a
+        ``query.scan.degraded`` span with a ``resilience.degraded``
+        attr, not a user-facing error."""
+        with obs_span("query.scan.degraded", tier=tier, reason=reason,
+                      runs=len(gens)) as sp:
+            sp.set_attr("resilience.degraded", True)
+            obs_count(RESILIENCE_DEGRADED, len(gens))
+            for g in gens:
+                if g.tier != "host":
+                    self._spill(g)
+            stack = HostStack([g.run for g in gens])
+            return stack.candidates(ra["rbin"], ra["rzlo"], ra["rzhi"],
+                                    ra["rqid"], pos_bits)

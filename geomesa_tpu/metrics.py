@@ -37,7 +37,12 @@ __all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "LEAN_DEVICE_DISPATCHES", "LEAN_DEVICE_MS",
            "JAX_COMPILE_COUNT", "JAX_COMPILE_MS", "JAX_COMPILE_FALLBACK",
            "PLAN_ESTIMATE_RATIO", "WRITE_SEALS", "WRITE_SPILLS",
-           "ARROW_CHUNKS", "ARROW_ROWS", "ARROW_BYTES"]
+           "ARROW_CHUNKS", "ARROW_ROWS", "ARROW_BYTES",
+           "QUERY_TIMEOUTS", "QUERY_SHED",
+           "RESILIENCE_DEGRADED", "RESILIENCE_RETRIES",
+           "RESILIENCE_BREAKER_OPEN", "RESILIENCE_FAULTS",
+           "RESILIENCE_ADMISSION_ACTIVE", "RESILIENCE_ADMISSION_QUEUE_MS",
+           "RESILIENCE_ADMISSION_ADMITTED"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -89,6 +94,21 @@ WRITE_SPILLS = "write.spills"
 ARROW_CHUNKS = "arrow.chunks"
 ARROW_ROWS = "arrow.rows"
 ARROW_BYTES = "arrow.ipc_bytes"
+#: resilience layer (ISSUE 16, geomesa_tpu/resilience): deadline
+#: expiries and admission sheds are QUERY-plane outcomes (a caller saw
+#: a 504/503 or a partial result), so they live under ``query.``;
+#: the ``resilience.`` namespace carries the layer's own mechanics —
+#: degraded (host-demoted) dispatches, bounded retries, circuit-breaker
+#: rejections, injected faults, and the admission gate's live state
+QUERY_TIMEOUTS = "query.timeout"
+QUERY_SHED = "query.shed"
+RESILIENCE_DEGRADED = "resilience.degraded"
+RESILIENCE_RETRIES = "resilience.retries"
+RESILIENCE_BREAKER_OPEN = "resilience.breaker.open"
+RESILIENCE_FAULTS = "resilience.faults.injected"
+RESILIENCE_ADMISSION_ACTIVE = "resilience.admission.active"
+RESILIENCE_ADMISSION_QUEUE_MS = "resilience.admission.queue_ms"
+RESILIENCE_ADMISSION_ADMITTED = "resilience.admission.admitted"
 
 #: the metric naming contract (docs/observability.md): every registry
 #: key lives under one of these top-level namespaces, dot-separated,
@@ -97,7 +117,8 @@ ARROW_BYTES = "arrow.ipc_bytes"
 #: tier-1 lint test (tests/test_zzz_metric_lint.py) walks the full
 #: registry after the suite and fails on any drive-by key outside it.
 METRIC_NAMESPACES = ("query", "write", "lean", "jax", "web", "storage",
-                     "plan", "obs", "pallas", "heat", "job", "arrow")
+                     "plan", "obs", "pallas", "heat", "job", "arrow",
+                     "resilience")
 _METRIC_KEY_RE = re.compile(
     r"^(?:" + "|".join(METRIC_NAMESPACES)
     + r")(?:\.[A-Za-z0-9_:\-]+)+$")

@@ -89,7 +89,14 @@ def run_packed_query(dispatch, capacity: int):
     ``(sorted_values int64, capacity)``.
     """
     import numpy as np
+    from ..resilience import check_cancel
     while True:
+        # deadline yield point shared by every full-fat z2/z3 entry
+        # (ISSUE 16): checked before each dispatch, including capacity
+        # regrows; partial mode returns what a caller can live with —
+        # nothing — rather than a truncated gather
+        if check_cancel("query.scan.device"):
+            return np.empty(0, dtype=np.int64), capacity
         out = np.asarray(dispatch(capacity))
         total = (int(out[0]) << _TOTAL_SPLIT) | int(out[1])
         if total <= capacity:

@@ -747,14 +747,29 @@ class ShardedLeanAttrIndex:
                                             minimum=self.DEFAULT_CAPACITY)
                             for g in range(len(dev_gens))
                             if int(gen_tot[g])]
+                from ..resilience import breaker, classify_device_failure
                 for group, cap in zip(groups, caps):
-                    cols: list = []
-                    for gen in group:
-                        cols += [gen.keys, gen.sec, gen.gid]
-                    self.dispatch_count += 1
-                    packed = _fetch_global(_scan_program(
-                        self.mesh, len(group), cap, pos_bits)(
-                        *jk, jnp.asarray(qqid), *cols))
+                    # ISSUE 16: these dispatches are mesh collectives —
+                    # no per-process deadline break and no local
+                    # demote-and-retry (a lone process bailing would
+                    # strand its peers).  Failures still classify so the
+                    # breaker/metrics see device pressure even where
+                    # degraded routing cannot run (parallel/lean.py
+                    # precedent).
+                    try:
+                        cols: list = []
+                        for gen in group:
+                            cols += [gen.keys, gen.sec, gen.gid]
+                        self.dispatch_count += 1
+                        packed = _fetch_global(_scan_program(
+                            self.mesh, len(group), cap, pos_bits)(
+                            *jk, jnp.asarray(qqid), *cols))
+                    except Exception as e:  # noqa: BLE001 — classify
+                        if classify_device_failure(e) == "transient":
+                            for gen in group:
+                                breaker.record_failure(
+                                    (id(self), gen.gen_id))
+                        raise
                     flat = packed.ravel()
                     parts.append(flat[flat >= 0])
         host_cand_n = 0

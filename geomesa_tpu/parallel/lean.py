@@ -976,8 +976,17 @@ class ShardedLeanZ3Index:
         qtlo = np.empty(n_q, dtype=np.int64)
         qthi = np.empty(n_q, dtype=np.int64)
         from ..index.z3_lean import _MAX_RANGES_PER_WINDOW, _bins_spanned
+        from ..resilience import check_cancel
         with obs_span("query.decompose", windows=n_q) as dsp:
             for q, (bxs, lo, hi) in enumerate(windows):
+                # per-process raise BETWEEN collective phases — the
+                # planner's QueryTimeoutError precedent.  The PARTIAL
+                # break is single-controller only: under multihost a
+                # wall-clock break could plan fewer ranges than peers
+                # and diverge the collective shapes (a raise at least
+                # fails loudly, like the legacy reaper)
+                if not self._multihost and check_cancel("query.decompose"):
+                    break
                 lo, hi = self._clamp_time(lo, hi)
                 qtlo[q], qthi[q] = lo, hi
                 bxs = np.atleast_2d(np.asarray(bxs, dtype=np.float64))
@@ -1031,9 +1040,15 @@ class ShardedLeanZ3Index:
                     _count_program(self.mesh, len(padded))(
                         rb, rlo, rhi, *count_cols))    # (n_shards, G_pad)
 
+        # deadline yield points between tier phases: single-controller
+        # only (see the decompose note — a lone process skipping a
+        # collective tier dispatch would strand its peers)
+        def _yield_point(point: str) -> bool:
+            return (not self._multihost) and check_cancel(point)
+
         exact_parts: list = []      # full tier — true hits already
         cand_parts: list = []       # keys/host — need the host mask
-        if full_gens:
+        if full_gens and not _yield_point("query.scan.full"):
             t_full = totals[:, :len(full_gens)]
             if int(t_full.sum()):
                 boxes_c, bqid_c = self._concat_boxes(w_boxes)
@@ -1042,7 +1057,7 @@ class ShardedLeanZ3Index:
                     exact_args=(jnp.asarray(boxes_c),
                                 jnp.asarray(bqid_c),
                                 jnp.asarray(qtlo), jnp.asarray(qthi)))
-        if keys_gens:
+        if keys_gens and not _yield_point("query.scan.keys"):
             t_keys = totals[:, len(full_gens):len(dev_gens)]
             if int(t_keys.sum()):
                 cand_parts += self._scan_tier(
@@ -1052,7 +1067,7 @@ class ShardedLeanZ3Index:
         # runs (its local rows) — flat in run count, no dispatch at all
         # (round-4 VERDICT #9)
         host_cand_n = 0
-        if host_gens:
+        if host_gens and not _yield_point("query.scan.host"):
             with obs_span("query.scan.host", stage="seek",
                           runs=len(host_gens)):
                 coded = self._host_runs_stack(host_gens).candidates(
@@ -1390,25 +1405,39 @@ class ShardedLeanZ3Index:
                                     minimum=self.DEFAULT_CAPACITY)
                     for g in range(len(gens)) if int(gen_tot[g])]
         parts = []
+        from ..resilience import breaker, classify_device_failure
         for group, cap in zip(groups, caps):
-            with device_span("query.scan.device", tier=tier,
-                             runs=len(group)):
-                scan_cols: list = []
-                for gen in group:
+            # NOTE (ISSUE 16): no per-process deadline break and no
+            # demote-and-retry INSIDE this loop — these dispatches are
+            # mesh collectives, and a process bailing or retrying alone
+            # would strand its peers (deadline checks live at the
+            # phase boundaries in query_many, the planner precedent).
+            # Failures still classify, so the breaker/metrics see
+            # device pressure even where degraded routing cannot run.
+            try:
+                with device_span("query.scan.device", tier=tier,
+                                 runs=len(group)):
+                    scan_cols: list = []
+                    for gen in group:
+                        if tier == "full":
+                            scan_cols += [gen.bins, gen.z, gen.pos,
+                                          gen.x, gen.y, gen.t]
+                        else:
+                            scan_cols += [gen.bins, gen.z, gen.pos]
+                    self.dispatch_count += 1
                     if tier == "full":
-                        scan_cols += [gen.bins, gen.z, gen.pos,
-                                      gen.x, gen.y, gen.t]
+                        packed = _fetch_global(_scan_program_exact(
+                            self.mesh, len(group), cap, pos_bits)(
+                            rb, rlo, rhi, rq, *exact_args, *scan_cols))
                     else:
-                        scan_cols += [gen.bins, gen.z, gen.pos]
-                self.dispatch_count += 1
-                if tier == "full":
-                    packed = _fetch_global(_scan_program_exact(
-                        self.mesh, len(group), cap, pos_bits)(
-                        rb, rlo, rhi, rq, *exact_args, *scan_cols))
-                else:
-                    packed = _fetch_global(_scan_program(
-                        self.mesh, len(group), cap, pos_bits)(
-                        rb, rlo, rhi, rq, *scan_cols))
+                        packed = _fetch_global(_scan_program(
+                            self.mesh, len(group), cap, pos_bits)(
+                            rb, rlo, rhi, rq, *scan_cols))
+            except Exception as e:  # noqa: BLE001 — classify + rethrow
+                if classify_device_failure(e) == "transient":
+                    for gen in group:
+                        breaker.record_failure((id(self), gen.gen_id))
+                raise
             # host-side filtering after the span — device_ms must not
             # absorb numpy post-processing (see z3_lean._scan_tier)
             part = packed.ravel()

@@ -233,13 +233,21 @@ class ShardedXZ2Index:
                         "rzhi": ranges[:, 1].astype(np.int64)},
                        pad_pow2(len(ranges)))
         capacity = self._capacity
+        from ..resilience import breaker, classify_device_failure
         while True:
-            scan = _xz2_scan_program(self.mesh, capacity)
-            packed, totals = scan(
-                self.codes, self.gid, *self.bbox_cols,
-                jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
-                jnp.float64(env.xmin), jnp.float64(env.ymin),
-                jnp.float64(env.xmax), jnp.float64(env.ymax))
+            # ISSUE 16: collective dispatch — classify-only, no local
+            # retry/degrade (parallel/lean.py precedent)
+            try:
+                scan = _xz2_scan_program(self.mesh, capacity)
+                packed, totals = scan(
+                    self.codes, self.gid, *self.bbox_cols,
+                    jnp.asarray(r["rzlo"]), jnp.asarray(r["rzhi"]),
+                    jnp.float64(env.xmin), jnp.float64(env.ymin),
+                    jnp.float64(env.xmax), jnp.float64(env.ymax))
+            except Exception as e:  # noqa: BLE001 — classify + rethrow
+                if classify_device_failure(e) == "transient":
+                    breaker.record_failure((id(self), "xz2"))
+                raise
             totals = _fetch_global(totals)
             if int(totals.max(initial=0)) <= capacity:
                 self._capacity = capacity
@@ -364,15 +372,22 @@ class ShardedXZ3Index:
                         "rzhi": np.concatenate(rhi)},
                        pad_pow2(sum(len(a) for a in rbin)))
         capacity = self._capacity
+        from ..resilience import breaker, classify_device_failure
         while True:
-            scan = _xz3_scan_program(self.mesh, capacity)
-            packed, totals = scan(
-                self.bins, self.codes, self.gid, *self.bbox_cols, self.dtg,
-                jnp.asarray(r["rbin"]), jnp.asarray(r["rzlo"]),
-                jnp.asarray(r["rzhi"]),
-                jnp.float64(env.xmin), jnp.float64(env.ymin),
-                jnp.float64(env.xmax), jnp.float64(env.ymax),
-                jnp.int64(t_lo_ms), jnp.int64(t_hi_ms))
+            try:
+                scan = _xz3_scan_program(self.mesh, capacity)
+                packed, totals = scan(
+                    self.bins, self.codes, self.gid, *self.bbox_cols,
+                    self.dtg,
+                    jnp.asarray(r["rbin"]), jnp.asarray(r["rzlo"]),
+                    jnp.asarray(r["rzhi"]),
+                    jnp.float64(env.xmin), jnp.float64(env.ymin),
+                    jnp.float64(env.xmax), jnp.float64(env.ymax),
+                    jnp.int64(t_lo_ms), jnp.int64(t_hi_ms))
+            except Exception as e:  # noqa: BLE001 — classify + rethrow
+                if classify_device_failure(e) == "transient":
+                    breaker.record_failure((id(self), "xz3"))
+                raise
             totals = _fetch_global(totals)
             if int(totals.max(initial=0)) <= capacity:
                 self._capacity = capacity

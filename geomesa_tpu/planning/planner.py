@@ -63,6 +63,10 @@ class QueryResult:
     #: ``positions`` single-host; under multihost ``positions`` are
     #: global gids and this is the local slice
     local_rows: np.ndarray | None = None
+    #: set when a ``timeout_ms`` deadline expired mid-scan and the
+    #: caller asked for ``partial_results`` — the rows present are
+    #: exact hits over what WAS scanned before the deadline (ISSUE 16)
+    timed_out: bool = False
 
 
 class QueryTimeoutError(TimeoutError):
@@ -100,11 +104,18 @@ class QueryPlanner:
         timeout_s = QueryProperties.QUERY_TIMEOUT.to_int()
         deadline = (time.perf_counter() + timeout_s) if timeout_s else None
 
+        from ..resilience import check_cancel
+
         def check_deadline(stage: str):
             if deadline is not None and time.perf_counter() > deadline:
                 raise QueryTimeoutError(
                     f"query on {self.sft.name!r} exceeded "
                     f"{timeout_s}s during {stage}")
+            # the per-query ``timeout_ms`` deadline (ISSUE 16) checks at
+            # the same phase boundaries the legacy reaper does: raises
+            # are per-process BETWEEN collective phases, the precedent
+            # this module already set for multihost safety
+            check_cancel(f"planner.{stage}")
 
         from ..obs import span as obs_span
         from ..utils.profiling import profile

@@ -1,0 +1,26 @@
+"""Resilience layer (ISSUE 16): query deadlines + cooperative
+cancellation, HBM admission control, degraded execution with a circuit
+breaker, and a deterministic fault-injection harness.
+
+Import surface is jax-free: everything here is host-side bookkeeping
+(contextvars, locks, perf_counter comparisons) threaded through the
+query/ingest/serving planes.  See docs/resilience.md for semantics.
+"""
+
+from .admission import AdmissionGate, AdmissionToken, Backpressure
+from .admission import gate as admission_gate
+from .deadline import (Cancelled, CancelScope, QueryTimeout, check_cancel,
+                       current_scope, deadline_scope)
+from .degrade import CircuitBreaker, breaker, classify_device_failure
+from .degrade import retry_budget
+from .faults import FAULT_POINTS, FaultInjected, FaultRegistry, fault_point
+from .faults import registry as fault_registry
+
+__all__ = [
+    "QueryTimeout", "Cancelled", "CancelScope", "deadline_scope",
+    "check_cancel", "current_scope",
+    "Backpressure", "AdmissionToken", "AdmissionGate", "admission_gate",
+    "classify_device_failure", "CircuitBreaker", "breaker", "retry_budget",
+    "FAULT_POINTS", "FaultInjected", "FaultRegistry", "fault_point",
+    "fault_registry",
+]

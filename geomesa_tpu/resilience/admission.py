@@ -1,0 +1,158 @@
+"""Admission control for the query plane (ISSUE 16).
+
+A token gate in front of the datastore's query entry points: each query
+acquires an :class:`AdmissionToken` before planning and releases it when
+its results are fully delivered (for streamed Arrow responses that is
+after the LAST chunk drains, not when the generator is created).  Two
+shed conditions, both config-driven and both OFF by default:
+
+- concurrency: more than ``geomesa.resilience.admission.max.concurrent``
+  in-flight queries;
+- HBM pressure: the live ``storage.total.device_bytes`` gauge above
+  ``geomesa.resilience.hbm.headroom`` bytes (the gauge is maintained by
+  obs/resource.py's storage publisher — size the headroom below the
+  device's usable HBM minus the compiled-program/workspace reserve, see
+  docs/resilience.md).
+
+An over-budget request queues up to ``admission.queue.ms`` (a brief
+wait absorbs bursts without queueing unboundedly), then sheds with
+:class:`Backpressure`; web/app.py maps that to ``503 + Retry-After``.
+Token release is idempotent — the chaos tests assert zero leaked tokens
+after repeated shed/timeout/abort cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import config as _config
+from .. import metrics as _metrics
+from ..config import ResilienceProperties
+from ..metrics import (QUERY_SHED, RESILIENCE_ADMISSION_ADMITTED,
+                       RESILIENCE_ADMISSION_ACTIVE,
+                       RESILIENCE_ADMISSION_QUEUE_MS)
+
+__all__ = ["Backpressure", "AdmissionToken", "AdmissionGate", "gate"]
+
+#: the storage gauge the HBM check reads (obs/resource.py publishes it)
+_DEVICE_BYTES_GAUGE = "storage.total.device_bytes"
+
+
+class Backpressure(RuntimeError):
+    """The admission gate shed this request.  web/app.py maps it to
+    ``503`` with ``Retry-After: ceil(retry_after_s)``."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionToken:
+    """One admitted query's slot.  ``release()`` is idempotent: the
+    abort/timeout/normal-completion paths may all reach it without
+    double-decrementing the in-flight count."""
+
+    __slots__ = ("_gate", "_released")
+
+    def __init__(self, gate: "AdmissionGate | None"):
+        self._gate = gate
+        self._released = False
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        if self._gate is not None:
+            self._gate._release()
+
+
+class AdmissionGate:
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self._inflight = 0
+        self._gen = -1
+        self._max = 0
+        self._queue_ms = 50.0
+        self._headroom = 0
+
+    def _refresh_locked(self) -> None:
+        gen = _config.config_generation()
+        if gen == self._gen:
+            return
+        self._max = int(
+            ResilienceProperties.ADMISSION_MAX_CONCURRENT.get() or 0)
+        self._queue_ms = float(
+            ResilienceProperties.ADMISSION_QUEUE_MS.get() or 0.0)
+        self._headroom = int(ResilienceProperties.HBM_HEADROOM.get() or 0)
+        self._gen = gen
+
+    def _hbm_over_budget(self) -> bool:
+        if self._headroom <= 0:
+            return False
+        return (_metrics.registry.gauge(_DEVICE_BYTES_GAUGE).value
+                > self._headroom)
+
+    @property
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    def acquire(self, schema: str = "") -> AdmissionToken:
+        t0 = time.perf_counter()
+        with self._cond:
+            self._refresh_locked()
+            if self._max <= 0 and self._headroom <= 0:
+                # gate disabled: admit unconditionally but still track
+                # in-flight, so enabling the gate mid-flight sees truth
+                self._inflight += 1
+                _metrics.registry.gauge(
+                    RESILIENCE_ADMISSION_ACTIVE).set(self._inflight)
+                return AdmissionToken(self)
+            queue_deadline = t0 + self._queue_ms / 1000.0
+            while ((self._max > 0 and self._inflight >= self._max)
+                   or self._hbm_over_budget()):
+                remaining = queue_deadline - time.perf_counter()
+                if remaining <= 0:
+                    _metrics.registry.counter(QUERY_SHED).inc()
+                    reason = ("concurrency" if (self._max > 0 and
+                                                self._inflight >= self._max)
+                              else "hbm")
+                    raise Backpressure(
+                        f"admission shed ({reason}) for "
+                        f"{schema or 'query'}: {self._inflight} in flight",
+                        retry_after_s=max(0.05, self._queue_ms / 1000.0))
+                self._cond.wait(remaining)
+            self._inflight += 1
+            _metrics.registry.gauge(
+                RESILIENCE_ADMISSION_ACTIVE).set(self._inflight)
+        _metrics.registry.timer(RESILIENCE_ADMISSION_QUEUE_MS).update(
+            (time.perf_counter() - t0) * 1000.0)
+        _metrics.registry.counter(RESILIENCE_ADMISSION_ADMITTED).inc()
+        return AdmissionToken(self)
+
+    def reset(self) -> None:
+        """Zero the in-flight count and wake queued waiters — a
+        leaked-token recovery hook for tests and operators, NOT part of
+        the query path (live queries double-release harmlessly: tokens
+        are idempotent and the count floors at zero)."""
+        with self._cond:
+            self._inflight = 0
+            _metrics.registry.gauge(
+                RESILIENCE_ADMISSION_ACTIVE).set(0)
+            self._cond.notify_all()
+
+    def _release(self) -> None:
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            _metrics.registry.gauge(
+                RESILIENCE_ADMISSION_ACTIVE).set(self._inflight)
+            self._cond.notify_all()
+
+
+#: process-wide gate (one HBM, one process — the unit that sheds)
+gate = AdmissionGate()

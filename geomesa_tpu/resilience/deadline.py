@@ -1,0 +1,155 @@
+"""Query deadlines and cooperative cancellation (ISSUE 16).
+
+Mirrors the obs/trace.py propagation pattern: a contextvar carries the
+active :class:`CancelScope` so deeply-nested scan code never threads a
+deadline argument through its signatures — it calls :func:`check_cancel`
+at natural yield points (between generation scans, range-decomposition
+batches, Arrow chunks, compaction merge steps) and the ambient scope
+decides whether to keep going, stop with partial results, or raise.
+
+The checks are pure host-side ``time.perf_counter()`` comparisons: no
+device sync, no data-dependent Python branching inside traced code, so
+a deadline on a warm query cannot introduce a host sync or a recompile
+(the gm-lint host-sync check covers the instrumented hot paths).
+
+Generators need care: a generator's body runs AFTER the function that
+created it returned, so an ambient scope installed around the creating
+call is gone by iteration time.  Streaming code (arrow/stream.py)
+therefore takes the scope as an explicit argument and passes it to
+:func:`check_cancel` via ``scope=`` instead of relying on the
+contextvar.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+
+from .. import metrics as _metrics
+from ..metrics import QUERY_TIMEOUTS
+
+__all__ = ["QueryTimeout", "Cancelled", "CancelScope", "deadline_scope",
+           "check_cancel", "current_scope"]
+
+
+class QueryTimeout(TimeoutError):
+    """The query's ``timeout_ms`` deadline expired and partial results
+    were not requested.  web/app.py maps this to ``504``."""
+
+    def __init__(self, message: str, elapsed_ms: float | None = None):
+        super().__init__(message)
+        self.elapsed_ms = elapsed_ms
+
+
+class Cancelled(RuntimeError):
+    """Raised at the next yield point after :meth:`CancelScope.cancel`."""
+
+
+class CancelScope:
+    """One query's deadline + cancellation state.
+
+    ``timed_out`` latches once the deadline is first observed expired;
+    with ``partial=True`` the scan layers use it to stop starting new
+    work while still finishing the exactness-preserving steps (host
+    recheck) over what was already scanned.
+    """
+
+    __slots__ = ("timeout_ms", "partial", "timed_out", "cancelled",
+                 "_start_t", "_deadline_t", "_counted")
+
+    def __init__(self, timeout_ms: float | None = None,
+                 partial: bool = False):
+        self.timeout_ms = timeout_ms
+        self.partial = bool(partial)
+        self.timed_out = False
+        self.cancelled = False
+        self._start_t = time.perf_counter()
+        self._deadline_t = (self._start_t + float(timeout_ms) / 1000.0
+                            if timeout_ms else None)
+        self._counted = False
+
+    def expired(self) -> bool:
+        return (self._deadline_t is not None
+                and time.perf_counter() >= self._deadline_t)
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def elapsed_ms(self) -> float:
+        return (time.perf_counter() - self._start_t) * 1000.0
+
+    def remaining_ms(self) -> float | None:
+        """Time left, or None when no deadline is set (never negative)."""
+        if self._deadline_t is None:
+            return None
+        return max(0.0, (self._deadline_t - time.perf_counter()) * 1000.0)
+
+    def poll(self) -> bool:
+        """Non-raising check for streaming drains: True once cancelled
+        or expired, latching ``timed_out`` (and counting
+        ``query.timeout`` once) on first expiry.  A drain that must end
+        with a well-formed EOS breaks on True instead of raising
+        mid-stream."""
+        if self.cancelled:
+            return True
+        if not self.expired():
+            return False
+        self.timed_out = True
+        if not self._counted:
+            self._counted = True
+            _metrics.registry.counter(QUERY_TIMEOUTS).inc()
+        return True
+
+
+_current_scope: contextvars.ContextVar = contextvars.ContextVar(
+    "geomesa_resilience_scope", default=None)
+
+
+def current_scope() -> CancelScope | None:
+    return _current_scope.get()
+
+
+@contextlib.contextmanager
+def deadline_scope(timeout_ms: float | None = None, partial: bool = False,
+                   scope: CancelScope | None = None):
+    """Install a :class:`CancelScope` for the body (nestable; the inner
+    scope shadows the outer one for the duration).  Pass ``scope=`` to
+    install an externally-created scope — the datastore does this so it
+    can read ``timed_out`` after the body exits."""
+    if scope is None:
+        scope = CancelScope(timeout_ms, partial)
+    token = _current_scope.set(scope)
+    try:
+        yield scope
+    finally:
+        _current_scope.reset(token)
+
+
+def check_cancel(point: str = "", scope: CancelScope | None = None) -> bool:
+    """The cooperative yield point.
+
+    Returns False (fast, no allocation) when no scope is active or the
+    deadline has not expired.  On expiry: latches ``timed_out``, counts
+    ``query.timeout`` once per scope, then either returns True (partial
+    mode — the caller stops starting new work) or raises
+    :class:`QueryTimeout`.  An explicitly cancelled scope always raises
+    :class:`Cancelled`.
+    """
+    s = scope if scope is not None else _current_scope.get()
+    if s is None:
+        return False
+    if s.cancelled:
+        raise Cancelled(f"query cancelled at {point or 'yield point'}")
+    if not s.expired():
+        return False
+    s.timed_out = True
+    if not s._counted:
+        s._counted = True
+        _metrics.registry.counter(QUERY_TIMEOUTS).inc()
+    if s.partial:
+        return True
+    raise QueryTimeout(
+        f"deadline of {s.timeout_ms} ms expired at "
+        f"{point or 'yield point'} after {s.elapsed_ms():.1f} ms",
+        elapsed_ms=s.elapsed_ms())

@@ -1,0 +1,106 @@
+"""Degraded execution: device-failure classification, bounded retry
+budget, and a per-generation circuit breaker (ISSUE 16).
+
+Dispatch boundaries in the lean indexes wrap device scans with
+``try/except``; on failure they ask :func:`classify_device_failure`
+whether the error is *transient* (device memory pressure — the scan can
+succeed after demoting the offending generations' payload to host) or
+*poison* (bad input / logic error — retrying would fail identically, so
+it propagates).  A transient classification triggers at most
+``geomesa.resilience.retry.max`` demote-and-retry rounds, recorded as a
+``resilience.degraded`` span attribute rather than a user-facing error.
+
+The circuit breaker keeps a generation that trips repeatedly from
+re-admitting device dispatch at all: after ``breaker.threshold``
+consecutive transient failures the key's circuit opens for
+``breaker.cooldown.s`` seconds, during which callers route that
+generation through the host tier directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import metrics as _metrics
+from ..config import ResilienceProperties
+from ..metrics import RESILIENCE_BREAKER_OPEN
+from .faults import FaultInjected
+
+__all__ = ["classify_device_failure", "CircuitBreaker", "breaker",
+           "retry_budget"]
+
+#: substrings (upper-cased match) that mark a device failure as memory
+#: pressure rather than poison input.  XLA/TPU OOMs surface as
+#: RESOURCE_EXHAUSTED status payloads; CPU jax raises bare
+#: "out of memory" RuntimeErrors.
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY",
+                      "HBM OOM", "ALLOCATION FAILURE")
+
+
+def classify_device_failure(exc: BaseException) -> str:
+    """``'transient'`` (retry after demotion) or ``'poison'``
+    (propagate).  Injected faults classify by their armed kind."""
+    if isinstance(exc, FaultInjected):
+        return "transient" if exc.kind == "oom" else "poison"
+    msg = str(exc).upper()
+    for marker in _TRANSIENT_MARKERS:
+        if marker in msg:
+            return "transient"
+    return "poison"
+
+
+def retry_budget() -> int:
+    return int(ResilienceProperties.RETRY_MAX.get() or 0)
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker keyed by an opaque hashable (the
+    lean indexes use ``(catalog_key, gen_id)``).  Closed → counts
+    failures; at threshold → open for the cooldown (``allows`` False,
+    counted as ``resilience.breaker.open``); after cooldown → half-open
+    (one trial dispatch; success resets, failure re-opens)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: dict = {}  # key -> [consecutive_failures, open_until_t]
+
+    def allows(self, key) -> bool:
+        with self._lock:
+            st = self._state.get(key)
+            if st is None:
+                return True
+            failures, open_until = st
+            threshold = int(
+                ResilienceProperties.BREAKER_THRESHOLD.get() or 0)
+            if threshold <= 0 or failures < threshold:
+                return True
+            if time.monotonic() >= open_until:
+                # half-open: admit one trial; a failure re-opens below
+                st[0] = threshold - 1
+                return True
+        _metrics.registry.counter(RESILIENCE_BREAKER_OPEN).inc()
+        return False
+
+    def record_failure(self, key) -> None:
+        with self._lock:
+            st = self._state.setdefault(key, [0, 0.0])
+            st[0] += 1
+            threshold = int(
+                ResilienceProperties.BREAKER_THRESHOLD.get() or 0)
+            if threshold > 0 and st[0] >= threshold:
+                cooldown = float(
+                    ResilienceProperties.BREAKER_COOLDOWN_S.get() or 0.0)
+                st[1] = time.monotonic() + cooldown
+
+    def record_success(self, key) -> None:
+        with self._lock:
+            self._state.pop(key, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state.clear()
+
+
+#: process-wide breaker (generations are process-local objects)
+breaker = CircuitBreaker()

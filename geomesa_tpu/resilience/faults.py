@@ -1,0 +1,128 @@
+"""Deterministic fault injection (ISSUE 16).
+
+A fixed registry of named injection points threaded through the write,
+query, streaming, and compaction planes.  Arming is pure config —
+``geomesa.resilience.fault.points`` holds a comma-separated spec of
+``point[:trigger][=kind]`` entries:
+
+- bare ``point`` fires on every hit;
+- integer trigger (``compaction.merge_step:2``) fires on exactly the
+  Nth hit of that point (then never again until re-armed);
+- float trigger < 1 (``device.dispatch:0.25``) fires with that
+  probability from a ``Random(geomesa.resilience.fault.seed)`` stream —
+  same seed + same hit order = same failures, so chaos runs replay;
+- ``kind`` is ``error`` (default: poison, propagates) or ``oom``
+  (message carries RESOURCE_EXHAUSTED so degrade.py classifies it
+  transient and exercises the demote-and-retry path).
+
+The catalog of known points is closed: arming an unknown name raises at
+the first injection check, and gm-lint's fault-point check validates
+every literal reaching :func:`fault_point` against the catalog table in
+docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from .. import config as _config
+from .. import metrics as _metrics
+from ..config import ResilienceProperties
+from ..metrics import RESILIENCE_FAULTS
+
+__all__ = ["FAULT_POINTS", "FaultInjected", "FaultRegistry", "fault_point",
+           "registry"]
+
+#: the closed catalog (docs/resilience.md "Fault-point catalog").
+#: ``ingest.append`` stands where the issue sketch said ``wal.append``:
+#: this store has no WAL — the append entry point is the equivalent
+#: boundary between "row accepted" and "row indexed".
+FAULT_POINTS = ("device.dispatch", "host.spill", "arrow.flush",
+                "compaction.merge_step", "ingest.append")
+
+
+class FaultInjected(RuntimeError):
+    """An injected failure.  ``kind='oom'`` messages carry the
+    RESOURCE_EXHAUSTED marker so the failure classifier treats them as
+    transient device pressure."""
+
+    def __init__(self, point: str, kind: str = "error"):
+        marker = "RESOURCE_EXHAUSTED" if kind == "oom" else "INJECTED_FAULT"
+        super().__init__(f"{marker}: injected fault at {point!r}")
+        self.point = point
+        self.kind = kind
+
+
+class FaultRegistry:
+    """Per-process injection state.  Disabled (the tier-1 default) the
+    check is one generation compare + one empty-dict truth test — cheap
+    enough for scan hot paths."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gen = -1
+        self._arms: dict[str, tuple] = {}
+        self._hits: dict[str, int] = {}
+        self._rng = random.Random(0)
+
+    def _refresh_locked(self) -> None:
+        gen = _config.config_generation()
+        if gen == self._gen:
+            return
+        spec = str(ResilienceProperties.FAULT_POINTS.get() or "")
+        arms: dict[str, tuple] = {}
+        for part in (p.strip() for p in spec.split(",") if p.strip()):
+            kind = "error"
+            if "=" in part:
+                part, kind = part.rsplit("=", 1)
+            trigger = None
+            if ":" in part:
+                part, raw = part.rsplit(":", 1)
+                trigger = float(raw) if "." in raw else int(raw)
+            if part not in FAULT_POINTS:
+                raise ValueError(
+                    f"unknown fault point {part!r}; known: {FAULT_POINTS}")
+            if kind not in ("error", "oom"):
+                raise ValueError(f"unknown fault kind {kind!r} for {part!r}")
+            arms[part] = (trigger, kind)
+        self._arms = arms
+        self._hits = {}
+        self._rng = random.Random(
+            int(ResilienceProperties.FAULT_SEED.get() or 0))
+        self._gen = gen
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def maybe_fail(self, point: str) -> None:
+        # disabled fast path: no lock, no allocation (hot scan loops)
+        if _config.config_generation() == self._gen and not self._arms:
+            return
+        with self._lock:
+            self._refresh_locked()
+            arm = self._arms.get(point)
+            if arm is None:
+                return
+            self._hits[point] = hit = self._hits.get(point, 0) + 1
+            trigger, kind = arm
+            if trigger is None:
+                fire = True
+            elif isinstance(trigger, float) and trigger < 1.0:
+                fire = self._rng.random() < trigger
+            else:
+                fire = hit == int(trigger)
+            if not fire:
+                return
+        _metrics.registry.counter(RESILIENCE_FAULTS).inc()
+        raise FaultInjected(point, kind)
+
+
+registry = FaultRegistry()
+
+
+def fault_point(point: str) -> None:
+    """The hook instrumented code calls: raises :class:`FaultInjected`
+    when ``point`` is armed and its trigger fires, else returns."""
+    registry.maybe_fail(point)

@@ -376,10 +376,18 @@ class WebApp:
                 if d not in sft.attribute_names:
                     raise HttpError(400, f"bad 'dicts' parameter: "
                                          f"no attribute {d!r}")
+        timeout_ms = int_param(params, "timeout_ms")
+        if timeout_ms is not None and timeout_ms <= 0:
+            raise HttpError(400,
+                            f"bad 'timeout_ms' parameter: {timeout_ms}")
+        # partial=1 keeps an expired deadline from 504ing: the stream
+        # ends early but well-formed (Arrow EOS), rows-so-far delivered
+        partial = bool_param(params, "partial")
         from ..arrow.stream import ipc_chunks
         stream = self.store.query_arrow(
             name, q, chunk_rows=chunk_rows,
-            dictionary_fields=dictionary_fields)
+            dictionary_fields=dictionary_fields,
+            timeout_ms=timeout_ms, partial_results=partial)
         return (200, StreamingBody(ipc_chunks(stream)),
                 "application/vnd.apache.arrow.stream")
 
@@ -623,9 +631,14 @@ def _jsonable(v):
     return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)
 
 
-def serve(app: WebApp, host: str = "127.0.0.1", port: int = 8765):
-    """Run the app under wsgiref (dev/demo server)."""
-    from wsgiref.simple_server import make_server
-    with make_server(host, port, app) as httpd:
+def serve(app: WebApp, host: str = "127.0.0.1", port: int = 8765,
+          max_concurrent: int = 32):
+    """Run the app under wsgiref (dev/demo server) — threaded with a
+    bounded in-flight cap: past ``max_concurrent`` requests shed 503 +
+    Retry-After instead of growing an unbounded thread pile (ISSUE
+    16)."""
+    from .wsgi import make_bounded_server
+    with make_bounded_server(host, port, app,
+                             max_concurrent=max_concurrent) as httpd:
         print(f"geomesa-tpu web on http://{host}:{port}/api/version")
         httpd.serve_forever()

@@ -1,25 +1,31 @@
 """Shared WSGI plumbing for the REST apps (web data/stats app, GeoJSON
-servlet): status lines, regex-route dispatch, param/body parsing."""
+servlet): status lines, regex-route dispatch, param/body parsing, and
+the bounded-concurrency server wrapper (ISSUE 16)."""
 
 from __future__ import annotations
 
 import json
 import re
+import threading
 from urllib.parse import parse_qs, unquote
 
 __all__ = ["HttpError", "STATUS", "read_json_body", "Router",
-           "StreamingBody", "int_param", "float_param", "bool_param"]
+           "StreamingBody", "int_param", "float_param", "bool_param",
+           "BoundedApp", "make_bounded_server"]
 
 STATUS = {200: "200 OK", 201: "201 Created", 204: "204 No Content",
           400: "400 Bad Request", 404: "404 Not Found",
-          405: "405 Method Not Allowed", 500: "500 Internal Server Error"}
+          405: "405 Method Not Allowed", 500: "500 Internal Server Error",
+          503: "503 Service Unavailable", 504: "504 Gateway Timeout"}
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, headers=None):
         super().__init__(message)
         self.status = status
         self.message = message
+        #: extra response headers, e.g. Retry-After on a 503 shed
+        self.headers = list(headers or ())
 
 
 def read_json_body(environ) -> dict:
@@ -81,6 +87,22 @@ class StreamingBody:
             yield c if isinstance(c, bytes) else bytes(c)
 
 
+def _resilience_error(e):
+    """Map resilience signals to HTTP: Backpressure → 503 with
+    Retry-After (the client should back off and retry), QueryTimeout →
+    504 (the deadline the CLIENT set expired — retrying with the same
+    budget will time out again unless load drops)."""
+    from ..resilience import Backpressure, QueryTimeout
+    if isinstance(e, Backpressure):
+        return HttpError(
+            503, str(e),
+            headers=[("Retry-After",
+                      str(max(1, int(round(e.retry_after_s)))))])
+    if isinstance(e, QueryTimeout):
+        return HttpError(504, str(e))
+    return None
+
+
 class Router:
     """Regex-route table with shared dispatch/error handling.
 
@@ -99,6 +121,7 @@ class Router:
         params = {k: v[0] for k, v in
                   parse_qs(environ.get("QUERY_STRING", "")).items()}
         ctype = "application/json"
+        headers: list = []
         try:
             for pattern, handler in self.routes:
                 m = pattern.match(path)
@@ -112,13 +135,18 @@ class Router:
             else:
                 raise HttpError(404, f"no such route: {path}")
         except HttpError as e:
-            status, body = e.status, {"error": e.message}
+            status, body, headers = e.status, {"error": e.message}, e.headers
         except (ValueError,) as e:
             status, body = 400, {"error": str(e)}
         except KeyError as e:
             status, body = 404, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — no internals in the response
-            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+            mapped = _resilience_error(e)
+            if mapped is not None:
+                status, body = mapped.status, {"error": mapped.message}
+                headers = mapped.headers
+            else:
+                status, body = 500, {"error": f"{type(e).__name__}: {e}"}
         if isinstance(body, StreamingBody):
             # chunked path: the body generates as the store produces
             # it, so there is no Content-Length to announce, and the
@@ -128,7 +156,7 @@ class Router:
             # and mid-stream failures (counted separately: the 200
             # status line is already on the wire by then)
             start_response(STATUS.get(status, f"{status} Error"),
-                           [("Content-Type", ctype)])
+                           [("Content-Type", ctype)] + headers)
 
             def _stream():
                 try:
@@ -150,5 +178,69 @@ class Router:
                    else (body or b""))
         start_response(STATUS.get(status, f"{status} Error"), [
             ("Content-Type", ctype),
-            ("Content-Length", str(len(payload)))])
+            ("Content-Length", str(len(payload)))] + headers)
         return [payload]
+
+
+class BoundedApp:
+    """WSGI middleware capping in-flight requests at ``max_concurrent``.
+
+    The stock ``wsgiref`` threading server spawns one UNBOUNDED thread
+    per connection — under a connection flood every request gets a
+    thread, they all pile onto the store's locks, and the process dies
+    by memory instead of shedding (the bug ISSUE 16 fixes).  This wraps
+    the app with a non-blocking semaphore: over the cap, the request is
+    answered 503 + Retry-After immediately — no handler runs, no store
+    lock is touched.  The slot is held until the RESPONSE BODY is fully
+    drained (streaming bodies do their work during iteration), released
+    exactly once via the closing wrapper's finally."""
+
+    def __init__(self, app, max_concurrent: int = 32,
+                 retry_after_s: int = 1):
+        self.app = app
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.retry_after_s = max(1, int(retry_after_s))
+        self._sem = threading.Semaphore(self.max_concurrent)
+
+    def __call__(self, environ, start_response):
+        if not self._sem.acquire(blocking=False):
+            from ..metrics import QUERY_SHED, registry as _metrics
+            _metrics.counter(QUERY_SHED).inc()
+            payload = json.dumps(
+                {"error": "server saturated; retry later"}).encode()
+            start_response(STATUS[503], [
+                ("Content-Type", "application/json"),
+                ("Content-Length", str(len(payload))),
+                ("Retry-After", str(self.retry_after_s))])
+            return [payload]
+        try:
+            body = self.app(environ, start_response)
+        except BaseException:
+            self._sem.release()
+            raise
+        return self._drain(body)
+
+    def _drain(self, body):
+        try:
+            yield from body
+        finally:
+            close = getattr(body, "close", None)
+            if close is not None:
+                close()
+            self._sem.release()
+
+
+def make_bounded_server(host: str, port: int, app,
+                        max_concurrent: int = 32):
+    """A threading ``wsgiref`` server wrapping ``app`` in
+    :class:`BoundedApp`: concurrent requests each get a thread (a
+    long-lived Arrow stream must not block /metrics.prom), but past the
+    cap new requests shed 503 instead of growing the thread pile."""
+    from socketserver import ThreadingMixIn
+    from wsgiref.simple_server import WSGIServer, make_server
+
+    class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+        daemon_threads = True
+
+    return make_server(host, port, BoundedApp(app, max_concurrent),
+                       server_class=_ThreadingWSGIServer)

@@ -351,7 +351,7 @@ def test_analyzer_import_is_jax_free():
     running the analyzer never pulls in jax or numpy — pure ast."""
     code = ("import sys; import geomesa_tpu.analysis as a; "
             "from geomesa_tpu.analysis.checks import CHECKS; "
-            "assert len(CHECKS) == 5; "
+            "assert len(CHECKS) == 6; "
             "assert 'jax' not in sys.modules, 'jax imported'; "
             "assert 'numpy' not in sys.modules, 'numpy imported'; "
             "print('ok')")
