@@ -420,6 +420,7 @@ def main():
     arrow_stanza = _guarded_stanza(_arrow_stanza)
     lint_stanza = _guarded_stanza(_lint_stanza)
     resilience_stanza = _guarded_stanza(_resilience_stanza)
+    serving_stanza = _guarded_stanza(_serving_stanza)
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -455,6 +456,7 @@ def main():
             "arrow": arrow_stanza,
             "lint": lint_stanza,
             "resilience": resilience_stanza,
+            "serving": serving_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -481,6 +483,12 @@ def main():
     # shed behavior) fail the run the same way (ISSUE 16)
     for f in (resilience_stanza or {}).get("gate_failures", ()):
         regressions.append({"metric": "resilience.gate", "prior": None,
+                            "current": None, "ratio": None,
+                            "detail": f})
+    # serving acceptance-gate failures (fused >= 3x serial, zero warm
+    # recompiles, real fan-in) fail the run the same way (ISSUE 17)
+    for f in (serving_stanza or {}).get("gate_failures", ()):
+        regressions.append({"metric": "serving.gate", "prior": None,
                             "current": None, "ratio": None,
                             "detail": f})
     full["regressions"] = regressions
@@ -572,6 +580,11 @@ def _compact_summary(full: dict) -> dict:
                 for k in ("overshoot_p99", "shed_ms",
                           "timeout_gate_ok", "warm_recompiles")
                 if k in (ex.get("resilience") or {})},
+            "serving": {
+                k: (ex.get("serving") or {}).get(k)
+                for k in ("serving_qps", "serial_qps", "fused_speedup",
+                          "fanin", "warm_recompiles")
+                if k in (ex.get("serving") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -1193,6 +1206,158 @@ def _resilience_stanza() -> dict:
     return out
 
 
+def _serving_stanza() -> dict:
+    """Fused serving plane acceptance gate (ISSUE 17): 64 concurrent
+    clients of warm bbox/window queries submitted through the fusion
+    scheduler must beat a serial solo baseline of the same workload by
+    >= 3x throughput, with ZERO warm recompiles — the power-of-two
+    capacity bucketing pins the compiled-shape set (docs/serving.md).
+    ``SERVING_BENCH_N=0`` skips."""
+    import numpy as np
+
+    n = int(os.environ.get("SERVING_BENCH_N", 2_000_000))
+    if not n:
+        return {"skipped": True}
+    clients = int(os.environ.get("SERVING_BENCH_CLIENTS", 64))
+    rounds = int(os.environ.get("SERVING_BENCH_ROUNDS", 4))
+    out: dict = {}
+    try:
+        import threading
+        from geomesa_tpu import config as gm_config
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.metrics import (SERVING_FUSED_BATCHES,
+                                         SERVING_FUSED_REQUESTS, registry)
+        from geomesa_tpu.obs import compile_count
+
+        ms0 = 1_514_764_800_000
+        day = 86_400_000
+        slots = 1 << 16
+        rng = np.random.default_rng(47)
+        ds = TpuDataStore(user="serving-bench")
+        ds.create_schema("sb", (
+            "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+            f"geomesa.lean.generation.slots={slots},"
+            "geomesa.lean.compaction.factor=0"))
+        for lo in range(0, n, slots):
+            m = min(slots, n - lo)
+            ds.write("sb", {
+                "dtg": rng.integers(ms0, ms0 + 14 * day, m),
+                "geom": (rng.uniform(-180, 180, m),
+                         rng.uniform(-90, 90, m))})
+        ds._store("sb")._indexes["z3"].block()
+        # the concurrent-dashboard workload: selective bbox+window
+        # filters, distinct per client, all ONE compatibility key
+        queries, windows = [], []
+        for i in range(16):
+            x = -170.0 + i * 1.5
+            d = 1 + (i % 5)          # 2018-01-02 .. 2018-01-06 starts
+            queries.append(
+                f"BBOX(geom,{x},-60,{x + 3},-57) AND dtg DURING "
+                f"2018-01-{d:02d}T00:00:00Z/2018-01-{d + 3:02d}"
+                "T00:00:00Z")
+            windows.append((((x, -60.0, x + 3.0, -57.0),),
+                            ms0 + (d - 1) * day, ms0 + (d + 2) * day))
+        # a wide coalesce window + full-size batches for the measured
+        # phase: on a loaded CI box 2ms of linger can miss riders that
+        # a real server's steady-state arrival stream would catch
+        gm_config.set_property("geomesa.serving.fuse.window.ms", 10.0)
+        gm_config.set_property("geomesa.serving.fuse.max.batch", clients)
+        try:
+            # warm EVERY pow2 capacity bucket the fused path can hit,
+            # then the solo path, then one unrecorded concurrent round
+            k = 1
+            while k <= clients:
+                ds._fused_windows_dispatch(
+                    "sb", [windows[j % len(windows)] for j in range(k)])
+                k <<= 1
+            for q in queries:
+                ds.query_result("sb", q)
+            errors: list = []
+            barrier = threading.Barrier(clients + 1)
+
+            def client(i: int) -> None:
+                try:
+                    barrier.wait(timeout=60)
+                    for r in range(rounds):
+                        ds.query_fused(
+                            "sb", queries[(i + r) % len(queries)],
+                            tenant=f"t{i % 8}")
+                except Exception as e:  # surfaced via the gate below
+                    errors.append(repr(e))
+
+            def fused_round() -> float:
+                barrier.reset()
+                threads = [threading.Thread(target=client, args=(i,),
+                                            daemon=True)
+                           for i in range(clients)]
+                for t in threads:
+                    t.start()
+                barrier.wait(timeout=60)   # releases all clients at once
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.join()
+                return time.perf_counter() - t0
+
+            fused_round()                  # unrecorded warm round
+            # serial solo baseline: the SAME total query count, one at
+            # a time down the unfused path
+            total = clients * rounds
+            t0 = time.perf_counter()
+            for j in range(total):
+                ds.query_result("sb", queries[j % len(queries)])
+            serial_dt = time.perf_counter() - t0
+            c0 = compile_count()
+            req0 = registry.counter(SERVING_FUSED_REQUESTS).count
+            bat0 = registry.counter(SERVING_FUSED_BATCHES).count
+            fused_dt = fused_round()
+            out["warm_recompiles"] = int(compile_count() - c0)
+            reqs = registry.counter(SERVING_FUSED_REQUESTS).count - req0
+            bats = registry.counter(SERVING_FUSED_BATCHES).count - bat0
+            out["serial_qps"] = round(total / serial_dt, 1)
+            out["serving_qps"] = round(total / fused_dt, 1)
+            out["fused_speedup"] = round(serial_dt / fused_dt, 2)
+            out["fanin"] = round(reqs / bats, 2) if bats else 0.0
+            out["fused_requests"] = int(reqs)
+            out["fused_batches"] = int(bats)
+            out["clients"] = clients
+            if errors:
+                out["client_errors"] = errors[:4]
+        finally:
+            gm_config.clear_property("geomesa.serving.fuse.window.ms")
+            gm_config.clear_property("geomesa.serving.fuse.max.batch")
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
+    # the acceptance gate runs OUTSIDE the try (resilience/arrow
+    # precedent: an assert swallowed by the stanza's blanket except
+    # could never fail a run)
+    failures = []
+    if "error" not in out and not out.get("skipped"):
+        if out.get("client_errors"):
+            failures.append(
+                f"fused clients errored: {out['client_errors']}")
+        speedup = out.get("fused_speedup")
+        if speedup is None or speedup < 3.0:
+            failures.append(
+                f"fused throughput {out.get('serving_qps')} qps is not "
+                f">= 3x the serial baseline {out.get('serial_qps')} qps "
+                f"(speedup {speedup})")
+        if out.get("warm_recompiles", 1) != 0:
+            failures.append(
+                f"warm fused path recompiled "
+                f"{out.get('warm_recompiles')} time(s) — the capacity "
+                "bucketing is leaking shapes")
+        if out.get("fanin", 0) < 2.0:
+            failures.append(
+                f"fan-in {out.get('fanin')} — requests are not "
+                "coalescing into shared batches")
+    if failures:
+        out["gate_failures"] = failures
+        for f in failures:
+            print(f"BENCH SERVING GATE FAILED: {f}", flush=True)
+    out.update(_mem_probe())
+    return out
+
+
 def _lint_stanza() -> dict:
     """gm-lint no-op guard (ISSUE 13 satellite): the static-analysis
     gate must pass on the benched tree AND stay importable with NO jax
@@ -1255,7 +1420,10 @@ REGRESSION_TOLERANCE = 0.20
 #: never flagged
 _LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_rss_mb", "_resident_bytes",
                           "_overhead_pct")
-_HIGHER_BETTER_MARKS = ("per_sec", "speedup", "wins", "value")
+#: the SERVING direction (ISSUE 17) adds the fused-plane leaves: qps
+#: and batch fan-in regress DOWN like any other rate
+_HIGHER_BETTER_MARKS = ("per_sec", "speedup", "wins", "value",
+                        "_qps", "fanin")
 
 
 def _flat_scalars(rec, prefix: str = "", depth: int = 0) -> dict:

@@ -341,11 +341,35 @@ class ResilienceProperties:
     FAULT_SEED = SystemProperty("geomesa.resilience.fault.seed", 0)
 
 
+class ServingProperties:
+    """Fused serving plane knobs (ISSUE 17, geomesa_tpu/serving):
+    query fusion — coalescing concurrent compatible queries into one
+    batched device dispatch — and per-tenant fairness over it."""
+
+    #: master switch for query fusion; False routes every request down
+    #: the solo path untouched
+    FUSE_ENABLED = SystemProperty("geomesa.serving.fuse.enabled", True)
+    #: how long (ms) a batch leader lingers collecting riders before
+    #: dispatching the fused batch
+    FUSE_WINDOW_MS = SystemProperty("geomesa.serving.fuse.window.ms", 2.0)
+    #: max requests fused into one batched dispatch; a full batch
+    #: dispatches immediately without waiting out the window
+    FUSE_MAX_BATCH = SystemProperty("geomesa.serving.fuse.max.batch", 64)
+    #: per-tenant queued-request ceiling; a tenant at its ceiling sheds
+    #: (Backpressure → 503) instead of growing the queue; 0 = unbounded
+    TENANT_QUEUE_MAX = SystemProperty("geomesa.serving.tenant.queue.max", 0)
+    #: deficit-round-robin quantum (window-count units) each tenant
+    #: earns per batch-assembly pass — larger values trade fairness
+    #: granularity for fewer scheduling rounds
+    TENANT_QUANTUM = SystemProperty("geomesa.serving.tenant.quantum", 4)
+
+
 def _register_declarations() -> None:
     """Fill the option registry from the declaration classes above —
     the one place a knob becomes 'known' to the strict mode."""
     for cls in (QueryProperties, ObsProperties, ArrowProperties,
-                SchemaProperties, ConfigProperties, ResilienceProperties):
+                SchemaProperties, ConfigProperties, ResilienceProperties,
+                ServingProperties):
         for value in vars(cls).values():
             if isinstance(value, (SystemProperty, SchemaOption)):
                 _REGISTRY[value.name] = value

@@ -1154,6 +1154,11 @@ class TpuDataStore:
         self._user = user
         self._interceptors: dict[str, list] = {}
         self._lock_depth = 0
+        # the fused serving plane (ISSUE 17): one coalescing scheduler
+        # per store — compatible concurrent queries share one batched
+        # device dispatch (serving/fusion.py)
+        from .serving import FusionScheduler
+        self._fusion = FusionScheduler()
         if catalog_dir:
             os.makedirs(catalog_dir, exist_ok=True)
             with self._catalog_lock():
@@ -1789,7 +1794,8 @@ class TpuDataStore:
                     chunk_rows: int | None = None,
                     dictionary_fields="auto",
                     timeout_ms: float | None = None,
-                    partial_results: bool = False):
+                    partial_results: bool = False,
+                    tenant: str = ""):
         """Streaming Arrow results (ISSUE 14): run the query to hit
         POSITIONS only — no per-row feature objects ever exist — and
         return an :class:`~geomesa_tpu.arrow.stream.ArrowStream`
@@ -1821,14 +1827,14 @@ class TpuDataStore:
         try:
             return self._query_arrow_under_token(
                 name, query, chunk_rows, dictionary_fields,
-                timeout_ms, partial_results, token)
+                timeout_ms, partial_results, token, tenant)
         except BaseException:
             token.release()
             raise
 
     def _query_arrow_under_token(self, name, query, chunk_rows,
                                  dictionary_fields, timeout_ms,
-                                 partial_results, token):
+                                 partial_results, token, tenant=""):
         from .arrow.schema import sft_to_arrow_schema
         from .arrow.stream import (
             ArrowStream, auto_dictionary_fields, stream_batches,
@@ -1855,11 +1861,38 @@ class TpuDataStore:
             eval_store = store
         else:
             from .resilience import deadline_scope
-            if scope is not None:
+            # fused serving plane (ISSUE 17): compatible queries submit
+            # through the fusion scheduler and the Arrow stream picks
+            # up from the demuxed positions — the token this caller
+            # already holds covers the whole drain, and the scheduler
+            # itself never touches the gate
+            window = self._fusible_window(name, store, q)
+            if window is not None:
+                tenant = tenant or str(q.hints.get("TENANT", "") or "")
+                outcome = self._fusion.submit(
+                    ("fuse", name), window,
+                    lambda ws: self._fused_windows_dispatch(name, ws),
+                    scope=scope, partial=partial_results,
+                    tenant=tenant, schema=name)
+                from .planning.strategy import FilterStrategy
+                result = QueryResult(
+                    None, outcome.positions,
+                    FilterStrategy("fused",
+                                   float(len(outcome.positions))),
+                    0.0, 0.0, local_rows=outcome.positions,
+                    timed_out=outcome.timed_out)
+                eval_store = store
+            elif scope is not None:
+                from .metrics import SERVING_BYPASS
+                from .metrics import registry as _metrics
+                _metrics.counter(SERVING_BYPASS).inc()
                 with deadline_scope(scope=scope):
                     result, eval_store = self._query_result_ex(
                         name, q, materialize=False, _token=token)
             else:
+                from .metrics import SERVING_BYPASS
+                from .metrics import registry as _metrics
+                _metrics.counter(SERVING_BYPASS).inc()
                 result, eval_store = self._query_result_ex(
                     name, q, materialize=False, _token=token)
             source = eval_store.batch
@@ -2128,6 +2161,116 @@ class TpuDataStore:
             self._audit_record(name, f"batched windows[{len(windows)}]",
                                {}, None, (time.time() - t0) * 1e3, n_hits)
             return hits
+
+    # -- fused serving plane (ISSUE 17) -----------------------------------
+    def query_fused(self, name: str, query="INCLUDE", *,
+                    timeout_ms: float | None = None,
+                    partial_results: bool = False,
+                    tenant: str = "") -> QueryResult:
+        """Run a query through the fusion scheduler: concurrent
+        compatible queries (lean z3 point schema, pure bbox(+time)
+        predicate, no projections/sorts/interceptors) coalesce into ONE
+        batched decompose + multi-window device scan and demux their
+        per-request positions — bit-exact against
+        :meth:`query_result`, pinned by tests.  Incompatible queries
+        bypass to the solo path untouched.
+
+        ``tenant`` (or a ``TENANT`` query hint, or the web ``X-Tenant``
+        header) keys per-tenant deficit-weighted round-robin in batch
+        assembly so a flooding tenant cannot starve the rest; each
+        request still acquires its own admission token (FIFO-fair), so
+        the gate's view of in-flight work stays truthful."""
+        from .metrics import SERVING_BYPASS
+        from .metrics import registry as _metrics
+        from .resilience import CancelScope, admission_gate
+        q = query if isinstance(query, Query) else Query.of(query)
+        tenant = tenant or str(q.hints.get("TENANT", "") or "")
+        store = self._store(name)
+        window = self._fusible_window(name, store, q)
+        if window is None:
+            _metrics.counter(SERVING_BYPASS).inc()
+            return self.query_result(name, q, timeout_ms=timeout_ms,
+                                     partial_results=partial_results)
+        token = admission_gate.acquire(name)
+        try:
+            scope = (CancelScope(timeout_ms, partial_results)
+                     if timeout_ms is not None else None)
+            outcome = self._fusion.submit(
+                ("fuse", name), window,
+                lambda ws: self._fused_windows_dispatch(name, ws),
+                scope=scope, partial=partial_results, tenant=tenant,
+                schema=name)
+            positions = outcome.positions
+            from .planning.strategy import FilterStrategy
+            batch = (store.batch.take(positions)
+                     if store.batch is not None
+                     else FeatureBatch.empty(store.sft))
+            return QueryResult(batch, positions,
+                               FilterStrategy("fused",
+                                              float(len(positions))),
+                               0.0, 0.0, local_rows=positions,
+                               timed_out=outcome.timed_out)
+        finally:
+            token.release()
+
+    def _fusible_window(self, name: str, store: _SchemaStore, q: Query):
+        """The fused-path compatibility gate: the ``(boxes, lo, hi)``
+        window this query fuses as, or None to bypass.  Conservative
+        by design — only the shapes whose fused execution is provably
+        identical to solo fuse: lean z3 point schemas with no
+        interceptors, no per-caller visibility (auth providers can
+        carry per-thread auths; the dispatch runs on the LEADER's
+        thread), single-host, and a hint/projection/sort-free query
+        whose filter is a pure bbox(+time) predicate."""
+        from .config import ServingProperties
+        if not ServingProperties.FUSE_ENABLED.get():
+            return None
+        if not (store.lean and store.lean_kind == "z3"):
+            return None
+        if store.multihost or self._auth_provider is not None:
+            return None
+        sft = store.sft
+        if sft.name not in self._interceptors:
+            from .planning.interceptor import load_interceptors
+            self._interceptors[sft.name] = load_interceptors(sft)
+        if self._interceptors[sft.name]:
+            return None
+        if (q.properties is not None or q.sort_by is not None
+                or q.max_features is not None or q.crs):
+            return None
+        if any(k != "TENANT" for k in q.hints):
+            return None
+        from .serving import extract_fused_window
+        return extract_fused_window(sft, q.filter)
+
+    def _fused_windows_dispatch(self, name: str, windows):
+        """One fused device dispatch for a batch of compatible
+        requests: the lean z3 ``query_many`` program over every
+        member's window, capacity-bucketed so the warm path never
+        recompiles — the window count pads to the next power of two by
+        duplicating window 0 (bounded extra scan work, log-many
+        compiled shapes; ``coded_pos_bits``/``qtlo``/``qthi`` shapes
+        depend on the window count).  Padded outputs are dropped
+        before demux.  No admission here: every member holds its own
+        token (see :meth:`query_fused`)."""
+        store = self._store(name)
+        if store.batch is None or len(store.batch) == 0:
+            return [np.empty(0, dtype=np.int64) for _ in windows]
+        n = len(windows)
+        n_pad = 1 << max(0, (n - 1).bit_length())
+        padded = list(windows) + [windows[0]] * (n_pad - n)
+        t0 = time.time()
+        hits = store.index("z3").query_many(padded)
+        allowed = self._effective_mask(store)
+        if allowed is not None:
+            hits = _apply_mask_global(store, hits, allowed)
+        hits = hits[:n]
+        from .metrics import registry as _metrics
+        _metrics.counter(f"query.{name}.windows").inc(n)
+        n_hits = int(sum(len(h) for h in hits))
+        self._audit_record(name, f"fused windows[{n}]", {}, None,
+                           (time.time() - t0) * 1e3, n_hits)
+        return hits
 
     def explain(self, name: str, query="INCLUDE") -> str:
         from .planning.explain import ExplainString

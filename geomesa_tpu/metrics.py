@@ -42,7 +42,11 @@ __all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "RESILIENCE_DEGRADED", "RESILIENCE_RETRIES",
            "RESILIENCE_BREAKER_OPEN", "RESILIENCE_FAULTS",
            "RESILIENCE_ADMISSION_ACTIVE", "RESILIENCE_ADMISSION_QUEUE_MS",
-           "RESILIENCE_ADMISSION_ADMITTED"]
+           "RESILIENCE_ADMISSION_ADMITTED",
+           "SERVING_FUSED_BATCHES", "SERVING_FUSED_REQUESTS",
+           "SERVING_FANIN", "SERVING_COALESCE_MS",
+           "SERVING_BATCH_WINDOWS", "SERVING_BYPASS",
+           "SERVING_TENANT_SHED", "SERVING_RIDER_EXPIRED"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -110,6 +114,21 @@ RESILIENCE_ADMISSION_ACTIVE = "resilience.admission.active"
 RESILIENCE_ADMISSION_QUEUE_MS = "resilience.admission.queue_ms"
 RESILIENCE_ADMISSION_ADMITTED = "resilience.admission.admitted"
 
+#: the fused serving plane (ISSUE 17, geomesa_tpu/serving): fan-in is
+#: the requests-per-dispatch histogram (1.0 = no coalescing happened),
+#: coalesce_ms the time a request waited in the fusion queue before its
+#: batch dispatched, batch_windows the fused window count per dispatch
+#: (post-merge, pre-padding).  Per-tenant sheds append the tenant as a
+#: trailing segment: ``serving.tenant.shed.<tenant>``.
+SERVING_FUSED_BATCHES = "serving.fused.batches"
+SERVING_FUSED_REQUESTS = "serving.fused.requests"
+SERVING_FANIN = "serving.fanin"
+SERVING_COALESCE_MS = "serving.coalesce_ms"
+SERVING_BATCH_WINDOWS = "serving.batch.windows"
+SERVING_BYPASS = "serving.bypass"
+SERVING_TENANT_SHED = "serving.tenant.shed"
+SERVING_RIDER_EXPIRED = "serving.rider.expired"
+
 #: the metric naming contract (docs/observability.md): every registry
 #: key lives under one of these top-level namespaces, dot-separated,
 #: segments drawn from [A-Za-z0-9_:-] (attr-index keys like
@@ -118,7 +137,7 @@ RESILIENCE_ADMISSION_ADMITTED = "resilience.admission.admitted"
 #: registry after the suite and fails on any drive-by key outside it.
 METRIC_NAMESPACES = ("query", "write", "lean", "jax", "web", "storage",
                      "plan", "obs", "pallas", "heat", "job", "arrow",
-                     "resilience")
+                     "resilience", "serving")
 _METRIC_KEY_RE = re.compile(
     r"^(?:" + "|".join(METRIC_NAMESPACES)
     + r")(?:\.[A-Za-z0-9_:\-]+)+$")

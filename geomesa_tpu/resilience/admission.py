@@ -79,6 +79,12 @@ class AdmissionGate:
         self._max = 0
         self._queue_ms = 50.0
         self._headroom = 0
+        # FIFO ticket queue: waiters admit in arrival order.  notify_all
+        # wakes everyone, but only the queue head may take the freed
+        # slot — without this a late arrival could barge past waiters
+        # that had been queued for most of their budget.
+        self._tickets: list[object] = []
+        self._next_ticket = 0
 
     def _refresh_locked(self) -> None:
         gen = _config.config_generation()
@@ -102,34 +108,61 @@ class AdmissionGate:
         with self._cond:
             return self._inflight
 
+    def _ticket_count(self) -> int:
+        """Queued-waiter count (test hook for the FIFO ordering pin)."""
+        with self._cond:
+            return len(self._tickets)
+
     def acquire(self, schema: str = "") -> AdmissionToken:
         t0 = time.perf_counter()
         with self._cond:
             self._refresh_locked()
             if self._max <= 0 and self._headroom <= 0:
                 # gate disabled: admit unconditionally but still track
-                # in-flight, so enabling the gate mid-flight sees truth
+                # in-flight, so enabling the gate mid-flight sees truth.
+                # The admitted counter and queue timer record here too —
+                # dashboards must not undercount when the gate is off.
                 self._inflight += 1
                 _metrics.registry.gauge(
                     RESILIENCE_ADMISSION_ACTIVE).set(self._inflight)
+                _metrics.registry.timer(RESILIENCE_ADMISSION_QUEUE_MS).update(
+                    (time.perf_counter() - t0) * 1000.0)
+                _metrics.registry.counter(
+                    RESILIENCE_ADMISSION_ADMITTED).inc()
                 return AdmissionToken(self)
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._tickets.append(ticket)
             queue_deadline = t0 + self._queue_ms / 1000.0
-            while ((self._max > 0 and self._inflight >= self._max)
-                   or self._hbm_over_budget()):
-                remaining = queue_deadline - time.perf_counter()
-                if remaining <= 0:
-                    _metrics.registry.counter(QUERY_SHED).inc()
-                    reason = ("concurrency" if (self._max > 0 and
-                                                self._inflight >= self._max)
-                              else "hbm")
-                    raise Backpressure(
-                        f"admission shed ({reason}) for "
-                        f"{schema or 'query'}: {self._inflight} in flight",
-                        retry_after_s=max(0.05, self._queue_ms / 1000.0))
-                self._cond.wait(remaining)
-            self._inflight += 1
-            _metrics.registry.gauge(
-                RESILIENCE_ADMISSION_ACTIVE).set(self._inflight)
+            try:
+                # only the queue HEAD may take a freed slot: notify_all
+                # wakes every waiter, and without the head check a late
+                # arrival (or a waiter that happened to be scheduled
+                # first) could barge past longer-queued requests
+                while (self._tickets[0] != ticket
+                       or (self._max > 0 and self._inflight >= self._max)
+                       or self._hbm_over_budget()):
+                    remaining = queue_deadline - time.perf_counter()
+                    if remaining <= 0:
+                        _metrics.registry.counter(QUERY_SHED).inc()
+                        reason = ("concurrency"
+                                  if (self._max > 0
+                                      and self._inflight >= self._max)
+                                  else ("hbm" if self._hbm_over_budget()
+                                        else "queued"))
+                        raise Backpressure(
+                            f"admission shed ({reason}) for "
+                            f"{schema or 'query'}: {self._inflight} in flight",
+                            retry_after_s=max(0.05, self._queue_ms / 1000.0))
+                    self._cond.wait(remaining)
+                self._inflight += 1
+                _metrics.registry.gauge(
+                    RESILIENCE_ADMISSION_ACTIVE).set(self._inflight)
+            finally:
+                # success or shed, this waiter leaves the queue; wake
+                # the rest so the new head can re-check its turn
+                self._tickets.remove(ticket)
+                self._cond.notify_all()
         _metrics.registry.timer(RESILIENCE_ADMISSION_QUEUE_MS).update(
             (time.perf_counter() - t0) * 1000.0)
         _metrics.registry.counter(RESILIENCE_ADMISSION_ADMITTED).inc()

@@ -1,0 +1,34 @@
+"""The fused serving plane (ISSUE 17).
+
+The store answers queries one at a time; a *server* answers thousands
+of concurrent ones.  This package closes that gap with query fusion:
+concurrent compatible queries — same schema, lean z3 point path, same
+bbox+time-window predicate shape, same visibility/mask state — coalesce
+into ONE batched decompose + multi-window device scan (the existing
+``query_many`` program), and the per-request hit positions demultiplex
+back out bit-exact against solo execution.  Incompatible queries
+(interceptors, non-point schemas, id filters, projections, sorts)
+bypass untouched.
+
+Layered over the planes that already exist:
+
+* per-tenant deficit-weighted round-robin batch assembly over the
+  PR 8 :class:`~geomesa_tpu.resilience.AdmissionGate` (tenant from a
+  ``TENANT`` query hint or the web ``X-Tenant`` header) so one hot
+  tenant cannot starve the queue;
+* cooperative deadlines compose — expired riders drop before dispatch,
+  a fused batch runs under its members' minimum remaining margin, and
+  a timed-out rider never poisons the batch (survivors re-dispatch);
+* ``serving.*`` spans/metrics (fan-in ratio, coalesce wait, batch
+  size, per-tenant shed) flow into ``/metrics.prom``.
+
+Entry points: :meth:`TpuDataStore.query_fused` and the web
+``GET /query`` Arrow stream (which picks its hit positions up from the
+demuxed fused result).  docs/serving.md is the operator contract.
+"""
+
+from __future__ import annotations
+
+from .fusion import FusedOutcome, FusionScheduler, extract_fused_window
+
+__all__ = ["FusionScheduler", "FusedOutcome", "extract_fused_window"]

@@ -383,11 +383,19 @@ class WebApp:
         # partial=1 keeps an expired deadline from 504ing: the stream
         # ends early but well-formed (Arrow EOS), rows-so-far delivered
         partial = bool_param(params, "partial")
+        # fused serving plane (ISSUE 17): the tenant id (X-Tenant
+        # header, or ?tenant=) keys per-tenant fair batch assembly in
+        # the fusion scheduler; compatible queries coalesce into shared
+        # device dispatches and the Arrow stream picks up from the
+        # demuxed per-caller positions
+        tenant = (environ.get("HTTP_X_TENANT")
+                  or params.get("tenant", "") or "")
         from ..arrow.stream import ipc_chunks
         stream = self.store.query_arrow(
             name, q, chunk_rows=chunk_rows,
             dictionary_fields=dictionary_fields,
-            timeout_ms=timeout_ms, partial_results=partial)
+            timeout_ms=timeout_ms, partial_results=partial,
+            tenant=tenant)
         return (200, StreamingBody(ipc_chunks(stream)),
                 "application/vnd.apache.arrow.stream")
 
