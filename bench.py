@@ -421,6 +421,7 @@ def main():
     lint_stanza = _guarded_stanza(_lint_stanza)
     resilience_stanza = _guarded_stanza(_resilience_stanza)
     serving_stanza = _guarded_stanza(_serving_stanza)
+    pyramid_stanza = _guarded_stanza(_pyramid_stanza)
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -457,6 +458,7 @@ def main():
             "lint": lint_stanza,
             "resilience": resilience_stanza,
             "serving": serving_stanza,
+            "pyramid": pyramid_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -489,6 +491,13 @@ def main():
     # recompiles, real fan-in) fail the run the same way (ISSUE 17)
     for f in (serving_stanza or {}).get("gate_failures", ()):
         regressions.append({"metric": "serving.gate", "prior": None,
+                            "current": None, "ratio": None,
+                            "detail": f})
+    # pyramid acceptance-gate failures (>= 20x warm speedup, <50ms
+    # warm tile p99, zero recompiles, bit-exactness) likewise
+    # (ISSUE 18)
+    for f in (pyramid_stanza or {}).get("gate_failures", ()):
+        regressions.append({"metric": "pyramid.gate", "prior": None,
                             "current": None, "ratio": None,
                             "detail": f})
     full["regressions"] = regressions
@@ -585,6 +594,12 @@ def _compact_summary(full: dict) -> dict:
                 for k in ("serving_qps", "serial_qps", "fused_speedup",
                           "fanin", "warm_recompiles")
                 if k in (ex.get("serving") or {})},
+            "pyramid": {
+                k: (ex.get("pyramid") or {}).get(k)
+                for k in ("pyramid_speedup", "tile_warm_p99_ms",
+                          "bit_exact", "fault_exact",
+                          "warm_recompiles")
+                if k in (ex.get("pyramid") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -1354,6 +1369,149 @@ def _serving_stanza() -> dict:
         out["gate_failures"] = failures
         for f in failures:
             print(f"BENCH SERVING GATE FAILED: {f}", flush=True)
+    out.update(_mem_probe())
+    return out
+
+
+def _pyramid_stanza() -> dict:
+    """Density-pyramid acceptance gate (ISSUE 18): a warm whole-extent
+    heatmap served off the sealed generations' cached pyramids must
+    beat the cold direct sweep by >= 20x, warm single-tile p99 must
+    stay under 50 ms with ZERO warm recompiles, and an interrupted
+    build (``pyramid.build`` fault point) must leave results exact
+    through the sweep fallback.  Bit-exactness of the pyramid-served
+    grid vs the direct scan is asserted OUTSIDE the stanza's blanket
+    except (the arrow-stanza precedent).  ``PYRAMID_BENCH_N=0``
+    skips."""
+    import numpy as np
+
+    n = int(os.environ.get("PYRAMID_BENCH_N", 2_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    grids: dict = {}
+    try:
+        from geomesa_tpu import config as gm_config
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.metrics import PYRAMID_SERVE_HITS, registry
+        from geomesa_tpu.obs import compile_count
+        from geomesa_tpu.resilience import FaultInjected
+
+        ms0 = 1_514_764_800_000
+        day = 86_400_000
+        slots = 1 << 16
+        base = 512
+        world = (-180.0, -90.0, 180.0, 90.0)
+        rng = np.random.default_rng(53)
+        ds = TpuDataStore(user="pyramid-bench")
+        ds.create_schema("pyr", (
+            "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+            f"geomesa.lean.generation.slots={slots},"
+            "geomesa.lean.compaction.factor=0"))
+        for lo in range(0, n, slots):
+            m = min(slots, n - lo)
+            ds.write("pyr", {
+                "dtg": rng.integers(ms0, ms0 + 14 * day, m),
+                "geom": (rng.uniform(-180, 180, m),
+                         rng.uniform(-90, 90, m))})
+        idx = ds._store("pyr")._indexes["z3"]
+        idx.block()
+        out["generations"] = len(idx.generations)
+
+        def whole_extent():
+            return idx.density([world], None, None, world, base, base)
+
+        def cold():
+            # the density-partial AND pyramid caches both short-circuit
+            # repeat sweeps — drop them so every iteration pays the
+            # full direct scan the cold path costs
+            idx._density_cache.clear()
+            idx._pyramid_cache.clear()
+            return whole_extent()
+
+        grids["direct"] = np.asarray(cold())
+        cold_ms = _median_time(cold, iters=3) * 1e3
+        out["cold_direct_ms"] = round(cold_ms, 2)
+        idx._pyramid_cache.clear()
+        t0 = time.perf_counter()
+        out["builds"] = int(idx.build_pyramids(base=base))
+        out["build_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        idx._density_cache.clear()
+        h0 = registry.counter(PYRAMID_SERVE_HITS).count
+        grids["pyramid"] = np.asarray(whole_extent())
+        out["serve_hits"] = int(
+            registry.counter(PYRAMID_SERVE_HITS).count - h0)
+        warm_ms = _median_time(whole_extent, iters=5) * 1e3
+        out["warm_pyramid_ms"] = round(warm_ms, 3)
+        out["pyramid_speedup"] = round(cold_ms / max(warm_ms, 1e-3), 1)
+
+        # warm single-tile latency at the finest pyramid-served zoom
+        tiles = [(1, tx, ty) for tx in (0, 1) for ty in (0, 1)]
+        for z, tx, ty in tiles:
+            ds.density_tile("pyr", z, tx, ty)         # warm-up
+        c0 = compile_count()
+        lat = []
+        for i in range(40):
+            z, tx, ty = tiles[i % len(tiles)]
+            t0 = time.perf_counter()
+            ds.density_tile("pyr", z, tx, ty)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        out["warm_recompiles"] = int(compile_count() - c0)
+        lat.sort()
+        out["tile_warm_p99_ms"] = round(
+            lat[min(len(lat) - 1, int(0.99 * len(lat)))], 2)
+
+        # interrupted build: exact through the fallback, then resumes
+        idx._pyramid_cache.clear()
+        gm_config.set_property("geomesa.resilience.fault.points",
+                               "pyramid.build:2")
+        try:
+            try:
+                idx.build_pyramids(base=base)
+                out["fault_error"] = "fault point did not fire"
+            except FaultInjected:
+                idx._density_cache.clear()
+                grids["interrupted"] = np.asarray(whole_extent())
+        finally:
+            gm_config.clear_property("geomesa.resilience.fault.points")
+        out["resumed_builds"] = int(idx.build_pyramids(base=base))
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
+    # acceptance gates OUTSIDE the try: a swallowed assert could never
+    # fail a run
+    failures = []
+    if "error" not in out and not out.get("skipped"):
+        out["bit_exact"] = bool(
+            "pyramid" in grids
+            and np.array_equal(grids["direct"], grids["pyramid"]))
+        if not out["bit_exact"]:
+            failures.append("pyramid-served grid != direct scan grid")
+        out["fault_exact"] = bool(
+            "interrupted" in grids
+            and np.array_equal(grids["direct"], grids["interrupted"]))
+        if not out["fault_exact"]:
+            failures.append(
+                out.get("fault_error",
+                        "interrupted-build grid != direct scan grid"))
+        if out.get("serve_hits", 0) <= 0:
+            failures.append("warm heatmap never touched a pyramid")
+        if out.get("pyramid_speedup", 0.0) < 20.0:
+            failures.append(
+                f"pyramid_speedup {out.get('pyramid_speedup')} < 20x "
+                f"(cold {out.get('cold_direct_ms')}ms, warm "
+                f"{out.get('warm_pyramid_ms')}ms)")
+        if out.get("tile_warm_p99_ms", float("inf")) >= 50.0:
+            failures.append(
+                f"tile_warm_p99_ms {out.get('tile_warm_p99_ms')} "
+                "breaches the 50ms interactive pin")
+        if out.get("warm_recompiles", 1) != 0:
+            failures.append(
+                f"{out.get('warm_recompiles')} recompiles while "
+                "serving warm tiles")
+    if failures:
+        out["gate_failures"] = failures
+        for f in failures:
+            print(f"BENCH PYRAMID GATE FAILED: {f}", flush=True)
     out.update(_mem_probe())
     return out
 

@@ -21,6 +21,7 @@ from typing import Any
 __all__ = ["SystemProperty", "SchemaOption", "QueryProperties",
            "ObsProperties", "ArrowProperties", "SchemaProperties",
            "ConfigProperties", "ResilienceProperties",
+           "DensityProperties",
            "set_property", "clear_property", "config_generation",
            "known_option_names", "check_option_name",
            "UnknownOptionWarning"]
@@ -364,12 +365,36 @@ class ServingProperties:
     TENANT_QUANTUM = SystemProperty("geomesa.serving.tenant.quantum", 4)
 
 
+class DensityProperties:
+    """Density-pyramid knobs (ISSUE 18, docs/density.md): sealed
+    generations precompute world-aligned multi-resolution density
+    grids so whole-extent/zoomed-out heatmaps and ``/tiles/{z}/{x}/{y}``
+    requests sum cached cells instead of rescanning history."""
+
+    #: base pyramid resolution (cells per axis, power of two): each
+    #: sealed generation's pyramid starts at a (base, base) world grid
+    #: and halves down from there.  Tile requests whose effective world
+    #: resolution exceeds the base fall back to the direct density scan
+    PYRAMID_BASE = SystemProperty("geomesa.density.pyramid.base", 512)
+    #: reduction-ladder depth; 0 = the full ladder down to 1×1
+    PYRAMID_LEVELS = SystemProperty("geomesa.density.pyramid.levels", 0)
+    #: byte ceiling for the per-index pyramid cache (the shared
+    #: PartialCache LRU/invalidation policy density partials use)
+    PYRAMID_CACHE_BYTES = SystemProperty(
+        "geomesa.density.pyramid.cache.bytes", 256 * (1 << 20))
+    #: build trigger: ``off`` (builds happen only on explicit
+    #: ``store.build_pyramids`` / ``jobs.run_pyramid_build`` calls) or
+    #: ``seal`` (a generation seal schedules a build-behind job —
+    #: never blocking the append, never changing results)
+    PYRAMID_BUILD = SystemProperty("geomesa.density.pyramid.build", "off")
+
+
 def _register_declarations() -> None:
     """Fill the option registry from the declaration classes above —
     the one place a knob becomes 'known' to the strict mode."""
     for cls in (QueryProperties, ObsProperties, ArrowProperties,
                 SchemaProperties, ConfigProperties, ResilienceProperties,
-                ServingProperties):
+                ServingProperties, DensityProperties):
         for value in vars(cls).values():
             if isinstance(value, (SystemProperty, SchemaOption)):
                 _REGISTRY[value.name] = value

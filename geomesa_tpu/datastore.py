@@ -148,6 +148,11 @@ class _SchemaStore:
         self._vis_masks: dict = {}
         self._dirty = True
         self._indexes: dict = {}
+        #: generation-lifecycle hook the owning datastore parks here
+        #: BEFORE the lean scale index exists (ISSUE 18): attached to
+        #: the index's generation_listeners once its (re)build streams,
+        #: it triggers the build-behind pyramid job on seal
+        self.pyramid_trigger = None
         #: rows covered by each cached index (indexes kept across
         #: writes serve [0, coverage) from their structure and the
         #: appended TAIL [coverage, n) as unconditional candidates)
@@ -352,6 +357,12 @@ class _SchemaStore:
         # access-temperature attribution scope (obs/heat): the index's
         # touches record under this schema + registry key
         idx.heat_scope = (self.sft.name, kind)
+        # build-behind pyramid trigger (ISSUE 18) — registered only
+        # AFTER the (re)build streamed, so seals during the initial
+        # stream never recurse into the builder
+        if (self.pyramid_trigger is not None
+                and hasattr(idx, "build_pyramids")):
+            idx.generation_listeners.append(self.pyramid_trigger)
         self._indexes[kind] = idx
         self._index_coverage[kind] = n
         self.build_counts[kind] = self.build_counts.get(kind, 0) + 1
@@ -407,6 +418,18 @@ class _SchemaStore:
             if idx is not None and hasattr(idx, "compact"):
                 out[key] = idx.compact(budget_ms=remaining())
         return out
+
+    def build_pyramids(self) -> int:
+        """Build density pyramids over the lean scale index's sealed
+        generations (ISSUE 18); returns the number built.  Schemas
+        whose scale index has no pyramid support (xz2/xz3, full-fat)
+        build nothing."""
+        if not self.lean or self.batch is None:
+            return 0
+        idx = self._lean_index()
+        if not hasattr(idx, "build_pyramids"):
+            return 0
+        return idx.build_pyramids()
 
     def _lean_z3_budget(self) -> int:
         """The z3 index's share: the full lean budget minus the
@@ -1241,6 +1264,8 @@ class TpuDataStore:
                     "(created by another process)")
             self._schemas[sft.name] = _SchemaStore(sft, mesh=self._mesh,
                                          multihost=self._multihost)
+            self._schemas[sft.name].pyramid_trigger = \
+                self._pyramid_listener(sft.name)
             # interceptors resolve EAGERLY at schema load (ISSUE 16): a
             # typoed ``geomesa.query.interceptors`` dotted path fails
             # create_schema, not the first query hours later
@@ -2571,6 +2596,93 @@ class TpuDataStore:
         (_maybe_compact)."""
         return self._store(name).compact_lean(budget_ms=budget_ms)
 
+    def _pyramid_listener(self, name: str):
+        """The generation-lifecycle hook parked on every schema store
+        (ISSUE 18): on seal — when ``geomesa.density.pyramid.build`` is
+        ``seal`` at fire time — run one build-behind pyramid pass as a
+        registered background job.  Best-effort by contract: a failed
+        build must never fail the write that sealed the generation
+        (queries stay exact through the scan fallback)."""
+        def on_event(kind: str, gen_ids: list) -> None:
+            if kind != "seal":
+                return
+            from .config import DensityProperties
+            if str(DensityProperties.PYRAMID_BUILD.get() or "off") != "seal":
+                return
+            from .jobs import run_pyramid_build
+            try:
+                run_pyramid_build(self, name)
+            except Exception:  # noqa: BLE001 — build-behind is best-effort
+                pass
+        return on_event
+
+    def build_pyramids(self, name: str) -> int:
+        """Build density pyramids for a lean schema's sealed scale-index
+        generations (ISSUE 18): one whole-world multi-resolution grid
+        stack per generation, cached under the compaction-invalidated
+        partial-cache policy so interactive heatmap/tile requests stop
+        rescanning immutable history.  Idempotent — generations that
+        already have pyramids are skipped.  Returns the number built
+        (0 for non-lean schemas or indexes without pyramid support)."""
+        return self._store(name).build_pyramids()
+
+    def density_tile(self, name: str, z: int, x: int, y: int, *,
+                     tile: int = 256, query=None,
+                     timeout_ms: float | None = None) -> np.ndarray:
+        """One ``(tile, tile)`` density grid for slippy-map tile
+        ``(z, x, y)`` on the plate-carrée world grid (ISSUE 18).
+
+        Serving holds one admission token and an optional deadline like
+        any query.  With no ``query``, no auth provider, and no
+        tombstones, a lean point schema serves the tile from the scale
+        index's density path — pyramid-cached for sealed generations
+        while ``tile·2^z`` stays at/below the configured pyramid base,
+        live/pyramid-less generations rescanned (exact either way).
+        Otherwise the tile runs through :func:`density_process` with
+        the tile envelope ANDed into the filter (CQL string)."""
+        import time
+        from .index.pyramid import tile_env
+        from .metrics import (
+            TILE_REQUEST_MS, TILE_REQUESTS, registry as _metrics,
+        )
+        from .obs import span as obs_span
+        from .resilience import admission_gate, deadline_scope
+        z, x, y = int(z), int(x), int(y)
+        n = 1 << z
+        if not (0 <= z <= 30) or not (0 <= x < n and 0 <= y < n):
+            raise ValueError(f"tile ({z}/{x}/{y}) out of range")
+        store = self._store(name)
+        token = admission_gate.acquire(name)
+        t0 = time.perf_counter()
+        try:
+            with deadline_scope(timeout_ms, False):
+                with obs_span("tile.render", schema=name, z=z, x=x,
+                              y=y, tile=tile):
+                    _metrics.counter(TILE_REQUESTS).inc()
+                    has_tomb = (store.tombstone is not None
+                                and bool(store.tombstone.any()))
+                    if (query is None and self._auth_provider is None
+                            and store.lean and not has_tomb
+                            and store.batch is not None):
+                        idx = store._lean_index()
+                        if hasattr(idx, "density_tile"):
+                            return np.asarray(
+                                idx.density_tile(z, x, y, tile),
+                                np.float64)
+                    from .process.density import density_process
+                    env = tile_env(z, x, y)
+                    gf = self.get_schema(name).geom_field
+                    bbox = (f"BBOX({gf}, {env[0]}, {env[1]}, "
+                            f"{env[2]}, {env[3]})")
+                    q = bbox if query is None else f"({query}) AND {bbox}"
+                    return np.asarray(
+                        density_process(self, name, q, env, tile, tile),
+                        np.float64)
+        finally:
+            _metrics.timer(TILE_REQUEST_MS).update(
+                (time.perf_counter() - t0) * 1e3)
+            token.release()
+
     def _stats_path(self, name: str, store) -> str:
         """Per-schema stats file.  Multihost (with >1 process, matching
         the lean id-prefix gating in _init_lean): sketches hold THIS
@@ -3017,6 +3129,7 @@ class TpuDataStore:
                 sft = parse_spec(meta["name"], meta["spec"])
                 store = _SchemaStore(sft, mesh=self._mesh,
                                          multihost=self._multihost)
+                store.pyramid_trigger = self._pyramid_listener(sft.name)
                 # recorded layout versions win over spec defaults; v1
                 # (pre-versioning) catalogs were written with the then-
                 # current layouts, which match today's defaults

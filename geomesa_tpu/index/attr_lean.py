@@ -452,6 +452,9 @@ class LeanAttrIndex:
         from .partial_cache import PartialCache
         self._sketch_cache = PartialCache(self.SKETCH_CACHE_SPECS,
                                           self.SKETCH_CACHE_MAX_BYTES)
+        #: generation-lifecycle hooks ``(kind, gen_ids)`` fired on
+        #: seal/merge (index/lsm.notify_generation_event)
+        self.generation_listeners: list = []
         #: store-lifetime run-id source (see _AttrGeneration.gen_id)
         self._gen_counter = 0
 
@@ -571,10 +574,13 @@ class LeanAttrIndex:
             if gen is None or gen.tier == "host" or gen.n >= gen.capacity:
                 if gen is not None and gen.tier != "host":
                     # live run seals on rollover (write-span taxonomy)
+                    sealed_id = gen.gen_id
                     with obs_span("write.seal", gen_id=gen.gen_id,
                                   tier=gen.tier, rows=int(gen.n)):
                         obs_count(WRITE_SEALS)
                         gen = self._roll_generation()
+                    from .lsm import notify_generation_event
+                    notify_generation_event(self, "seal", [sealed_id])
                 else:
                     gen = self._roll_generation()
             room = gen.capacity - gen.n
@@ -642,6 +648,8 @@ class LeanAttrIndex:
         )
         _metrics.counter(LEAN_COMPACTION_MERGES).inc()
         _metrics.counter(LEAN_COMPACTION_ROWS).inc(total)
+        from .lsm import notify_generation_event
+        notify_generation_event(self, "merge", [merged.gen_id])
 
     def compact(self, budget_ms: float | None = None,
                 factor: int | None = None,

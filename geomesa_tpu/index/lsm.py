@@ -13,7 +13,23 @@ from __future__ import annotations
 import time
 
 __all__ = ["plan_size_tiered", "compact_incremental", "merged_capacity",
-           "replace_group"]
+           "notify_generation_event", "replace_group"]
+
+
+def notify_generation_event(index, kind: str, gen_ids: list) -> None:
+    """Fan a generation-lifecycle event (``"seal"`` / ``"merge"``) out
+    to an index's registered ``generation_listeners``.
+
+    Listeners drive OPTIONAL build-behind work (the density-pyramid
+    jobs of ISSUE 18); a listener failure must never break the ingest
+    or compaction path that fired the event, so exceptions are
+    swallowed here — listeners that want visibility run inside the job
+    registry, which records the failure on its own record."""
+    for listener in getattr(index, "generation_listeners", ()):
+        try:
+            listener(kind, list(gen_ids))
+        except Exception:  # noqa: BLE001 — background hooks are best-effort
+            pass
 
 
 def replace_group(generations: list, group: list, merged) -> list:

@@ -64,6 +64,7 @@ IndexAdapter.scala:95-106 (writers), AccumuloQueryPlan.scala:87-157
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -76,6 +77,7 @@ from ..metrics import (
     LEAN_COMPACTION_MERGES, LEAN_COMPACTION_ROWS,
     LEAN_DENSITY_CACHE_HITS, LEAN_DENSITY_CACHE_MISSES,
     LEAN_SKETCH_CACHE_HITS, LEAN_SKETCH_CACHE_MISSES,
+    PYRAMID_BUILDS, PYRAMID_BUILD_MS, PYRAMID_SERVE_HITS,
     RESILIENCE_DEGRADED, RESILIENCE_RETRIES,
     WRITE_SEALS, WRITE_SPILLS, registry as _metrics,
 )
@@ -902,6 +904,11 @@ class LeanZ3Index:
     #: time-bins × 2^bits int64 per sealed generation)
     SKETCH_CACHE_SPECS = 8
     SKETCH_CACHE_MAX_BYTES = 64 * 2**20
+    #: density-pyramid cache spec bound (ISSUE 18): one spec per base
+    #: resolution — two lets a live base-resolution retune keep serving
+    #: off the old stack while the new one builds behind.  The byte
+    #: ceiling comes from ``geomesa.density.pyramid.cache.bytes``.
+    PYRAMID_CACHE_SPECS = 2
 
     def __init__(self, period: TimePeriod | str = TimePeriod.WEEK,
                  version: int = Z3_INDEX_VERSION,
@@ -957,6 +964,18 @@ class LeanZ3Index:
         #: policy over the z3 cell-count folds Z3Histogram pushes down
         self._sketch_cache = PartialCache(self.SKETCH_CACHE_SPECS,
                                           self.SKETCH_CACHE_MAX_BYTES)
+        #: sealed-generation density pyramids (ISSUE 18): the same
+        #: policy over whole-world multi-resolution grid stacks —
+        #: spec is ``("pyramid", base)``, so rebuilds at a new base
+        #: resolution coexist until the LRU retires the old one
+        from ..config import DensityProperties
+        self._pyramid_cache = PartialCache(
+            self.PYRAMID_CACHE_SPECS,
+            DensityProperties.PYRAMID_CACHE_BYTES.to_int())
+        #: generation-lifecycle listeners (index/lsm
+        #: notify_generation_event): ``listener(kind, gen_ids)`` fired
+        #: on seal/merge — the build-behind hook pyramid jobs ride
+        self.generation_listeners: list = []
         #: store-lifetime generation id source (see _Generation.gen_id)
         self._gen_counter = 0
 
@@ -1022,7 +1041,8 @@ class LeanZ3Index:
                 "hbm_budget_bytes": self.hbm_budget_bytes,
                 "generations": gens,
                 "caches": {"density": self._density_cache.stats(),
-                           "sketch": self._sketch_cache.stats()},
+                           "sketch": self._sketch_cache.stats(),
+                           "pyramid": self._pyramid_cache.stats()},
                 "dispatches": self.dispatch_count}
 
     # -- write path -------------------------------------------------------
@@ -1150,10 +1170,16 @@ class LeanZ3Index:
                 if gen is not None and gen.tier != "host":
                     # the live generation SEALS on rollover; the span
                     # covers the rebalance (demote/spill) it triggers
+                    sealed_id = gen.gen_id
                     with obs_span("write.seal", gen_id=gen.gen_id,
                                   tier=gen.tier, rows=int(gen.n)):
                         obs_count(WRITE_SEALS)
                         gen = self._new_generation(self._n_rows + done)
+                    # AFTER the seal span: listeners schedule optional
+                    # build-behind work (density pyramids) and must
+                    # never break or slow the append itself
+                    from .lsm import notify_generation_event
+                    notify_generation_event(self, "seal", [sealed_id])
                 else:
                     gen = self._new_generation(self._n_rows + done)
             room = gen.capacity - gen.n
@@ -1241,12 +1267,20 @@ class LeanZ3Index:
         # freshly-stamped merged entry rides the prune grace window
         # while dead ids may be long-cold
         merge_index_generations(self, dead_ids, merged.gen_id)
+        # pyramid inheritance mirrors the heat inheritance above: the
+        # merged run's pyramid is the exact elementwise SUM of its
+        # sources' (same immutable keys, renamed), computed BEFORE the
+        # stale parents drop — a merge must not send tile serving back
+        # to the scan path when its inputs were already built
+        self._inherit_pyramids(dead_ids, merged.gen_id)
         self.generations = replace_group(self.generations, group,
                                          merged)
         self._drop_cached_partials(dead_ids)
         self.compactions += 1
         _metrics.counter(LEAN_COMPACTION_MERGES).inc()
         _metrics.counter(LEAN_COMPACTION_ROWS).inc(total)
+        from .lsm import notify_generation_event
+        notify_generation_event(self, "merge", [merged.gen_id])
 
     def compact(self, budget_ms: float | None = None,
                 factor: int | None = None,
@@ -1282,6 +1316,33 @@ class LeanZ3Index:
     def _drop_cached_partials(self, gen_ids: list) -> None:
         self._density_cache.drop_generations(gen_ids)
         self._sketch_cache.drop_generations(gen_ids)
+        self._pyramid_cache.drop_generations(gen_ids)
+
+    def _inherit_pyramids(self, dead_ids: list, new_gen_id: int) -> None:
+        """Compaction inheritance: when EVERY merged-away parent has a
+        pyramid under a spec (same level set), the merged run gets
+        their elementwise sum — bit-exact, because each parent level is
+        the parent's exact count grid and the merged run is exactly the
+        union of the parents' rows.  Any missing parent leaves the
+        merged run pyramid-less (the next build fills it)."""
+        from .pyramid import DensityPyramid
+        for _spec, cache in self._pyramid_cache.items():
+            parents = [cache.get(gid) for gid in dead_ids]
+            if all(p is not None for p in parents):
+                merged = DensityPyramid.sum(parents)
+                if merged is not None:
+                    self._pyramid_cache.add(cache, new_gen_id, merged)
+
+    def _pyramid_level(self, gen_id: int, width: int):
+        """The cached (width, width) pyramid grid for one sealed
+        generation, or None — serving never waits on a build."""
+        for _spec, cache in self._pyramid_cache.items():
+            pyr = cache.get(gen_id)
+            if pyr is not None:
+                lvl = pyr.level(width)
+                if lvl is not None:
+                    return lvl
+        return None
 
     def _cache_partial(self, cache: dict, gen_id: int, part) -> None:
         """Store one sealed-generation density partial (the shared
@@ -1794,10 +1855,33 @@ class LeanZ3Index:
         live = self.generations[-1] if self.generations else None
         spec = ("sweep", env_t, width, height)
         cache = self._density_spec_cache(spec)
+        # pyramid serving (ISSUE 18): a sealed generation whose built
+        # pyramid carries this exact (world, pow2, square) resolution
+        # contributes its cached level grid — bit-identical to what
+        # sweeping it produces (docs/density.md), no keys touched.
+        # Generations without a pyramid sweep as before: build-behind
+        # never blocks or changes results
+        pyr_ok = world and width == height
         dev = [g for g in self.generations if g.tier != "host"]
         scan: list = []
         for g in dev:
-            part = cache.get(g.gen_id) if g is not live else None
+            part = None
+            if g is not live:
+                if pyr_ok:
+                    part = self._pyramid_level(g.gen_id, width)
+                    if part is not None:
+                        obs_count(PYRAMID_SERVE_HITS)
+                        grid += part
+                        continue
+                part = cache.get(g.gen_id)
+            else:
+                # the live partial is immutable FOR A GIVEN ROW COUNT
+                # (the store is append-only: existing rows never
+                # change), so a repeat sweep with no interleaved
+                # appends is served without any dispatch — the
+                # interactive-tile warm path.  Any append bumps g.n
+                # and misses
+                part = cache.get(("live", g.gen_id, int(g.n)))
             if part is None:
                 scan.append(g)
             else:
@@ -1820,10 +1904,23 @@ class LeanZ3Index:
                 if g is not live:
                     obs_count(LEAN_DENSITY_CACHE_MISSES)
                     self._cache_partial(cache, g.gen_id, part)
+                else:
+                    for k in [k for k in cache
+                              if isinstance(k, tuple) and k[0] == "live"
+                              and k[1] == g.gen_id]:
+                        cache.pop(k)   # superseded row counts
+                    self._cache_partial(
+                        cache, ("live", g.gen_id, int(g.n)), part)
         scanned = {id(g) for g in scan}
         for g in self.generations:
             if g.tier != "host":
                 continue
+            if pyr_ok:
+                lvl = self._pyramid_level(g.gen_id, width)
+                if lvl is not None:
+                    obs_count(PYRAMID_SERVE_HITS)
+                    grid += lvl
+                    continue
             part = cache.get(g.gen_id)
             if part is None:
                 obs_count(LEAN_DENSITY_CACHE_MISSES)
@@ -1841,6 +1938,75 @@ class LeanZ3Index:
                  None)
                 for g in self.generations])
         return grid
+
+    def build_pyramids(self, base: int | None = None,
+                       levels: int | None = None) -> int:
+        """Build the density pyramid of every sealed generation that
+        lacks one (ISSUE 18): one whole-world sweep per generation at
+        the pow2 ``base`` resolution (device generations through the
+        jitted sweep + 2×2 reduction ladder, spilled host runs through
+        their numpy twins), cached under the shared PartialCache
+        policy.  Idempotent build-behind: already-built generations
+        are skipped, an interrupted build leaves every result exact
+        (unbuilt generations simply keep sweeping), and the next call
+        resumes with the missing ones.  Returns pyramids built."""
+        from ..config import DensityProperties
+        from ..ops.density import pyramid_reduce
+        from ..resilience import fault_point
+        from .pyramid import DensityPyramid, _ladder_depth, pyramid_spec
+        base = int(base if base is not None
+                   else DensityProperties.PYRAMID_BASE.to_int())
+        if base <= 0 or base & (base - 1):
+            raise ValueError(
+                f"pyramid base must be a power of two, got {base}")
+        levels = int(levels if levels is not None
+                     else DensityProperties.PYRAMID_LEVELS.to_int())
+        depth = _ladder_depth(base, levels)
+        cache = self._pyramid_cache.spec_cache(pyramid_spec(base))
+        env_j = jnp.asarray(np.asarray(_WORLD_ENV))
+        built = 0
+        for g in self._sealed():
+            if g.gen_id in cache:
+                continue
+            fault_point("pyramid.build")
+            t0 = time.perf_counter()
+            with obs_span("pyramid.build", gen_id=g.gen_id,
+                          tier=g.tier, base=base):
+                if g.tier == "host":
+                    pyr = DensityPyramid.from_base(
+                        g.run.sweep_partial(self.sfc, _WORLD_ENV,
+                                            base, base, True), levels)
+                else:
+                    group = self._pad_bucket([g])
+                    zs = [(self._sentinel_cols("keys")[1] if gg is None
+                           else gg.z) for gg in group]
+                    self.dispatch_count += 1
+                    with device_span("query.scan.device", stage="sweep",
+                                     runs=1):
+                        stacked = _lean_density_sweep(
+                            self.sfc, env_j, *zs, width=base,
+                            height=base, world=True)
+                        base_dev = stacked[0]
+                        lv = {base: np.asarray(base_dev, np.float64)}
+                        if depth:
+                            for arr in pyramid_reduce(base_dev, depth):
+                                a = np.asarray(arr, np.float64)
+                                lv[a.shape[0]] = a
+                    pyr = DensityPyramid(lv)
+            self._pyramid_cache.add(cache, g.gen_id, pyr)
+            obs_count(PYRAMID_BUILDS)
+            _metrics.timer(PYRAMID_BUILD_MS).update(
+                (time.perf_counter() - t0) * 1e3)
+            built += 1
+        return built
+
+    def density_tile(self, z: int, x: int, y: int, tile: int = 256,
+                     max_ranges: int = 2000) -> np.ndarray:
+        """One slippy map tile's density grid (index/pyramid.py):
+        pyramid-served while ``tile·2^z`` stays at/below the pyramid
+        base, direct bbox density scan beyond."""
+        from .pyramid import density_tile as _tile
+        return _tile(self, z, x, y, tile, max_ranges)
 
     def range_count(self, boxes, t_lo_ms, t_hi_ms,
                     max_ranges: int = 2000) -> int:

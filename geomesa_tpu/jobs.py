@@ -17,7 +17,8 @@ from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass, field
 
 __all__ = ["IngestJob", "IngestResult", "run_ingest",
-           "CompactionJob", "run_compaction"]
+           "CompactionJob", "run_compaction",
+           "PyramidJob", "run_pyramid_build"]
 
 
 @dataclass
@@ -148,6 +149,41 @@ class CompactionJob:
 def run_compaction(store, type_name: str,
                    budget_ms: float | None = None) -> dict:
     return CompactionJob(store, type_name, budget_ms).run()
+
+
+@dataclass
+class PyramidJob:
+    """Build-behind density-pyramid maintenance over a lean schema
+    (ISSUE 18): fold each sealed generation's whole-world density into
+    its multi-resolution pyramid so interactive heatmap/tile requests
+    stop rescanning immutable history.  Idempotent and resumable — a
+    generation that already has a pyramid is skipped, so an
+    interrupted build picks up the missing generations on the next
+    pass while queries keep serving exact results through the scan
+    fallback.
+
+    ``store`` — TpuDataStore; ``type_name`` — the lean schema.
+    """
+
+    store: object
+    type_name: str
+
+    def run(self) -> int:
+        """Run one build pass, registered in the background-job
+        registry (obs/jobs): the run appears in ``/debug/jobs`` with a
+        ``build`` phase span, built-pyramid progress, and a terminal
+        outcome — ``failed`` (with the error) when a build raises, so
+        an interrupted build-behind pass is traceable."""
+        from .obs.jobs import jobs_registry
+        with jobs_registry.run("pyramid", schema=self.type_name) as job:
+            with job.phase("build"):
+                built = self.store.build_pyramids(self.type_name)
+            job.progress(built=built)
+            return built
+
+
+def run_pyramid_build(store, type_name: str) -> int:
+    return PyramidJob(store, type_name).run()
 
 
 def local_paths_for_process(paths: list[str], process_index: int,

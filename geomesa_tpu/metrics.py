@@ -46,7 +46,10 @@ __all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "SERVING_FUSED_BATCHES", "SERVING_FUSED_REQUESTS",
            "SERVING_FANIN", "SERVING_COALESCE_MS",
            "SERVING_BATCH_WINDOWS", "SERVING_BYPASS",
-           "SERVING_TENANT_SHED", "SERVING_RIDER_EXPIRED"]
+           "SERVING_TENANT_SHED", "SERVING_RIDER_EXPIRED",
+           "TILE_REQUESTS", "TILE_REQUEST_MS",
+           "PYRAMID_BUILDS", "PYRAMID_BUILD_MS",
+           "PYRAMID_SERVE_HITS", "PYRAMID_SERVE_FALLBACKS"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -129,6 +132,20 @@ SERVING_BYPASS = "serving.bypass"
 SERVING_TENANT_SHED = "serving.tenant.shed"
 SERVING_RIDER_EXPIRED = "serving.rider.expired"
 
+#: density pyramids + map-tile serving (ISSUE 18, docs/density.md):
+#: ``tile.*`` is the request plane — /tiles/{z}/{x}/{y} hits and their
+#: end-to-end latency — while ``pyramid.*`` carries the precompute
+#: mechanics: per-generation builds and their durations, density
+#: requests answered by summing cached pyramid cells, and requests
+#: whose granularity was finer than the pyramid base (or whose
+#: pyramids were missing), which fell back to the direct scan path
+TILE_REQUESTS = "tile.requests"
+TILE_REQUEST_MS = "tile.request.ms"
+PYRAMID_BUILDS = "pyramid.builds"
+PYRAMID_BUILD_MS = "pyramid.build.ms"
+PYRAMID_SERVE_HITS = "pyramid.serve.hits"
+PYRAMID_SERVE_FALLBACKS = "pyramid.serve.fallbacks"
+
 #: the metric naming contract (docs/observability.md): every registry
 #: key lives under one of these top-level namespaces, dot-separated,
 #: segments drawn from [A-Za-z0-9_:-] (attr-index keys like
@@ -137,7 +154,7 @@ SERVING_RIDER_EXPIRED = "serving.rider.expired"
 #: registry after the suite and fails on any drive-by key outside it.
 METRIC_NAMESPACES = ("query", "write", "lean", "jax", "web", "storage",
                      "plan", "obs", "pallas", "heat", "job", "arrow",
-                     "resilience", "serving")
+                     "resilience", "serving", "tile", "pyramid")
 _METRIC_KEY_RE = re.compile(
     r"^(?:" + "|".join(METRIC_NAMESPACES)
     + r")(?:\.[A-Za-z0-9_:\-]+)+$")

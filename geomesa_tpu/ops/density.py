@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["density_grid", "density_grid_auto", "density_grid_sorted",
-           "grid_snap"]
+           "grid_snap", "pyramid_reduce", "pyramid_reduce_np"]
 
 
 def grid_snap(x, y, env, width: int, height: int):
@@ -72,6 +72,40 @@ def density_grid_sorted(x, y, weights, mask, env, width: int, height: int):
 #: above ~2M points (or per-point one-hot work ~6e10 compares) the sorted
 #: path beats the MXU one-hot kernel; measured crossover on v5e
 _SORTED_MIN_N = 2_000_000
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def pyramid_reduce(grid, levels: int):
+    """2×2 reduction ladder for density pyramids (ISSUE 18): fold a
+    square power-of-two (w, w) float64 cell-count grid into ``levels``
+    successively-halved sum grids, returning the tuple
+    ``(w/2, w/4, ..., w/2^levels)``.
+
+    Each level is an EXACT 2×2 block sum of its parent — counts are
+    integers carried in float64 (exact below 2^53), so any level equals
+    what binning the raw points at that resolution would produce,
+    bit-for-bit (the pyramid-serving exactness contract in
+    docs/density.md)."""
+    out = []
+    g = grid
+    for _ in range(levels):
+        h, w = g.shape
+        g = g.reshape(h // 2, 2, w // 2, 2).sum(axis=(1, 3))
+        out.append(g)
+    return tuple(out)
+
+
+def pyramid_reduce_np(grid, levels: int):
+    """Numpy twin of :func:`pyramid_reduce` for host-tier (spilled) run
+    grids — same exact 2×2 integer-in-f64 block sums, no device
+    round-trip."""
+    out = []
+    g = grid
+    for _ in range(levels):
+        h, w = g.shape
+        g = g.reshape(h // 2, 2, w // 2, 2).sum(axis=(1, 3))
+        out.append(g)
+    return tuple(out)
 
 
 def density_grid_auto(x, y, weights, mask, env, width: int, height: int):

@@ -312,6 +312,9 @@ class ShardedLeanAttrIndex:
         self._sketch_cache = PartialCache(
             LeanAttrIndex.SKETCH_CACHE_SPECS,
             LeanAttrIndex.SKETCH_CACHE_MAX_BYTES)
+        #: generation-lifecycle hooks ``(kind, gen_ids)`` fired on
+        #: seal/merge (index/lsm.notify_generation_event)
+        self.generation_listeners: list = []
         self._gen_counter = 0
 
     def _next_gen_id(self) -> int:
@@ -447,11 +450,14 @@ class ShardedLeanAttrIndex:
                     or gen.n_slots + m_pad > gen.slots:
                 if gen is not None and gen.tier != "host":
                     # live run seals on rollover (write-span taxonomy)
+                    sealed_id = gen.gen_id
                     with obs_span("write.seal", gen_id=gen.gen_id,
                                   tier=gen.tier,
                                   slots=int(gen.n_slots)):
                         obs_count(WRITE_SEALS)
                         gen = self._roll_generation()
+                    from ..index.lsm import notify_generation_event
+                    notify_generation_event(self, "seal", [sealed_id])
                 else:
                     gen = self._roll_generation()
             if gen.fill is None:
@@ -554,6 +560,8 @@ class ShardedLeanAttrIndex:
         # counts live on device)
         _metrics.counter(LEAN_COMPACTION_ROWS).inc(
             n_slots * int(self.mesh.devices.size))
+        from ..index.lsm import notify_generation_event
+        notify_generation_event(self, "merge", [merged.gen_id])
 
     def compact(self, budget_ms: float | None = None,
                 factor: int | None = None,

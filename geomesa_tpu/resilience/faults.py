@@ -39,7 +39,8 @@ __all__ = ["FAULT_POINTS", "FaultInjected", "FaultRegistry", "fault_point",
 #: this store has no WAL — the append entry point is the equivalent
 #: boundary between "row accepted" and "row indexed".
 FAULT_POINTS = ("device.dispatch", "host.spill", "arrow.flush",
-                "compaction.merge_step", "ingest.append")
+                "compaction.merge_step", "ingest.append",
+                "pyramid.build")
 
 
 class FaultInjected(RuntimeError):

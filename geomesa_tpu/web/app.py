@@ -32,6 +32,7 @@ Routes::
     GET    /debug/jobs?kind=&state=&limit=N  background-job registry
     GET    /explain?schema=&cql=             EXPLAIN ANALYZE (plan+actuals)
     GET    /explain?sql=                     EXPLAIN ANALYZE of a SQL text
+    GET    /tiles/{z}/{x}/{y}?schema=&cql=&format=json|png   density tile
 
 Malformed query-string parameters (a non-numeric ``limit``, an
 unrecognized flag value, an unknown ``state``) are a **400** with the
@@ -102,6 +103,7 @@ class WebApp:
             (r"^/debug/heat$", self._debug_heat),
             (r"^/debug/jobs$", self._debug_jobs),
             (r"^/explain$", self._explain),
+            (r"^/tiles/([^/]+)/([^/]+)/([^/]+)$", self._tile),
             (r"^/api/blob$", self._blob_index),
             (r"^/api/blob/([^/]+)$", self._blob_item),
             (r"^/wcs$", self._wcs),
@@ -569,6 +571,50 @@ class WebApp:
         if fmt != "png":
             raise HttpError(400, f"unsupported format {fmt!r}")
         return 200, _png_gray(np.asarray(grid)), "image/png"
+
+    # -- map tiles (ISSUE 18) ---------------------------------------------
+    def _tile(self, method, params, environ, z, x, y):
+        """``GET /tiles/{z}/{x}/{y}?schema=&cql=&format=json|png`` —
+        one density tile, pyramid-served over sealed generations while
+        the zoom stays at/below the pyramid base (the store's
+        density_tile contract).  Strict hardening: malformed z/x/y or
+        params are a 400 naming the offender, an unknown schema a 404,
+        malformed CQL a 400 — never a 500."""
+        if method != "GET":
+            raise HttpError(405, method)
+        try:
+            zi, xi, yi = int(z), int(x), int(y)
+        except ValueError:
+            raise HttpError(400, f"malformed tile address {z}/{x}/{y}: "
+                                 "z, x, y must be integers")
+        n = 1 << zi if zi >= 0 else 0
+        if not (0 <= zi <= 30) or not (0 <= xi < n and 0 <= yi < n):
+            raise HttpError(400, f"tile ({zi}/{xi}/{yi}) out of range: "
+                                 "need 0 <= z <= 30 and 0 <= x,y < 2^z")
+        name = params.get("schema")
+        if not name:
+            raise HttpError(400, "need ?schema=")
+        self._sft(name)
+        cql = params.get("cql")
+        if cql:
+            self._parse_cql(cql)  # strict 400 before any scan work
+        tile = int_param(params, "tile", 256)
+        if tile is None or not (1 <= tile <= 4096):
+            raise HttpError(400, f"tile size {tile} out of range (1-4096)")
+        timeout_ms = float_param(params, "timeout_ms", None)
+        fmt = (params.get("format") or "json").lower()
+        if fmt not in ("json", "png"):
+            raise HttpError(400, f"unsupported format {fmt!r}")
+        grid = np.asarray(self.store.density_tile(
+            name, zi, xi, yi, tile=tile, query=cql,
+            timeout_ms=timeout_ms))
+        if fmt == "png":
+            # grid row 0 is SOUTH; PNG row 0 renders on top → flip for
+            # the north-up image a slippy map expects
+            return 200, _png_gray(grid[::-1]), "image/png"
+        return 200, {"z": zi, "x": xi, "y": yi, "tile": tile,
+                     "total": float(grid.sum()),
+                     "grid": grid.tolist()}
 
     # -- blob store (geomesa-blobstore-web BlobstoreServlet analog) -------
     def _require_blob(self):

@@ -1,0 +1,437 @@
+"""Sealed-generation density pyramids + /tiles serving (ISSUE 18).
+
+Pins the exactness matrix of docs/density.md: a pyramid-served grid is
+bit-identical to the direct density scan at the same resolution across
+every tier mix (full / keys / host, single-chip and sharded), tiles
+slice out of that path and reassemble exactly, compaction invalidates
+merged-away pyramids and the merged run inherits its parents' sum,
+pyramid-served generations record zero-byte heat touches, an
+interrupted build (``pyramid.build`` fault point) leaves results exact
+and resumes, and the ``/tiles/{z}/{x}/{y}`` endpoint hardens malformed
+requests to 400/404 while staying recompile-free when warm.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.index.pyramid import pyramid_spec, tile_env
+from geomesa_tpu.index.z3_lean import LeanZ3Index
+from geomesa_tpu.metrics import (
+    PYRAMID_SERVE_FALLBACKS,
+    PYRAMID_SERVE_HITS,
+    registry as metrics,
+)
+
+MS = 1514764800000
+DAY = 86_400_000
+WORLD = (-180.0, -90.0, 180.0, 90.0)
+SLOTS = 1 << 12
+
+
+def _data(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return (rng.uniform(-75, -73, n), rng.uniform(40, 42, n),
+            rng.integers(MS, MS + 14 * DAY, n))
+
+
+def _brute_grid(x, y, sel, env, w, h):
+    g = np.zeros((h, w))
+    gx = np.clip(((x[sel] - env[0]) / (env[2] - env[0]) * w).astype(int),
+                 0, w - 1)
+    gy = np.clip(((y[sel] - env[1]) / (env[3] - env[1]) * h).astype(int),
+                 0, h - 1)
+    np.add.at(g, (gy, gx), 1.0)
+    return g
+
+
+def _streamed(n_gens, payload=False, budget=None, seed=3):
+    x, y, t = _data(n_gens * SLOTS, seed=seed)
+    idx = LeanZ3Index(period="week", generation_slots=SLOTS,
+                      payload_on_device=payload,
+                      hbm_budget_bytes=budget,
+                      compaction_factor=0)
+    for lo in range(0, len(x), SLOTS):
+        sl = slice(lo, lo + SLOTS)
+        idx.append(x[sl], y[sl], t[sl])
+    return idx, x, y, t
+
+
+def _hits():
+    return metrics.counter(PYRAMID_SERVE_HITS).count
+
+
+# -- bit-exactness matrix --------------------------------------------------
+@pytest.mark.parametrize("payload,budget", [
+    (True, None),                 # all full
+    (False, None),                # all keys
+    (False, 3 * SLOTS * 16),      # mixed keys/host (forced demotions)
+])
+def test_pyramid_served_density_bit_exact_all_tiers(payload, budget):
+    idx, x, y, t = _streamed(6, payload=payload, budget=budget)
+    all_m = np.ones(len(x), bool)
+    direct = idx.density([WORLD], None, None, WORLD, 128, 128)
+    np.testing.assert_array_equal(
+        direct, _brute_grid(x, y, all_m, WORLD, 128, 128))
+    built = idx.build_pyramids(base=128)
+    assert built == len(idx.generations) - 1   # every sealed gen
+    assert idx.build_pyramids(base=128) == 0   # idempotent resume
+    before = _hits()
+    served = idx.density([WORLD], None, None, WORLD, 128, 128)
+    assert _hits() - before == built           # sealed gens off-pyramid
+    np.testing.assert_array_equal(served, direct)
+    # every level the 2x2 ladder carries is bit-exact too (64 -> 1)
+    w = 64
+    while w >= 1:
+        np.testing.assert_array_equal(
+            idx.density([WORLD], None, None, WORLD, w, w),
+            _brute_grid(x, y, all_m, WORLD, w, w))
+        w //= 2
+
+
+def test_pyramid_never_stales_live_appends():
+    """Build-behind contract: appends after a build land in the live
+    generation, which is always rescanned — pyramid serving can never
+    hide new rows."""
+    idx, x, y, t = _streamed(3)
+    idx.build_pyramids(base=64)
+    x2, y2, t2 = _data(500, seed=11)
+    idx.append(x2, y2, t2)
+    ax, ay = np.concatenate([x, x2]), np.concatenate([y, y2])
+    np.testing.assert_array_equal(
+        idx.density([WORLD], None, None, WORLD, 64, 64),
+        _brute_grid(ax, ay, np.ones(len(ax), bool), WORLD, 64, 64))
+
+
+def test_empty_index_builds_nothing_and_serves_zeros():
+    idx = LeanZ3Index(period="week", generation_slots=SLOTS)
+    assert idx.build_pyramids(base=64) == 0
+    assert idx.density([WORLD], None, None, WORLD, 64, 64).sum() == 0
+
+
+# -- tiles -----------------------------------------------------------------
+def test_tiles_reassemble_exactly_and_fall_back_past_base():
+    idx, x, y, t = _streamed(4)
+    idx.build_pyramids(base=128)
+    all_m = np.ones(len(x), bool)
+    want = _brute_grid(x, y, all_m, WORLD, 128, 128)
+    # z=0: the whole world in one 64-px tile == the 64x64 ladder level
+    np.testing.assert_array_equal(
+        idx.density_tile(0, 0, 0, tile=64),
+        _brute_grid(x, y, all_m, WORLD, 64, 64))
+    # z=1: four 64-px tiles reassemble into the 128 base grid (slippy
+    # y=0 is the NORTH row; grid row 0 is south)
+    assembled = np.zeros((128, 128))
+    for ty in range(2):
+        for tx in range(2):
+            assembled[(1 - ty) * 64:(2 - ty) * 64,
+                      tx * 64:(tx + 1) * 64] = \
+                idx.density_tile(1, tx, ty, tile=64)
+    np.testing.assert_array_equal(assembled, want)
+    # finer than the pyramid base: direct bbox scan fallback, counted
+    config.set_property("geomesa.density.pyramid.base", 128)
+    try:
+        fb = metrics.counter(PYRAMID_SERVE_FALLBACKS).count
+        tz, txx, tyy = 2, 1, 1    # (-90..0, 0..45): inside the data
+        g = idx.density_tile(tz, txx, tyy, tile=64)
+        assert metrics.counter(PYRAMID_SERVE_FALLBACKS).count == fb + 1
+        env = tile_env(tz, txx, tyy)
+        m = ((x >= env[0]) & (x <= env[2])
+             & (y >= env[1]) & (y <= env[3]))
+        np.testing.assert_array_equal(
+            g, _brute_grid(x, y, m, env, 64, 64))
+    finally:
+        config.clear_property("geomesa.density.pyramid.base")
+
+
+# -- compaction: invalidation + inheritance --------------------------------
+def test_compaction_inherits_summed_pyramids_and_drops_dead():
+    idx, x, y, t = _streamed(12)     # keys tier: what compaction merges
+    built = idx.build_pyramids(base=64)
+    assert built == 11
+    cache = idx._pyramid_cache.spec_cache(pyramid_spec(64))
+    pre_ids = set(cache)
+    stats = idx.compact()
+    assert stats["merged_groups"] >= 1
+    live_ids = {g.gen_id for g in idx.generations}
+    post_ids = set(idx._pyramid_cache.spec_cache(pyramid_spec(64)))
+    assert post_ids <= live_ids              # dead gens invalidated
+    assert post_ids - pre_ids                # merged runs inherited
+    # inheritance is the SUM of the parents: no rebuild needed, and the
+    # pyramid-served grid is still bit-exact after the merge
+    assert idx.build_pyramids(base=64) == 0
+    before = _hits()
+    np.testing.assert_array_equal(
+        idx.density([WORLD], None, None, WORLD, 64, 64),
+        _brute_grid(x, y, np.ones(len(x), bool), WORLD, 64, 64))
+    assert _hits() - before == len(idx.generations) - 1
+
+
+# -- zero-byte heat touches (the PR-5 cache-hit convention) ----------------
+def test_pyramid_served_scans_record_zero_byte_heat():
+    from geomesa_tpu.obs.heat import heat_tracker
+
+    idx, x, y, t = _streamed(4)
+    idx.heat_scope = ("pyr_heat_t", "z3")
+    idx.density([WORLD], None, None, WORLD, 64, 64)   # cold: scans all
+    idx.build_pyramids(base=64)
+    sealed = [g.gen_id for g in idx.generations[:-1]]
+    live = idx.generations[-1].gen_id
+
+    def snap(gid):
+        e = heat_tracker._entries.get(("pyr_heat_t", "z3", gid))
+        return (e.scans, e.bytes_read) if e else (0, 0)
+
+    before = {gid: snap(gid) for gid in sealed + [live]}
+    idx.density([WORLD], None, None, WORLD, 64, 64)   # warm: pyramids
+    for gid in sealed + [live]:
+        scans0, bytes0 = before[gid]
+        scans1, bytes1 = snap(gid)
+        assert scans1 == scans0 + 1    # the touch IS recorded...
+        assert bytes1 == bytes0        # ...at zero bytes read (the
+        #                                live partial is row-count-keyed)
+    # an append invalidates the live partial: the next sweep reads it
+    x2, y2, t2 = _data(100, seed=13)
+    idx.append(x2, y2, t2)
+    live = idx.generations[-1].gen_id
+    b0 = snap(live)[1]
+    idx.density([WORLD], None, None, WORLD, 64, 64)
+    assert snap(live)[1] > b0          # live gen really rescanned
+
+
+# -- fault injection -------------------------------------------------------
+def test_interrupted_build_stays_exact_and_resumes():
+    from geomesa_tpu.resilience import FaultInjected
+
+    idx, x, y, t = _streamed(5)
+    want = _brute_grid(x, y, np.ones(len(x), bool), WORLD, 64, 64)
+    config.set_property("geomesa.resilience.fault.points",
+                        "pyramid.build:2")
+    try:
+        with pytest.raises(FaultInjected):
+            idx.build_pyramids(base=64)
+    finally:
+        config.clear_property("geomesa.resilience.fault.points")
+    cache = idx._pyramid_cache.spec_cache(pyramid_spec(64))
+    assert len(cache) == 1            # first gen built, rest missing
+    # unbuilt generations keep sweeping: results exact mid-build
+    np.testing.assert_array_equal(
+        idx.density([WORLD], None, None, WORLD, 64, 64), want)
+    # the next pass resumes with exactly the missing generations
+    assert idx.build_pyramids(base=64) == 3
+    np.testing.assert_array_equal(
+        idx.density([WORLD], None, None, WORLD, 64, 64), want)
+
+
+# -- build-on-seal trigger (jobs) ------------------------------------------
+def test_build_on_seal_trigger_runs_pyramid_jobs():
+    from geomesa_tpu.obs.jobs import jobs_registry
+
+    config.set_property("geomesa.density.pyramid.build", "seal")
+    config.set_property("geomesa.density.pyramid.base", 64)
+    try:
+        ds = TpuDataStore()
+        ds.create_schema(
+            "sealed", "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+                      f"geomesa.lean.generation.slots={SLOTS},"
+                      "geomesa.lean.compaction.factor=0")
+        x, y, t = _data(3 * SLOTS + 100)
+        for lo in range(0, len(x), SLOTS):
+            sl = slice(lo, lo + SLOTS)
+            ds.write("sealed", {"dtg": t[sl], "geom": (x[sl], y[sl])})
+        idx = ds._store("sealed")._lean_index()
+        cache = idx._pyramid_cache.spec_cache(pyramid_spec(64))
+        sealed = [g.gen_id for g in idx.generations[:-1]]
+        assert sealed and all(gid in cache for gid in sealed)
+        jobs = jobs_registry.jobs(kind="pyramid")
+        assert jobs and all(j.state == "succeeded" for j in jobs)
+    finally:
+        config.clear_property("geomesa.density.pyramid.build")
+        config.clear_property("geomesa.density.pyramid.base")
+
+
+# -- sharded variant -------------------------------------------------------
+def test_sharded_pyramid_exact_and_compaction_inherits():
+    from geomesa_tpu.parallel import device_mesh
+    from geomesa_tpu.parallel.lean import ShardedLeanZ3Index
+
+    slots = 1 << 9                      # per-SHARD slots: a generation
+    step = slots * len(device_mesh().devices.ravel())   # seals per step
+    x, y, t = _data(8 * step)
+    idx = ShardedLeanZ3Index(period="week", mesh=device_mesh(),
+                             generation_slots=slots,
+                             hbm_budget_bytes=slots * 20 * 3)
+    for lo in range(0, len(x), step):
+        sl = slice(lo, lo + step)
+        idx.append(x[sl], y[sl], t[sl])
+    assert idx.tier_counts()["host"] >= 1
+    want = _brute_grid(x, y, np.ones(len(x), bool), WORLD, 64, 64)
+    built = idx.build_pyramids(base=64)
+    assert built == len(idx.generations) - 1
+    before = _hits()
+    np.testing.assert_array_equal(
+        idx.density([WORLD], None, None, WORLD, 64, 64), want)
+    assert _hits() - before == built
+    np.testing.assert_array_equal(
+        idx.density_tile(0, 0, 0, tile=32),
+        _brute_grid(x, y, np.ones(len(x), bool), WORLD, 32, 32))
+    idx.compact()
+    assert idx.build_pyramids(base=64) == 0   # merged runs inherited
+    np.testing.assert_array_equal(
+        idx.density([WORLD], None, None, WORLD, 64, 64), want)
+
+
+# -- /tiles endpoint -------------------------------------------------------
+def call(app, method, path, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = int(status.split()[0])
+        captured["headers"] = dict(headers)
+
+    qs = ""
+    if "?" in path:
+        path, qs = path.split("?", 1)
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": qs,
+        "CONTENT_LENGTH": str(len(raw)),
+        "wsgi.input": io.BytesIO(raw),
+    }
+    chunks = app(environ, start_response)
+    body = b"".join(chunks)
+    ctype = captured["headers"].get("Content-Type", "")
+    parsed = (json.loads(body.decode())
+              if "json" in ctype and body else body)
+    return captured["status"], parsed
+
+
+@pytest.fixture(scope="module")
+def tile_app():
+    from geomesa_tpu.web import WebApp
+
+    ds = TpuDataStore(user="tiler")
+    ds.create_schema(
+        "pts", "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+               f"geomesa.lean.generation.slots={SLOTS},"
+               "geomesa.lean.compaction.factor=0")
+    x, y, t = _data(3 * SLOTS)
+    ds.write("pts", {"dtg": t, "geom": (x, y)})
+    ds.build_pyramids("pts")
+    return WebApp(ds), (x, y, t)
+
+
+def test_tiles_endpoint_serves_json_and_png(tile_app):
+    app, (x, y, t) = tile_app
+    status, body = call(app, "GET", "/tiles/0/0/0?schema=pts")
+    assert status == 200
+    assert body["z"] == 0 and body["tile"] == 256
+    grid = np.asarray(body["grid"])
+    np.testing.assert_array_equal(
+        grid, _brute_grid(x, y, np.ones(len(x), bool),
+                          WORLD, 256, 256))
+    assert body["total"] == len(x)
+    status, png = call(app, "GET",
+                       "/tiles/0/0/0?schema=pts&format=png")
+    assert status == 200
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_tiles_endpoint_cql_filter_and_timeout_param(tile_app):
+    app, (x, y, t) = tile_app
+    cql = "BBOX(geom, -75, 40, -74, 41)"
+    status, body = call(
+        app, "GET",
+        f"/tiles/0/0/0?schema=pts&cql={cql}&timeout_ms=60000")
+    assert status == 200
+    m = (x >= -75) & (x <= -74) & (y >= 40) & (y <= 41)
+    assert body["total"] == int(m.sum())
+
+
+def test_tiles_endpoint_request_hardening(tile_app):
+    app, _ = tile_app
+    cases = [
+        ("/tiles/abc/0/0?schema=pts", 400),        # malformed z
+        ("/tiles/0/0/0.5?schema=pts", 400),        # malformed y
+        ("/tiles/0/0/0", 400),                     # missing schema
+        ("/tiles/0/0/0?schema=nope", 404),         # unknown schema
+        ("/tiles/1/2/0?schema=pts", 400),          # x out of range at z
+        ("/tiles/-1/0/0?schema=pts", 400),         # negative zoom
+        ("/tiles/31/0/0?schema=pts", 400),         # zoom past ceiling
+        ("/tiles/0/0/0?schema=pts&cql=NOT%20CQL(", 400),   # bad CQL
+        ("/tiles/0/0/0?schema=pts&format=gif", 400),       # bad format
+        ("/tiles/0/0/0?schema=pts&tile=0", 400),           # bad tile px
+        ("/tiles/0/0/0?schema=pts&tile=9999", 400),        # tile ceiling
+    ]
+    for path, want in cases:
+        status, _body = call(app, "GET", path)
+        assert status == want, path
+    status, _body = call(app, "POST", "/tiles/0/0/0?schema=pts",
+                         body={})
+    assert status == 405
+
+
+def test_warm_tile_serving_is_recompile_free(tile_app):
+    from geomesa_tpu.obs import compile_count
+
+    app, _ = tile_app
+    for tx, ty in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        call(app, "GET", f"/tiles/1/{tx}/{ty}?schema=pts")   # warm-up
+    before = compile_count()
+    for tx, ty in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        status, _b = call(app, "GET",
+                          f"/tiles/1/{tx}/{ty}?schema=pts")
+        assert status == 200
+    assert compile_count() - before == 0
+
+
+def test_store_tile_with_visibility_masks_falls_back_exact():
+    """An auth provider forces the density_process path (pyramids sum
+    EVERY row; visibility filtering happens at materialization) — the
+    tile counts only the rows the caller may see."""
+    class Auth:
+        def get_authorizations(self):
+            return ["user"]
+
+    ds = TpuDataStore(auth_provider=Auth())
+    ds.create_schema(
+        "vis", "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+               f"geomesa.lean.generation.slots={SLOTS},"
+               "geomesa.lean.compaction.factor=0")
+    x, y, t = _data(SLOTS)
+    half = SLOTS // 2
+    ds.write("vis", {"dtg": t[:half], "geom": (x[:half], y[:half])},
+             visibility="user")
+    ds.write("vis", {"dtg": t[half:], "geom": (x[half:], y[half:])},
+             visibility="admin")
+    ds.build_pyramids("vis")
+    grid = ds.density_tile("vis", 0, 0, 0, tile=64)
+    vis = np.zeros(SLOTS, bool)
+    vis[:half] = True
+    np.testing.assert_array_equal(
+        grid, _brute_grid(x, y, vis, WORLD, 64, 64))
+
+
+def test_store_tile_with_tombstones_falls_back_exact():
+    """Deleted rows force the density_process path (pyramids would
+    over-count them); the tile is exact over the surviving rows."""
+    ds = TpuDataStore(user="tiler")
+    ds.create_schema(
+        "del", "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+               f"geomesa.lean.generation.slots={SLOTS},"
+               "geomesa.lean.compaction.factor=0")
+    x, y, t = _data(2 * SLOTS)
+    ds.write("del", {"dtg": t, "geom": (x, y)})
+    ds.build_pyramids("del")
+    assert ds.delete("del", [str(i) for i in range(500)]) == 500
+    alive = np.ones(len(x), bool)
+    alive[:500] = False
+    grid = ds.density_tile("del", 0, 0, 0, tile=64)
+    np.testing.assert_array_equal(
+        grid, _brute_grid(x, y, alive, WORLD, 64, 64))
