@@ -422,6 +422,7 @@ def main():
     resilience_stanza = _guarded_stanza(_resilience_stanza)
     serving_stanza = _guarded_stanza(_serving_stanza)
     pyramid_stanza = _guarded_stanza(_pyramid_stanza)
+    planning_stanza = _guarded_stanza(_planning_stanza)
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -459,6 +460,7 @@ def main():
             "resilience": resilience_stanza,
             "serving": serving_stanza,
             "pyramid": pyramid_stanza,
+            "planning": planning_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -498,6 +500,13 @@ def main():
     # (ISSUE 18)
     for f in (pyramid_stanza or {}).get("gate_failures", ()):
         regressions.append({"metric": "pyramid.gate", "prior": None,
+                            "current": None, "ratio": None,
+                            "detail": f})
+    # planning acceptance-gate failures (sketch-fed mispredict p95,
+    # exactly-once bit-exact replans, zero warm recompiles) likewise
+    # (ISSUE 19)
+    for f in (planning_stanza or {}).get("gate_failures", ()):
+        regressions.append({"metric": "planning.gate", "prior": None,
                             "current": None, "ratio": None,
                             "detail": f})
     full["regressions"] = regressions
@@ -600,6 +609,12 @@ def _compact_summary(full: dict) -> dict:
                           "bit_exact", "fault_exact",
                           "warm_recompiles")
                 if k in (ex.get("pyramid") or {})},
+            "planning": {
+                k: (ex.get("planning") or {}).get(k)
+                for k in ("sketch_p95_ratio_dist",
+                          "heuristic_p95_ratio_dist",
+                          "replan_count", "warm_recompiles")
+                if k in (ex.get("planning") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -1516,6 +1531,172 @@ def _pyramid_stanza() -> dict:
     return out
 
 
+def _planning_stanza() -> dict:
+    """Sketch-driven planning acceptance gate (ISSUE 19): on a SKEWED
+    multi-generation lean store, sketch-fed estimates must pull the
+    per-query ``plan.estimate.ratio`` distance-from-1 p95 at or below
+    the heuristic baseline's (docs/planning.md); a skew-constructed
+    mispredict must replan exactly once with bit-exact results; a
+    well-predicted query must never replan; warm queries stay
+    recompile-free through the adaptive machinery.
+    ``PLANNING_BENCH_N=0`` skips."""
+    import numpy as np
+
+    n = int(os.environ.get("PLANNING_BENCH_N", 2_000_000))
+    if not n:
+        return {"skipped": True}
+    out: dict = {}
+    try:
+        from geomesa_tpu import config as gm_config
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.metrics import PLAN_REPLANNED, registry
+        from geomesa_tpu.obs import compile_count
+
+        ms0 = 1_514_764_800_000
+        day = 86_400_000
+        slots = 1 << 16
+        rng = np.random.default_rng(41)
+        ds = TpuDataStore(user="planning-bench")
+        ds.create_schema(
+            "pb", "name:String:index=true,dtg:Date,*geom:Point;"
+                  "geomesa.index.profile=lean,"
+                  f"geomesa.lean.generation.slots={slots},"
+                  "geomesa.lean.compaction.factor=0")
+        for lo in range(0, n, slots):
+            m = min(slots, n - lo)
+            dense = int(m * 0.85)     # skew: hot cluster + sparse tail
+            ds.write("pb", {
+                "name": np.where(rng.uniform(size=m) < 0.9, "hot",
+                                 "cold").astype(object),
+                "dtg": rng.integers(ms0, ms0 + 14 * day, m),
+                "geom": (np.concatenate(
+                             [rng.uniform(-74.05, -74.0, dense),
+                              rng.uniform(-80, -70, m - dense)]),
+                         np.concatenate(
+                             [rng.uniform(40.0, 40.05, dense),
+                              rng.uniform(35, 45, m - dense)]))})
+        ds._store("pb")._indexes["z3"].block()
+        # the ratio workload: the hot cluster (heuristics underestimate
+        # badly), a same-size cold box (over), a wide box, and a
+        # time-restricted cluster slice
+        queries = [
+            "BBOX(geom,-74.06,39.99,-73.99,40.06)",
+            "BBOX(geom,-77.06,42.99,-76.99,43.06)",
+            "BBOX(geom,-79,36,-71,44)",
+            ("BBOX(geom,-74.06,39.99,-73.99,40.06) AND dtg DURING "
+             "2018-01-01T00:00:00Z/2018-01-04T00:00:00Z"),
+        ]
+
+        def _ratio_dists() -> list:
+            dists = []
+            for q in queries:
+                r = ds.explain_analyze("pb", q).summary.get(
+                    "estimate_ratio")
+                if r and r > 0:
+                    dists.append(max(float(r), 1.0 / float(r)))
+            return sorted(dists)
+
+        def _p(dists: list, q: float) -> float:
+            return round(dists[min(len(dists) - 1,
+                                   int(q * len(dists)))], 3)
+
+        # A/B the estimate ladder with replanning OFF so the ratios
+        # measure pure estimate quality, not the correction; pin the
+        # size gate open so a reduced PLANNING_BENCH_N can't silently
+        # turn the sketch arm into a second heuristic arm
+        gm_config.set_property("geomesa.planning.estimator.min.rows", 0)
+        gm_config.set_property("geomesa.planning.replan.threshold", 0.0)
+        gm_config.set_property("geomesa.planning.estimator.enabled",
+                               False)
+        try:
+            d = _ratio_dists()
+            out["heuristic_p50_ratio_dist"] = _p(d, 0.5)
+            out["heuristic_p95_ratio_dist"] = _p(d, 0.95)
+            gm_config.set_property("geomesa.planning.estimator.enabled",
+                                   True)
+            d = _ratio_dists()
+            out["sketch_p50_ratio_dist"] = _p(d, 0.5)
+            out["sketch_p95_ratio_dist"] = _p(d, 0.95)
+        finally:
+            gm_config.clear_property("geomesa.planning.replan.threshold")
+            gm_config.clear_property(
+                "geomesa.planning.estimator.enabled")
+
+        # warm latency + recompile discipline with the adaptive
+        # machinery at its DEFAULTS (replan armed, estimator on; the
+        # 2M store clears the size gate, so min.rows stays pinned at 0
+        # only for reduced-N runs)
+        hot = queries[0]
+        for q in queries:
+            ds.query_result("pb", q)        # warm every shape
+        c0 = compile_count()
+        times = sorted(_median_time(
+            lambda: ds.query_result("pb", hot), iters=3)
+            for _ in range(5))
+        out["query_warm_p99_ms"] = round(times[-1] * 1e3, 2)
+        out["warm_recompiles"] = int(compile_count() - c0)
+
+        # mispredict drill: heuristics under the skew MUST replan
+        # exactly once, bit-exact against the non-adaptive path; the
+        # sketch-fed plan of the same query must never replan
+        oracle = np.sort(ds.query_result("pb", hot).positions)
+        gm_config.set_property("geomesa.planning.estimator.enabled",
+                               False)
+        gm_config.set_property("geomesa.planning.replan.threshold", 2.0)
+        gm_config.set_property("geomesa.planning.replan.min.rows", 64)
+        try:
+            before = registry.counter(PLAN_REPLANNED).count
+            adaptive = np.sort(ds.query_result("pb", hot).positions)
+            out["replan_count"] = int(
+                registry.counter(PLAN_REPLANNED).count - before)
+            out["replan_exact"] = bool(np.array_equal(adaptive, oracle))
+            gm_config.set_property("geomesa.planning.estimator.enabled",
+                                   True)
+            before = registry.counter(PLAN_REPLANNED).count
+            ds.query_result("pb", hot)
+            out["well_predicted_replans"] = int(
+                registry.counter(PLAN_REPLANNED).count - before)
+        finally:
+            gm_config.clear_property(
+                "geomesa.planning.estimator.enabled")
+            gm_config.clear_property(
+                "geomesa.planning.estimator.min.rows")
+            gm_config.clear_property("geomesa.planning.replan.threshold")
+            gm_config.clear_property("geomesa.planning.replan.min.rows")
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
+    # acceptance gates OUTSIDE the try (arrow-stanza precedent)
+    failures = []
+    if "error" not in out and not out.get("skipped"):
+        sp, hp = (out.get("sketch_p95_ratio_dist"),
+                  out.get("heuristic_p95_ratio_dist"))
+        if sp is None or hp is None or sp > hp * 1.05:
+            failures.append(
+                f"sketch-fed ratio-dist p95 {sp} not <= heuristic "
+                f"baseline {hp}")
+        if out.get("replan_count") != 1:
+            failures.append(
+                f"skew mispredict replanned {out.get('replan_count')} "
+                "times, expected exactly 1")
+        if not out.get("replan_exact"):
+            failures.append("replanned results diverged from the "
+                            "non-adaptive oracle")
+        if out.get("well_predicted_replans", 1) != 0:
+            failures.append(
+                f"well-predicted query replanned "
+                f"{out.get('well_predicted_replans')} times")
+        if out.get("warm_recompiles", 1) != 0:
+            failures.append(
+                f"{out.get('warm_recompiles')} recompiles across warm "
+                "adaptive queries")
+    if failures:
+        out["gate_failures"] = failures
+        for f in failures:
+            print(f"BENCH PLANNING GATE FAILED: {f}", flush=True)
+    out.update(_mem_probe())
+    return out
+
+
 def _lint_stanza() -> dict:
     """gm-lint no-op guard (ISSUE 13 satellite): the static-analysis
     gate must pass on the benched tree AND stay importable with NO jax
@@ -1576,8 +1757,10 @@ REGRESSION_TOLERANCE = 0.20
 #: tax leaves (heat tracking + write spans must stay cheap); anything
 #: else (hit counts, row totals, booleans) is not a direction and is
 #: never flagged
+#: the PLANNING direction (ISSUE 19): mispredict distance
+#: (max(ratio, 1/ratio), 1.0 = perfect estimate) regresses UP
 _LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_rss_mb", "_resident_bytes",
-                          "_overhead_pct")
+                          "_overhead_pct", "_ratio_dist")
 #: the SERVING direction (ISSUE 17) adds the fused-plane leaves: qps
 #: and batch fan-in regress DOWN like any other rate
 _HIGHER_BETTER_MARKS = ("per_sec", "speedup", "wins", "value",

@@ -21,7 +21,7 @@ from typing import Any
 __all__ = ["SystemProperty", "SchemaOption", "QueryProperties",
            "ObsProperties", "ArrowProperties", "SchemaProperties",
            "ConfigProperties", "ResilienceProperties",
-           "DensityProperties",
+           "DensityProperties", "PlanningProperties",
            "set_property", "clear_property", "config_generation",
            "known_option_names", "check_option_name",
            "UnknownOptionWarning"]
@@ -389,12 +389,53 @@ class DensityProperties:
     PYRAMID_BUILD = SystemProperty("geomesa.density.pyramid.build", "off")
 
 
+class PlanningProperties:
+    """Cost-based planning knobs (ISSUE 19, docs/planning.md):
+    sketch-fed cardinality estimation and adaptive mid-query
+    replanning.  All are re-read per query plan, so a live process
+    retunes without restart."""
+
+    #: sketch-fed estimation master switch: off makes the decider cost
+    #: strategies from whole-store stats / heuristics only (the PR 4
+    #: baseline — what the bench A/B compares against)
+    ESTIMATOR_ENABLED = SystemProperty(
+        "geomesa.planning.estimator.enabled", True)
+    #: live-row floor below which the sketch tier is skipped entirely:
+    #: the cold per-generation sketch folds (device dispatches + XLA
+    #: compiles) cannot amortize on a store a whole scan finishes in
+    #: milliseconds, and at small scale a misplanned strategy costs
+    #: less than building the tables — the decider plans from
+    #: whole-store stats / heuristics exactly as if the estimator were
+    #: off.  0 sketches every store regardless of size
+    ESTIMATOR_MIN_ROWS = SystemProperty(
+        "geomesa.planning.estimator.min.rows", 262_144)
+    #: assumed selectivity of an attribute equality with no usable
+    #: stat (fraction of the store the strategy is costed at) — the
+    #: named replacement for the old bare ``total / 10``
+    SELECTIVITY_EQUALS_DEFAULT = SystemProperty(
+        "geomesa.planning.selectivity.equals.default", 0.1)
+    #: assumed selectivity of an attribute range/prefix with no usable
+    #: stat — the named replacement for the old bare ``total / 4``
+    SELECTIVITY_RANGE_DEFAULT = SystemProperty(
+        "geomesa.planning.selectivity.range.default", 0.25)
+    #: adaptive-replan divergence trigger: when a scan's candidate
+    #: probe observes more than ``threshold × estimate`` rows, the
+    #: remaining scan aborts and the query replans ONCE with the
+    #: observed actual folded in; <= 0 disables replanning
+    REPLAN_THRESHOLD = SystemProperty(
+        "geomesa.planning.replan.threshold", 8.0)
+    #: observed-row floor below which a divergence never triggers a
+    #: replan — aborting a tiny scan costs more than finishing it
+    REPLAN_MIN_ROWS = SystemProperty(
+        "geomesa.planning.replan.min.rows", 4096)
+
+
 def _register_declarations() -> None:
     """Fill the option registry from the declaration classes above —
     the one place a knob becomes 'known' to the strict mode."""
     for cls in (QueryProperties, ObsProperties, ArrowProperties,
                 SchemaProperties, ConfigProperties, ResilienceProperties,
-                ServingProperties, DensityProperties):
+                ServingProperties, DensityProperties, PlanningProperties):
         for value in vars(cls).values():
             if isinstance(value, (SystemProperty, SchemaOption)):
                 _REGISTRY[value.name] = value

@@ -173,6 +173,10 @@ class _SchemaStore:
         #: which cross-host clock/mtime-granularity skew can mis-order
         #: on shared catalog dirs (round-4 ADVICE)
         self.stats_generation: int = 0
+        #: lazily-built sketch-fed cardinality estimator (ISSUE 19);
+        #: one per store — it caches merged sketch tables per
+        #: generation signature internally
+        self._estimator = None
         self._init_stats()
         if self.lean:
             self._init_lean()
@@ -808,6 +812,30 @@ class _SchemaStore:
             mask = visibility_mask(self.visibilities, key)
             cache[key] = None if mask.all() else mask
         return cache[key]
+
+    def estimator(self):
+        """Sketch-fed cardinality estimator for the planner (ISSUE 19).
+
+        Lean stores only: the estimator reads the generational indexes'
+        run sketches (z3 cell-counts, attr histograms/count-min), which
+        exist only in the lean profile.  Full-fat stores return None and
+        the decider falls back to whole-store stats, then heuristics.
+        Small stores return None too (``estimator.min.rows``): the cold
+        per-generation sketch folds cannot amortize on a store a whole
+        scan finishes in milliseconds, so sketch costing only switches
+        on at the scale where misplanning actually hurts.
+        Multihost: sketch tables derive from globally-fetched index
+        state, so every process computes the same estimates."""
+        if not self.lean:
+            return None
+        from .config import PlanningProperties
+        rows = len(self.batch) if self.batch is not None else 0
+        if rows < PlanningProperties.ESTIMATOR_MIN_ROWS.to_int():
+            return None
+        if self._estimator is None:
+            from .planning.estimator import CardinalityEstimator
+            self._estimator = CardinalityEstimator(self)
+        return self._estimator
 
     def stats_map(self) -> dict:
         """Planning/stat sketches.  Multihost: the per-process sketches

@@ -814,6 +814,11 @@ class LeanAttrIndex:
                              rows=int(sum(g.n for g in dev_gens))):
                 totals = np.asarray(_attr_count_multi(
                     jklo, jkhi, jslo, jshi, *count_cols))
+            # adaptive-replan probe point (ISSUE 19): device totals are
+            # known BEFORE any gather, so aborting here discards nothing
+            from ..planning.adaptive import check_replan
+            dev_total = int(totals.sum())
+            check_replan("query.scan.probe", dev_total)
             if int(totals.sum()):
                 capacity = gather_capacity(int(totals.max()),
                                            minimum=self.DEFAULT_CAPACITY)
@@ -873,6 +878,10 @@ class LeanAttrIndex:
                 host_cand_n = int(len(coded))
                 if len(coded):
                     parts.append(coded)
+        if host_cand_n:
+            from ..planning.adaptive import check_replan
+            check_replan("query.scan.probe",
+                         (dev_total if dev_gens else 0) + host_cand_n)
         if heat_enabled():
             # heat touches: device runs attribute candidates exactly
             # from the probe totals; host candidates split

@@ -1464,6 +1464,11 @@ class LeanZ3Index:
                              bytes=n_dev * KEYS_BYTES):
                 totals = np.asarray(_lean_count_multi(rb, rlo, rhi,
                                                       *count_cols))
+        # adaptive-replan probe point (ISSUE 19): the device totals are
+        # known BEFORE any gather, so aborting here discards nothing
+        from ..planning.adaptive import check_replan
+        dev_total = int(totals.sum()) if dev_gens else 0
+        check_replan("query.scan.probe", dev_total)
         coded_parts: list = []
         # keys_cand also collects DEGRADED candidates from either
         # device tier (ISSUE 16): the recheck below restores exactness
@@ -1501,6 +1506,10 @@ class LeanZ3Index:
                 host_cand_n = int(len(coded))
                 if len(coded):
                     keys_cand.append(coded)
+        if host_cand_n:
+            # second probe point: host-tier candidates are counted
+            # before the payload recheck, the expensive host step
+            check_replan("query.scan.probe", dev_total + host_cand_n)
         if heat_enabled():
             # per-generation access temperature (obs/heat): device
             # generations attribute candidates exactly (the probe's

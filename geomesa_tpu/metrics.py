@@ -36,7 +36,8 @@ __all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "LEAN_SKETCH_SCANS", "LEAN_STATS_MATERIALIZED",
            "LEAN_DEVICE_DISPATCHES", "LEAN_DEVICE_MS",
            "JAX_COMPILE_COUNT", "JAX_COMPILE_MS", "JAX_COMPILE_FALLBACK",
-           "PLAN_ESTIMATE_RATIO", "WRITE_SEALS", "WRITE_SPILLS",
+           "PLAN_ESTIMATE_RATIO", "PLAN_REPLANNED",
+           "WRITE_SEALS", "WRITE_SPILLS",
            "ARROW_CHUNKS", "ARROW_ROWS", "ARROW_BYTES",
            "QUERY_TIMEOUTS", "QUERY_SHED",
            "RESILIENCE_DEGRADED", "RESILIENCE_RETRIES",
@@ -86,6 +87,11 @@ JAX_COMPILE_FALLBACK = "jax.compile.fallback_count"
 #: histogram whose p50/p95/p99 say how wrong the cost model runs (the
 #: baseline the item-4 sketch-driven planner has to beat)
 PLAN_ESTIMATE_RATIO = "plan.estimate.ratio"
+#: adaptive mid-query replans (ISSUE 19, planning/adaptive.py): scans
+#: whose candidate probe diverged past geomesa.planning.replan.threshold
+#: and re-entered the decider with observed actuals — bounded to one
+#: per query, so this counts mispredicts bad enough to act on
+PLAN_REPLANNED = "plan.replanned"
 #: write-path lifecycle events (ISSUE 12): generations sealed by a
 #: rollover and key runs spilled device → host under budget pressure —
 #: counted once per event and mirrored onto the active write span via

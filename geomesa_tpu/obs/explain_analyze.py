@@ -91,6 +91,8 @@ def _summary_from(trace) -> dict:
         "actual_scanned": a.get("plan.actual.scanned"),
         "actual_matched": a.get("plan.actual.matched"),
         "estimate_ratio": a.get("plan.estimate.ratio"),
+        "estimate_source": a.get("plan.estimate.source"),
+        "replanned": bool(a.get("plan.replanned", False)),
     })
     for s in trace.spans:
         if s.name == "query.plan":
@@ -145,11 +147,14 @@ class ExplainAnalyzeResult:
             est, act = s.get("estimate_rows"), s.get("actual_scanned")
             lines.append(
                 f"  strategy={s.get('strategy')} "
-                f"estimated_rows={est} scanned={act} "
+                f"estimated_rows={est} "
+                f"({s.get('estimate_source') or 'heuristic'}) "
+                f"scanned={act} "
                 f"matched={s.get('actual_matched')} "
                 f"ratio={s.get('estimate_ratio')}x "
                 f"hits={s.get('hits')} "
-                f"device_ms={_fmt_attr(s.get('device_ms'))}")
+                f"device_ms={_fmt_attr(s.get('device_ms'))}"
+                + (" REPLANNED" if s.get("replanned") else ""))
             if s.get("options"):
                 opts = " ".join(f"{k}={v}"
                                 for k, v in s["options"].items())

@@ -741,6 +741,11 @@ class ShardedLeanAttrIndex:
             self.dispatch_count += 1
             totals = _fetch_global(
                 _count_program(self.mesh, len(padded))(*jk, *count_cols))
+            # adaptive-replan probe point (ISSUE 19): fetched totals are
+            # GLOBAL (process-invariant) so the signal is multihost-
+            # agreed; host-tier counts are process-local — no probe
+            from ..planning.adaptive import check_replan
+            check_replan("query.scan.probe", int(totals.sum()))
             if int(totals.sum()):
                 per_gen_cap = gather_capacity(
                     int(totals.max()), minimum=self.DEFAULT_CAPACITY)

@@ -1066,6 +1066,12 @@ class ShardedLeanZ3Index:
                 totals = _fetch_global(
                     _count_program(self.mesh, len(padded))(
                         rb, rlo, rhi, *count_cols))    # (n_shards, G_pad)
+            # adaptive-replan probe point (ISSUE 19): the fetched totals
+            # are GLOBAL (process-invariant), so a ReplanSignal raised
+            # here is multihost-agreed; host-tier candidate counts are
+            # process-local and therefore get no probe
+            from ..planning.adaptive import check_replan
+            check_replan("query.scan.probe", int(totals.sum()))
 
         # deadline yield points between tier phases: single-controller
         # only (see the decompose note — a lone process skipping a

@@ -5,6 +5,8 @@ The analog of the reference's planning stack
 StrategyDecider, Explainer, LocalQueryRunner.
 """
 
+from .adaptive import ReplanSignal, check_replan, replan_scope
+from .estimator import CardinalityEstimator
 from .explain import ExplainLogging, ExplainNull, ExplainString, Explainer
 from .planner import QueryPlanner, QueryResult
 from .strategy import FilterStrategy, StrategyDecider
@@ -12,4 +14,6 @@ from .strategy import FilterStrategy, StrategyDecider
 __all__ = [
     "Explainer", "ExplainString", "ExplainLogging", "ExplainNull",
     "QueryPlanner", "QueryResult", "FilterStrategy", "StrategyDecider",
+    "CardinalityEstimator", "ReplanSignal", "check_replan",
+    "replan_scope",
 ]

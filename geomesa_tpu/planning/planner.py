@@ -24,6 +24,7 @@ from ..features.feature_type import FeatureType
 from ..filters.ast import And, Filter, IdFilter, Include, Not, Or, _Include
 from ..filters.ecql import parse_ecql
 from ..filters.evaluate import evaluate_filter
+from .adaptive import ReplanSignal
 from .explain import Explainer, ExplainNull
 from .strategy import FilterStrategy, StrategyDecider
 
@@ -128,32 +129,47 @@ class QueryPlanner:
             n_plan = (stats["count"].count
                       if getattr(store, "multihost", False) else len(batch))
             lean = getattr(store, "lean", False)
+            est_fn = getattr(store, "estimator", None)
             decider = StrategyDecider(
                 self.sft, stats, n_plan,
                 allowed_indices=getattr(store, "query_indices", None),
                 attr_z3_tier=not lean,
                 servable_attrs=(set(store._lean_attr_names())
-                                if lean else None))
-            strategy = decider.decide(query.filter, explain,
-                                      forced=query.hints.get("QUERY_INDEX"))
+                                if lean else None),
+                estimator=(est_fn() if callable(est_fn) else None))
+            strategy, options = decider.decide_with_options(
+                query.filter, explain,
+                forced=query.hints.get("QUERY_INDEX"))
             psp.set_attr("strategy", strategy.index)
-            # estimate audit (ISSUE 9): the chosen estimate plus every
-            # option's cost land on the plan span, so the cost model
-            # the decider used is reconstructable from the trace —
-            # strategy.py computed these and threw them away before
+            # estimate audit (ISSUE 9): the chosen estimate, which
+            # estimator tier produced it (ISSUE 19), and every option's
+            # cost land on the plan span, so the cost model the decider
+            # used is reconstructable from the trace
             psp.set_attr("plan.estimate.rows", round(float(strategy.cost), 1))
-            if psp.recording and decider.last_options:
+            psp.set_attr("plan.estimate.source", strategy.source)
+            if psp.recording and options:
                 psp.set_attr("plan.options",
                              {o.index: round(float(o.cost), 1)
-                              for o in decider.last_options})
+                              for o in options})
         plan_ms = plan_span.ms
         check_deadline("planning")
 
         mh = getattr(store, "multihost", False)
         t1 = time.perf_counter()
+        replanned = False
         with profile("query.scan"), \
                 obs_span("query.scan", strategy=strategy.index) as ssp:
-            candidates = self._scan(strategy, query, explain)
+            try:
+                with self._replan_scope_for(strategy, query):
+                    candidates = self._scan(strategy, query, explain)
+            except ReplanSignal as sig:
+                # adaptive mid-query replan (ISSUE 19): the scan's probe
+                # observed candidates diverging past the threshold —
+                # re-decide with the actual folded in, re-scan ONCE
+                strategy, candidates = self._replan(
+                    sig, strategy, decider, query, explain)
+                replanned = True
+                ssp.set_attr("strategy", strategy.index)
             ssp.set_attr("candidates",
                          -1 if candidates is None else int(len(candidates)))
         check_deadline("index scan")
@@ -205,11 +221,15 @@ class QueryPlanner:
         if root is not None:
             root.set_attr("plan.estimate.rows",
                           round(float(strategy.cost), 1))
+            root.set_attr("plan.estimate.source", strategy.source)
             root.set_attr("plan.actual.scanned", actual_scanned)
             root.set_attr("plan.actual.matched", int(len(positions)))
             root.set_attr("plan.estimate.ratio", round(ratio, 4))
+            if replanned:
+                root.set_attr("plan.replanned", True)
         explain(lambda: f"Estimate audit: predicted {strategy.cost:.0f} "
-                        f"rows, scanned {actual_scanned}, matched "
+                        f"rows ({strategy.source}), scanned "
+                        f"{actual_scanned}, matched "
                         f"{len(positions)} (ratio {ratio:.2f}x)")
 
         if allowed is not None and len(positions):
@@ -269,6 +289,60 @@ class QueryPlanner:
         return QueryResult(result_batch, positions, strategy, plan_ms,
                            scan_ms, local_rows=local_rows)
 
+    # -- adaptive replanning (ISSUE 19) -----------------------------------
+    def _replan_scope_for(self, strategy: FilterStrategy, query: Query):
+        """A replan scope around one strategy's scan, or a null context
+        when replanning can't help: disabled by config, strategy pinned
+        by a QUERY_INDEX hint, no probe on the chosen path ('none' /
+        'id' / 'full'), or an or-split (its per-branch probe counts
+        can't re-cost the split as a whole)."""
+        import contextlib
+        if (query.hints.get("QUERY_INDEX") is not None
+                or strategy.index in ("none", "id", "full", "or-split")):
+            return contextlib.nullcontext()
+        from ..config import PlanningProperties
+        threshold = float(PlanningProperties.REPLAN_THRESHOLD.get())
+        if threshold <= 0.0:
+            return contextlib.nullcontext()
+        from .adaptive import replan_scope
+        return replan_scope(float(strategy.cost), threshold,
+                            int(PlanningProperties.REPLAN_MIN_ROWS.get()))
+
+    def _replan(self, sig: ReplanSignal, strategy: FilterStrategy,
+                decider: StrategyDecider, query: Query,
+                explain: Explainer) -> tuple[FilterStrategy, np.ndarray]:
+        """One bounded mid-query replan: the aborted scan's observed
+        candidate count replaces the mispredicted strategy's cost and
+        the decider re-runs; the re-scan executes OUTSIDE any replan
+        scope, so a query replans at most once.  Bit-exactness is
+        structural — the probe-point abort happened before any gather
+        (nothing collected, nothing lost), and the new strategy's
+        candidate superset passes the same residual filter as always.
+        Multihost-safe: probe totals are fetched GLOBAL values, so
+        every process raises at the same agreed point and re-decides
+        identically."""
+        from ..metrics import PLAN_REPLANNED, registry as _metrics
+        from ..obs import span as obs_span
+        with obs_span("query.replan", from_strategy=strategy.index,
+                      observed=int(sig.observed),
+                      estimate=round(float(sig.estimate), 1)) as rsp:
+            _metrics.counter(PLAN_REPLANNED).inc()
+            explain(lambda: f"Replanning: {strategy.index} observed "
+                            f"{sig.observed} candidates at {sig.point} "
+                            f"vs estimate {sig.estimate:.0f}")
+            try:
+                new, _ = decider.decide_with_options(
+                    query.filter, explain,
+                    observed={strategy.index: float(sig.observed)})
+            except RuntimeError:
+                # blocked full-table scan surfaced by the re-decide:
+                # finish under the original strategy rather than fail a
+                # query that was already admitted and running
+                new = strategy
+            rsp.set_attr("to_strategy", new.index)
+            candidates = self._scan(new, query, explain)
+        return new, candidates
+
     # -- strategy execution ----------------------------------------------
     def _scan(self, strategy: FilterStrategy, query: Query,
               explain: Explainer) -> np.ndarray | None:
@@ -326,6 +400,10 @@ class QueryPlanner:
         ]
         if name == "z3":
             idx = store.z3_index()
+            # sketch-sized decomposition budget (ISSUE 19): only ever
+            # set by the lean estimator, whose index accepts the kwarg
+            mr = ({} if strategy.max_ranges is None
+                  else {"max_ranges": int(strategy.max_ranges)})
             if len(strategy.intervals) > 1:
                 # auto-batch disjoint time windows into ONE device
                 # dispatch (the multi-window BatchScanner pattern —
@@ -334,9 +412,11 @@ class QueryPlanner:
                 explain(lambda: f"Auto-batched {len(strategy.intervals)} "
                                 "time windows into one dispatch")
                 parts = idx.query_many(
-                    [(boxes, lo, hi) for lo, hi in strategy.intervals])
+                    [(boxes, lo, hi) for lo, hi in strategy.intervals],
+                    **mr)
                 return _union(list(parts))
-            parts = [idx.query(boxes, lo, hi) for lo, hi in strategy.intervals]
+            parts = [idx.query(boxes, lo, hi, **mr)
+                     for lo, hi in strategy.intervals]
             return _union(parts)
         if name == "z2":
             return store.z2_index().query(boxes)

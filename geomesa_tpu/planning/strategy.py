@@ -10,11 +10,11 @@ per-strategy feature counts from stats and picks the cheapest.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 import numpy as np
 
-from ..config import QueryProperties
+from ..config import PlanningProperties, QueryProperties
 from ..features.feature_type import FeatureType
 from ..filters.ast import (
     And, Between, Filter, IdFilter, In, Like, Or,
@@ -41,6 +41,13 @@ class FilterStrategy:
     ids: tuple = ()             # extracted feature ids
     attr_values: tuple = ()     # attribute predicate descriptors
     branches: tuple = ()        # ('or-split') per-branch FilterStrategy
+    #: which estimator tier produced ``cost``: 'sketch' (per-generation
+    #: sketches), 'stats' (whole-store stats), 'heuristic' (named
+    #: defaults), or 'observed' (a replan folded a scan's actual in)
+    source: str = "heuristic"
+    #: sketch-sized covering-range budget for z3/xz scans; None = the
+    #: geomesa.scan.ranges.target default
+    max_ranges: int | None = None
 
     def __repr__(self):
         return f"FilterStrategy({self.index}, cost={self.cost:.0f})"
@@ -90,7 +97,8 @@ class StrategyDecider:
                  total_count: int = 0,
                  allowed_indices: set[str] | None = None,
                  attr_z3_tier: bool = True,
-                 servable_attrs: set[str] | None = None):
+                 servable_attrs: set[str] | None = None,
+                 estimator=None):
         """``allowed_indices`` further restricts the offered strategies
         beyond the schema's ``geomesa.indices.enabled`` user data (the
         store's lean profile serves {z3, id, attr} plus full scans).
@@ -102,17 +110,25 @@ class StrategyDecider:
         index-serve (None = every indexed attribute) — the lean
         lexicode covers numerics/dates/strings only, and offering a
         strategy the executor must reject would turn a fallback-able
-        query into an error."""
+        query into an error.
+        ``estimator``: a ``planning.estimator.CardinalityEstimator``
+        answering selectivity questions from per-generation sketches —
+        the preferred costing tier when it can answer (ISSUE 19);
+        ignored while ``geomesa.planning.estimator.enabled`` is off."""
         self.sft = sft
         self.stats = stats or {}
         self.total = max(1, total_count)
         self.allowed_indices = allowed_indices
         self.attr_z3_tier = attr_z3_tier
         self.servable_attrs = servable_attrs
-        #: every option the last decide() costed (chosen included) —
-        #: the planner stamps these onto the query span so EXPLAIN
-        #: ANALYZE can show the estimates the decider threw away
-        #: (ISSUE 9; the reference narrates them via explainQuery only)
+        self.estimator = (
+            estimator if estimator is not None
+            and PlanningProperties.ESTIMATOR_ENABLED.to_bool() else None)
+        #: every option the last decide() costed (chosen included) — a
+        #: best-effort MIRROR for embedders; concurrent deciders must
+        #: use the per-call return of :meth:`decide_with_options`
+        #: instead (the fused serving plane submits concurrently, and
+        #: instance state would clobber cross-thread)
         self.last_options: tuple = ()
 
     # -- cost estimates (StatsBasedEstimator spirit) ----------------------
@@ -157,24 +173,83 @@ class StrategyDecider:
             covered += max(0.0, min(float(hi), float(mm.max)) - max(float(lo), float(mm.min)))
         return min(1.0, covered / span)
 
-    def _attr_cost(self, attr: str, kind: str, payload) -> float:
+    def _attr_cost(self, attr: str, kind: str, payload) -> tuple[float, str]:
+        """(cost, source) of an attribute predicate from whole-store
+        stats, falling back to the named heuristic selectivities
+        (``geomesa.planning.selectivity.*`` — the old bare ``total/10``
+        and ``total/4`` magic constants, now operator-tunable)."""
         enum: EnumerationStat | None = self.stats.get(f"{attr}_enumeration")
         freq: Frequency | None = self.stats.get(f"{attr}_frequency")
         hist: Histogram | None = self.stats.get(f"{attr}_histogram")
         if kind == "equals":
             if enum is not None and not enum.is_empty:
-                return float(enum.counts.get(payload, enum.counts.get(str(payload), 0)))
+                return float(enum.counts.get(
+                    payload, enum.counts.get(str(payload), 0))), "stats"
             if freq is not None and not freq.is_empty:
-                return float(freq.count(payload))
-            return self.total / 10
+                return float(freq.count(payload)), "stats"
+            return self.total * float(
+                PlanningProperties.SELECTIVITY_EQUALS_DEFAULT.get()), \
+                "heuristic"
         if kind == "in":
-            return sum(self._attr_cost(attr, "equals", v) for v in payload)
+            total, source = 0.0, "stats"
+            for v in payload:
+                c, s = self._attr_cost(attr, "equals", v)
+                total += c
+                if s != "stats":
+                    source = s
+            return total, source
         if kind == "range" and hist is not None and not hist.is_empty:
             lo, hi, *_ = payload
             return float(hist.estimate_range(
                 float(lo) if lo is not None else hist.lo,
-                float(hi) if hi is not None else hist.hi))
-        return self.total / 4
+                float(hi) if hi is not None else hist.hi)), "stats"
+        return self.total * float(
+            PlanningProperties.SELECTIVITY_RANGE_DEFAULT.get()), "heuristic"
+
+    # -- sketch tier (planning/estimator.py, ISSUE 19) --------------------
+    def _frac_source(self, spatial: bool, temporal: bool) -> str:
+        """Whether the fraction-product cost for a z-index strategy was
+        stats-backed ('stats') or ran on fallback constants
+        ('heuristic')."""
+        ok = True
+        if spatial:
+            bb = self.stats.get(f"{self.sft.geom_field}_bbox")
+            ok = bb is not None and not bb.is_empty
+        if ok and temporal:
+            mm = self.stats.get("dtg_minmax")
+            ok = mm is not None and not mm.is_empty and mm.max != mm.min
+        return "stats" if ok else "heuristic"
+
+    def _estimate_z3(self, geometries, intervals):
+        """Sketch-tier candidate estimate for a z3 scan, or None when
+        the tier can't answer (no estimator, non-lean store, no z3
+        cell-count sketch).  Estimation must never fail a plan."""
+        if self.estimator is None or not intervals:
+            return None
+        boxes = [g.envelope.as_tuple() for g in geometries]
+        if not boxes:
+            boxes = [(-180.0, -90.0, 180.0, 90.0)]
+        try:
+            return self.estimator.z3_rows(boxes, intervals)
+        except Exception:
+            return None
+
+    def _estimate_attr(self, attr: str, kind: str, payload):
+        """Sketch-tier row estimate for an attribute predicate, or
+        None when the tier can't answer."""
+        if self.estimator is None:
+            return None
+        try:
+            if kind == "equals":
+                return self.estimator.attr_equals_rows(attr, (payload,))
+            if kind == "in":
+                return self.estimator.attr_equals_rows(attr, payload)
+            if kind == "range":
+                lo, hi, *_ = payload
+                return self.estimator.attr_range_rows(attr, lo, hi)
+        except Exception:
+            return None
+        return None
 
     # -- strategy enumeration ---------------------------------------------
     def _enabled(self, index: str) -> bool:
@@ -221,11 +296,16 @@ class StrategyDecider:
         if temporal and dtg:
             idx = "z3" if sft.is_points else "xz3"
             if self._enabled(idx):
+                qgeoms = tuple(geoms.values) if geoms else ()
                 cost = self.total * sp_frac * tm_frac
+                source, mr = self._frac_source(spatial, True), None
+                est = self._estimate_z3(qgeoms, usable)
+                if est is not None:
+                    cost, source = float(est), "sketch"
+                    mr = self.estimator.size_max_ranges(est)
                 out.append(FilterStrategy(
-                    idx, max(1.0, cost),
-                    geometries=tuple(geoms.values) if geoms else (),
-                    intervals=usable))
+                    idx, max(1.0, cost), geometries=qgeoms,
+                    intervals=usable, source=source, max_ranges=mr))
         if spatial:
             idx = "z2" if sft.is_points else "xz2"
             if self._enabled(idx):
@@ -234,7 +314,8 @@ class StrategyDecider:
                 # exists
                 out.append(FilterStrategy(
                     idx, max(1.0, cost), geometries=tuple(geoms.values),
-                    intervals=tuple(intervals.values) if intervals else ()))
+                    intervals=tuple(intervals.values) if intervals else (),
+                    source=self._frac_source(True, False)))
             elif (not temporal and dtg and sft.is_points
                   and self._enabled("z3")):
                 # no z2 available (e.g. the lean profile serves only the
@@ -242,10 +323,17 @@ class StrategyDecider:
                 # an OPEN interval, which the point index clamps to the
                 # data's time extent — same trick that admits half-open
                 # intervals above
+                qgeoms = tuple(geoms.values)
+                cost = self.total * sp_frac
+                source, mr = self._frac_source(True, False), None
+                est = self._estimate_z3(qgeoms, ((None, None),))
+                if est is not None:
+                    cost, source = float(est), "sketch"
+                    mr = self.estimator.size_max_ranges(est)
                 out.append(FilterStrategy(
-                    "z3", max(1.0, self.total * sp_frac),
-                    geometries=tuple(geoms.values),
-                    intervals=((None, None),)))
+                    "z3", max(1.0, cost), geometries=qgeoms,
+                    intervals=((None, None),), source=source,
+                    max_ranges=mr))
             elif (not temporal and dtg and not sft.is_points
                   and self._enabled("xz3")):
                 # the non-point analog: a lean XZ3 schema (no xz2
@@ -254,14 +342,18 @@ class StrategyDecider:
                 out.append(FilterStrategy(
                     "xz3", max(1.0, self.total * sp_frac),
                     geometries=tuple(geoms.values),
-                    intervals=((None, None),)))
+                    intervals=((None, None),),
+                    source=self._frac_source(True, False)))
 
         indexed = ({a.name for a in sft.attributes if a.indexed}
                    if self._enabled("attr") else set())
         if self.servable_attrs is not None:
             indexed &= self.servable_attrs
         for attr, kind, payload in _collect_attr_predicates(f, indexed):
-            cost = self._attr_cost(attr, kind, payload)
+            cost, source = self._attr_cost(attr, kind, payload)
+            est = self._estimate_attr(attr, kind, payload)
+            if est is not None:
+                cost, source = float(est), "sketch"
             # secondary tiers narrow equality/IN runs (tiered-range
             # assembly, api/GeoMesaFeatureIndex.scala:248-338): the date
             # tier by the temporal fraction; the z3 tier (schemas with
@@ -277,9 +369,12 @@ class StrategyDecider:
             out.append(FilterStrategy(
                 f"attr:{attr}", max(1.0, cost),
                 attr_values=((attr, kind, payload),),
-                intervals=tiered_ivs, geometries=tiered_geoms))
+                intervals=tiered_ivs, geometries=tiered_geoms,
+                source=source))
 
-        out.append(FilterStrategy("full", float(self.total)))
+        # the full-scan cost is the maintained row count — exact
+        out.append(FilterStrategy("full", float(self.total),
+                                  source="stats"))
         return out
 
     def decide(self, f: Filter, explain: Explainer | None = None,
@@ -287,12 +382,27 @@ class StrategyDecider:
         """``forced`` pins the strategy to a named index (the reference's
         QUERY_INDEX hint, index/planning/StrategyDecider.scala:67-79:
         a requested index bypasses cost comparison)."""
+        return self.decide_with_options(f, explain, forced)[0]
+
+    def decide_with_options(
+            self, f: Filter, explain: Explainer | None = None,
+            forced: str | None = None,
+            observed: dict | None = None,
+    ) -> tuple[FilterStrategy, tuple]:
+        """:meth:`decide` plus every option costed, returned PER CALL —
+        the thread-safe surface (the fused serving plane runs
+        concurrent decides; ``last_options`` instance state would
+        clobber cross-thread).  ``observed`` maps strategy-index names
+        to actual candidate counts a replanning query measured
+        mid-scan (planning/adaptive.py): a named strategy's cost is
+        replaced by its observed actual before comparison."""
         explain = explain or ExplainNull()
-        chosen, options = self._decide(f)
+        chosen, options = self._decide(f, observed)
         self.last_options = tuple(options)
         explain.push("Strategy selection:")
         for o in options:
-            explain(lambda o=o: f"option {o.index}: estimated cost {o.cost:.0f}")
+            explain(lambda o=o: f"option {o.index}: estimated cost "
+                    f"{o.cost:.0f} [{o.source}]")
         if forced is not None:
             match = [o for o in options
                      if o.index == forced or o.index.startswith(f"{forced}:")]
@@ -307,14 +417,30 @@ class StrategyDecider:
             raise RuntimeError(
                 "full-table scan required but blocked "
                 "(geomesa.scan.block.full.table=true)")
-        explain(lambda: f"chosen: {chosen.index} (cost {chosen.cost:.0f})")
+        explain(lambda: f"chosen: {chosen.index} (cost {chosen.cost:.0f}, "
+                f"source {chosen.source})")
         explain.pop()
-        return chosen
+        return chosen, tuple(options)
 
-    def _decide(self, f: Filter) -> tuple[FilterStrategy, list]:
+    def _reobserve(self, o: FilterStrategy, observed: dict) -> FilterStrategy:
+        """Fold a replanning query's measured candidate count into the
+        strategy it was measured on (the probe count IS that
+        strategy's candidate cardinality — no estimation left)."""
+        if o.index not in observed:
+            return o
+        cost = max(1.0, float(observed[o.index]))
+        mr = o.max_ranges
+        if self.estimator is not None and o.index in ("z3", "xz3"):
+            mr = self.estimator.size_max_ranges(cost)
+        return replace(o, cost=cost, source="observed", max_ranges=mr)
+
+    def _decide(self, f: Filter,
+                observed: dict | None = None) -> tuple[FilterStrategy, list]:
         if isinstance(f, _Exclude):
             return FilterStrategy("none", 0.0), []
         options = self.strategies(f)
+        if observed:
+            options = [self._reobserve(o, observed) for o in options]
         chosen = min(options, key=lambda o: o.cost)
         if chosen.index == "full":
             # OR-split (FilterSplitter's disjunction handling,
@@ -323,7 +449,8 @@ class StrategyDecider:
             # branch costs beat one full scan, serve the query per branch
             from ..filters.ast import Or
             if isinstance(f, Or):
-                branch = [(p, self._decide(p)[0]) for p in f.filters]
+                branch = [(p, self._decide(p, observed)[0])
+                          for p in f.filters]
                 if all(st.index != "full" for _, st in branch):
                     total = sum(st.cost for _, st in branch)
                     if total < chosen.cost:

@@ -324,6 +324,69 @@ def fold_attr_runs(runs: list, fold: SketchFold,
 
 
 # ---------------------------------------------------------------------------
+# sketch queries (planning/estimator.py's selectivity probes)
+# ---------------------------------------------------------------------------
+
+def sketch_equals_count(sk: RunSketch, fold: SketchFold, value,
+                        attr_type: str) -> int | None:
+    """Estimated rows with ``attr == value`` from a (merged) sketch:
+    the exact value map when the fold carried one, else the count-min
+    table's min-over-depth probe — hashed exactly as the fold hashed
+    (``_hash_col`` over decoded floats for float types, encoded int64
+    keys otherwise), so the probe hits the same buckets the device and
+    host folds filled.  None when the sketch can't answer."""
+    if sk.count == 0:
+        return 0
+    is_float = attr_type.lower() in _FLOAT_TYPES
+    if sk.cms is None or not fold.depth or not fold.width:
+        return None
+    from ..index.attr_lean import encode_attr_value
+    try:
+        if is_float:
+            col = np.array([float(value)], np.float64)
+        else:
+            col = np.array([int(encode_attr_value(value, attr_type))],
+                           np.int64)
+    except (TypeError, ValueError, OverflowError):
+        return None
+    est = None
+    for d in range(fold.depth):
+        h = int(_hash_col(col, d + 1)[0] % np.uint64(fold.width))
+        row = int(sk.cms[d, h])
+        est = row if est is None else min(est, row)
+    return est
+
+
+def sketch_range_count(sk: RunSketch, fold: SketchFold, lo,
+                       hi) -> int | None:
+    """Estimated rows with ``lo <= attr <= hi`` (None bound = open)
+    from a (merged) sketch's fixed-bin histogram, pro-rating the two
+    partial edge bins.  None when the fold carried no histogram."""
+    if sk.count == 0:
+        return 0
+    if sk.hist is None or not fold.bins:
+        return None
+    width = (fold.hhi - fold.hlo) / fold.bins
+    if not width > 0:
+        return None
+    try:
+        b_lo = (-np.inf if lo is None
+                else (float(lo) - fold.hlo) / width)
+        b_hi = (np.inf if hi is None
+                else (float(hi) - fold.hlo) / width)
+    except (TypeError, ValueError):
+        return None
+    if b_hi < b_lo:
+        return 0
+    # a bound past the histogram extent covers the whole edge bin —
+    # matching fold time, where outliers clamp into the edge bins
+    i0 = np.arange(fold.bins, dtype=np.float64)
+    cover = np.clip(np.minimum(b_hi, i0 + 1.0) - np.maximum(b_lo, i0),
+                    0.0, 1.0)
+    return int(round(float((cover * sk.hist).sum())))
+
+
+# ---------------------------------------------------------------------------
 # spec classification (the stats_process gate)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,359 @@
+"""Sketch-driven cost-based planning + adaptive replanning (ISSUE 19):
+the cardinality-estimator tiers (z3 cell-count sketches, attribute
+histogram/count-min folds), ``plan.estimate.source`` stamping, the
+named selectivity fallbacks, mid-query replan semantics (exactly once,
+bit-exact, never on a well-predicted query), decide_with_options
+thread-safety, and warm-plan dispatch discipline (docs/planning.md).
+
+Named ``zz`` so the scan-heavy lean runs land late in suite ordering.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.features.feature_type import parse_spec
+from geomesa_tpu.metrics import PLAN_REPLANNED, registry
+from geomesa_tpu.planning import StrategyDecider
+from geomesa_tpu.planning.adaptive import (
+    ReplanSignal, check_replan, replan_scope,
+)
+from geomesa_tpu.planning.planner import Query
+
+MS_2018 = 1_514_764_800_000
+DAY = 86_400_000
+SLOTS = 512
+N = 12 * SLOTS
+
+#: bbox over the dense cluster: tiny against the data extent, so the
+#: stats spatial-fraction heuristic underestimates it brutally
+HOT = "BBOX(geom,-74.06,39.99,-73.99,40.06)"
+
+_PLANNING_OPTS = ("geomesa.planning.estimator.enabled",
+                  "geomesa.planning.estimator.min.rows",
+                  "geomesa.planning.selectivity.equals.default",
+                  "geomesa.planning.selectivity.range.default",
+                  "geomesa.planning.replan.threshold",
+                  "geomesa.planning.replan.min.rows")
+
+
+@pytest.fixture(autouse=True)
+def _clean_planning_config():
+    for n in _PLANNING_OPTS:
+        config.clear_property(n)
+    # the fixture store is far below the production min.rows gate —
+    # open it so these tests exercise the sketch tier directly
+    config.set_property("geomesa.planning.estimator.min.rows", 0)
+    yield
+    for n in _PLANNING_OPTS:
+        config.clear_property(n)
+
+
+def _skewed_store() -> TpuDataStore:
+    """A multi-generation lean store with 85% of the points in a dense
+    cluster and the rest spread wide — the regime where whole-store
+    fraction heuristics mispredict and per-generation sketches don't."""
+    rng = np.random.default_rng(23)
+    ds = TpuDataStore()
+    ds.create_schema(
+        "evt", "name:String:index=true,score:Double:index=true,"
+               "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+               f"geomesa.lean.generation.slots={SLOTS},"
+               "geomesa.lean.compaction.factor=0")
+    for lo in range(0, N, SLOTS):
+        m = min(SLOTS, N - lo)
+        dense = int(m * 0.85)
+        ds.write("evt", {
+            "name": np.where(rng.uniform(size=m) < 0.9, "hot",
+                             "cold").astype(object),
+            "score": rng.uniform(0.0, 100.0, m),
+            "dtg": rng.integers(MS_2018, MS_2018 + 14 * DAY, m),
+            "geom": (np.concatenate(
+                         [rng.uniform(-74.05, -74.0, dense),
+                          rng.uniform(-80.0, -70.0, m - dense)]),
+                     np.concatenate(
+                         [rng.uniform(40.0, 40.05, dense),
+                          rng.uniform(35.0, 45.0, m - dense)]))})
+    return ds
+
+
+@pytest.fixture(scope="module")
+def store():
+    return _skewed_store()
+
+
+# -- replan-scope mechanics (pure, no store) ---------------------------
+
+def test_check_replan_outside_scope_is_noop():
+    check_replan("query.scan.probe", 10**9)  # must not raise
+
+
+def test_replan_scope_triggers_on_underestimate_once():
+    with pytest.raises(ReplanSignal) as ei:
+        with replan_scope(10.0, 8.0, min_rows=0):
+            check_replan("query.scan.probe", 1000)
+    sig = ei.value
+    assert sig.observed == 1000 and sig.estimate == 10.0
+    assert sig.point == "query.scan.probe"
+
+
+def test_replan_scope_disarms_after_signal():
+    try:
+        with replan_scope(10.0, 8.0, min_rows=0):
+            try:
+                check_replan("query.scan.probe", 1000)
+            except ReplanSignal:
+                pass
+            check_replan("query.scan.probe", 10**6)  # disarmed: no raise
+    except ReplanSignal:
+        pytest.fail("scope re-fired after disarming")
+
+
+def test_replan_scope_respects_min_rows_and_threshold():
+    with replan_scope(10.0, 8.0, min_rows=4096):
+        check_replan("query.scan.probe", 1000)   # under the floor
+    with replan_scope(100.0, 8.0, min_rows=0):
+        check_replan("query.scan.probe", 500)    # under 8x(100+1)
+    with replan_scope(100.0, 0.0, min_rows=0):
+        check_replan("query.scan.probe", 10**9)  # threshold<=0 disarms
+
+
+# -- estimator tiers ---------------------------------------------------
+
+def test_z3_sketch_estimate_bounds(store):
+    est = store._store("evt").estimator()
+    assert est is not None
+    full = est.z3_rows([(-180.0, -90.0, 180.0, 90.0)],
+                       [(MS_2018, MS_2018 + 14 * DAY)])
+    assert full == N
+    hot = est.z3_rows([(-74.06, 39.99, -73.99, 40.06)],
+                      [(MS_2018, MS_2018 + 14 * DAY)])
+    hits = len(store.query_result("evt", Query.of(HOT)).positions)
+    # the estimate integrates the scan's own covering at cell
+    # granularity: an upper bound on candidates, nowhere near total
+    assert hits <= hot <= N
+    assert hot >= 0.5 * N  # the skew IS visible to the sketch
+    cold = est.z3_rows([(-77.06, 42.99, -76.99, 43.06)],
+                       [(MS_2018, MS_2018 + 14 * DAY)])
+    assert cold < 0.1 * N
+
+
+def test_attr_sketch_estimates(store):
+    est = store._store("evt").estimator()
+    hot = est.attr_equals_rows("name", ("hot",))
+    truth = len(store.query_result("evt", Query.of("name = 'hot'"))
+                .positions)
+    assert hot is not None
+    # count-min overcounts only; bound the error band
+    assert truth <= hot <= 1.25 * truth
+    half = est.attr_range_rows("score", 0.0, 50.0)
+    assert half is not None
+    assert 0.3 * N <= half <= 0.7 * N
+    # unanswerable tiers report None, never a fake number
+    assert est.attr_equals_rows("nosuch", ("x",)) is None
+
+
+def test_estimator_warm_estimates_do_no_dispatch(store):
+    st = store._store("evt")
+    est = st.estimator()
+    est.z3_rows([(-74.06, 39.99, -73.99, 40.06)],
+                [(MS_2018, MS_2018 + 3 * DAY)])
+    idx = st._indexes["z3"]
+    d0 = idx.dispatch_count
+    for _ in range(5):
+        est.z3_rows([(-75.0, 39.0, -73.0, 41.0)],
+                    [(MS_2018, MS_2018 + 7 * DAY)])
+    assert idx.dispatch_count == d0  # cached per generation signature
+
+
+def test_size_max_ranges_monotone_and_bounded(store):
+    est = store._store("evt").estimator()
+    vals = [est.size_max_ranges(x)
+            for x in (0, 100, 10_000, 1_000_000, 10**9)]
+    assert vals == sorted(vals)
+    assert vals[0] >= 512 and vals[-1] <= 1 << 14
+
+
+def test_estimate_source_stamped_sketch(store):
+    res = store.explain_analyze("evt", HOT)
+    assert res.summary["estimate_source"] == "sketch"
+    assert res.summary["replanned"] is False
+    assert "(sketch)" in res.render()
+
+
+def test_estimate_source_heuristic_when_estimator_off(store):
+    config.set_property("geomesa.planning.estimator.enabled", False)
+    config.set_property("geomesa.planning.replan.threshold", 0.0)
+    res = store.explain_analyze("evt", HOT)
+    assert res.summary["estimate_source"] in ("stats", "heuristic")
+
+
+# -- named selectivity fallbacks (satellite: no bare magic) ------------
+
+def test_selectivity_defaults_are_configurable():
+    sft = parse_spec(
+        "t", "name:String:index=true,dtg:Date,*geom:Point")
+    d = StrategyDecider(sft, stats={}, total_count=1000)
+    from geomesa_tpu.filters import parse_ecql
+    cost, source = d._attr_cost("name", "equals", "x")
+    assert (cost, source) == (100.0, "heuristic")  # total * 0.1
+    cost, source = d._attr_cost("name", "range", (None, "x", True, True))
+    assert (cost, source) == (250.0, "heuristic")  # total * 0.25
+    config.set_property("geomesa.planning.selectivity.equals.default",
+                        0.5)
+    config.set_property("geomesa.planning.selectivity.range.default",
+                        0.9)
+    assert d._attr_cost("name", "equals", "x")[0] == 500.0
+    assert d._attr_cost("name", "range", (None, "x", True, True))[0] == 900.0
+    # the configured selectivity flows into real plans
+    chosen, _ = d.decide_with_options(parse_ecql("name = 'x'"))
+    assert chosen.cost == 500.0 and chosen.source == "heuristic"
+
+
+# -- fraction edge cases (satellite d) ---------------------------------
+
+def _decider(stats: dict, total: int = 1000) -> StrategyDecider:
+    sft = parse_spec("t", "dtg:Date,*geom:Point")
+    return StrategyDecider(sft, stats=stats, total_count=total)
+
+
+class _Box:
+    def __init__(self, x0, y0, x1, y1):
+        self._t = (x0, y0, x1, y1)
+
+    @property
+    def envelope(self):
+        return self
+
+    def as_tuple(self):
+        return self._t
+
+    @property
+    def area(self):
+        x0, y0, x1, y1 = self._t
+        return (x1 - x0) * (y1 - y0)
+
+
+def test_spatial_fraction_empty_stats_uses_world_fraction():
+    d = _decider({})
+    assert d._spatial_fraction(()) == 1.0
+    f = d._spatial_fraction((_Box(-180, -90, 180, 90),))
+    assert f == 1.0
+    assert d._spatial_fraction((_Box(0, 0, 3.6, 1.8),)) == \
+        pytest.approx(1e-4)
+
+
+def test_spatial_fraction_degenerate_extent():
+    from geomesa_tpu.stats.stat import BBoxStat
+    bb = BBoxStat("geom", xmin=5.0, ymin=7.0, xmax=5.0, ymax=7.0)
+    d = _decider({"geom_bbox": bb})
+    assert d._spatial_fraction((_Box(0, 0, 10, 10),)) == 1.0
+    assert d._spatial_fraction((_Box(20, 20, 30, 30),)) == 0.0
+
+
+def test_spatial_fraction_query_outside_extent():
+    from geomesa_tpu.stats.stat import BBoxStat
+    bb = BBoxStat("geom", xmin=0.0, ymin=0.0, xmax=10.0, ymax=10.0)
+    d = _decider({"geom_bbox": bb})
+    assert d._spatial_fraction((_Box(20, 20, 30, 30),)) == 0.0
+    assert d._spatial_fraction((_Box(0, 0, 10, 10),)) == 1.0
+    assert d._spatial_fraction((_Box(0, 0, 5, 10),)) == pytest.approx(0.5)
+
+
+def test_temporal_fraction_edges():
+    from geomesa_tpu.stats.stat import MinMax
+    d = _decider({})
+    assert d._temporal_fraction(()) == 1.0           # no interval
+    assert d._temporal_fraction(((0, 10),)) == 0.1   # no stat: fallback
+    mm = MinMax("dtg", 1000.0, 1000.0)               # degenerate span
+    d = _decider({"dtg_minmax": mm})
+    assert d._temporal_fraction(((0, 10),)) == 0.1
+    mm2 = MinMax("dtg", 0.0, 1000.0)
+    d = _decider({"dtg_minmax": mm2})
+    assert d._temporal_fraction(((0, 500),)) == pytest.approx(0.5)
+    # open-ended intervals clamp to the data extent
+    assert d._temporal_fraction(((None, 500),)) == pytest.approx(0.5)
+    assert d._temporal_fraction(((500, None),)) == pytest.approx(0.5)
+    assert d._temporal_fraction(((None, None),)) == 1.0
+    # fully outside the extent covers nothing
+    assert d._temporal_fraction(((2000, 3000),)) == 0.0
+
+
+# -- decide_with_options thread-safety (satellite c) -------------------
+
+def test_decide_with_options_is_per_call(store):
+    from geomesa_tpu.filters import parse_ecql
+    st = store._store("evt")
+    d = StrategyDecider(st.sft, stats=st.stats_map(),
+                        total_count=N, estimator=st.estimator())
+    filters = [parse_ecql(HOT), parse_ecql("name = 'hot'"),
+               parse_ecql("score < 10.0"), parse_ecql("IN ('7')")]
+    results: dict = {}
+
+    def run(i: int):
+        f = filters[i % len(filters)]
+        for _ in range(25):
+            chosen, options = d.decide_with_options(f)
+            got = {o.index for o in options}
+            ok = results.setdefault(i, True)
+            # every per-call option set must contain its own chosen
+            # strategy — a cross-thread clobber of shared state would
+            # surface as a foreign option list
+            results[i] = ok and chosen.index in got and chosen == min(
+                options, key=lambda o: o.cost)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(results.values())
+    # the mirror still exists for embedders, per-call returns don't
+    # depend on it
+    assert isinstance(d.last_options, tuple) and d.last_options
+
+
+# -- adaptive replanning end-to-end ------------------------------------
+
+def test_mispredict_replans_exactly_once_bit_exact(store):
+    # non-adaptive oracle first
+    config.set_property("geomesa.planning.replan.threshold", 0.0)
+    oracle = np.sort(store.query_result("evt", Query.of(HOT)).positions)
+    # heuristic plan of the skewed hot box underestimates -> replan
+    config.set_property("geomesa.planning.estimator.enabled", False)
+    config.set_property("geomesa.planning.replan.threshold", 2.0)
+    config.set_property("geomesa.planning.replan.min.rows", 64)
+    before = registry.counter(PLAN_REPLANNED).count
+    res = store.explain_analyze("evt", HOT)
+    assert registry.counter(PLAN_REPLANNED).count - before == 1
+    assert res.summary["replanned"] is True
+    assert res.summary["estimate_source"] == "observed"
+    assert "REPLANNED" in res.render()
+    adaptive = np.sort(
+        store.query_result("evt", Query.of(HOT)).positions)
+    assert np.array_equal(adaptive, oracle)
+
+
+def test_well_predicted_query_never_replans(store):
+    config.set_property("geomesa.planning.replan.threshold", 2.0)
+    config.set_property("geomesa.planning.replan.min.rows", 64)
+    before = registry.counter(PLAN_REPLANNED).count
+    res = store.explain_analyze("evt", HOT)  # sketch-fed: predicted
+    assert registry.counter(PLAN_REPLANNED).count == before
+    assert res.summary["replanned"] is False
+
+
+def test_forced_index_hint_never_replans(store):
+    config.set_property("geomesa.planning.estimator.enabled", False)
+    config.set_property("geomesa.planning.replan.threshold", 2.0)
+    config.set_property("geomesa.planning.replan.min.rows", 64)
+    before = registry.counter(PLAN_REPLANNED).count
+    q = Query.of(HOT)
+    q.hints["QUERY_INDEX"] = "z3"
+    store.query_result("evt", q)
+    assert registry.counter(PLAN_REPLANNED).count == before
