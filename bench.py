@@ -423,6 +423,7 @@ def main():
     serving_stanza = _guarded_stanza(_serving_stanza)
     pyramid_stanza = _guarded_stanza(_pyramid_stanza)
     planning_stanza = _guarded_stanza(_planning_stanza)
+    slo_stanza = _guarded_stanza(_slo_stanza)
     full = {
         "metric": "z3_ingest_keys_per_sec_per_chip",
         "value": round(ingest_rate),
@@ -461,6 +462,7 @@ def main():
             "serving": serving_stanza,
             "pyramid": pyramid_stanza,
             "planning": planning_stanza,
+            "slo": slo_stanza,
             "device": str(jax.devices()[0]),
         },
     }
@@ -507,6 +509,13 @@ def main():
     # (ISSUE 19)
     for f in (planning_stanza or {}).get("gate_failures", ()):
         regressions.append({"metric": "planning.gate", "prior": None,
+                            "current": None, "ratio": None,
+                            "detail": f})
+    # SLO-plane acceptance-gate failures (>= 90% attributed wall,
+    # <= 5% hook overhead, zero warm recompiles, resolvable exemplar)
+    # likewise (ISSUE 20)
+    for f in (slo_stanza or {}).get("gate_failures", ()):
+        regressions.append({"metric": "slo.gate", "prior": None,
                             "current": None, "ratio": None,
                             "detail": f})
     full["regressions"] = regressions
@@ -615,6 +624,11 @@ def _compact_summary(full: dict) -> dict:
                           "heuristic_p95_ratio_dist",
                           "replan_count", "warm_recompiles")
                 if k in (ex.get("planning") or {})},
+            "slo": {
+                k: (ex.get("slo") or {}).get(k)
+                for k in ("residual_pct", "overhead_pct",
+                          "exemplar_resolves", "warm_recompiles")
+                if k in (ex.get("slo") or {})},
             "scale_1b": _scale_ptr("recorded_1b"),
             "store_1b": _scale_ptr("store_recorded"),
             "store_live": _scale_ptr("store_live"),
@@ -2010,6 +2024,178 @@ def _stats_pushdown_stanza() -> dict:
         out["recompiles"] = int(compile_count() - _c0)
     except Exception as e:  # never kill the bench over a stanza
         out["error"] = repr(e)
+    out.update(_mem_probe())
+    return out
+
+
+def _slo_stanza() -> dict:
+    """SLO-plane acceptance gate (ISSUE 20): on the warm fused
+    64-client workload >= 90% of each root query's wall must land in
+    named ledger stages (mean residual < 10%), the per-tenant
+    quantiles and burn gauges must appear in the Prometheus
+    exposition with at least one parseable exemplar whose trace_id
+    resolves in the tracer, and the finish-hook attribution must cost
+    <= 5% wall overhead vs ``geomesa.slo.enabled=false`` with ZERO
+    warm recompiles.  ``SLO_BENCH_N=0`` skips."""
+    import numpy as np
+
+    n = int(os.environ.get("SLO_BENCH_N", 1_000_000))
+    if not n:
+        return {"skipped": True}
+    clients = int(os.environ.get("SLO_BENCH_CLIENTS", 64))
+    rounds = int(os.environ.get("SLO_BENCH_ROUNDS", 3))
+    out: dict = {}
+    try:
+        import re as _re
+        import threading
+        from geomesa_tpu import config as gm_config
+        from geomesa_tpu.datastore import TpuDataStore
+        from geomesa_tpu.metrics import registry
+        from geomesa_tpu.obs import (compile_count, prometheus_text,
+                                     slo_plane, tracer)
+
+        ms0 = 1_514_764_800_000
+        day = 86_400_000
+        slots = 1 << 16
+        rng = np.random.default_rng(53)
+        ds = TpuDataStore(user="slo-bench")
+        ds.create_schema("slob", (
+            "dtg:Date,*geom:Point;geomesa.index.profile=lean,"
+            f"geomesa.lean.generation.slots={slots},"
+            "geomesa.lean.compaction.factor=0"))
+        for lo in range(0, n, slots):
+            m = min(slots, n - lo)
+            ds.write("slob", {
+                "dtg": rng.integers(ms0, ms0 + 14 * day, m),
+                "geom": (rng.uniform(-180, 180, m),
+                         rng.uniform(-90, 90, m))})
+        ds._store("slob")._indexes["z3"].block()
+        # the serving stanza's dashboard workload: selective
+        # bbox+window filters, one compatibility key, 8 tenants
+        queries, windows = [], []
+        for i in range(16):
+            x = -170.0 + i * 1.5
+            d = 1 + (i % 5)
+            queries.append(
+                f"BBOX(geom,{x},-60,{x + 3},-57) AND dtg DURING "
+                f"2018-01-{d:02d}T00:00:00Z/2018-01-{d + 3:02d}"
+                "T00:00:00Z")
+            windows.append((((x, -60.0, x + 3.0, -57.0),),
+                            ms0 + (d - 1) * day, ms0 + (d + 2) * day))
+        gm_config.set_property("geomesa.serving.fuse.window.ms", 10.0)
+        gm_config.set_property("geomesa.serving.fuse.max.batch", clients)
+        try:
+            # warm every pow2 capacity bucket so the measured rounds
+            # see a pinned compiled-shape set (serving-stanza recipe)
+            k = 1
+            while k <= clients:
+                ds._fused_windows_dispatch(
+                    "slob", [windows[j % len(windows)] for j in range(k)])
+                k <<= 1
+            errors: list = []
+            barrier = threading.Barrier(clients + 1)
+
+            def client(i: int) -> None:
+                try:
+                    barrier.wait(timeout=60)
+                    for r in range(rounds):
+                        ds.query_fused(
+                            "slob", queries[(i + r) % len(queries)],
+                            tenant=f"t{i % 8}")
+                except Exception as e:  # surfaced via the gate below
+                    errors.append(repr(e))
+
+            def fused_round() -> float:
+                barrier.reset()
+                threads = [threading.Thread(target=client, args=(i,),
+                                            daemon=True)
+                           for i in range(clients)]
+                for t in threads:
+                    t.start()
+                barrier.wait(timeout=60)   # releases all clients at once
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.join()
+                return time.perf_counter() - t0
+
+            fused_round()                  # unrecorded warm round
+            # A/B overhead: the SAME warm workload with the plane off
+            # then on, best-of-2 per mode so one scheduler hiccup
+            # cannot fake (or mask) an overhead
+            gm_config.set_property("geomesa.slo.enabled", False)
+            off_dt = min(fused_round() for _ in range(2))
+            gm_config.set_property("geomesa.slo.enabled", True)
+            slo_plane.reset()              # only warm traces attribute
+            c0 = compile_count()
+            on_dt = min(fused_round() for _ in range(2))
+            out["warm_recompiles"] = int(compile_count() - c0)
+            out["slo_off_s"] = round(off_dt, 3)
+            out["slo_on_s"] = round(on_dt, 3)
+            out["overhead_pct"] = round(
+                (on_dt - off_dt) / off_dt * 100.0, 2)
+            if errors:
+                out["client_errors"] = errors[:4]
+            # attributed coverage of the warm fused root query wall
+            report = slo_plane.report()
+            qcls = report.get("classes", {}).get("query", {})
+            out["residual_pct"] = qcls.get("residual_pct")
+            out["burn_5m"] = qcls.get("burn_5m")
+            # the exposition must carry >= 1 exemplar whose trace_id
+            # the tracer can still resolve (the /traces/<id> join)
+            expo = slo_plane.exposition()
+            m = _re.search(r' # \{trace_id="([0-9a-f]+)"\}', expo)
+            out["exemplar_found"] = bool(m)
+            out["exemplar_resolves"] = bool(
+                m and tracer.find(m.group(1)) is not None)
+            # per-tenant p99 + burn gauges on the scrape surface
+            slo_plane.publish()
+            body = prometheus_text(registry.snapshot())
+            out["tenant_p99_exposed"] = (
+                "geomesa_slo_tenant_" in body and 'quantile="0.99"' in body)
+            out["burn_gauges_exposed"] = (
+                "geomesa_slo_query_burn_5m" in body
+                and "geomesa_slo_query_burn_1h" in body)
+            out["clients"] = clients
+        finally:
+            gm_config.clear_property("geomesa.serving.fuse.window.ms")
+            gm_config.clear_property("geomesa.serving.fuse.max.batch")
+            gm_config.clear_property("geomesa.slo.enabled")
+    except Exception as e:  # never kill the bench over a stanza
+        out["error"] = repr(e)
+    # acceptance gates run OUTSIDE the try (resilience/arrow
+    # precedent: an assert swallowed by the stanza's blanket except
+    # could never fail a run)
+    failures = []
+    if "error" not in out and not out.get("skipped"):
+        if out.get("client_errors"):
+            failures.append(f"fused clients errored: {out['client_errors']}")
+        residual = out.get("residual_pct")
+        if residual is None or residual >= 10.0:
+            failures.append(
+                f"unattributed residual {residual}% of warm fused root "
+                "wall — the stage ledger must cover >= 90%")
+        if out.get("overhead_pct", 100.0) > 5.0:
+            failures.append(
+                f"SLO attribution costs {out.get('overhead_pct')}% wall "
+                "vs slo.enabled=false (budget 5%)")
+        if out.get("warm_recompiles", 1) != 0:
+            failures.append(
+                f"warm fused path recompiled {out.get('warm_recompiles')} "
+                "time(s) with the SLO plane on")
+        if not out.get("exemplar_resolves"):
+            failures.append(
+                "no exposition exemplar resolves in the tracer "
+                f"(found={out.get('exemplar_found')}) — the "
+                "/metrics.prom → /traces/<id> join is broken")
+        if not out.get("tenant_p99_exposed"):
+            failures.append("slo.tenant.* p99 missing from exposition")
+        if not out.get("burn_gauges_exposed"):
+            failures.append("slo.query.burn.{5m,1h} gauges missing "
+                            "from exposition")
+    if failures:
+        out["gate_failures"] = failures
+        for f in failures:
+            print(f"BENCH SLO GATE FAILED: {f}", flush=True)
     out.update(_mem_probe())
     return out
 

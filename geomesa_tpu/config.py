@@ -278,6 +278,20 @@ class ObsProperties:
     #: background-job registry retention (obs/jobs.py): finished
     #: IngestJob/CompactionJob records kept for /debug/jobs
     JOBS_CAPACITY = SystemProperty("geomesa.obs.jobs.capacity", 128)
+    #: /metrics.prom scrape cache: while a scrape is younger than this
+    #: many ms, the next scrape reuses its rendered text instead of
+    #: re-walking storage and re-publishing every gauge (aggressive
+    #: scrapers must not hammer storage_report); <= 0 disables the
+    #: cache (every scrape walks).  ``?mesh=1`` scrapes never cache —
+    #: the mesh merge is a collective that must run when driven.
+    SCRAPE_MIN_INTERVAL_MS = SystemProperty(
+        "geomesa.obs.scrape.min.interval.ms", 0.0)
+    #: hard cap on recorded spans per trace: past it, child spans
+    #: yield the shared no-op and the root accumulates a
+    #: ``spans.dropped`` count — a 10k-generation scan must not
+    #: balloon the ring exporter; <= 0 disables the cap
+    TRACE_MAX_SPANS = SystemProperty("geomesa.obs.trace.max.spans",
+                                     4096)
 
 
 class ArrowProperties:
@@ -430,12 +444,52 @@ class PlanningProperties:
         "geomesa.planning.replan.min.rows", 4096)
 
 
+class SloProperties:
+    """SLO plane knobs (ISSUE 20, geomesa_tpu/obs/slo.py —
+    docs/slo.md): per-class latency objectives, rolling burn windows,
+    and the alert ring.  Everything re-reads through a
+    config-generation cache, so a live process retunes without
+    restart."""
+
+    #: master switch: off makes the root-span finish hook a no-op —
+    #: no stage ledger, no windows, no exemplars (tracing itself is
+    #: governed separately by ``geomesa.obs.enabled``; the SLO plane
+    #: only ever sees traces the tracer recorded)
+    ENABLED = SystemProperty("geomesa.slo.enabled", True)
+    #: latency/availability objectives, one per request class:
+    #: comma-separated ``class:latency_ms:target`` triples.  A request
+    #: counts against the class's error budget when it errored OR its
+    #: end-to-end latency (admission queue included) exceeded
+    #: ``latency_ms``; ``target`` is the good-fraction objective the
+    #: burn rate normalizes against (burn = bad_fraction / (1-target))
+    OBJECTIVES = SystemProperty(
+        "geomesa.slo.objectives",
+        "query:250:0.99,write:1000:0.99,tile.render:250:0.99")
+    #: short burn window in seconds (the fast-burn signal)
+    WINDOW_SHORT_S = SystemProperty("geomesa.slo.window.short.s", 300.0)
+    #: long burn window in seconds (the sustained-burn confirmation)
+    WINDOW_LONG_S = SystemProperty("geomesa.slo.window.long.s", 3600.0)
+    #: rolling-window time-bucket width in seconds (retention is
+    #: ceil(window.long.s / bucket.s) buckets per (class, tenant))
+    BUCKET_S = SystemProperty("geomesa.slo.bucket.s", 10.0)
+    #: multi-window alert threshold: an alert fires (edge-triggered)
+    #: when BOTH windows' burn rates exceed this, and re-arms when the
+    #: short window drops back under; <= 0 disables alerting
+    BURN_ALERT = SystemProperty("geomesa.slo.burn.alert", 10.0)
+    #: bounded /debug/alerts ring capacity (threshold crossings kept)
+    ALERTS_CAPACITY = SystemProperty("geomesa.slo.alerts.capacity", 128)
+    #: distinct-tenant label bound: past it, new tenants fold into the
+    #: ``other`` label (bounded metric cardinality under tenant churn)
+    TENANTS_MAX = SystemProperty("geomesa.slo.tenants.max", 64)
+
+
 def _register_declarations() -> None:
     """Fill the option registry from the declaration classes above —
     the one place a knob becomes 'known' to the strict mode."""
     for cls in (QueryProperties, ObsProperties, ArrowProperties,
                 SchemaProperties, ConfigProperties, ResilienceProperties,
-                ServingProperties, DensityProperties, PlanningProperties):
+                ServingProperties, DensityProperties, PlanningProperties,
+                SloProperties):
         for value in vars(cls).values():
             if isinstance(value, (SystemProperty, SchemaOption)):
                 _REGISTRY[value.name] = value

@@ -1741,14 +1741,17 @@ class TpuDataStore:
         own_token = _token is None
         token = _token if _token is not None else admission_gate.acquire(name)
         try:
+            queue_ms = getattr(token, "queue_ms", 0.0)
             if timeout_ms is not None:
                 with deadline_scope(timeout_ms, partial_results) as scope:
                     result, eval_store = self._run_query(
-                        name, query, explain, materialize)
+                        name, query, explain, materialize,
+                        queue_ms=queue_ms)
                 result.timed_out = scope.timed_out
             else:
                 result, eval_store = self._run_query(
-                    name, query, explain, materialize)
+                    name, query, explain, materialize,
+                    queue_ms=queue_ms)
                 ambient = current_scope()
                 if ambient is not None and ambient.timed_out:
                     result.timed_out = True
@@ -1759,7 +1762,7 @@ class TpuDataStore:
 
     def _run_query(self, name: str, query="INCLUDE",
                    explain: Explainer | None = None,
-                   materialize: bool = True):
+                   materialize: bool = True, queue_ms: float = 0.0):
         from .obs import span as obs_span
         store = self._store(name)
         q = query if isinstance(query, Query) else Query.of(query)
@@ -1768,6 +1771,13 @@ class TpuDataStore:
             if sp.recording:
                 sp.set_attr("filter", repr(q.filter))
                 sp.set_attr("lean", bool(store.lean))
+                if queue_ms:
+                    # the admission wait happens BEFORE this span opens
+                    # — the SLO plane's queue stage rides the root attr
+                    sp.set_attr("admission.queue_ms", round(queue_ms, 3))
+                tenant = q.hints.get("TENANT")
+                if tenant:
+                    sp.set_attr("tenant", str(tenant))
             if store.batch is None or len(store.batch) == 0:
                 if store.multihost:
                     # a locally-empty process must still ENTER the
@@ -1921,12 +1931,39 @@ class TpuDataStore:
             # itself never touches the gate
             window = self._fusible_window(name, store, q)
             if window is not None:
+                from .obs import span as obs_span
                 tenant = tenant or str(q.hints.get("TENANT", "") or "")
-                outcome = self._fusion.submit(
-                    ("fuse", name), window,
-                    lambda ws: self._fused_windows_dispatch(name, ws),
-                    scope=scope, partial=partial_results,
-                    tenant=tenant, schema=name)
+                # root span for the fused path: on the LEADER thread
+                # the scheduler's serving.fuse span nests under it; a
+                # RIDER's trace records no scan spans at all, so the
+                # coalesce/dispatch attrs stamped below are the SLO
+                # plane's only attribution source (attribution.py)
+                with obs_span("query", schema=name, fused=True) as sp:
+                    if sp.recording:
+                        sp.set_attr("filter", repr(q.filter))
+                        if tenant:
+                            sp.set_attr("tenant", tenant)
+                        queue_ms = getattr(token, "queue_ms", 0.0)
+                        if queue_ms:
+                            sp.set_attr("admission.queue_ms",
+                                        round(queue_ms, 3))
+                    t_sub = time.perf_counter()
+                    outcome = self._fusion.submit(
+                        ("fuse", name), window,
+                        lambda ws: self._fused_windows_dispatch(name, ws),
+                        scope=scope, partial=partial_results,
+                        tenant=tenant, schema=name)
+                    if sp.recording:
+                        # every scheduler millisecond that was NOT the
+                        # batch executing is coalesce wait: the linger
+                        # window plus wake-up/demux latency
+                        submit_ms = (time.perf_counter() - t_sub) * 1e3
+                        sp.set_attr("coalesce.ms", round(max(
+                            outcome.coalesce_ms,
+                            submit_ms - outcome.dispatch_ms), 3))
+                        sp.set_attr("fused.dispatch.ms",
+                                    outcome.dispatch_ms)
+                        sp.set_attr("hits", int(len(outcome.positions)))
                 from .planning.strategy import FilterStrategy
                 result = QueryResult(
                     None, outcome.positions,
@@ -2098,14 +2135,18 @@ class TpuDataStore:
         from .resilience import admission_gate, deadline_scope
         token = admission_gate.acquire(name)
         try:
+            queue_ms = getattr(token, "queue_ms", 0.0)
             if timeout_ms is not None:
                 with deadline_scope(timeout_ms, partial_results):
-                    return self._query_windows_body(name, windows)
-            return self._query_windows_body(name, windows)
+                    return self._query_windows_body(name, windows,
+                                                    queue_ms=queue_ms)
+            return self._query_windows_body(name, windows,
+                                            queue_ms=queue_ms)
         finally:
             token.release()
 
-    def _query_windows_body(self, name: str, windows) -> list[np.ndarray]:
+    def _query_windows_body(self, name: str, windows,
+                            queue_ms: float = 0.0) -> list[np.ndarray]:
         store = self._store(name)
         if store.batch is None or len(store.batch) == 0:
             if store.multihost:
@@ -2133,6 +2174,8 @@ class TpuDataStore:
             from .obs import span as obs_span
             with obs_span("query", schema=name,
                           windows=len(windows), lean=True) as sp:
+                if sp.recording and queue_ms:
+                    sp.set_attr("admission.queue_ms", round(queue_ms, 3))
                 t0 = time.time()
                 hits = store.index("z3").query_many(
                     [(boxes, lo, hi) for boxes, lo, hi in windows])
@@ -2176,6 +2219,8 @@ class TpuDataStore:
             return out
         from .obs import span as obs_span
         with obs_span("query", schema=name, windows=len(windows)) as sp:
+            if sp.recording and queue_ms:
+                sp.set_attr("admission.queue_ms", round(queue_ms, 3))
             t0 = time.time()
             # untimed windows (both bounds None) scan the Z2 index: with
             # the time axis unconstrained, z3 covering ranges degrade to
@@ -2246,18 +2291,40 @@ class TpuDataStore:
                                      partial_results=partial_results)
         token = admission_gate.acquire(name)
         try:
+            from .obs import span as obs_span
             scope = (CancelScope(timeout_ms, partial_results)
                      if timeout_ms is not None else None)
-            outcome = self._fusion.submit(
-                ("fuse", name), window,
-                lambda ws: self._fused_windows_dispatch(name, ws),
-                scope=scope, partial=partial_results, tenant=tenant,
-                schema=name)
-            positions = outcome.positions
-            from .planning.strategy import FilterStrategy
-            batch = (store.batch.take(positions)
-                     if store.batch is not None
-                     else FeatureBatch.empty(store.sft))
+            with obs_span("query", schema=name, fused=True) as sp:
+                if sp.recording:
+                    sp.set_attr("filter", repr(q.filter))
+                    if tenant:
+                        sp.set_attr("tenant", tenant)
+                    queue_ms = getattr(token, "queue_ms", 0.0)
+                    if queue_ms:
+                        sp.set_attr("admission.queue_ms",
+                                    round(queue_ms, 3))
+                t_sub = time.perf_counter()
+                outcome = self._fusion.submit(
+                    ("fuse", name), window,
+                    lambda ws: self._fused_windows_dispatch(name, ws),
+                    scope=scope, partial=partial_results, tenant=tenant,
+                    schema=name)
+                positions = outcome.positions
+                if sp.recording:
+                    # every scheduler millisecond that was NOT the batch
+                    # executing is coalesce wait: the linger window plus
+                    # wake-up/demux latency
+                    submit_ms = (time.perf_counter() - t_sub) * 1e3
+                    sp.set_attr("coalesce.ms", round(max(
+                        outcome.coalesce_ms,
+                        submit_ms - outcome.dispatch_ms), 3))
+                    sp.set_attr("fused.dispatch.ms", outcome.dispatch_ms)
+                    sp.set_attr("hits", int(len(positions)))
+                from .planning.strategy import FilterStrategy
+                with obs_span("query.materialize", rows=len(positions)):
+                    batch = (store.batch.take(positions)
+                             if store.batch is not None
+                             else FeatureBatch.empty(store.sft))
             return QueryResult(batch, positions,
                                FilterStrategy("fused",
                                               float(len(positions))),
@@ -2685,7 +2752,11 @@ class TpuDataStore:
         try:
             with deadline_scope(timeout_ms, False):
                 with obs_span("tile.render", schema=name, z=z, x=x,
-                              y=y, tile=tile):
+                              y=y, tile=tile) as sp:
+                    queue_ms = getattr(token, "queue_ms", 0.0)
+                    if sp.recording and queue_ms:
+                        sp.set_attr("admission.queue_ms",
+                                    round(queue_ms, 3))
                     _metrics.counter(TILE_REQUESTS).inc()
                     has_tomb = (store.tombstone is not None
                                 and bool(store.tombstone.any()))

@@ -50,7 +50,9 @@ __all__ = ["MetricRegistry", "Timer", "Counter", "Gauge", "HistogramMetric",
            "SERVING_TENANT_SHED", "SERVING_RIDER_EXPIRED",
            "TILE_REQUESTS", "TILE_REQUEST_MS",
            "PYRAMID_BUILDS", "PYRAMID_BUILD_MS",
-           "PYRAMID_SERVE_HITS", "PYRAMID_SERVE_FALLBACKS"]
+           "PYRAMID_SERVE_HITS", "PYRAMID_SERVE_FALLBACKS",
+           "OBS_SCRAPE_MS", "OBS_SCRAPE_CACHED", "OBS_SPANS_DROPPED",
+           "ALERT_SLO_FIRED", "ALERT_SLO_ACTIVE"]
 
 #: canonical counter names for the lean LSM lifecycle — compaction work
 #: (index/*_lean compact()) and the sealed-generation density-partial
@@ -152,6 +154,19 @@ PYRAMID_BUILD_MS = "pyramid.build.ms"
 PYRAMID_SERVE_HITS = "pyramid.serve.hits"
 PYRAMID_SERVE_FALLBACKS = "pyramid.serve.fallbacks"
 
+#: SLO plane self-observation (ISSUE 20): the /metrics.prom scrape's
+#: own wall time + cache hits (a scraper must be able to see what its
+#: scrapes cost), and child spans dropped by the per-trace span cap
+#: (``geomesa.obs.trace.max.spans``).  The ``slo.*`` keys themselves
+#: are built in obs/slo.py from (class, stage, tenant) parts; the
+#: ``alert.*`` pair carries the burn-alert edge state served at
+#: /debug/alerts.
+OBS_SCRAPE_MS = "obs.scrape.ms"
+OBS_SCRAPE_CACHED = "obs.scrape.cached"
+OBS_SPANS_DROPPED = "obs.trace.spans.dropped"
+ALERT_SLO_FIRED = "alert.slo.fired"
+ALERT_SLO_ACTIVE = "alert.slo.active"
+
 #: the metric naming contract (docs/observability.md): every registry
 #: key lives under one of these top-level namespaces, dot-separated,
 #: segments drawn from [A-Za-z0-9_:-] (attr-index keys like
@@ -160,7 +175,8 @@ PYRAMID_SERVE_FALLBACKS = "pyramid.serve.fallbacks"
 #: registry after the suite and fails on any drive-by key outside it.
 METRIC_NAMESPACES = ("query", "write", "lean", "jax", "web", "storage",
                      "plan", "obs", "pallas", "heat", "job", "arrow",
-                     "resilience", "serving", "tile", "pyramid")
+                     "resilience", "serving", "tile", "pyramid",
+                     "slo", "alert")
 _METRIC_KEY_RE = re.compile(
     r"^(?:" + "|".join(METRIC_NAMESPACES)
     + r")(?:\.[A-Za-z0-9_:\-]+)+$")

@@ -46,7 +46,9 @@ from .jobs import JobRecord, JobRegistry, jobs_registry
 from .prom import prometheus_text
 from .recompile import compile_count, counting_jit, install as \
     install_recompile_tracker
+from .attribution import STAGES as SLO_STAGES, attribute
 from .resource import publish_storage_gauges, storage_report
+from .slo import ExemplarHistogram, Objective, SloPlane, slo_plane
 from .trace import (
     AlwaysSampler, JsonlExporter, NeverSampler, RatioSampler,
     RingExporter, Sampler, SlowOnlySampler, Span, Trace, Tracer,
@@ -65,10 +67,17 @@ __all__ = ["Span", "Trace", "Tracer", "Sampler", "AlwaysSampler",
            "HeatTracker", "heat_tracker", "heat_enabled",
            "record_index_scan", "merge_index_generations",
            "heat_report", "publish_heat_gauges",
-           "JobRecord", "JobRegistry", "jobs_registry"]
+           "JobRecord", "JobRegistry", "jobs_registry",
+           "SloPlane", "slo_plane", "ExemplarHistogram", "Objective",
+           "SLO_STAGES", "attribute"]
 
 # the recompile listener is process-global and effectively free — hook
 # it as soon as observability loads (gated by the option so fully
 # instrumentation-silent runs stay possible)
 if ObsProperties.RECOMPILE_TRACK.to_bool():
     install_recompile_tracker()
+
+# the SLO plane feeds off finished root traces; the hook itself
+# fast-exits when geomesa.slo.enabled is off, so wiring it
+# unconditionally costs one list iteration per finished trace
+tracer.add_finish_hook(slo_plane.on_trace_finish)

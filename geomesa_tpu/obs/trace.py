@@ -34,13 +34,15 @@ import contextvars
 import json
 import os
 import random
+import sys
 import threading
 import time
 from collections import deque
 
 from ..config import ObsProperties
 from ..metrics import (
-    LEAN_DEVICE_DISPATCHES, LEAN_DEVICE_MS, registry as _metrics,
+    LEAN_DEVICE_DISPATCHES, LEAN_DEVICE_MS, OBS_SPANS_DROPPED,
+    registry as _metrics,
 )
 
 __all__ = ["Span", "Trace", "Tracer", "Sampler", "AlwaysSampler",
@@ -358,6 +360,12 @@ class Tracer:
         self._cfg_enabled = True
         self._cfg_sampler: Sampler = _ALWAYS
         self._cfg_slow_ms = 0.0
+        self._cfg_max_spans = 0
+        # finish hooks: called for EVERY naturally finished root trace
+        # (the SLO plane's feed) with (trace, retained) — retained says
+        # whether the trace also landed in the exporters, i.e. whether
+        # its trace_id will resolve at /traces/<id>
+        self._finish_hooks: list = []
 
     def _refresh_config(self) -> None:
         from ..config import config_generation
@@ -366,7 +374,22 @@ class Tracer:
             self._cfg_enabled = ObsProperties.ENABLED.to_bool()
             self._cfg_sampler = self._resolve_sampler()
             self._cfg_slow_ms = float(ObsProperties.SLOW_MS.get())
+            self._cfg_max_spans = ObsProperties.TRACE_MAX_SPANS.to_int()
             self._cfg_gen = gen
+
+    def add_finish_hook(self, fn) -> None:
+        """Register ``fn(trace, retained)`` to run on every finished
+        root trace (after exporter/slow-log routing).  Hooks must be
+        cheap and must not raise — a raising hook is logged and the
+        query proceeds."""
+        if fn not in self._finish_hooks:
+            self._finish_hooks.append(fn)
+
+    def remove_finish_hook(self, fn) -> None:
+        try:
+            self._finish_hooks.remove(fn)
+        except ValueError:
+            pass
 
     @property
     def ring(self) -> RingExporter | None:
@@ -434,12 +457,27 @@ class Tracer:
         else:
             trace = parent.trace
             sampler = parent.sampler
+            if self._cfg_max_spans > 0 \
+                    and len(trace.spans) >= self._cfg_max_spans:
+                # pathological trace (10k-generation scan): stop
+                # recording children, count the overflow on the root so
+                # the truncation is visible in the span tree
+                if trace.root_span is not None:
+                    trace.root_span.add_attr("spans.dropped", 1)
+                _metrics.counter(OBS_SPANS_DROPPED).inc()
+                yield NOOP_SPAN
+                return
             sp = Span(trace.trace_id, parent.span.span_id, name,
                       dict(attributes))
         token = _current.set(_Ctx(trace, sp, sampler))
         try:
             yield sp
         finally:
+            exc = sys.exc_info()[1]
+            if exc is not None:
+                # the SLO plane's error signal: a root that exits via
+                # an exception is a failed request for RED accounting
+                sp.set_attr("error", type(exc).__name__)
             sp.duration_ms = (time.perf_counter() - sp._t0) * 1e3
             trace.spans.append(sp)
             _current.reset(token)
@@ -448,7 +486,8 @@ class Tracer:
 
     def _finish(self, trace: Trace, sampler: Sampler,
                 sampled: bool = True, natural: bool = True) -> None:
-        if natural and sampled and sampler.retain(trace):
+        retained = natural and sampled and sampler.retain(trace)
+        if retained:
             for e in self.exporters:
                 try:
                     e.export(trace)
@@ -468,6 +507,13 @@ class Tracer:
             slow_ms = self._cfg_slow_ms
             if slow_ms > 0 and trace.duration_ms >= slow_ms:
                 self.slow_log.export(trace)
+            for h in self._finish_hooks:
+                try:
+                    h(trace, retained)
+                except Exception:
+                    import logging
+                    logging.getLogger("geomesa_tpu.obs").warning(
+                        "trace finish hook failed", exc_info=True)
 
     @contextlib.contextmanager
     def capture(self, capacity: int = 16):

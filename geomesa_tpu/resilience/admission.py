@@ -51,13 +51,17 @@ class Backpressure(RuntimeError):
 class AdmissionToken:
     """One admitted query's slot.  ``release()`` is idempotent: the
     abort/timeout/normal-completion paths may all reach it without
-    double-decrementing the in-flight count."""
+    double-decrementing the in-flight count.  ``queue_ms`` is the wait
+    this request spent inside the gate — the SLO plane's ``queue``
+    stage (the wait happens BEFORE the root span opens, so only the
+    token can carry it in)."""
 
-    __slots__ = ("_gate", "_released")
+    __slots__ = ("_gate", "_released", "queue_ms")
 
     def __init__(self, gate: "AdmissionGate | None"):
         self._gate = gate
         self._released = False
+        self.queue_ms = 0.0
 
     @property
     def released(self) -> bool:
@@ -129,7 +133,9 @@ class AdmissionGate:
                     (time.perf_counter() - t0) * 1000.0)
                 _metrics.registry.counter(
                     RESILIENCE_ADMISSION_ADMITTED).inc()
-                return AdmissionToken(self)
+                token = AdmissionToken(self)
+                token.queue_ms = (time.perf_counter() - t0) * 1000.0
+                return token
             ticket = self._next_ticket
             self._next_ticket += 1
             self._tickets.append(ticket)
@@ -166,7 +172,9 @@ class AdmissionGate:
         _metrics.registry.timer(RESILIENCE_ADMISSION_QUEUE_MS).update(
             (time.perf_counter() - t0) * 1000.0)
         _metrics.registry.counter(RESILIENCE_ADMISSION_ADMITTED).inc()
-        return AdmissionToken(self)
+        token = AdmissionToken(self)
+        token.queue_ms = (time.perf_counter() - t0) * 1000.0
+        return token
 
     def reset(self) -> None:
         """Zero the in-flight count and wake queued waiters — a

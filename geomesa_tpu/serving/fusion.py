@@ -100,15 +100,23 @@ def extract_fused_window(sft, f):
 class FusedOutcome:
     """What ``submit`` hands back: the member's exact hit positions and
     whether its deadline expired (partial mode only — without
-    ``partial`` an expiry raises instead)."""
+    ``partial`` an expiry raises instead).  ``coalesce_ms`` is this
+    member's wait inside the fuse window; ``dispatch_ms`` the wall time
+    of the batch round(s) it rode — the caller stamps both onto its
+    root span so the SLO plane can attribute a rider's wall clock
+    (riders block in ``submit`` while the LEADER's thread runs the
+    batch, so their own traces record no scan spans)."""
 
     positions: np.ndarray
     timed_out: bool = False
+    coalesce_ms: float = 0.0
+    dispatch_ms: float = 0.0
 
 
 class _Member:
     __slots__ = ("window", "tenant", "scope", "partial", "enqueued_at",
-                 "queued", "done", "positions", "error", "timed_out")
+                 "queued", "done", "positions", "error", "timed_out",
+                 "coalesce_ms", "dispatch_ms")
 
     def __init__(self, window, tenant, scope, partial):
         self.window = window
@@ -121,6 +129,8 @@ class _Member:
         self.positions = None
         self.error = None
         self.timed_out = False
+        self.coalesce_ms = 0.0
+        self.dispatch_ms = 0.0
 
 
 class _FuseQueue:
@@ -204,6 +214,8 @@ class FusionScheduler:
                         and me.scope.poll()):
                     self._unlink(q, me)
                     me.done, me.timed_out = True, True
+                    me.coalesce_ms = (time.perf_counter()
+                                      - me.enqueued_at) * 1000.0
                     _registry.counter(SERVING_RIDER_EXPIRED).inc()
                     return self._finish(me)
                 rem = None
@@ -272,6 +284,8 @@ class FusionScheduler:
                     if m.scope is not None and m.scope.poll():
                         # expired while queued: drop before dispatch
                         m.done, m.timed_out = True, True
+                        m.coalesce_ms = (time.perf_counter()
+                                         - m.enqueued_at) * 1000.0
                         _registry.counter(SERVING_RIDER_EXPIRED).inc()
                         continue
                     batch.append(m)
@@ -320,8 +334,9 @@ class FusionScheduler:
             t0 = time.perf_counter()
             if first_round:
                 for m in pending:
+                    m.coalesce_ms = (t0 - m.enqueued_at) * 1000.0
                     _registry.timer(SERVING_COALESCE_MS).update(
-                        (t0 - m.enqueued_at) * 1000.0)
+                        m.coalesce_ms)
                 first_round = False
             try:
                 with obs_span("serving.fuse", schema=schema,
@@ -347,6 +362,11 @@ class FusionScheduler:
                     m.error = e
                     m.done = True
                 return
+            round_ms = (time.perf_counter() - t0) * 1000.0
+            for m in pending:
+                # accumulate across re-dispatch rounds: a survivor's
+                # total dispatch wall is every round it rode
+                m.dispatch_ms += round_ms
             _registry.counter(SERVING_FUSED_BATCHES).inc()
             _registry.counter(SERVING_FUSED_REQUESTS).inc(len(pending))
             _registry.histogram(SERVING_FANIN).update(float(len(pending)))
@@ -390,11 +410,15 @@ class FusionScheduler:
             if me.partial:
                 pos = (me.positions if me.positions is not None
                        else np.empty(0, dtype=np.int64))
-                return FusedOutcome(pos, timed_out=True)
+                return FusedOutcome(pos, timed_out=True,
+                                    coalesce_ms=round(me.coalesce_ms, 3),
+                                    dispatch_ms=round(me.dispatch_ms, 3))
             raise QueryTimeout(
                 "fused query deadline expired"
                 + ("" if me.scope is None else
                    f" after {me.scope.elapsed_ms():.1f} ms"),
                 elapsed_ms=(None if me.scope is None
                             else me.scope.elapsed_ms()))
-        return FusedOutcome(me.positions, timed_out=False)
+        return FusedOutcome(me.positions, timed_out=False,
+                            coalesce_ms=round(me.coalesce_ms, 3),
+                            dispatch_ms=round(me.dispatch_ms, 3))

@@ -25,11 +25,13 @@ Routes::
     GET    /api/metrics                      request + store metrics dump
     GET    /query?schema=&cql=&format=arrow  chunked Arrow-IPC result stream
     GET    /metrics.prom                     Prometheus text exposition
-    GET    /traces?slow=1&limit=N            recent (or slow-log) traces
+    GET    /traces?slow=1&limit=N&schema=    recent (or slow-log) traces
     GET    /traces/{trace_id}                full span tree of one trace
     GET    /debug/storage?audit=0            storage/HBM accounting report
     GET    /debug/heat?limit=N               access-temperature ranking
     GET    /debug/jobs?kind=&state=&limit=N  background-job registry
+    GET    /debug/slo                        SLO report (burn, exemplars)
+    GET    /debug/alerts?limit=N&class=      burn-alert crossing ring
     GET    /explain?schema=&cql=             EXPLAIN ANALYZE (plan+actuals)
     GET    /explain?sql=                     EXPLAIN ANALYZE of a SQL text
     GET    /tiles/{z}/{x}/{y}?schema=&cql=&format=json|png   density tile
@@ -102,12 +104,17 @@ class WebApp:
             (r"^/debug/storage$", self._debug_storage),
             (r"^/debug/heat$", self._debug_heat),
             (r"^/debug/jobs$", self._debug_jobs),
+            (r"^/debug/slo$", self._debug_slo),
+            (r"^/debug/alerts$", self._debug_alerts),
             (r"^/explain$", self._explain),
             (r"^/tiles/([^/]+)/([^/]+)/([^/]+)$", self._tile),
             (r"^/api/blob$", self._blob_index),
             (r"^/api/blob/([^/]+)$", self._blob_item),
             (r"^/wcs$", self._wcs),
         ])
+        #: /metrics.prom response cache: (monotonic ts, body text) —
+        #: geomesa.obs.scrape.min.interval.ms bounds the walk rate
+        self._scrape_cache: tuple | None = None
 
     # -- WSGI entry point --------------------------------------------------
     def __call__(self, environ, start_response):
@@ -115,8 +122,11 @@ class WebApp:
                 and environ.get("PATH_INFO", "/").startswith("/geojson/")):
             return self.geojson_app(environ, start_response)
         t0 = time.perf_counter()
+        path = environ.get("PATH_INFO", "/")
+        tenant = environ.get("HTTP_X_TENANT", "") or ""
 
-        def on_metrics(status: int, aborted: bool = False):
+        def on_metrics(status: int, aborted: bool = False,
+                       drain_ms: float = 0.0):
             _metrics.counter(f"web.{status}").inc()
             if aborted:
                 # a streaming body died after the status line went out
@@ -124,8 +134,18 @@ class WebApp:
                 # response (wsgi.Router streams call this from the
                 # body generator's except path)
                 _metrics.counter("web.stream_aborted").inc()
-            _metrics.timer("web.request_ms").update(
-                (time.perf_counter() - t0) * 1e3)
+            total_ms = (time.perf_counter() - t0) * 1e3
+            _metrics.timer("web.request_ms").update(total_ms)
+            try:
+                # SLO middleware (ISSUE 20): per-endpoint tenant-aware
+                # RED plus the web_drain stage (streamed-body drain
+                # time no datastore span can see)
+                from ..obs import slo_plane
+                slo_plane.observe_web(_endpoint_class(path), tenant,
+                                      status, total_ms,
+                                      drain_ms=drain_ms, aborted=aborted)
+            except Exception:   # the SLO plane must never fail a request
+                pass
 
         return self._router.dispatch(environ, start_response, on_metrics)
 
@@ -309,10 +329,27 @@ class WebApp:
         a lone scrape would strand the mesh in the allgather)."""
         if method != "GET":
             raise HttpError(405, method)
+        from ..config import ObsProperties
+        from ..metrics import OBS_SCRAPE_CACHED, OBS_SCRAPE_MS
         from ..obs import (
             prometheus_text, publish_heat_gauges, publish_storage_gauges,
-            storage_report,
+            slo_plane, storage_report,
         )
+        mesh = (params.get("mesh") in ("1", "true", "yes")
+                and getattr(self.store, "_multihost", False))
+        min_interval_ms = float(
+            ObsProperties.SCRAPE_MIN_INTERVAL_MS.get() or 0.0)
+        # scrape cache: an aggressive scraper reuses the last rendered
+        # body instead of re-walking storage.  Mesh scrapes NEVER cache
+        # (the merge is a collective every process must enter).
+        if min_interval_ms > 0 and not mesh:
+            cached = self._scrape_cache
+            if (cached is not None
+                    and (time.perf_counter() - cached[0]) * 1e3
+                    < min_interval_ms):
+                _metrics.counter(OBS_SCRAPE_CACHED).inc()
+                return 200, cached[1], "text/plain; version=0.0.4"
+        t0 = time.perf_counter()
         rep = None
         try:
             # refresh the storage.* gauges so every scrape carries
@@ -328,13 +365,30 @@ class WebApp:
             publish_heat_gauges(self.store, storage=rep)
         except Exception:
             pass
-        if (params.get("mesh") in ("1", "true", "yes")
-                and getattr(self.store, "_multihost", False)):
+        try:
+            # slo.* burn + residual gauges (obs/slo) — same
+            # publish-on-scrape discipline
+            slo_plane.publish()
+        except Exception:
+            pass
+        if mesh:
             from ..parallel.stats import allreduce_metrics_snapshot
             snap = allreduce_metrics_snapshot()
         else:
             snap = _metrics.snapshot()
-        return 200, prometheus_text(snap), "text/plain; version=0.0.4"
+        body = prometheus_text(snap)
+        try:
+            # OpenMetrics exemplar histograms (trace_id-linked latency
+            # buckets) append after the summary body
+            body += slo_plane.exposition()
+        except Exception:
+            pass
+        # the scrape's own cost, recorded for the NEXT scrape to report
+        _metrics.timer(OBS_SCRAPE_MS).update(
+            (time.perf_counter() - t0) * 1e3)
+        if not mesh:
+            self._scrape_cache = (time.perf_counter(), body)
+        return 200, body, "text/plain; version=0.0.4"
 
     def _query_stream(self, method, params, environ):
         """Chunked Arrow-IPC query results (ISSUE 14):
@@ -404,18 +458,27 @@ class WebApp:
     def _traces(self, method, params, environ):
         """Recent traces (ring buffer), or the slow-query log with
         ``?slow=1`` — newest last, summaries only.  ``?limit=N`` pages
-        to the NEWEST N; malformed params are a 400."""
+        to the NEWEST N; ``?schema=`` keeps only traces whose root
+        recorded that schema (filter BEFORE limit, so the page is N
+        matching traces); malformed params are a 400."""
         if method != "GET":
             raise HttpError(405, method)
         from ..obs import tracer
         limit = int_param(params, "limit")
         if limit is not None and limit < 0:
             raise HttpError(400, f"bad 'limit' parameter: {limit}")
+        schema = params.get("schema")
+        if schema is not None and not schema:
+            raise HttpError(400, "bad 'schema' parameter: ''")
         if bool_param(params, "slow"):
             traces = tracer.slow_log.traces()
         else:
             ring = tracer.ring
             traces = ring.traces() if ring is not None else []
+        if schema is not None:
+            traces = [t for t in traces
+                      if t.root_span is not None
+                      and t.root_span.attributes.get("schema") == schema]
         if limit is not None:
             traces = traces[len(traces) - min(limit, len(traces)):]
         return 200, [t.summary() for t in traces]
@@ -476,6 +539,36 @@ class WebApp:
         jobs = self.store_jobs().jobs(kind=params.get("kind"),
                                       state=state, limit=limit)
         return 200, {"jobs": [j.to_json() for j in jobs]}
+
+    def _debug_slo(self, method, params, environ):
+        """SLO plane report (obs/slo): per-class objectives, 5m/1h
+        error-budget burn, unattributed residual pct, and the worst
+        recent exemplar traces (each trace_id resolvable at
+        ``/traces/<id>``) — the JSON join of what /metrics.prom
+        exposes as gauges + exemplars."""
+        if method != "GET":
+            raise HttpError(405, method)
+        from ..obs import slo_plane
+        return 200, slo_plane.report()
+
+    def _debug_alerts(self, method, params, environ):
+        """Burn-alert crossing ring (obs/slo): newest first.
+        ``?limit=N`` truncates; ``?class=`` filters to one SLO class
+        (unknown classes are a strict 400 naming the valid set)."""
+        if method != "GET":
+            raise HttpError(405, method)
+        from ..obs import slo_plane
+        limit = int_param(params, "limit")
+        if limit is not None and limit < 0:
+            raise HttpError(400, f"bad 'limit' parameter: {limit}")
+        cls = params.get("class")
+        if cls is not None:
+            known = slo_plane.classes()
+            if cls not in known:
+                raise HttpError(
+                    400, f"bad 'class' parameter: {cls!r} "
+                         f"(known: {', '.join(sorted(known))})")
+        return 200, {"alerts": slo_plane.alerts(limit=limit, cls=cls)}
 
     def store_jobs(self):
         """The registry /debug/jobs serves — the process-wide one
@@ -676,6 +769,31 @@ def _png_gray(grid: np.ndarray) -> bytes:
     ihdr = struct.pack(">IIBBBBB", w, h, 8, 0, 0, 0, 0)  # 8-bit gray
     return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
             + chunk(b"IDAT", zlib.compress(raw)) + chunk(b"IEND", b""))
+
+
+def _endpoint_class(path: str) -> str:
+    """Fold a request path into a BOUNDED endpoint label for the
+    ``slo.web.*`` metrics — raw paths carry schema names / trace ids
+    and would grow the registry without bound."""
+    if path == "/query":
+        return "query"
+    if path.startswith("/api/data"):
+        return "data"
+    if path.startswith("/api/stats"):
+        return "stats"
+    if path.startswith("/tiles"):
+        return "tiles"
+    if path in ("/metrics.prom", "/api/metrics", "/api/metrics.prom"):
+        return "metrics"
+    if path.startswith("/traces"):
+        return "traces"
+    if path.startswith("/debug"):
+        return "debug"
+    if path == "/explain":
+        return "explain"
+    if path.startswith("/api"):
+        return "api"
+    return "other"
 
 
 def _jsonable(v):

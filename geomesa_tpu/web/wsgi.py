@@ -7,6 +7,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from urllib.parse import parse_qs, unquote
 
 __all__ = ["HttpError", "STATUS", "read_json_body", "Router",
@@ -118,8 +119,12 @@ class Router:
     def dispatch(self, environ, start_response, on_metrics=None):
         path = environ.get("PATH_INFO", "/")
         method = environ.get("REQUEST_METHOD", "GET")
+        # keep_blank_values: a blank ``?schema=`` must reach the
+        # handler (strict-400 surface), not silently vanish as if the
+        # parameter were never sent
         params = {k: v[0] for k, v in
-                  parse_qs(environ.get("QUERY_STRING", "")).items()}
+                  parse_qs(environ.get("QUERY_STRING", ""),
+                           keep_blank_values=True).items()}
         ctype = "application/json"
         headers: list = []
         try:
@@ -159,15 +164,21 @@ class Router:
                            [("Content-Type", ctype)] + headers)
 
             def _stream():
+                # drain time (the SLO web_drain stage): how long the
+                # client + socket took to consume the body — wall time
+                # the datastore root span cannot see
+                t_drain = time.perf_counter()
                 try:
                     yield from body
                 except Exception:
                     if on_metrics is not None:
-                        on_metrics(status, aborted=True)
+                        on_metrics(status, aborted=True, drain_ms=(
+                            time.perf_counter() - t_drain) * 1e3)
                     raise
                 else:
                     if on_metrics is not None:
-                        on_metrics(status)
+                        on_metrics(status, drain_ms=(
+                            time.perf_counter() - t_drain) * 1e3)
 
             return _stream()
         if on_metrics is not None:

@@ -396,11 +396,17 @@ def test_periodic_reporter_runs_and_stops(tmp_path):
 # -- web surface -----------------------------------------------------------
 
 _PROM_LINE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{quantile=\"[0-9.]+\"\})? -?[0-9]"
-    r"[0-9.e+-]*$")
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{(quantile=\"[0-9.]+\"|le=\"(\+Inf|[0-9.e+-]+)\")\})? -?[0-9]"
+    r"[0-9.e+-]*"
+    # OpenMetrics exemplar suffix (the SLO latency histograms): the
+    # trace_id joining a bucket to /traces/<id>
+    r"( # \{trace_id=\"[0-9a-f]+\"\} -?[0-9][0-9.e+-]*)?$")
 
 
 def test_prometheus_exposition_parses(lean_ds):
+    import math
+
     from geomesa_tpu.web import WebApp
     registry.timer("obs.test.empty_ms")      # empty histogram in the dump
     lean_ds.query("evt", LEAN_Q)
@@ -408,13 +414,17 @@ def test_prometheus_exposition_parses(lean_ds):
     status, headers, body = _call(app, "GET", "/metrics.prom")
     assert status == 200
     assert headers["Content-Type"].startswith("text/plain")
-    assert "inf" not in body and "nan" not in body.lower()
     for line in body.strip().splitlines():
         if line.startswith("#"):
             assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
-                            r"(counter|summary|gauge)$", line), line
+                            r"(counter|summary|gauge|histogram)$",
+                            line), line
         else:
             assert _PROM_LINE.match(line), line
+            # sample VALUES are always finite — scrapers reject
+            # inf/nan (a substring scan would false-positive on the
+            # "nan" inside slo.teNANt.* metric names)
+            assert math.isfinite(float(line.split()[-1])), line
     assert 'geomesa_query_evt_scan_ms{quantile="0.5"}' in body
     assert 'geomesa_query_evt_scan_ms{quantile="0.99"}' in body
     assert "geomesa_query_evt_count_total" in body

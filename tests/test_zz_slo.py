@@ -1,0 +1,370 @@
+"""SLO plane (ISSUE 20): stage attribution math, window folding and
+burn rates, edge-triggered alerts, exemplar joins, the web middleware
+feed, strict-400 endpoint hardening, and the obs satellites (span cap,
+scrape cache).
+
+Named ``zz`` so the config-mutating runs land late in the suite
+ordering, after the correctness suites have exercised clean defaults.
+"""
+
+from __future__ import annotations
+
+import io
+import itertools
+import json
+import re
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config
+from geomesa_tpu.datastore import TpuDataStore
+from geomesa_tpu.metrics import METRIC_NAMESPACES, registry
+from geomesa_tpu.obs import SLO_STAGES, Span, Trace, attribute, slo_plane, \
+    tracer
+from geomesa_tpu.obs.slo import _parse_objectives
+from geomesa_tpu.web import WebApp
+
+MS_2018 = 1_514_764_800_000
+
+_SLO_OPTS = ("geomesa.slo.enabled", "geomesa.slo.objectives",
+             "geomesa.slo.burn.alert", "geomesa.slo.tenants.max",
+             "geomesa.obs.trace.max.spans",
+             "geomesa.obs.scrape.min.interval.ms")
+
+_ids = itertools.count(1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo_state():
+    for n in _SLO_OPTS:
+        config.clear_property(n)
+    slo_plane.reset()
+    yield
+    for n in _SLO_OPTS:
+        config.clear_property(n)
+    slo_plane.reset()
+
+
+def _mk_span(trace_id, parent_id, name, ms, **attrs):
+    sp = Span(trace_id, parent_id, name, dict(attrs))
+    sp.duration_ms = float(ms)
+    return sp
+
+
+def _mk_trace(cls="query", root_ms=100.0, root_attrs=None, children=()):
+    """Hand-build a finished trace: ``children`` is a list of
+    ``(name, ms, parent_key)`` where parent_key is None (child of
+    root) or the index of an earlier child."""
+    tid = f"slotest{next(_ids):08x}"
+    t = Trace(tid)
+    root = _mk_span(tid, None, cls, root_ms, **(root_attrs or {}))
+    t.root_span = root
+    made: list[Span] = []
+    for name, ms, parent_key in children:
+        pid = (root.span_id if parent_key is None
+               else made[parent_key].span_id)
+        made.append(_mk_span(tid, pid, name, ms))
+    # finish order: children first, root last (span() appends on exit)
+    t.spans = made + [root]
+    return t
+
+
+def call(app, method, path, body=None, headers=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    captured = {}
+
+    def start_response(status, hdrs):
+        captured["status"] = int(status.split()[0])
+        captured["headers"] = dict(hdrs)
+
+    qs = ""
+    if "?" in path:
+        path, qs = path.split("?", 1)
+    environ = {"REQUEST_METHOD": method, "PATH_INFO": path,
+               "QUERY_STRING": qs, "CONTENT_LENGTH": str(len(raw)),
+               "wsgi.input": io.BytesIO(raw)}
+    environ.update(headers or {})
+    chunks = app(environ, start_response)
+    text = b"".join(chunks).decode()
+    ctype = captured["headers"].get("Content-Type", "")
+    parsed = json.loads(text) if "json" in ctype and text else text
+    return captured["status"], parsed
+
+
+@pytest.fixture
+def app():
+    ds = TpuDataStore(user="slo-tester")
+    ds.create_schema("pts", "name:String,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(9)
+    n = 100
+    ds.write("pts", {
+        "name": np.asarray([f"n{i % 4}" for i in range(n)], dtype=object),
+        "dtg": rng.integers(MS_2018, MS_2018 + 7 * 86_400_000, n),
+        "geom": (rng.uniform(-10, 10, n), rng.uniform(40, 50, n)),
+    })
+    return WebApp(ds)
+
+
+# -- attribution math ------------------------------------------------------
+
+def test_attribution_exclusive_time_and_residual():
+    t = _mk_trace(root_ms=100.0, children=[
+        ("query.plan", 10.0, None),
+        ("query.materialize", 40.0, None),
+        ("query.scan.device", 30.0, 1),   # nested under materialize
+    ])
+    att = attribute(t)
+    assert att is not None and att["class"] == "query"
+    st = att["stages"]
+    assert set(st) == set(SLO_STAGES)
+    assert st["plan"] == pytest.approx(10.0)
+    # materialize bills only its EXCLUSIVE 10ms; the wrapped device
+    # dispatch keeps its 30ms — no double-billing
+    assert st["materialize"] == pytest.approx(10.0)
+    assert st["device_scan"] == pytest.approx(30.0)
+    assert st["unattributed"] == pytest.approx(50.0)
+    # in-root stages + residual always reconstruct the root wall
+    in_root = sum(ms for s, ms in st.items()
+                  if s not in ("queue", "web_drain"))
+    assert in_root == pytest.approx(att["root_ms"])
+
+
+def test_attribution_out_of_root_queue_rides_token_attr():
+    t = _mk_trace(root_ms=50.0,
+                  root_attrs={"admission.queue_ms": 7.5},
+                  children=[("query.plan", 50.0, None)])
+    att = attribute(t)
+    assert att["stages"]["queue"] == pytest.approx(7.5)
+    # queue time is OUTSIDE the root wall: total grows, residual not
+    assert att["total_ms"] == pytest.approx(57.5)
+    assert att["stages"]["unattributed"] == pytest.approx(0.0)
+
+
+def test_attribution_rider_vs_leader_dispatch():
+    # rider: no serving.fuse span — the stamped attribute is the only
+    # record of the batch the leader ran on its behalf
+    rider = _mk_trace(root_ms=20.0, root_attrs={
+        "coalesce.ms": 5.0, "fused.dispatch.ms": 12.0})
+    att = attribute(rider)
+    assert att["stages"]["coalesce"] == pytest.approx(5.0)
+    assert att["stages"]["device_scan"] == pytest.approx(12.0)
+    # leader: the fuse span IS in its trace — counting the attribute
+    # too would double-bill the dispatch
+    leader = _mk_trace(root_ms=20.0, root_attrs={
+        "coalesce.ms": 1.0, "fused.dispatch.ms": 12.0},
+        children=[("serving.fuse", 12.0, None)])
+    att = attribute(leader)
+    assert att["stages"]["device_scan"] == pytest.approx(12.0)
+
+
+def test_attribution_error_flag_and_no_root():
+    t = _mk_trace(root_attrs={"error": "ValueError"})
+    assert attribute(t)["error"] is True
+    empty = Trace("noroot")
+    assert attribute(empty) is None
+
+
+def test_parse_objectives_handles_dotted_class_and_garbage():
+    objs = _parse_objectives(
+        "query:250:0.99, tile.render:250:0.999, bogus, a:b:c,")
+    assert set(objs) == {"query", "tile.render"}
+    assert objs["tile.render"].latency_ms == 250.0
+    assert objs["tile.render"].target == pytest.approx(0.999)
+
+
+# -- plane ingestion / burn / alerts ---------------------------------------
+
+def test_finish_hook_folds_registry_and_windows():
+    config.set_property("geomesa.slo.objectives", "query:100:0.9")
+    req0 = registry.counter("slo.query.requests").count
+    ten0 = registry.counter("slo.tenant.acme_co.requests").count
+    t = _mk_trace(root_ms=250.0,
+                  root_attrs={"tenant": "acme co"},   # sanitized label
+                  children=[("query.plan", 250.0, None)])
+    slo_plane.on_trace_finish(t, retained=False)
+    assert registry.counter("slo.query.requests").count == req0 + 1
+    assert registry.counter("slo.tenant.acme_co.requests").count == ten0 + 1
+    # 250ms > the 100ms objective: the request burns budget
+    assert slo_plane.burn("query", 300.0) == pytest.approx(
+        1.0 / (1.0 - 0.9))
+    # class without an objective is ignored entirely
+    other0 = registry.counter("slo.nope.requests").count
+    slo_plane.on_trace_finish(_mk_trace(cls="nope"), retained=False)
+    assert registry.counter("slo.nope.requests").count == other0
+
+
+def test_burn_math_mixed_good_bad():
+    config.set_property("geomesa.slo.objectives", "query:100:0.9")
+    for ms in (50.0, 50.0, 50.0, 200.0):   # 1 bad of 4
+        slo_plane.on_trace_finish(_mk_trace(root_ms=ms), retained=False)
+    # bad_fraction 0.25 over budget 0.1 -> burn 2.5 in BOTH windows
+    assert slo_plane.burn("query", 300.0) == pytest.approx(2.5)
+    assert slo_plane.burn("query", 3600.0) == pytest.approx(2.5)
+
+
+def test_alert_edge_trigger_and_rearm():
+    config.set_property("geomesa.slo.objectives", "query:100:0.9")
+    config.set_property("geomesa.slo.burn.alert", 1.0)
+    fired0 = registry.counter("alert.slo.fired").count
+    for _ in range(3):   # all bad -> burn 10 > 1 in both windows
+        slo_plane.on_trace_finish(_mk_trace(root_ms=500.0),
+                                  retained=False)
+    assert registry.counter("alert.slo.fired").count == fired0 + 1
+    alerts = slo_plane.alerts()
+    assert alerts and alerts[0]["class"] == "query"
+    assert alerts[0]["burn_short"] > 1.0
+    # still burning: edge-triggered, no refire
+    slo_plane.on_trace_finish(_mk_trace(root_ms=500.0), retained=False)
+    assert registry.counter("alert.slo.fired").count == fired0 + 1
+    # short window drops under a raised threshold -> re-arms ...
+    config.set_property("geomesa.slo.burn.alert", 1000.0)
+    slo_plane.on_trace_finish(_mk_trace(root_ms=500.0), retained=False)
+    # ... and the next crossing fires a SECOND alert
+    config.set_property("geomesa.slo.burn.alert", 1.0)
+    slo_plane.on_trace_finish(_mk_trace(root_ms=500.0), retained=False)
+    assert registry.counter("alert.slo.fired").count == fired0 + 2
+    assert len(slo_plane.alerts(cls="query")) == 2
+
+
+def test_tenant_label_bound_overflows_to_other():
+    config.set_property("geomesa.slo.objectives", "query:100:0.9")
+    config.set_property("geomesa.slo.tenants.max", 2)
+    for t in ("alpha", "beta", "gamma", "delta"):
+        slo_plane.on_trace_finish(
+            _mk_trace(root_ms=10.0, root_attrs={"tenant": t}),
+            retained=False)
+    assert slo_plane._tenants == {"alpha", "beta"}
+    assert registry.counter("slo.tenant.other.requests").count >= 2
+
+
+def test_exemplar_only_for_retained_traces():
+    config.set_property("geomesa.slo.objectives", "query:100:0.9")
+    slo_plane.on_trace_finish(_mk_trace(root_ms=40.0), retained=False)
+    assert slo_plane._exemplars["query"].exemplars() == []
+    kept = _mk_trace(root_ms=40.0)
+    slo_plane.on_trace_finish(kept, retained=True)
+    ex = slo_plane._exemplars["query"].exemplars()
+    assert ex and ex[0]["trace_id"] == kept.trace_id
+    # and the rendered OpenMetrics line carries the join key
+    expo = slo_plane.exposition()
+    assert f'# {{trace_id="{kept.trace_id}"}}' in expo
+    assert "geomesa_slo_query_latency_ms_bucket" in expo
+    assert 'le="+Inf"' in expo
+
+
+def test_slo_disabled_is_inert():
+    config.set_property("geomesa.slo.enabled", False)
+    req0 = registry.counter("slo.query.requests").count
+    slo_plane.on_trace_finish(_mk_trace(root_ms=500.0), retained=True)
+    assert registry.counter("slo.query.requests").count == req0
+    assert slo_plane.exposition() == ""
+
+
+# -- end-to-end: real traces through the tracer ----------------------------
+
+def test_real_query_trace_attributes_and_report(app):
+    status, _ = call(app, "GET",
+                     "/api/data/pts?cql=BBOX(geom,-10,40,10,50)",
+                     headers={"HTTP_X_TENANT": "acme"})
+    assert status == 200
+    rep = slo_plane.report()
+    assert rep["enabled"] is True
+    q = rep["classes"]["query"]
+    assert q["objective"]["latency_ms"] == 250.0
+    # the ledger covered SOME of the root wall on a real query
+    snap = registry.snapshot()
+    assert snap.get("slo.query.requests", {}).get("count", 0) >= 1
+    stage_keys = [k for k in snap if k.startswith("slo.query.stage.")]
+    assert stage_keys, "no stage timers recorded for a real query"
+    # the web middleware fed the endpoint RED family too
+    assert snap.get("slo.web.data.requests", {}).get("count", 0) >= 1
+
+
+def test_exemplar_joins_metrics_prom_to_traces(app):
+    status, _ = call(app, "GET",
+                     "/api/data/pts?cql=BBOX(geom,-10,40,10,50)")
+    assert status == 200
+    status, body = call(app, "GET", "/metrics.prom")
+    assert status == 200
+    assert "geomesa_slo_query_burn_5m" in body
+    assert "geomesa_slo_query_burn_1h" in body
+    ids = re.findall(
+        r'geomesa_slo_query_latency_ms_bucket\{le="[^"]+"\} \d+ '
+        r'# \{trace_id="([0-9a-f]+)"\}', body)
+    assert ids, "no parseable exemplar in the exposition"
+    resolved = [i for i in ids if tracer.find(i) is not None]
+    assert resolved, "no exemplar trace_id resolves in the tracer"
+    status, tr = call(app, "GET", f"/traces/{resolved[0]}")
+    assert status == 200 and tr["trace_id"] == resolved[0]
+
+
+# -- endpoint hardening ----------------------------------------------------
+
+def test_debug_slo_endpoint(app):
+    status, body = call(app, "GET", "/debug/slo")
+    assert status == 200
+    assert "classes" in body and "alerts_active" in body
+    status, _ = call(app, "POST", "/debug/slo")
+    assert status == 405
+
+
+def test_debug_alerts_strict_400s(app):
+    status, body = call(app, "GET", "/debug/alerts")
+    assert status == 200 and body == {"alerts": []}
+    status, _ = call(app, "GET", "/debug/alerts?limit=0")
+    assert status == 200
+    status, _ = call(app, "GET", "/debug/alerts?limit=-1")
+    assert status == 400
+    status, _ = call(app, "GET", "/debug/alerts?limit=zap")
+    assert status == 400
+    status, body = call(app, "GET", "/debug/alerts?class=bogus")
+    assert status == 400 and "bogus" in body["error"]
+    status, _ = call(app, "GET", "/debug/alerts?class=query")
+    assert status == 200
+    status, _ = call(app, "POST", "/debug/alerts")
+    assert status == 405
+
+
+def test_traces_schema_filter(app):
+    status, _ = call(app, "GET",
+                     "/api/data/pts?cql=BBOX(geom,-10,40,10,50)")
+    assert status == 200
+    status, body = call(app, "GET", "/traces?schema=pts")
+    assert status == 200 and body
+    assert all(t["attributes"].get("schema") == "pts" for t in body)
+    status, body = call(app, "GET", "/traces?schema=nope")
+    assert status == 200 and body == []
+    status, _ = call(app, "GET", "/traces?schema=")
+    assert status == 400
+
+
+# -- obs satellites --------------------------------------------------------
+
+def test_trace_span_cap_drops_and_counts():
+    config.set_property("geomesa.obs.trace.max.spans", 2)
+    d0 = registry.counter("obs.trace.spans.dropped").count
+    with tracer.span("query", schema="cap") as root:
+        for _ in range(4):
+            with tracer.span("query.plan"):
+                pass
+    assert registry.counter("obs.trace.spans.dropped").count == d0 + 2
+    assert root.attributes.get("spans.dropped") == 2
+
+
+def test_scrape_cache_serves_identical_body(app):
+    config.set_property("geomesa.obs.scrape.min.interval.ms", 60_000.0)
+    c0 = registry.counter("obs.scrape.cached").count
+    status, first = call(app, "GET", "/metrics.prom")
+    assert status == 200
+    status, second = call(app, "GET", "/metrics.prom")
+    assert status == 200
+    assert second == first            # byte-identical cached body
+    assert registry.counter("obs.scrape.cached").count == c0 + 1
+    # the scrape self-timer recorded the RENDERED scrape only
+    assert registry.timer("obs.scrape.ms").count >= 1
+
+
+def test_slo_namespaces_registered():
+    assert "slo" in METRIC_NAMESPACES
+    assert "alert" in METRIC_NAMESPACES
